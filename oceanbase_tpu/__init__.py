@@ -1,0 +1,26 @@
+"""oceanbase_tpu — a TPU-native distributed SQL (HTAP) engine.
+
+A ground-up rebuild of the capabilities of OceanBase (reference: /root/reference)
+designed TPU-first:
+
+- column batches are SoA JAX device arrays (reference: expression frames,
+  src/sql/engine/expr/ob_expr.h:541 and rich vector formats,
+  src/share/vector/type_traits.h:23),
+- the vectorized operator hot loops (scan/filter/project, hash join, hash
+  group-by, sort — reference: src/sql/engine/ob_operator.cpp:1425) are
+  `jax.jit` programs,
+- the PX parallel-exchange layer (reference: src/sql/engine/px +
+  src/sql/dtl) lowers to XLA collectives over a `jax.sharding.Mesh`,
+- the SQL compiler, MVCC transactions, LSM storage and Paxos-replicated log
+  remain host-side components.
+
+64-bit integer support is required for SQL semantics (BIGINT, scaled-decimal
+arithmetic), so x64 mode is enabled at import. All kernels are explicit about
+dtypes; nothing relies on JAX's default widths.
+"""
+
+from jax import config as _jax_config
+
+_jax_config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

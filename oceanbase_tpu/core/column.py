@@ -1,0 +1,161 @@
+"""Column batches: the device-resident unit of execution.
+
+This is the TPU redesign of OceanBase's expression frames + rich vector
+formats + ObBatchRows:
+
+- reference frames hold per-expr ObDatum[batch_size] + VectorHeader
+  (sql/engine/expr/ob_expr.h:541, code_generator/ob_static_engine_expr_cg.h:70);
+  here a batch is a dict of SoA device arrays, one per column.
+- reference VectorFormat {FIXED, DISCRETE, CONTINUOUS, UNIFORM, UNIFORM_CONST}
+  (share/vector/type_traits.h:23) collapses to: FIXED = dense array,
+  DISCRETE/CONTINUOUS (varlen) = dictionary codes (core/dictionary.py),
+  UNIFORM_CONST = jnp scalar broadcast (XLA folds it).
+- reference ObBatchRows {skip_ bitmap, size_, all_rows_active_}
+  (sql/engine/ob_batch_rows.h:26) becomes `sel` (bool mask, True = row live)
+  plus `nrows` (live-row count). Capacities are static for XLA; dead tail
+  rows are simply masked out, which the VPU handles at full width anyway.
+
+ColumnBatch is a pytree so whole batches flow through jit/shard_map.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dictionary import Dictionary
+from .dtypes import DataType, Field, Schema, TypeKind
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ColumnBatch:
+    """A batch of rows as SoA device arrays, with a live-row mask.
+
+    cols:  name -> values array, shape [capacity], dtype = DataType.storage_np
+    valid: name -> bool array (True = non-null); absent for non-nullable cols
+    sel:   bool [capacity] live-row mask (ObBatchRows.skip_ inverted)
+    nrows: traced scalar count of live rows
+    schema: static metadata (field names, logical types)
+    dicts: static host-side dictionaries for VARCHAR columns
+    """
+
+    cols: dict[str, jnp.ndarray]
+    valid: dict[str, jnp.ndarray]
+    sel: jnp.ndarray
+    nrows: jnp.ndarray
+    schema: Schema = field(metadata=dict(static=True), default=Schema())
+    dicts: dict[str, Dictionary] = field(
+        metadata=dict(static=True), default_factory=dict
+    )
+
+    @property
+    def capacity(self) -> int:
+        return int(self.sel.shape[0])
+
+    def col(self, name: str) -> jnp.ndarray:
+        return self.cols[name]
+
+    def validity(self, name: str) -> jnp.ndarray:
+        """Validity mask for a column (all-True if non-nullable)."""
+        v = self.valid.get(name)
+        if v is None:
+            return jnp.ones(self.capacity, dtype=jnp.bool_)
+        return v
+
+    def with_sel(self, sel: jnp.ndarray) -> "ColumnBatch":
+        return replace(self, sel=sel, nrows=jnp.sum(sel, dtype=jnp.int64))
+
+    def project(self, names: list[str]) -> "ColumnBatch":
+        fields = tuple(Field(n, self.schema[n]) for n in names)
+        return replace(
+            self,
+            cols={n: self.cols[n] for n in names},
+            valid={n: v for n, v in self.valid.items() if n in names},
+            schema=Schema(fields),
+            dicts={n: d for n, d in self.dicts.items() if n in names},
+        )
+
+
+def make_batch(
+    data: dict[str, np.ndarray],
+    schema: Schema,
+    dicts: dict[str, Dictionary] | None = None,
+    capacity: int | None = None,
+    valid: dict[str, np.ndarray] | None = None,
+) -> ColumnBatch:
+    """Build a ColumnBatch from host arrays, padding to `capacity`.
+
+    Capacity defaults to nrows rounded up to a multiple of 1024 (keeps XLA
+    tiling happy: last-dim lanes of 128, sublane multiples).
+    """
+    names = schema.names()
+    n = len(next(iter(data.values()))) if data else 0
+    for name in names:
+        if len(data[name]) != n:
+            raise ValueError(f"column {name} length mismatch")
+    cap = capacity if capacity is not None else max(1024, -(-n // 1024) * 1024)
+    if cap < n:
+        raise ValueError(f"capacity {cap} < nrows {n}")
+
+    cols: dict[str, jnp.ndarray] = {}
+    vmap_: dict[str, jnp.ndarray] = {}
+    for f in schema.fields:
+        a = np.asarray(data[f.name], dtype=f.dtype.storage_np)
+        if cap > n:
+            a = np.concatenate([a, np.zeros(cap - n, dtype=a.dtype)])
+        cols[f.name] = jnp.asarray(a)
+        if f.dtype.nullable:
+            v = (
+                np.asarray(valid[f.name], dtype=np.bool_)
+                if valid and f.name in valid
+                else np.ones(n, dtype=np.bool_)
+            )
+            if cap > n:
+                v = np.concatenate([v, np.zeros(cap - n, dtype=np.bool_)])
+            vmap_[f.name] = jnp.asarray(v)
+    sel = np.zeros(cap, dtype=np.bool_)
+    sel[:n] = True
+    return ColumnBatch(
+        cols=cols,
+        valid=vmap_,
+        sel=jnp.asarray(sel),
+        nrows=jnp.asarray(n, dtype=jnp.int64),
+        schema=schema,
+        dicts=dict(dicts or {}),
+    )
+
+
+def batch_to_host(batch: ColumnBatch, decode_strings: bool = True) -> dict[str, np.ndarray | list]:
+    """Pull live rows back to host (compacting out dead rows).
+
+    NULL rows of nullable columns surface as None (lists) / NaN (floats) /
+    masked ints via an object-dtype fallback, so callers never see the
+    garbage payloads stored under invalid slots.
+    """
+    sel = np.asarray(batch.sel)
+    out: dict[str, np.ndarray | list] = {}
+    for f in batch.schema.fields:
+        a = np.asarray(batch.cols[f.name])[sel]
+        v = batch.valid.get(f.name)
+        vm = np.asarray(v)[sel] if v is not None else None
+        if f.dtype.kind is TypeKind.VARCHAR and decode_strings and f.name in batch.dicts:
+            codes = a.copy()
+            if vm is not None:
+                codes[~vm] = -1  # Dictionary.decode maps negatives to None
+            out[f.name] = batch.dicts[f.name].decode(codes)
+        elif f.dtype.is_decimal:
+            d = a.astype(np.float64) / f.dtype.decimal_factor
+            if vm is not None:
+                d[~vm] = np.nan
+            out[f.name] = d
+        elif vm is not None and not vm.all():
+            o = a.astype(object)
+            o[~vm] = None
+            out[f.name] = o
+        else:
+            out[f.name] = a
+    return out

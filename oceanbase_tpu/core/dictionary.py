@@ -1,0 +1,82 @@
+"""Host-side global string dictionaries.
+
+Strings never reach the TPU: every VARCHAR column is dictionary-encoded at
+load/ingest time into int32 codes, with the code->string mapping kept on the
+host. Joins and group-bys on strings become integer problems on device.
+
+Reference precedent: OceanBase's per-micro-block dictionary encodings
+(storage/blocksstable/encoding/ob_dict_decoder_simd.cpp and
+cs_encoding/ob_dict_column_decoder_simd.cpp). The TPU redesign promotes the
+dictionary from a block-local compression detail to the *global* physical
+representation of the column, because device kernels cannot chase varlen
+bytes.
+
+Two dictionary flavors:
+
+- Dictionary: insertion-ordered, codes are arbitrary. O(1) encode.
+- SortedDictionary: codes are assigned in lexicographic order so that
+  code comparison == string comparison; required when range predicates
+  (<, >, BETWEEN, ORDER BY) apply to the column. Built by finalizing an
+  unsorted dictionary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Dictionary:
+    """Insertion-ordered string <-> int32 code mapping."""
+
+    __slots__ = ("_values", "_index", "sorted")
+
+    def __init__(self, values: list[str] | None = None, sorted_: bool = False):
+        self._values: list[str] = list(values) if values else []
+        self._index: dict[str, int] = {v: i for i, v in enumerate(self._values)}
+        self.sorted = sorted_
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def encode_one(self, s: str, add: bool = True) -> int:
+        code = self._index.get(s)
+        if code is None:
+            if not add:
+                return -1
+            code = len(self._values)
+            self._values.append(s)
+            self._index[s] = code
+            self.sorted = self.sorted and (
+                len(self._values) < 2 or self._values[-2] <= s
+            )
+        return code
+
+    def encode(self, strings, add: bool = True) -> np.ndarray:
+        return np.fromiter(
+            (self.encode_one(s, add) for s in strings),
+            dtype=np.int32,
+            count=len(strings),
+        )
+
+    def decode_one(self, code: int) -> str:
+        return self._values[code]
+
+    def decode(self, codes: np.ndarray) -> list[str]:
+        vals = self._values
+        return [vals[c] if c >= 0 else None for c in codes]
+
+    def values(self) -> list[str]:
+        return list(self._values)
+
+    def finalize_sorted(self, codes: np.ndarray) -> tuple["Dictionary", np.ndarray]:
+        """Return an order-preserving dictionary and remapped codes.
+
+        After this, code order == lexicographic string order, enabling device
+        range predicates and ORDER BY directly on codes.
+        """
+        order = np.argsort(np.asarray(self._values, dtype=object), kind="stable")
+        remap = np.empty(len(self._values), dtype=np.int32)
+        remap[order] = np.arange(len(self._values), dtype=np.int32)
+        new_values = [self._values[i] for i in order]
+        d = Dictionary(new_values, sorted_=True)
+        return d, remap[codes]

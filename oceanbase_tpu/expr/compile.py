@@ -1,0 +1,587 @@
+"""Expression compiler: IR -> whole-batch JAX computation.
+
+The analog of OceanBase's expression code generator + eval function library
+(sql/code_generator/ob_static_engine_expr_cg.h:70,
+sql/engine/expr/ob_expr_eval_functions.cpp:554). Differences by design:
+
+- One eval mode: whole-batch arrays through XLA (the reference keeps scalar /
+  batch / rich-vector triples, ob_expr.h:888-898). XLA fuses the resulting
+  elementwise graphs into the surrounding operator kernels, which is the TPU
+  replacement for the reference's hand-fused SIMD eval functions.
+- Decimals are scaled integers with compile-time scales: + - rescale to the
+  max scale, * adds scales (promoting storage to int64), / leaves the decimal
+  domain and produces float (matching how the reference routes decimal
+  division through lib/number only on the CPU).
+- String predicates (=, <, LIKE, IN) on dictionary-encoded columns are
+  evaluated once against the host-side dictionary, producing either a code
+  threshold (sorted dicts) or a boolean lookup table that becomes a gather on
+  device — the global-dictionary version of the reference's dict-decoder
+  pushdown filters (storage/blocksstable/encoding/ob_dict_decoder_simd.cpp).
+- NULL semantics: separate validity masks, Kleene AND/OR, comparisons yield
+  NULL if either side is NULL; filters treat NULL as reject. (Reference:
+  ObBitVector skip/eval flags, sql/engine/ob_bit_vector.h.)
+
+evaluate() runs during jit tracing: host work (dictionary lookups, literal
+parsing) folds into compile-time constants; everything per-row becomes XLA.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.column import ColumnBatch
+from ..core.dtypes import (
+    BOOL,
+    DataType,
+    Schema,
+    TypeKind,
+    common_numeric_type,
+)
+from .ir import (
+    Between,
+    BinaryOp,
+    BoolOp,
+    Case,
+    Cast,
+    ColRef,
+    Compare,
+    Expr,
+    Func,
+    InList,
+    IsNull,
+    Literal,
+    Not,
+)
+
+MAX_DECIMAL_SCALE = 6
+
+
+# ---------------------------------------------------------------------------
+# type inference
+# ---------------------------------------------------------------------------
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=65536)
+def infer_type(e: Expr, schema: Schema) -> DataType:
+    if isinstance(e, ColRef):
+        return schema[e.name]
+    if isinstance(e, Literal):
+        return e.dtype
+    if isinstance(e, BinaryOp):
+        lt, rt = infer_type(e.left, schema), infer_type(e.right, schema)
+        if e.op == "/":
+            return DataType.float64(lt.nullable or rt.nullable)
+        if lt.is_decimal or rt.is_decimal:
+            # float operand forces float result
+            if lt.is_float or rt.is_float:
+                return DataType.float64(lt.nullable or rt.nullable)
+            ls = lt.scale if lt.is_decimal else 0
+            rs = rt.scale if rt.is_decimal else 0
+            if e.op == "*":
+                scale = min(ls + rs, MAX_DECIMAL_SCALE)
+                return DataType.decimal(18, scale, lt.nullable or rt.nullable)
+            scale = max(ls, rs)
+            prec = 18 if (lt.storage_np.itemsize > 4 or rt.storage_np.itemsize > 4 or e.op in "+-") else 9
+            return DataType.decimal(prec, scale, lt.nullable or rt.nullable)
+        return common_numeric_type(lt, rt)
+    if isinstance(e, (Compare, BoolOp, Not, IsNull, InList, Between)):
+        return BOOL
+    if isinstance(e, Cast):
+        return e.dtype
+    if isinstance(e, Case):
+        branch_types = [infer_type(v, schema) for _, v in e.whens]
+        if e.default is not None:
+            branch_types.append(infer_type(e.default, schema))
+        t = branch_types[0]
+        for bt in branch_types[1:]:
+            if bt != t:
+                t = common_numeric_type(t, bt)
+        return t
+    if isinstance(e, Func):
+        if e.name in ("extract_year", "extract_month", "extract_day"):
+            return DataType.int32()
+        if e.name in ("like", "prefix", "contains"):
+            return BOOL
+        if e.name in ("abs", "neg"):
+            return infer_type(e.args[0], schema)
+        if e.name in ("least", "greatest"):
+            t = infer_type(e.args[0], schema)
+            for a in e.args[1:]:
+                t = common_numeric_type(t, infer_type(a, schema))
+            return t
+        raise NotImplementedError(f"function {e.name}")
+    raise NotImplementedError(type(e))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _parse_date(s: str) -> int:
+    return int(np.datetime64(s, "D").astype(np.int64))
+
+
+def _like_to_regex(pattern: str) -> re.Pattern:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _merge_valid(*vs):
+    vs = [v for v in vs if v is not None]
+    if not vs:
+        return None
+    out = vs[0]
+    for v in vs[1:]:
+        out = out & v
+    return out
+
+
+def _rescale_decimal(vals, from_scale: int, to_scale: int):
+    if to_scale == from_scale:
+        return vals
+    if to_scale > from_scale:
+        return vals.astype(jnp.int64) * (10 ** (to_scale - from_scale))
+    # scale down, SQL round-half-away-from-zero (sign-aware)
+    f = 10 ** (from_scale - to_scale)
+    half = f // 2
+    return jnp.where(vals >= 0, (vals + half) // f, -((-vals + half) // f))
+
+
+def _literal_as(value, target: DataType, batch: ColumnBatch, col_name: str | None):
+    """Materialize a python literal in the physical domain of `target`."""
+    if value is None:
+        return None
+    if target.kind is TypeKind.DATE and isinstance(value, str):
+        return jnp.asarray(_parse_date(value), dtype=jnp.int32)
+    if target.kind is TypeKind.VARCHAR:
+        raise AssertionError("string literals handled by dictionary paths")
+    np_dt = target.storage_np
+    if target.is_decimal:
+        return jnp.asarray(
+            int(round(float(value) * target.decimal_factor)), dtype=np_dt
+        )
+    return jnp.asarray(value, dtype=np_dt)
+
+
+# ---------------------------------------------------------------------------
+# evaluation (runs under jit tracing)
+# ---------------------------------------------------------------------------
+
+
+def evaluate(e: Expr, batch: ColumnBatch):
+    """Evaluate an expression over a batch -> (values, valid|None)."""
+    schema = batch.schema
+
+    if isinstance(e, ColRef):
+        return batch.cols[e.name], batch.valid.get(e.name)
+
+    if isinstance(e, Literal):
+        t = e.dtype
+        if e.value is None:
+            cap = batch.capacity
+            return (
+                jnp.zeros(cap, dtype=t.storage_np),
+                jnp.zeros(cap, dtype=jnp.bool_),
+            )
+        if t.kind is TypeKind.VARCHAR:
+            raise NotImplementedError(
+                "bare string literal outside a dictionary comparison"
+            )
+        return _literal_as(e.value, t, batch, None), None
+
+    if isinstance(e, BinaryOp):
+        return _eval_arith(e, batch)
+
+    if isinstance(e, Compare):
+        return _eval_compare(e, batch)
+
+    if isinstance(e, BoolOp):
+        vals_valid = [evaluate(a, batch) for a in e.args]
+        if e.op == "and":
+            out = vals_valid[0][0]
+            for v, _ in vals_valid[1:]:
+                out = out & v
+            # Kleene: NULL unless result decidable
+            if all(vv is None for _, vv in vals_valid):
+                return out, None
+            known_false = jnp.zeros_like(out)
+            all_valid = jnp.ones_like(out)
+            for v, vv in vals_valid:
+                if vv is None:
+                    known_false = known_false | ~v
+                    continue
+                known_false = known_false | (vv & ~v)
+                all_valid = all_valid & vv
+            return out, all_valid | known_false
+        else:
+            out = vals_valid[0][0]
+            for v, _ in vals_valid[1:]:
+                out = out | v
+            if all(vv is None for _, vv in vals_valid):
+                return out, None
+            known_true = jnp.zeros_like(out)
+            all_valid = jnp.ones_like(out)
+            for v, vv in vals_valid:
+                if vv is None:
+                    known_true = known_true | v
+                    continue
+                known_true = known_true | (vv & v)
+                all_valid = all_valid & vv
+            return out, all_valid | known_true
+
+    if isinstance(e, Not):
+        v, valid = evaluate(e.arg, batch)
+        return ~v, valid
+
+    if isinstance(e, IsNull):
+        _, valid = evaluate(e.arg, batch)
+        if valid is None:
+            out = jnp.zeros(batch.capacity, dtype=jnp.bool_)
+        else:
+            out = ~valid
+        if e.negated:
+            out = ~out
+        return out, None
+
+    if isinstance(e, Cast):
+        return _eval_cast(e, batch)
+
+    if isinstance(e, Case):
+        return _eval_case(e, batch)
+
+    if isinstance(e, InList):
+        return _eval_in_list(e, batch)
+
+    if isinstance(e, Between):
+        from .ir import and_
+
+        lo = Compare(">=", e.arg, e.low)
+        hi = Compare("<=", e.arg, e.high)
+        v, valid = evaluate(and_(lo, hi), batch)
+        return (~v if e.negated else v), valid
+
+    if isinstance(e, Func):
+        return _eval_func(e, batch)
+
+    raise NotImplementedError(type(e))
+
+
+def _numeric_align(e_left: Expr, e_right: Expr, batch: ColumnBatch):
+    """Evaluate two numeric operands into a common physical domain.
+
+    Returns (lv, rv, lvalid, rvalid, result_kind, scale) where result_kind is
+    'float' or 'decimal'/'int' with the given scale (0 for pure ints).
+    """
+    schema = batch.schema
+    lt, rt = infer_type(e_left, batch.schema), infer_type(e_right, batch.schema)
+    lv, lvalid = evaluate(e_left, batch)
+    rv, rvalid = evaluate(e_right, batch)
+
+    if lt.is_float or rt.is_float:
+        tgt = jnp.result_type(lv.dtype if lt.is_float else jnp.float32,
+                              rv.dtype if rt.is_float else jnp.float32)
+        if lt.is_decimal:
+            lv = lv.astype(tgt) / lt.decimal_factor
+        else:
+            lv = lv.astype(tgt)
+        if rt.is_decimal:
+            rv = rv.astype(tgt) / rt.decimal_factor
+        else:
+            rv = rv.astype(tgt)
+        return lv, rv, lvalid, rvalid, "float", 0
+
+    ls = lt.scale if lt.is_decimal else 0
+    rs = rt.scale if rt.is_decimal else 0
+    s = max(ls, rs)
+    if s > 0:
+        # literals were already scaled by _literal_as via evaluate()? No —
+        # Literal ints evaluate at scale 0; rescale both sides to s.
+        lv = _rescale_decimal(lv, ls, s)
+        rv = _rescale_decimal(rv, rs, s)
+        return lv, rv, lvalid, rvalid, "decimal", s
+    return lv, rv, lvalid, rvalid, "int", 0
+
+
+def _eval_arith(e: BinaryOp, batch: ColumnBatch):
+    out_t = infer_type(e, batch.schema)
+    lt = infer_type(e.left, batch.schema)
+    rt = infer_type(e.right, batch.schema)
+
+    if e.op == "/" or out_t.is_float:
+        lv, rv, lvalid, rvalid, _, _ = _numeric_align_float(e.left, e.right, batch)
+        ops = {
+            "+": jnp.add,
+            "-": jnp.subtract,
+            "*": jnp.multiply,
+            "/": jnp.divide,
+            "%": jnp.mod,
+        }
+        return ops[e.op](lv, rv), _merge_valid(lvalid, rvalid)
+
+    if e.op == "*" and (lt.is_decimal or rt.is_decimal):
+        lv, lvalid = evaluate(e.left, batch)
+        rv, rvalid = evaluate(e.right, batch)
+        prod = lv.astype(jnp.int64) * rv.astype(jnp.int64)
+        ls = lt.scale if lt.is_decimal else 0
+        rs = rt.scale if rt.is_decimal else 0
+        prod = _rescale_decimal(prod, ls + rs, out_t.scale)
+        return prod.astype(out_t.storage_np), _merge_valid(lvalid, rvalid)
+
+    lv, rv, lvalid, rvalid, kind, s = _numeric_align(e.left, e.right, batch)
+    tgt = out_t.storage_np
+    lv = lv.astype(tgt)
+    rv = rv.astype(tgt)
+    if e.op == "+":
+        out = lv + rv
+    elif e.op == "-":
+        out = lv - rv
+    elif e.op == "*":
+        out = lv * rv
+    elif e.op == "%":
+        out = jnp.where(rv != 0, lv % jnp.where(rv == 0, 1, rv), 0)
+    else:
+        raise NotImplementedError(e.op)
+    return out, _merge_valid(lvalid, rvalid)
+
+
+def _numeric_align_float(e_left: Expr, e_right: Expr, batch: ColumnBatch):
+    lt, rt = infer_type(e_left, batch.schema), infer_type(e_right, batch.schema)
+    lv, lvalid = evaluate(e_left, batch)
+    rv, rvalid = evaluate(e_right, batch)
+    tgt = jnp.float64 if (lt.kind is TypeKind.FLOAT64 or rt.kind is TypeKind.FLOAT64
+                          or not (lt.is_float or rt.is_float)) else jnp.float32
+    if lt.is_decimal:
+        lv = lv.astype(tgt) / lt.decimal_factor
+    else:
+        lv = lv.astype(tgt)
+    if rt.is_decimal:
+        rv = rv.astype(tgt) / rt.decimal_factor
+    else:
+        rv = rv.astype(tgt)
+    return lv, rv, lvalid, rvalid, "float", 0
+
+
+_CMP = {
+    "=": lambda a, b: a == b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<>": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+def _eval_compare(e: Compare, batch: ColumnBatch):
+    lt = infer_type(e.left, batch.schema)
+    rt = infer_type(e.right, batch.schema)
+
+    # date vs 'YYYY-MM-DD' string literal: parse on host, compare as int days
+    if lt.kind is TypeKind.DATE and isinstance(e.right, Literal) and isinstance(e.right.value, str):
+        lv, lvalid = evaluate(e.left, batch)
+        rv = _literal_as(e.right.value, lt, batch, None)
+        return _CMP[e.op](lv, rv), lvalid
+    if rt.kind is TypeKind.DATE and isinstance(e.left, Literal) and isinstance(e.left.value, str):
+        rv, rvalid = evaluate(e.right, batch)
+        lv = _literal_as(e.left.value, rt, batch, None)
+        return _CMP[e.op](lv, rv), rvalid
+
+    # --- dictionary string comparisons -------------------------------
+    if lt.kind is TypeKind.VARCHAR or rt.kind is TypeKind.VARCHAR:
+        if isinstance(e.right, Literal) and isinstance(e.left, ColRef):
+            return _dict_compare(e.left, e.op, e.right.value, batch)
+        if isinstance(e.left, Literal) and isinstance(e.right, ColRef):
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            op = flip.get(e.op, e.op)
+            return _dict_compare(e.right, op, e.left.value, batch)
+        if lt.kind is TypeKind.VARCHAR and rt.kind is TypeKind.VARCHAR:
+            # col-vs-col code comparison is only sound when both columns
+            # share one dictionary object (e.g. post-join copies); distinct
+            # dictionaries assign incomparable codes.
+            if (
+                isinstance(e.left, ColRef)
+                and isinstance(e.right, ColRef)
+                and batch.dicts.get(e.left.name) is not batch.dicts.get(e.right.name)
+            ):
+                raise NotImplementedError(
+                    f"varchar comparison {e.left.name} vs {e.right.name}: "
+                    "columns use different dictionaries; requires dictionary "
+                    "translation (not yet implemented)"
+                )
+            lv, lvalid = evaluate(e.left, batch)
+            rv, rvalid = evaluate(e.right, batch)
+            return _CMP[e.op](lv, rv), _merge_valid(lvalid, rvalid)
+        raise NotImplementedError("varchar comparison form")
+
+    lv, rv, lvalid, rvalid, _, _ = _numeric_align(e.left, e.right, batch)
+    return _CMP[e.op](lv, rv), _merge_valid(lvalid, rvalid)
+
+
+def _dict_compare(col_expr: ColRef, op: str, value: str, batch: ColumnBatch):
+    d = batch.dicts.get(col_expr.name)
+    if d is None:
+        raise KeyError(f"no dictionary for varchar column {col_expr.name}")
+    codes, valid = evaluate(col_expr, batch)
+    if d.sorted and op in ("<", "<=", ">", ">="):
+        import bisect
+
+        vals = d.values()
+        if op in ("<", ">="):
+            thr = bisect.bisect_left(vals, value)
+            out = codes < thr if op == "<" else codes >= thr
+        else:
+            thr = bisect.bisect_right(vals, value)
+            out = codes < thr if op == "<=" else codes >= thr
+        return out, valid
+    if op in ("=", "=="):
+        code = d.encode_one(value, add=False)
+        return codes == jnp.asarray(code, dtype=jnp.int32), valid
+    if op in ("!=", "<>"):
+        code = d.encode_one(value, add=False)
+        return codes != jnp.asarray(code, dtype=jnp.int32), valid
+    # general fallback: boolean LUT over dictionary values
+    lut = np.fromiter(
+        (_CMP[op](v, value) for v in d.values()), dtype=np.bool_, count=len(d)
+    )
+    return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
+
+
+def _eval_cast(e: Cast, batch: ColumnBatch):
+    src_t = infer_type(e.arg, batch.schema)
+    dst = e.dtype
+    v, valid = evaluate(e.arg, batch)
+    if src_t.is_decimal and dst.is_decimal:
+        return _rescale_decimal(v, src_t.scale, dst.scale).astype(dst.storage_np), valid
+    if src_t.is_decimal and dst.is_float:
+        return (v.astype(dst.storage_np) / src_t.decimal_factor), valid
+    if src_t.is_decimal and dst.is_integer:
+        return _rescale_decimal(v, src_t.scale, 0).astype(dst.storage_np), valid
+    if dst.is_decimal:
+        if src_t.is_float:
+            return jnp.round(v * dst.decimal_factor).astype(dst.storage_np), valid
+        return (v.astype(dst.storage_np) * dst.decimal_factor), valid
+    return v.astype(dst.storage_np), valid
+
+
+def _eval_case(e: Case, batch: ColumnBatch):
+    out_t = infer_type(e, batch.schema)
+    np_dt = out_t.storage_np
+    if e.default is not None:
+        out, out_valid = evaluate(Cast(e.default, out_t), batch)
+    else:
+        out = jnp.zeros(batch.capacity, dtype=np_dt)
+        out_valid = jnp.zeros(batch.capacity, dtype=jnp.bool_)
+    for cond, val in reversed(e.whens):
+        c, cvalid = evaluate(cond, batch)
+        take = c if cvalid is None else (c & cvalid)
+        v, vvalid = evaluate(Cast(val, out_t), batch)
+        out = jnp.where(take, v, out)
+        if out_valid is not None or vvalid is not None:
+            ov = out_valid if out_valid is not None else jnp.ones(batch.capacity, jnp.bool_)
+            vv = vvalid if vvalid is not None else jnp.ones(batch.capacity, jnp.bool_)
+            out_valid = jnp.where(take, vv, ov)
+    return out, out_valid
+
+
+def _eval_in_list(e: InList, batch: ColumnBatch):
+    t = infer_type(e.arg, batch.schema)
+    if t.kind is TypeKind.VARCHAR and isinstance(e.arg, ColRef):
+        d = batch.dicts[e.arg.name]
+        members = set(e.values)
+        lut = np.fromiter(
+            (v in members for v in d.values()), dtype=np.bool_, count=len(d)
+        )
+        codes, valid = evaluate(e.arg, batch)
+        out = jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))]
+        return (~out if e.negated else out), valid
+    v, valid = evaluate(e.arg, batch)
+    out = jnp.zeros(batch.capacity, dtype=jnp.bool_)
+    for item in e.values:
+        out = out | (v == _literal_as(item, t, batch, None))
+    return (~out if e.negated else out), valid
+
+
+# --- date decomposition (Howard Hinnant's civil-from-days, branch-free) ----
+
+
+def _civil_from_days(days):
+    z = days.astype(jnp.int32) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365
+    )
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _eval_func(e: Func, batch: ColumnBatch):
+    if e.name in ("extract_year", "extract_month", "extract_day"):
+        v, valid = evaluate(e.args[0], batch)
+        y, m, d = _civil_from_days(v)
+        return {"extract_year": y, "extract_month": m, "extract_day": d}[e.name], valid
+
+    if e.name == "like":
+        col_expr, pat = e.args
+        assert isinstance(col_expr, ColRef) and isinstance(pat, Literal)
+        d = batch.dicts[col_expr.name]
+        rx = _like_to_regex(str(pat.value))
+        lut = np.fromiter(
+            (rx.match(v) is not None for v in d.values()),
+            dtype=np.bool_,
+            count=len(d),
+        )
+        codes, valid = evaluate(col_expr, batch)
+        return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
+
+    if e.name in ("prefix", "contains"):
+        col_expr, pat = e.args
+        assert isinstance(col_expr, ColRef) and isinstance(pat, Literal)
+        d = batch.dicts[col_expr.name]
+        p = str(pat.value)
+        test = (lambda v: v.startswith(p)) if e.name == "prefix" else (lambda v: p in v)
+        lut = np.fromiter((test(v) for v in d.values()), dtype=np.bool_, count=len(d))
+        codes, valid = evaluate(col_expr, batch)
+        return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
+
+    if e.name == "abs":
+        v, valid = evaluate(e.args[0], batch)
+        return jnp.abs(v), valid
+    if e.name == "neg":
+        v, valid = evaluate(e.args[0], batch)
+        return -v, valid
+    if e.name in ("least", "greatest"):
+        op = jnp.minimum if e.name == "least" else jnp.maximum
+        v, valid = evaluate(e.args[0], batch)
+        for a in e.args[1:]:
+            v2, valid2 = evaluate(a, batch)
+            v = op(v, v2)
+            valid = _merge_valid(valid, valid2)
+        return v, valid
+    raise NotImplementedError(f"function {e.name}")
+
+
+def compile_predicate(e: Expr, batch: ColumnBatch) -> jnp.ndarray:
+    """Predicate -> bool mask over the batch; NULL results reject the row."""
+    v, valid = evaluate(e, batch)
+    mask = v if valid is None else (v & valid)
+    return mask & batch.sel

@@ -1,0 +1,24 @@
+"""Test environment: force CPU with 8 virtual devices.
+
+Mirrors the reference's test pyramid decision (SURVEY.md §4): multi-"node"
+behavior is exercised on one host. Here a virtual 8-device CPU platform
+stands in for a TPU slice so sharding/collective paths compile and run in CI
+without TPU hardware. Must run before any jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,80 @@
+"""Core type system, dictionary, and column batch tests."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core import (
+    DataType,
+    Dictionary,
+    Schema,
+    Table,
+    TypeKind,
+    batch_to_host,
+    common_numeric_type,
+)
+
+
+def test_decimal_storage_widths():
+    assert DataType.decimal(9, 2).storage_np == np.dtype(np.int32)
+    assert DataType.decimal(15, 2).storage_np == np.dtype(np.int64)
+    assert DataType.decimal(15, 2).decimal_factor == 100
+
+
+def test_common_numeric_type():
+    t = common_numeric_type(DataType.int32(), DataType.int64())
+    assert t.kind is TypeKind.INT64
+    t = common_numeric_type(DataType.decimal(9, 2), DataType.int32())
+    assert t.is_decimal and t.scale == 2
+    t = common_numeric_type(DataType.decimal(9, 2), DataType.float32())
+    assert t.is_float
+
+
+def test_dictionary_roundtrip():
+    d = Dictionary()
+    codes = d.encode(["beta", "alpha", "beta", "gamma"])
+    assert codes.tolist() == [0, 1, 0, 2]
+    assert d.decode(codes) == ["beta", "alpha", "beta", "gamma"]
+    d2, codes2 = d.finalize_sorted(codes)
+    assert d2.values() == ["alpha", "beta", "gamma"]
+    assert d2.decode(codes2) == ["beta", "alpha", "beta", "gamma"]
+    assert d2.sorted
+
+
+def test_table_to_batch_roundtrip():
+    schema = Schema.of(
+        k=DataType.int64(),
+        price=DataType.decimal(12, 2),
+        flag=DataType.varchar(),
+        d=DataType.date(),
+    )
+    t = Table.from_pydict(
+        "t",
+        schema,
+        {
+            "k": [1, 2, 3],
+            "price": [1.50, 2.25, 99.99],
+            "flag": ["A", "B", "A"],
+            "d": [0, 10957, 20000],
+        },
+    )
+    assert t.nrows == 3
+    b = t.to_batch()
+    assert b.capacity % 1024 == 0
+    assert int(b.nrows) == 3
+    host = batch_to_host(b)
+    assert list(host["k"]) == [1, 2, 3]
+    assert host["price"] == pytest.approx([1.50, 2.25, 99.99])
+    assert host["flag"] == ["A", "B", "A"]
+
+
+def test_batch_project_and_sel():
+    schema = Schema.of(a=DataType.int32(), b=DataType.int32())
+    t = Table.from_pydict("t", schema, {"a": [1, 2, 3, 4], "b": [5, 6, 7, 8]})
+    b = t.to_batch()
+    p = b.project(["b"])
+    assert list(p.cols.keys()) == ["b"]
+    sel = np.zeros(b.capacity, dtype=bool)
+    sel[1] = True
+    b2 = b.with_sel(sel)
+    host = batch_to_host(b2)
+    assert list(host["a"]) == [2]

@@ -1,0 +1,148 @@
+"""Expression engine tests: arithmetic, decimals, strings, dates, nulls."""
+
+import jax
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core import DataType, Schema, Table
+from oceanbase_tpu.expr import (
+    Between,
+    BinaryOp,
+    Case,
+    Compare,
+    Func,
+    InList,
+    IsNull,
+    Literal,
+    and_,
+    col,
+    compile_predicate,
+    evaluate,
+    infer_type,
+    lit,
+)
+
+
+@pytest.fixture
+def batch():
+    schema = Schema.of(
+        qty=DataType.decimal(9, 2),
+        price=DataType.decimal(12, 2),
+        disc=DataType.decimal(9, 2),
+        tag=DataType.varchar(),
+        d=DataType.date(),
+        n=DataType.int32(),
+    )
+    t = Table.from_pydict(
+        "t",
+        schema,
+        {
+            "qty": [1.00, 2.00, 3.00, 4.00],
+            "price": [10.00, 20.00, 30.00, 40.00],
+            "disc": [0.05, 0.06, 0.07, 0.10],
+            "tag": ["AIR", "RAIL", "AIR", "SHIP"],
+            "d": ["1994-01-01", "1994-06-01", "1995-01-01", "1993-12-31"],
+        }
+        | {"d": [np.datetime64(s, "D").astype(np.int64) for s in
+                 ["1994-01-01", "1994-06-01", "1995-01-01", "1993-12-31"]],
+           "n": [1, 2, 3, 4]},
+    )
+    return t.to_batch()
+
+
+def _live(vals, batch):
+    return np.asarray(vals)[np.asarray(batch.sel)]
+
+
+def test_decimal_mul(batch):
+    # price * (1 - disc): scale 2 * scale 2 -> scale 4
+    e = BinaryOp("*", col("price"), BinaryOp("-", lit(1), col("disc")))
+    t = infer_type(e, batch.schema)
+    assert t.is_decimal and t.scale == 4
+    vals, valid = evaluate(e, batch)
+    assert valid is None
+    got = _live(vals, batch) / 1e4
+    np.testing.assert_allclose(got, [9.5, 18.8, 27.9, 36.0])
+
+
+def test_decimal_compare_with_float_literal(batch):
+    e = Compare("<=", col("disc"), lit(0.06))
+    mask = compile_predicate(e, batch)
+    assert _live(mask, batch).tolist() == [True, True, False, False]
+
+
+def test_date_range_and_between(batch):
+    e = and_(
+        Compare(">=", col("d"), lit("1994-01-01")),
+        Compare("<", col("d"), lit("1995-01-01")),
+    )
+    mask = compile_predicate(e, batch)
+    assert _live(mask, batch).tolist() == [True, True, False, False]
+    e2 = Between(col("n"), lit(2), lit(3))
+    mask2 = compile_predicate(e2, batch)
+    assert _live(mask2, batch).tolist() == [False, True, True, False]
+
+
+def test_dict_string_predicates(batch):
+    eq = Compare("=", col("tag"), lit("AIR"))
+    assert _live(compile_predicate(eq, batch), batch).tolist() == [True, False, True, False]
+    inl = InList(col("tag"), ("AIR", "SHIP"))
+    assert _live(compile_predicate(inl, batch), batch).tolist() == [True, False, True, True]
+    like = Func("like", (col("tag"), lit("%AI%")))
+    vals, _ = evaluate(like, batch)
+    assert _live(vals, batch).tolist() == [True, True, True, False]
+    # sorted dict: range compare on codes
+    rng = Compare("<", col("tag"), lit("RAIL"))
+    assert _live(compile_predicate(rng, batch), batch).tolist() == [True, False, True, False]
+
+
+def test_extract_year(batch):
+    vals, _ = evaluate(Func("extract_year", (col("d"),)), batch)
+    assert _live(vals, batch).tolist() == [1994, 1994, 1995, 1993]
+    vals, _ = evaluate(Func("extract_month", (col("d"),)), batch)
+    assert _live(vals, batch).tolist() == [1, 6, 1, 12]
+
+
+def test_case_when(batch):
+    e = Case(
+        whens=((Compare("=", col("tag"), lit("AIR")), BinaryOp("*", col("price"), col("disc"))),),
+        default=lit(0),
+    )
+    t = infer_type(e, batch.schema)
+    assert t.is_decimal and t.scale == 4
+    vals, _ = evaluate(e, batch)
+    got = _live(vals, batch) / 1e4
+    np.testing.assert_allclose(got, [0.5, 0.0, 2.1, 0.0])
+
+
+def test_division_produces_float(batch):
+    e = BinaryOp("/", col("price"), col("qty"))
+    assert infer_type(e, batch.schema).is_float
+    vals, _ = evaluate(e, batch)
+    np.testing.assert_allclose(_live(vals, batch), [10.0, 10.0, 10.0, 10.0])
+
+
+def test_nulls_reject_in_predicate():
+    from oceanbase_tpu.core.dtypes import Field
+
+    schema = Schema(fields=(Field("x", DataType.int32(nullable=True)),))
+    t = Table("t", schema, {"x": np.array([1, 2, 3], np.int32)})
+    t.valid["x"] = np.array([True, False, True])
+    b = t.to_batch()
+    mask = compile_predicate(Compare(">", col("x"), lit(0)), b)
+    live = np.asarray(mask)[np.asarray(b.sel)]
+    assert live.tolist() == [True, False, True]
+    vals, _ = evaluate(IsNull(col("x")), b)
+    assert np.asarray(vals)[np.asarray(b.sel)].tolist() == [False, True, False]
+
+
+def test_expr_under_jit(batch):
+    e = BinaryOp("*", col("price"), BinaryOp("-", lit(1), col("disc")))
+
+    @jax.jit
+    def run(b):
+        vals, _ = evaluate(e, b)
+        return vals
+
+    got = _live(run(batch), batch) / 1e4
+    np.testing.assert_allclose(got, [9.5, 18.8, 27.9, 36.0])
