@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Headline benchmark: TPC-H Q6 rows/sec/chip, TPU engine vs CPU baseline.
+
+Per BASELINE.json: the metric is TPC-H rows/sec/chip on Q1/Q6 with the CPU
+vectorized engine as baseline (measured here with the same generated data —
+`published` is empty so the baseline is measured, not cited). Prints exactly
+ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "detail": {...}}
+
+Env knobs: BENCH_SF (default 1.0), BENCH_REPS (default 5).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _best(f, reps):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+
+    import jax
+
+    from oceanbase_tpu.models.tpch import datagen, queries
+
+    rng = np.random.default_rng(19920101)
+    _, li = datagen.gen_orders_lineitem(
+        sf, rng, max(1, int(150000 * sf)), max(1, int(200000 * sf)),
+        max(1, int(10000 * sf)),
+    )
+    n = li.nrows
+
+    # ---- CPU vectorized baseline (numpy) --------------------------------
+    q6_cpu = _best(lambda: queries.q6_numpy(li), max(2, reps // 2))
+    q1_cpu = _best(lambda: queries.q1_numpy_fast(li), max(2, reps // 2))
+
+    # ---- TPU engine ------------------------------------------------------
+    batch = li.to_batch()
+    jax.block_until_ready(batch.cols)
+
+    q6_fn, q6_finish = queries.build_q6()
+    rf_d, ls_d = li.dicts["l_returnflag"], li.dicts["l_linestatus"]
+    q1_fn, q1_finish = queries.build_q1(len(rf_d), len(ls_d))
+
+    # warmup / compile
+    q6_dev = q6_fn(batch)
+    jax.block_until_ready(q6_dev)
+    q1_dev = q1_fn(batch)
+    jax.block_until_ready(q1_dev)
+
+    q6_t = _best(lambda: jax.block_until_ready(q6_fn(batch)), reps)
+    q1_t = _best(lambda: jax.block_until_ready(q1_fn(batch)), reps)
+
+    # correctness cross-check
+    got = q6_finish(q6_fn(batch))
+    want = queries.q6_numpy(li)
+    ok = abs(got - want) <= 1e-6 * max(1.0, abs(want))
+
+    q6_rows_s = n / q6_t
+    vs = q6_rows_s / (n / q6_cpu)
+    out = {
+        "metric": f"tpch_q6_sf{sf:g}_rows_per_sec_chip",
+        "value": round(q6_rows_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "rows": int(n),
+            "q6_tpu_s": round(q6_t, 6),
+            "q6_cpu_s": round(q6_cpu, 6),
+            "q1_tpu_s": round(q1_t, 6),
+            "q1_cpu_s": round(q1_cpu, 6),
+            "q1_speedup": round(q1_cpu / q1_t, 3),
+            "q6_correct": bool(ok),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

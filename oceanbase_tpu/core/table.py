@@ -44,9 +44,13 @@ class Table:
         for f in schema.fields:
             col = pydata[f.name]
             if f.dtype.kind is TypeKind.VARCHAR:
-                d = Dictionary()
-                codes = d.encode([str(s) for s in col])
-                d, codes = d.finalize_sorted(codes)
+                arr = np.asarray(col)
+                if arr.dtype.kind not in ("U", "S"):
+                    # coerce everything (objects, numerics) to strings so
+                    # np.unique sorts lexicographically and the sorted-dict
+                    # invariant (code order == string order) holds
+                    arr = arr.astype(str)
+                d, codes = Dictionary.from_strings_bulk(arr)
                 data[f.name] = codes
                 dicts[f.name] = d
             elif f.dtype.is_decimal:

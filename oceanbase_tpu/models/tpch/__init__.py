@@ -1,0 +1,3 @@
+from . import datagen, queries, schema
+
+__all__ = ["datagen", "queries", "schema"]

@@ -1,0 +1,273 @@
+"""Spec-shaped TPC-H data generator (vectorized numpy, no dbgen).
+
+Generates the 8 TPC-H tables with the distributions, domains and PK-FK
+relationships the 22 queries rely on (dates within [1992-01-01, 1998-08-02],
+shipdate = orderdate + U[1,121], returnflag correlated with receiptdate,
+1-7 lineitems per order, etc.). Values are drawn with numpy vectorized RNG —
+generation of SF1 (6M lineitem rows) takes seconds, and the same generator
+with the same seed feeds both the CPU baseline and the TPU engine so
+benchmark comparisons are apples-to-apples.
+
+Comments are built from a small template vocabulary that still contains the
+keyword patterns queries grep for (Q13 '%special%requests%',
+Q16 '%Customer%Complaints%').
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.dictionary import Dictionary
+from ...core.table import Table
+from . import schema as S
+
+EPOCH = np.datetime64("1970-01-01", "D")
+START = int(np.datetime64("1992-01-01", "D").astype(int))
+END = int(np.datetime64("1998-12-01", "D").astype(int))
+CURRENT = int(np.datetime64("1995-06-17", "D").astype(int))
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINERS_1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINERS_2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+    "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+    "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+    "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+    "hot", "indian", "ivory", "khaki", "lace", "lavender", "lawn", "lemon",
+    "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+    "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive", "orange",
+    "orchid", "pale", "papaya", "peach", "peru", "pink", "plum", "powder",
+    "puff", "purple", "red", "rose", "rosy", "royal", "saddle", "salmon",
+    "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow", "spring",
+    "steel", "tan", "thistle", "tomato", "turquoise", "violet", "wheat",
+    "white", "yellow",
+]
+COMMENT_WORDS = [
+    "carefully", "quickly", "furiously", "slyly", "blithely", "ironic",
+    "final", "pending", "regular", "express", "bold", "even", "silent",
+    "unusual", "daring", "accounts", "packages", "deposits", "requests",
+    "instructions", "foxes", "pinto", "beans", "theodolites", "platelets",
+]
+
+
+def _comments(rng: np.random.Generator, n: int, special: str | None = None,
+              special_rate: float = 0.01) -> np.ndarray:
+    """Short comments from a bounded vocabulary; optionally inject a keyword
+    phrase (e.g. 'special requests') at special_rate."""
+    w = rng.integers(0, len(COMMENT_WORDS), (n, 3))
+    out = np.array(
+        [" ".join(COMMENT_WORDS[j] for j in row) for row in w], dtype=object
+    )
+    if special:
+        hit = rng.random(n) < special_rate
+        out[hit] = np.char.add(
+            np.char.add(out[hit].astype(str), " "), special
+        ).astype(object)
+    return out
+
+
+def _money(rng, n, lo, hi):
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def gen_region() -> Table:
+    return Table.from_pydict("region", S.REGION, {
+        "r_regionkey": np.arange(5), "r_name": REGIONS,
+    })
+
+
+def gen_nation() -> Table:
+    return Table.from_pydict("nation", S.NATION, {
+        "n_nationkey": np.arange(25),
+        "n_name": [n for n, _ in NATIONS],
+        "n_regionkey": [r for _, r in NATIONS],
+    })
+
+
+def gen_supplier(sf: float, rng) -> Table:
+    n = max(1, int(S.BASE_ROWS["supplier"] * sf))
+    keys = np.arange(1, n + 1)
+    return Table.from_pydict("supplier", S.SUPPLIER, {
+        "s_suppkey": keys,
+        "s_name": [f"Supplier#{k:09d}" for k in keys],
+        "s_address": _comments(rng, n),
+        "s_nationkey": rng.integers(0, 25, n),
+        "s_phone": [f"{10+k%25}-{k%1000:03d}-{(k*7)%1000:03d}-{(k*13)%10000:04d}" for k in keys],
+        "s_acctbal": _money(rng, n, -999.99, 9999.99),
+        "s_comment": _comments(rng, n, "Customer Complaints", 0.0005),
+    })
+
+
+def gen_customer(sf: float, rng) -> Table:
+    n = max(1, int(S.BASE_ROWS["customer"] * sf))
+    keys = np.arange(1, n + 1)
+    return Table.from_pydict("customer", S.CUSTOMER, {
+        "c_custkey": keys,
+        "c_name": [f"Customer#{k:09d}" for k in keys],
+        "c_address": _comments(rng, n),
+        "c_nationkey": rng.integers(0, 25, n),
+        "c_phone": [f"{10+k%25}-{k%1000:03d}-{(k*7)%1000:03d}-{(k*13)%10000:04d}" for k in keys],
+        "c_acctbal": _money(rng, n, -999.99, 9999.99),
+        "c_mktsegment": rng.choice(SEGMENTS, n),
+        "c_comment": _comments(rng, n, "special requests", 0.01),
+    })
+
+
+def gen_part(sf: float, rng) -> Table:
+    n = max(1, int(S.BASE_ROWS["part"] * sf))
+    keys = np.arange(1, n + 1)
+    w = rng.integers(0, len(P_NAME_WORDS), (n, 5))
+    names = [" ".join(P_NAME_WORDS[j] for j in row) for row in w]
+    mfgr = rng.integers(1, 6, n)
+    brand = mfgr * 10 + rng.integers(1, 6, n)
+    types = [
+        f"{TYPE_S1[a]} {TYPE_S2[b]} {TYPE_S3[c]}"
+        for a, b, c in zip(
+            rng.integers(0, 6, n), rng.integers(0, 5, n), rng.integers(0, 5, n)
+        )
+    ]
+    containers = [
+        f"{CONTAINERS_1[a]} {CONTAINERS_2[b]}"
+        for a, b in zip(rng.integers(0, 5, n), rng.integers(0, 8, n))
+    ]
+    return Table.from_pydict("part", S.PART, {
+        "p_partkey": keys,
+        "p_name": names,
+        "p_mfgr": [f"Manufacturer#{m}" for m in mfgr],
+        "p_brand": [f"Brand#{b}" for b in brand],
+        "p_type": types,
+        "p_size": rng.integers(1, 51, n),
+        "p_container": containers,
+        "p_retailprice": np.round(
+            900 + (keys % 1000) / 10 + 100 * (keys % 10), 2
+        ),
+    })
+
+
+def gen_partsupp(sf: float, rng, n_part: int, n_supp: int) -> Table:
+    # 4 suppliers per part, spec-style spread
+    pk = np.repeat(np.arange(1, n_part + 1), 4)
+    n = len(pk)
+    j = np.tile(np.arange(4), n_part)
+    sk = ((pk + (j * (n_supp // 4 + (pk - 1) // n_supp))) % n_supp) + 1
+    return Table.from_pydict("partsupp", S.PARTSUPP, {
+        "ps_partkey": pk,
+        "ps_suppkey": sk,
+        "ps_availqty": rng.integers(1, 10000, n),
+        "ps_supplycost": _money(rng, n, 1.00, 1000.00),
+    })
+
+
+def gen_orders_lineitem(sf: float, rng, n_cust: int, n_part: int, n_supp: int):
+    n_ord = max(1, int(S.BASE_ROWS["orders"] * sf))
+    okey = np.arange(1, n_ord + 1, dtype=np.int64) * 4  # sparse like spec
+    # only 2/3 of customers have orders (spec): custkey % 3 != 0
+    ck = rng.integers(1, max(n_cust, 2), n_ord).astype(np.int64)
+    ck = np.where(ck % 3 == 0, np.maximum((ck + 1) % (n_cust + 1), 1), ck)
+    odate = rng.integers(START, END - 151, n_ord)
+    n_li_per = rng.integers(1, 8, n_ord)
+    nl = int(n_li_per.sum())
+
+    # lineitem parent mapping
+    li_order = np.repeat(np.arange(n_ord), n_li_per)
+    l_orderkey = okey[li_order]
+    l_linenumber = (
+        np.arange(nl) - np.repeat(np.cumsum(n_li_per) - n_li_per, n_li_per) + 1
+    )
+    l_partkey = rng.integers(1, n_part + 1, nl)
+    l_suppkey = rng.integers(1, n_supp + 1, nl)
+    qty = rng.integers(1, 51, nl).astype(np.float64)
+    retail = 900 + (l_partkey % 1000) / 10 + 100 * (l_partkey % 10)
+    extprice = np.round(qty * retail, 2)
+    disc = rng.integers(0, 11, nl) / 100
+    tax = rng.integers(0, 9, nl) / 100
+    o_date_li = odate[li_order]
+    shipdate = o_date_li + rng.integers(1, 122, nl)
+    commitdate = o_date_li + rng.integers(30, 91, nl)
+    receiptdate = shipdate + rng.integers(1, 31, nl)
+    returned = receiptdate <= CURRENT
+    rf = np.where(returned, np.where(rng.random(nl) < 0.5, "R", "A"), "N")
+    ls = np.where(shipdate > CURRENT, "O", "F")
+
+    lineitem = Table.from_pydict("lineitem", S.LINEITEM, {
+        "l_orderkey": l_orderkey,
+        "l_partkey": l_partkey,
+        "l_suppkey": l_suppkey,
+        "l_linenumber": l_linenumber,
+        "l_quantity": qty,
+        "l_extendedprice": extprice,
+        "l_discount": disc,
+        "l_tax": tax,
+        "l_returnflag": rf,
+        "l_linestatus": ls,
+        "l_shipdate": shipdate,
+        "l_commitdate": commitdate,
+        "l_receiptdate": receiptdate,
+        "l_shipinstruct": rng.choice(INSTRUCTS, nl),
+        "l_shipmode": rng.choice(SHIPMODES, nl),
+    })
+
+    # order status/totalprice derived from lineitems
+    charge = extprice * (1 - disc) * (1 + tax)
+    totalprice = np.zeros(n_ord)
+    np.add.at(totalprice, li_order, charge)
+    all_f = np.ones(n_ord, bool)
+    any_f = np.zeros(n_ord, bool)
+    np.logical_and.at(all_f, li_order, ls == "F")
+    np.logical_or.at(any_f, li_order, ls == "F")
+    status = np.where(all_f, "F", np.where(any_f, "P", "O"))
+
+    orders = Table.from_pydict("orders", S.ORDERS, {
+        "o_orderkey": okey,
+        "o_custkey": ck,
+        "o_orderstatus": status,
+        "o_totalprice": np.round(totalprice, 2),
+        "o_orderdate": odate,
+        "o_orderpriority": rng.choice(PRIORITIES, n_ord),
+        "o_clerk": [f"Clerk#{k:09d}" for k in rng.integers(1, max(2, int(1000 * sf)), n_ord)],
+        "o_shippriority": np.zeros(n_ord, dtype=np.int32),
+        "o_comment": _comments(rng, n_ord, "special requests", 0.01),
+    })
+    return orders, lineitem
+
+
+def generate(sf: float = 0.01, seed: int = 19920101) -> dict[str, Table]:
+    """Generate all 8 tables at the given scale factor."""
+    rng = np.random.default_rng(seed)
+    region = gen_region()
+    nation = gen_nation()
+    supplier = gen_supplier(sf, rng)
+    customer = gen_customer(sf, rng)
+    part = gen_part(sf, rng)
+    partsupp = gen_partsupp(sf, rng, part.nrows, supplier.nrows)
+    orders, lineitem = gen_orders_lineitem(
+        sf, rng, customer.nrows, part.nrows, supplier.nrows
+    )
+    return {
+        "region": region,
+        "nation": nation,
+        "supplier": supplier,
+        "customer": customer,
+        "part": part,
+        "partsupp": partsupp,
+        "orders": orders,
+        "lineitem": lineitem,
+    }

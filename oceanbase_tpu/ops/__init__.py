@@ -1,0 +1,36 @@
+from .hashing import hash_combine, mix64, next_pow2, pack_keys
+from .hashagg import (
+    assign_group_slots,
+    groupby_direct,
+    groupby_hash,
+    scalar_aggregate,
+)
+from .join import (
+    build_hash_table,
+    expand_join,
+    gather_payload,
+    hash_join_probe,
+    join_keys64,
+    sort_build_side,
+)
+from .sort import apply_order, sort_indices, topn_indices
+
+__all__ = [
+    "hash_combine",
+    "mix64",
+    "next_pow2",
+    "pack_keys",
+    "assign_group_slots",
+    "groupby_direct",
+    "groupby_hash",
+    "scalar_aggregate",
+    "build_hash_table",
+    "expand_join",
+    "gather_payload",
+    "hash_join_probe",
+    "join_keys64",
+    "sort_build_side",
+    "apply_order",
+    "sort_indices",
+    "topn_indices",
+]

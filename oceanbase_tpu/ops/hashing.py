@@ -1,0 +1,79 @@
+"""Key hashing / packing primitives for device hash tables.
+
+Reference surface: OceanBase's murmur-based datum hashing feeding hash join /
+group-by / exchange slice calc (sql/engine/px/ob_slice_calc.h:55, the hash
+infrastructure in sql/engine/basic/ob_hp_infras_vec_op.h). The TPU redesign
+splits the problem:
+
+- When key domains are statically small (dictionary-encoded columns, bounded
+  ints), multiple keys BIT-PACK into one int32/int64 "direct key" whose value
+  is its own perfect-hash slot — group-by becomes a scatter-add, no table.
+- Otherwise keys hash-combine via a 64-bit finalizer (splitmix64) and feed
+  open-addressing tables (see hashagg.py / join.py).
+
+Everything is branch-free elementwise math the VPU eats whole.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# splitmix64 finalizer constants
+_C1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_C2 = jnp.uint64(0x94D049BB133111EB)
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer: avalanches a 64-bit value. uint64 in/out."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * _C1
+    x = (x ^ (x >> 27)) * _C2
+    return x ^ (x >> 31)
+
+
+def hash_combine(columns: list[jnp.ndarray]) -> jnp.ndarray:
+    """Combine N key columns into one avalanche-mixed uint64 hash."""
+    h = jnp.zeros_like(columns[0], shape=columns[0].shape, dtype=jnp.uint64)
+    for c in columns:
+        h = mix64(h ^ (c.astype(jnp.uint64) + _GOLDEN))
+    return h
+
+
+def pack_keys(columns: list[jnp.ndarray], domains: list[int]) -> tuple[jnp.ndarray, int]:
+    """Bit-pack bounded-domain key columns into a single dense int key.
+
+    columns[i] must take values in [0, domains[i]). Returns (packed, space)
+    where packed in [0, space) and space = prod(domains) rounded within the
+    packing's bit layout. Packed keys are their own perfect hash — the
+    direct-addressing fast path of group-by (the analog of the reference's
+    adaptive bypass for low-NDV group-bys, ob_adaptive_bypass_ctrl.h).
+    """
+    bits = [max(1, int(d - 1).bit_length()) for d in domains]
+    total = sum(bits)
+    dtype = jnp.int32 if total <= 31 else jnp.int64
+    packed = jnp.zeros_like(columns[0], dtype=dtype)
+    shift = 0
+    for c, b in zip(columns, bits):
+        packed = packed | (c.astype(dtype) << shift)
+        shift += b
+    return packed, 1 << total
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(1, (int(n) - 1).bit_length())
+
+
+def inherit_vma(arr: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
+    """Make a freshly-created array inherit `ref`'s varying-axis metadata.
+
+    Under shard_map, `lax.while_loop` requires carry inits to carry the same
+    varying-manual-axes annotation as the values the body produces; arrays
+    minted with jnp.full/zeros inside an op are 'unvarying' and trip the
+    checker. Adding a varying zero derived from a shard_map input fixes the
+    annotation; numerically a no-op and XLA folds it outside shard_map.
+    """
+    z = ref.ravel()[0].astype(jnp.int32) * 0
+    if arr.dtype == jnp.bool_:
+        return arr ^ (z != 0)
+    return arr + z.astype(arr.dtype)

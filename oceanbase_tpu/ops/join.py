@@ -1,0 +1,157 @@
+"""Vectorized join kernels.
+
+Reference surface: ObHashJoinVecOp (sql/engine/join/hash_join/
+ob_hash_join_vec_op.h:316 — build :402, probe :425), merge join, and
+nested-loop join. The TPU redesign avoids pointer-chasing buckets entirely:
+
+- hash_join_probe (unique build keys — the PK-FK case that covers most
+  TPC-H/TPC-DS joins): build side inserts into an open-addressing table via
+  the same lockstep-probe scatter loop as group-by; probe rows then walk the
+  probe chain in lockstep gathers until they hit their key or an empty slot.
+  Output keeps the probe side's static capacity: each probe row gets the
+  matching build row index (or -1), and payload columns materialize by
+  gather. Inner/semi/anti/left-outer all fall out of the match mask.
+
+- expand_join (M:N general case): sort the build side by key once, binary
+  search each probe key's [lo, hi) duplicate range, prefix-sum the counts,
+  and scatter/gather-expand into a static output capacity. The engine
+  chooses capacity from optimizer cardinality estimates and re-executes
+  with a larger capacity on overflow (detected via the returned total).
+
+Both paths are pure jittable functions with static shapes; XLA fuses the
+surrounding filters/projections into the gathers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .hashagg import assign_group_slots
+from .hashing import hash_combine, next_pow2
+
+_I64_MIN = jnp.iinfo(jnp.int64).min
+
+
+def join_keys64(key_cols: list[jnp.ndarray]) -> jnp.ndarray:
+    """Canonical 64-bit join key. Single integer key columns pass through
+    exactly (no collision risk); multi-column keys hash-combine (the engine
+    routes multi-key M:N joins through an extra exact post-filter on the
+    expanded pairs, so a 2^-64 collision cannot fabricate a result row)."""
+    if len(key_cols) == 1 and jnp.issubdtype(key_cols[0].dtype, jnp.integer):
+        return key_cols[0].astype(jnp.int64)
+    return hash_combine(key_cols).astype(jnp.int64)
+
+
+def build_hash_table(
+    key_cols: list[jnp.ndarray], mask: jnp.ndarray, table_size: int
+):
+    """Insert build rows into an open-addressing table.
+
+    Unique keys assumed (duplicates: one winner per key survives — callers
+    needing M:N semantics use expand_join). Returns (slot_key64 [T],
+    slot_row [T] int32).
+    """
+    row_slot, slot_used, slot_row = assign_group_slots(key_cols, mask, table_size)
+    keys64 = hash_combine(key_cols).astype(jnp.int64)
+    n = key_cols[0].shape[0]
+    slot_key = jnp.where(
+        slot_used, keys64[jnp.clip(slot_row, 0, n - 1)], _I64_MIN
+    )
+    return slot_key, slot_row
+
+
+def hash_join_probe(
+    slot_key: jnp.ndarray,
+    slot_row: jnp.ndarray,
+    build_key_cols: list[jnp.ndarray],
+    probe_key_cols: list[jnp.ndarray],
+    probe_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Probe the table; returns match_row [N] int32 (build row idx or -1)."""
+    ts = slot_key.shape[0]
+    nb = build_key_cols[0].shape[0]
+    n = probe_key_cols[0].shape[0]
+    keys64 = hash_combine(probe_key_cols).astype(jnp.int64)
+    h = (hash_combine(probe_key_cols) & jnp.uint64(ts - 1)).astype(jnp.int32)
+
+    def cond(state):
+        pending, probe, _ = state
+        return jnp.logical_and(jnp.any(pending), probe < ts)
+
+    def body(state):
+        pending, probe, match_row = state
+        pos = ((h + probe) & (ts - 1)).astype(jnp.int32)
+        at_key = slot_key[pos]
+        at_row = jnp.clip(slot_row[pos], 0, nb - 1)
+        empty = at_key == _I64_MIN
+        exact = jnp.ones(n, dtype=jnp.bool_)
+        for bc, pc in zip(build_key_cols, probe_key_cols):
+            exact = exact & (bc[at_row] == pc)
+        hit = pending & ~empty & (at_key == keys64) & exact
+        match_row = jnp.where(hit, slot_row[pos], match_row)
+        pending = pending & ~hit & ~empty
+        return pending, probe + 1, match_row
+
+    from .hashing import inherit_vma
+
+    init = (
+        probe_mask,
+        inherit_vma(jnp.zeros((), jnp.int32), keys64),
+        inherit_vma(jnp.full(n, -1, jnp.int32), keys64),
+    )
+    _, _, match_row = jax.lax.while_loop(cond, body, init)
+    return match_row
+
+
+def gather_payload(
+    columns: dict[str, jnp.ndarray], match_row: jnp.ndarray
+) -> dict[str, jnp.ndarray]:
+    """Materialize build-side payload columns for matched probe rows."""
+    idx = jnp.clip(match_row, 0, None)
+    return {name: c[idx] for name, c in columns.items()}
+
+
+def expand_join(
+    build_sorted_keys64: jnp.ndarray,
+    build_order: jnp.ndarray,
+    build_nrows: jnp.ndarray,
+    probe_key_cols: list[jnp.ndarray],
+    probe_mask: jnp.ndarray,
+    out_capacity: int,
+):
+    """M:N join expansion against a key-sorted build side.
+
+    build_sorted_keys64: 64-bit mixed keys of build rows, ascending, with
+    dead rows sorted to the end (callers pass +inf-like sentinel);
+    build_order: original build row index per sorted position;
+    Returns (out_probe_row [C] int32, out_build_row [C] int32, out_valid [C]
+    bool, total matches [scalar int64]). If total > out_capacity the output
+    is truncated — the engine checks and re-runs with a larger capacity.
+    """
+    keys64 = join_keys64(probe_key_cols)
+    lo = jnp.searchsorted(build_sorted_keys64, keys64, side="left")
+    hi = jnp.searchsorted(build_sorted_keys64, keys64, side="right")
+    cnt = jnp.where(probe_mask, (hi - lo).astype(jnp.int64), 0)
+    offs = jnp.cumsum(cnt)  # inclusive prefix sum
+    total = offs[-1] if cnt.shape[0] > 0 else jnp.zeros((), jnp.int64)
+    starts = offs - cnt  # exclusive
+    # for each output slot t: probe row p = first row with offs[p] > t
+    t = jnp.arange(out_capacity, dtype=jnp.int64)
+    p = jnp.searchsorted(offs, t, side="right").astype(jnp.int32)
+    pc = jnp.clip(p, 0, cnt.shape[0] - 1)
+    k = t - starts[pc]
+    b_sorted_pos = (lo[pc].astype(jnp.int64) + k).astype(jnp.int32)
+    out_valid = t < total
+    nb = build_order.shape[0]
+    out_build_row = build_order[jnp.clip(b_sorted_pos, 0, nb - 1)]
+    return pc, out_build_row, out_valid, total
+
+
+def sort_build_side(key_cols: list[jnp.ndarray], mask: jnp.ndarray):
+    """Sort build rows by mixed 64-bit key for expand_join; dead rows last."""
+    keys64 = join_keys64(key_cols)
+    keys64 = jnp.where(mask, keys64, jnp.iinfo(jnp.int64).max)
+    n = keys64.shape[0]
+    order = jnp.argsort(keys64)
+    return keys64[order], order.astype(jnp.int32)

@@ -1,0 +1,67 @@
+"""Sort / top-n kernels.
+
+Reference surface: ObSortVecOp with adaptive quicksort + external merge
+(sql/engine/sort/ob_sort_adaptive_qs_vec_op.h) and top-n pushdown
+(ob_pd_topn_sort_filter.h). On TPU the whole batch sorts in one fused XLA
+`lax.sort` (bitonic-style network on device) — no spill tier is needed until
+a partition exceeds HBM, which the parallel layer avoids by range/hash
+repartitioning first (the reference's own strategy, just static).
+
+Multi-key ORDER BY maps to `lax.sort` with num_keys = k + 1: a leading
+liveness key forces masked-out rows to the tail, then the user keys in
+order. DESC keys are value-negated (ints/floats) — exact for every physical
+type we store because decimals/dates/dict-codes are ints well inside the
+int64 range.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _desc_transform(v: jnp.ndarray) -> jnp.ndarray:
+    if v.dtype == jnp.bool_:
+        return ~v
+    return -v
+
+
+def sort_indices(
+    keys: list[jnp.ndarray], descending: list[bool], mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Return row order (int32 [N]) sorting live rows by keys; dead rows last.
+
+    Stable across equal keys (ties keep original order) because the original
+    row index is appended as the final key.
+    """
+    n = mask.shape[0]
+    ops = [(~mask)]  # dead rows (True) sort after live (False)
+    for k, d in zip(keys, descending):
+        ops.append(_desc_transform(k) if d else k)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ops.append(idx)
+    out = jax.lax.sort(tuple(ops), num_keys=len(ops))
+    return out[-1]
+
+
+def apply_order(columns: dict[str, jnp.ndarray], order: jnp.ndarray):
+    return {name: c[order] for name, c in columns.items()}
+
+
+def topn_indices(
+    keys: list[jnp.ndarray],
+    descending: list[bool],
+    mask: jnp.ndarray,
+    n_top: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-n rows by sort keys. Returns (order [n_top], valid [n_top]).
+
+    Full sort then slice: XLA's sort is fast enough that a separate heap
+    path only pays off for tiny n over huge batches; revisit with a pallas
+    partial-sort if profiling says so.
+    """
+    order = sort_indices(keys, descending, mask)
+    top = order[:n_top]
+    nlive = jnp.sum(mask, dtype=jnp.int64)
+    valid = jnp.arange(n_top, dtype=jnp.int64) < nlive
+    return top, valid

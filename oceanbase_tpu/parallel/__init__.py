@@ -1,0 +1,22 @@
+from .mesh import SHARD_AXIS, make_mesh, replicated, row_sharding
+from .exchange import (
+    broadcast_rows,
+    dest_by_hash,
+    dest_by_range,
+    dest_round_robin,
+    merge_partials,
+    repartition,
+)
+
+__all__ = [
+    "SHARD_AXIS",
+    "make_mesh",
+    "replicated",
+    "row_sharding",
+    "broadcast_rows",
+    "dest_by_hash",
+    "dest_by_range",
+    "dest_round_robin",
+    "merge_partials",
+    "repartition",
+]

@@ -1,0 +1,132 @@
+"""Exchange (repartition) primitives: PX/DTL lowered to XLA collectives.
+
+Reference surface: the PX exchange operators + DTL channels —
+ObPxTransmitOp/do_hash_dist routes each row to a target channel via
+ObSliceIdxCalc (sql/engine/px/exchange/ob_px_dist_transmit_op.cpp:283,
+ob_slice_calc.h:55), buffers serialize per-channel (dtl, credit flow
+control), receivers drain a channel loop. The TPU redesign compiles the
+whole exchange INTO the SPMD program:
+
+- HASH          -> bucketize rows by key hash, `lax.all_to_all` over the
+                   shard axis (this module's repartition_hash)
+- BROADCAST     -> `lax.all_gather` (broadcast_rows)
+- PARTITION(PKEY)-> repartition_hash with dest = owning shard of the
+                   partition id (affine routing, same kernel)
+- RANDOM        -> repartition with dest = round-robin counter
+- RANGE         -> dest = searchsorted(range_bounds, key) (range_partition)
+- aggregates    -> partial-agg + `psum` (merge_partials), the datahub
+                   rollup analog
+
+Flow control/credits disappear: the collective IS the synchronization.
+Capacity discipline replaces dynamic buffers: each (src shard -> dst shard)
+lane carries a static `cap` rows; overflow is counted and returned so the
+engine can re-execute with a larger capacity (same pattern as joins).
+
+All functions run INSIDE shard_map over mesh axis "shard".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.hashing import hash_combine
+from .mesh import SHARD_AXIS
+
+
+def dest_by_hash(key_cols: list[jnp.ndarray], n_shards: int) -> jnp.ndarray:
+    """HASH distribution: shard id per row from mixed key hash."""
+    h = hash_combine(key_cols)
+    return (h % jnp.uint64(n_shards)).astype(jnp.int32)
+
+
+def dest_by_range(
+    key: jnp.ndarray, bounds: jnp.ndarray
+) -> jnp.ndarray:
+    """RANGE distribution: bounds are n_shards-1 ascending split points."""
+    return jnp.searchsorted(bounds, key, side="right").astype(jnp.int32)
+
+
+def dest_round_robin(mask: jnp.ndarray, n_shards: int, shard_id) -> jnp.ndarray:
+    """RANDOM(_LOCAL) distribution: even resplit of live rows."""
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    return ((pos + shard_id) % n_shards).astype(jnp.int32)
+
+
+def repartition(
+    cols: dict[str, jnp.ndarray],
+    mask: jnp.ndarray,
+    dest: jnp.ndarray,
+    n_shards: int,
+    cap: int,
+    axis_name: str = SHARD_AXIS,
+):
+    """Redistribute rows to their dest shard via all_to_all.
+
+    Returns (new_cols, new_mask [n_shards*cap], overflow: scalar count of
+    rows dropped because a (src,dst) lane exceeded cap). Call inside
+    shard_map. cap is per source->dest lane.
+    """
+    dest = jnp.where(mask, dest, n_shards)  # dead rows -> dropped
+    # position of each row within its dest lane (stable, per-dest cumsum);
+    # n_shards is static and small so this unrolls into vector ops
+    send = {}
+    lane_pos = jnp.zeros_like(dest)
+    overflow = jnp.zeros((), jnp.int64)
+    onehots = []
+    for d in range(n_shards):
+        is_d = dest == d
+        pos_d = jnp.cumsum(is_d.astype(jnp.int32)) - 1
+        lane_pos = jnp.where(is_d, pos_d, lane_pos)
+        overflow = overflow + jnp.maximum(
+            jnp.sum(is_d, dtype=jnp.int64) - cap, 0
+        )
+        onehots.append(is_d)
+    in_lane = lane_pos < cap
+    flat_idx = jnp.where(
+        mask & (dest < n_shards) & in_lane,
+        dest * cap + lane_pos,
+        n_shards * cap,
+    )
+    for name, c in cols.items():
+        buf = jnp.zeros((n_shards * cap,), dtype=c.dtype)
+        buf = buf.at[flat_idx].set(c, mode="drop")
+        send[name] = buf.reshape(n_shards, cap)
+    sent_mask = (
+        jnp.zeros((n_shards * cap,), dtype=jnp.bool_)
+        .at[flat_idx]
+        .set(True, mode="drop")
+        .reshape(n_shards, cap)
+    )
+
+    recv = {}
+    for name, buf in send.items():
+        recv[name] = lax.all_to_all(
+            buf, axis_name, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(n_shards * cap)
+    new_mask = lax.all_to_all(
+        sent_mask, axis_name, split_axis=0, concat_axis=0, tiled=True
+    ).reshape(n_shards * cap)
+    overflow = lax.psum(overflow, axis_name)
+    return recv, new_mask, overflow
+
+
+def broadcast_rows(
+    cols: dict[str, jnp.ndarray],
+    mask: jnp.ndarray,
+    axis_name: str = SHARD_AXIS,
+):
+    """BROADCAST distribution: every shard receives all rows (all_gather)."""
+    out = {
+        name: lax.all_gather(c, axis_name, tiled=True) for name, c in cols.items()
+    }
+    new_mask = lax.all_gather(mask, axis_name, tiled=True)
+    return out, new_mask
+
+
+def merge_partials(partials, axis_name: str = SHARD_AXIS):
+    """Merge per-shard partial aggregates (datahub rollup analog)."""
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), partials)

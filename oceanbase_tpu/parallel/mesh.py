@@ -1,0 +1,35 @@
+"""Device mesh management.
+
+Reference surface: PX worker/SQC topology — a query runs at DOP d across
+nodes, each node hosting worker threads (sql/engine/px/ob_px_sub_coord.cpp,
+ob_px_worker.h:229). The TPU mapping: one mesh axis "shard" enumerates the
+execution shards (device = worker); multi-host slices extend the same mesh
+over ICI/DCN and XLA routes the collectives (SURVEY.md §2.7). A second
+optional axis "host" models the 2-level PARTITION_HASH/BC2HOST slave-mapping
+methods (hierarchical exchanges).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows split across shards (granule assignment, static)."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,52 @@
+"""End-to-end TPC-H slice: datagen -> device batch -> Q6/Q1 vs numpy oracle."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.models.tpch import datagen, queries
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return datagen.generate(sf=0.005)
+
+
+def test_datagen_shapes(tables):
+    assert tables["nation"].nrows == 25
+    assert tables["region"].nrows == 5
+    li = tables["lineitem"]
+    od = tables["orders"]
+    assert li.nrows > od.nrows  # 1-7 lines per order
+    # FK integrity: every l_orderkey appears in orders
+    assert np.isin(li.data["l_orderkey"], od.data["o_orderkey"]).all()
+    # dates consistent
+    assert (li.data["l_receiptdate"] > li.data["l_shipdate"]).all()
+
+
+def test_q6_end_to_end(tables):
+    li = tables["lineitem"]
+    batch = li.to_batch()
+    q6, finish = queries.build_q6()
+    got = finish(q6(batch))
+    want = queries.q6_numpy(li)
+    assert got == pytest.approx(want, rel=1e-12)
+    assert want != 0.0
+
+
+def test_q1_end_to_end(tables):
+    li = tables["lineitem"]
+    batch = li.to_batch()
+    rf_d = li.dicts["l_returnflag"]
+    ls_d = li.dicts["l_linestatus"]
+    q1, finish = queries.build_q1(len(rf_d), len(ls_d))
+    got = finish(q1(batch), rf_d, ls_d)
+    want = queries.q1_numpy(li)
+    assert len(got) == len(want) == 4  # R/A/N x O/F minus impossible combos
+    for g, w in zip(got, want):
+        assert g["l_returnflag"] == w["l_returnflag"]
+        assert g["l_linestatus"] == w["l_linestatus"]
+        assert g["count_order"] == w["count_order"]
+        for k in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge"):
+            assert g[k] == pytest.approx(w[k], rel=1e-12), k
+        for k in ("avg_qty", "avg_price", "avg_disc"):
+            assert g[k] == pytest.approx(w[k], rel=1e-9), k
