@@ -1,0 +1,4 @@
+from .executor import Executor, PhysicalParams
+from .session import ResultSet, Session
+
+__all__ = ["Executor", "PhysicalParams", "ResultSet", "Session"]

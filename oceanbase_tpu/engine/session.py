@@ -1,0 +1,64 @@
+"""Session facade: SQL text in, result rows out, with a plan cache.
+
+Reference surface: ObSql::stmt_query + ObPlanCache
+(src/sql/ob_sql.cpp:153, src/sql/plan_cache/ob_plan_cache.h:227). The cache
+key is the literal-normalized SQL text (fast-parser analog,
+sql/parser.py normalize_for_cache); a hit reuses the compiled jitted
+program — the expensive artifact on TPU is the XLA executable, so the plan
+cache IS the compile cache.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.column import batch_to_host
+from ..core.table import Table
+from ..sql import parser as P
+from ..sql.planner import Planner
+from .executor import Executor
+
+
+@dataclass
+class ResultSet:
+    names: tuple[str, ...]
+    columns: dict[str, object]  # name -> np.ndarray | list
+
+    @property
+    def nrows(self) -> int:
+        if not self.names:
+            return 0
+        c = self.columns[self.names[0]]
+        return len(c)
+
+    def rows(self) -> list[tuple]:
+        cols = [self.columns[n] for n in self.names]
+        return list(zip(*cols)) if cols else []
+
+
+class Session:
+    def __init__(self, catalog: dict[str, Table], unique_keys=None):
+        self.catalog = catalog
+        self.planner = Planner(catalog)
+        self.executor = Executor(catalog, unique_keys=unique_keys)
+        self._plan_cache: dict[str, tuple] = {}
+
+    def sql(self, text: str) -> ResultSet:
+        key, _params = P.normalize_for_cache(text)
+        cached = self._plan_cache.get(key)
+        if cached is None or cached[0] != text:
+            # (round-1 cache: exact text only; parameterized plans replace
+            # this once the executor takes literals as runtime args)
+            ast = P.parse(text)
+            planned = self.planner.plan(ast)
+            prepared = self.executor.prepare(planned.plan)
+            cached = (text, planned, prepared)
+            self._plan_cache[key] = cached
+        _, planned, prepared = cached
+        out_batch = prepared.run()
+        host = batch_to_host(out_batch)
+        # order columns per select list
+        cols = {n: host[n] for n in planned.output_names}
+        return ResultSet(planned.output_names, cols)

@@ -193,7 +193,13 @@ def and_(*args: Expr) -> Expr:
 
 
 def or_(*args: Expr) -> Expr:
-    return args[0] if len(args) == 1 else BoolOp("or", tuple(args))
+    flat: list[Expr] = []
+    for a in args:
+        if isinstance(a, BoolOp) and a.op == "or":
+            flat.extend(a.args)
+        else:
+            flat.append(a)
+    return flat[0] if len(flat) == 1 else BoolOp("or", tuple(flat))
 
 
 def walk(e: Expr):

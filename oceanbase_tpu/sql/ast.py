@@ -1,0 +1,188 @@
+"""SQL AST nodes.
+
+Reference surface: the parse-node layer (src/sql/parser/parse_node.h) that
+the flex/bison grammar produces. The rebuild uses a hand-written recursive
+descent parser (sql/parser.py) over these dataclasses; the grammar subset
+covers the analytic SQL the TPC-H/TPC-DS suites need and grows toward full
+MySQL-compatible DML.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class Node:
+    __slots__ = ()
+
+
+# ---- scalar expressions ---------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Name(Node):
+    """Possibly-qualified column reference: l_orderkey or l.l_orderkey."""
+
+    parts: tuple[str, ...]
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@dataclass(frozen=True)
+class NumberLit(Node):
+    value: str  # textual, typed later (int vs decimal)
+
+
+@dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclass(frozen=True)
+class DateLit(Node):
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclass(frozen=True)
+class IntervalLit(Node):
+    value: str
+    unit: str  # day | month | year
+
+
+@dataclass(frozen=True)
+class Star(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # '-' | 'not'
+    operand: Node
+
+
+@dataclass(frozen=True)
+class BinOp(Node):
+    op: str  # + - * / % = != <> < <= > >= and or
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class BetweenOp(Node):
+    expr: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InOp(Node):
+    expr: Node
+    items: tuple[Node, ...] | None  # literal list
+    subquery: "Select | None" = None
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class LikeOp(Node):
+    expr: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNullOp(Node):
+    expr: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ExistsOp(Node):
+    subquery: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Node):
+    subquery: "Select"
+
+
+@dataclass(frozen=True)
+class FuncCall(Node):
+    name: str
+    args: tuple[Node, ...]
+    distinct: bool = False  # count(distinct x)
+
+
+@dataclass(frozen=True)
+class ExtractOp(Node):
+    field_: str  # year | month | day
+    expr: Node
+
+
+@dataclass(frozen=True)
+class SubstringOp(Node):
+    expr: Node
+    start: Node
+    length: Node | None
+
+
+@dataclass(frozen=True)
+class CaseOp(Node):
+    whens: tuple[tuple[Node, Node], ...]
+    default: Node | None
+
+
+@dataclass(frozen=True)
+class CastOp(Node):
+    expr: Node
+    type_name: str  # 'decimal(12,2)' | 'date' | 'integer' ...
+
+
+# ---- relational -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TableRef(Node):
+    name: str
+    alias: str | None = None
+
+
+@dataclass(frozen=True)
+class SubqueryRef(Node):
+    subquery: "Select"
+    alias: str
+
+
+@dataclass(frozen=True)
+class Join(Node):
+    kind: str  # inner | left | right | full | cross
+    left: Node
+    right: Node
+    on: Node | None
+
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: str | None = None
+
+
+@dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Node
+    descending: bool = False
+
+
+@dataclass(frozen=True)
+class Select(Node):
+    items: tuple[SelectItem, ...]
+    from_: tuple[Node, ...] = ()  # TableRef | SubqueryRef | Join
+    where: Node | None = None
+    group_by: tuple[Node, ...] = ()
+    having: Node | None = None
+    order_by: tuple[OrderItem, ...] = ()
+    limit: int | None = None
+    offset: int | None = None
+    distinct: bool = False

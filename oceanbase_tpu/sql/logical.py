@@ -1,0 +1,378 @@
+"""Resolver: AST -> logical plan over typed expression IR.
+
+Reference surface: the resolver layer producing ObDMLStmt/ObSelectStmt with
+ObRawExpr trees (src/sql/resolver, ob_raw_expr.h). Scoping model: every
+table reference gets an alias; resolved columns are named "<alias>.<col>"
+internally, unqualified names resolve by unique suffix match across visible
+scopes. Aggregates are extracted from SELECT/HAVING/ORDER BY into an
+Aggregate node (avg decomposes into sum/count at planning).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.dtypes import DataType, Field, Schema
+from ..expr import ir as E
+from . import ast as A
+
+_counter = itertools.count()
+
+
+# ---- logical operators ----------------------------------------------------
+
+
+class LogicalOp:
+    __slots__ = ()
+
+
+@dataclass
+class Scan(LogicalOp):
+    table: str
+    alias: str
+    schema: Schema  # qualified names alias.col
+    pushed_filter: E.Expr | None = None
+    needed: tuple[str, ...] | None = None  # projection pruning
+
+
+@dataclass
+class Filter(LogicalOp):
+    child: LogicalOp
+    pred: E.Expr
+
+
+@dataclass
+class Project(LogicalOp):
+    child: LogicalOp
+    exprs: tuple[tuple[str, E.Expr], ...]  # (output name, expr)
+
+
+@dataclass
+class JoinOp(LogicalOp):
+    kind: str  # inner | left | semi | anti | cross
+    left: LogicalOp
+    right: LogicalOp
+    left_keys: tuple[E.Expr, ...] = ()
+    right_keys: tuple[E.Expr, ...] = ()
+    residual: E.Expr | None = None
+
+
+@dataclass
+class Aggregate(LogicalOp):
+    child: LogicalOp
+    group_keys: tuple[tuple[str, E.Expr], ...]  # (name, expr)
+    aggs: tuple[tuple[str, str, E.Expr | None, bool], ...]
+    # (output name, op in sum/count/min/max, input expr, distinct)
+
+
+@dataclass
+class Sort(LogicalOp):
+    child: LogicalOp
+    keys: tuple[tuple[E.Expr, bool], ...]  # (expr, descending)
+
+
+@dataclass
+class Limit(LogicalOp):
+    child: LogicalOp
+    n: int
+    offset: int = 0
+
+
+@dataclass
+class Distinct(LogicalOp):
+    child: LogicalOp
+
+
+def output_schema(op: LogicalOp) -> Schema:
+    """Schema of an operator's output (qualified names)."""
+    if isinstance(op, Scan):
+        if op.needed is None:
+            return op.schema
+        return Schema(tuple(f for f in op.schema.fields if f.name in op.needed))
+    if isinstance(op, Filter):
+        return output_schema(op.child)
+    if isinstance(op, Project):
+        from ..expr.compile import infer_type
+
+        child_s = output_schema(op.child)
+        return Schema(
+            tuple(Field(n, infer_type(e, child_s)) for n, e in op.exprs)
+        )
+    if isinstance(op, JoinOp):
+        ls, rs = output_schema(op.left), output_schema(op.right)
+        if op.kind in ("semi", "anti"):
+            return ls
+        fields = list(ls.fields)
+        nullable_right = op.kind == "left"
+        for f in rs.fields:
+            fields.append(
+                Field(f.name, f.dtype.with_nullable(f.dtype.nullable or nullable_right))
+            )
+        return Schema(tuple(fields))
+    if isinstance(op, Aggregate):
+        from ..expr.compile import infer_type
+
+        child_s = output_schema(op.child)
+        fields = [Field(n, infer_type(e, child_s)) for n, e in op.group_keys]
+        for name, fn, arg, _ in op.aggs:
+            if fn == "count":
+                fields.append(Field(name, DataType.int64()))
+            else:
+                t = infer_type(arg, child_s)
+                if fn == "sum" and t.is_decimal:
+                    t = DataType.decimal(18, t.scale)
+                elif fn == "sum" and t.is_integer:
+                    t = DataType.int64()
+                fields.append(Field(name, t))
+        return Schema(tuple(fields))
+    if isinstance(op, (Sort, Limit, Distinct)):
+        return output_schema(op.child)
+    raise AssertionError(type(op))
+
+
+# ---- resolver -------------------------------------------------------------
+
+_AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+
+
+class ResolveError(Exception):
+    pass
+
+
+@dataclass
+class ResolvedQuery:
+    plan: LogicalOp
+    output_names: tuple[str, ...]
+
+
+class Resolver:
+    """One instance per (sub)query block."""
+
+    def __init__(self, catalog, outer: "Resolver | None" = None):
+        self.catalog = catalog  # dict name -> Table (core.table.Table)
+        self.outer = outer
+        self.scopes: list[tuple[str, Schema]] = []  # (alias, schema)
+        self.agg_exprs: list[tuple[str, str, E.Expr | None, bool]] = []
+        self.correlated: list[E.Expr] = []
+
+    # -- name resolution -------------------------------------------------
+    def add_table(self, name: str, alias: str) -> Scan:
+        if name not in self.catalog:
+            raise ResolveError(f"unknown table {name}")
+        t = self.catalog[name]
+        qual = Schema(
+            tuple(Field(f"{alias}.{f.name}", f.dtype) for f in t.schema.fields)
+        )
+        self.scopes.append((alias, qual))
+        return Scan(name, alias, qual)
+
+    def resolve_name(self, parts: tuple[str, ...]) -> str:
+        if len(parts) == 2:
+            alias, col = parts
+            for a, s in self.scopes:
+                if a == alias:
+                    q = f"{a}.{col}"
+                    if q in s:
+                        return q
+            raise ResolveError(f"unknown column {'.'.join(parts)}")
+        col = parts[0]
+        matches = []
+        for a, s in self.scopes:
+            q = f"{a}.{col}"
+            if q in s:
+                matches.append(q)
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise ResolveError(f"ambiguous column {col}")
+        if self.outer is not None:
+            return self.outer.resolve_name(parts)
+        raise ResolveError(f"unknown column {col}")
+
+    def visible_schema(self) -> Schema:
+        fields = []
+        for _, s in self.scopes:
+            fields.extend(s.fields)
+        return Schema(tuple(fields))
+
+    # -- expression resolution -------------------------------------------
+    def expr(self, node: A.Node, allow_agg=False) -> E.Expr:
+        if isinstance(node, A.Name):
+            return E.ColRef(self.resolve_name(node.parts))
+        if isinstance(node, A.NumberLit):
+            if "." in node.value:
+                return E.lit(float(node.value))
+            return E.lit(int(node.value))
+        if isinstance(node, A.StringLit):
+            return E.lit(node.value)
+        if isinstance(node, A.DateLit):
+            days = int(np.datetime64(node.value, "D").astype(np.int64))
+            return E.Literal(days, DataType.date())
+        if isinstance(node, A.UnaryOp):
+            if node.op == "-":
+                inner = self.expr(node.operand, allow_agg)
+                if isinstance(inner, E.Literal):
+                    return E.Literal(-inner.value, inner.dtype)
+                return E.Func("neg", (inner,))
+            return E.Not(self.expr(node.operand, allow_agg))
+        if isinstance(node, A.BinOp):
+            return self._binop(node, allow_agg)
+        if isinstance(node, A.BetweenOp):
+            return E.Between(
+                self.expr(node.expr, allow_agg),
+                self.expr(node.low, allow_agg),
+                self.expr(node.high, allow_agg),
+                node.negated,
+            )
+        if isinstance(node, A.InOp):
+            if node.subquery is not None:
+                raise ResolveError("IN subquery handled by planner")
+            vals = []
+            for it in node.items:
+                lit_e = self.expr(it, allow_agg)
+                if not isinstance(lit_e, E.Literal):
+                    raise ResolveError("IN list items must be literals")
+                vals.append(lit_e.value)
+            return E.InList(
+                self.expr(node.expr, allow_agg), tuple(vals), node.negated
+            )
+        if isinstance(node, A.LikeOp):
+            pat = self.expr(node.pattern)
+            e = E.Func("like", (self.expr(node.expr, allow_agg), pat))
+            return E.Not(e) if node.negated else e
+        if isinstance(node, A.IsNullOp):
+            return E.IsNull(self.expr(node.expr, allow_agg), node.negated)
+        if isinstance(node, A.ExtractOp):
+            return E.Func(
+                f"extract_{node.field_}", (self.expr(node.expr, allow_agg),)
+            )
+        if isinstance(node, A.CaseOp):
+            whens = tuple(
+                (self.expr(c, allow_agg), self.expr(v, allow_agg))
+                for c, v in node.whens
+            )
+            default = (
+                self.expr(node.default, allow_agg)
+                if node.default is not None
+                else None
+            )
+            return E.Case(whens, default)
+        if isinstance(node, A.CastOp):
+            return E.Cast(self.expr(node.expr, allow_agg), _parse_type(node.type_name))
+        if isinstance(node, A.SubstringOp):
+            # substring(col from 1 for k) = 'lit'  -> handled as prefix in
+            # comparisons; standalone substring resolves to a dict transform
+            # at compile time (expr/compile handles Func('substr', ...)).
+            start = self.expr(node.start)
+            length = self.expr(node.length) if node.length else None
+            if not (isinstance(start, E.Literal) and (length is None or isinstance(length, E.Literal))):
+                raise ResolveError("substring bounds must be literals")
+            return E.Func(
+                "substr",
+                (
+                    self.expr(node.expr, allow_agg),
+                    start,
+                    length if length is not None else E.lit(-1),
+                ),
+            )
+        if isinstance(node, A.FuncCall):
+            if node.name in _AGG_FUNCS:
+                if not allow_agg:
+                    raise ResolveError(f"aggregate {node.name} not allowed here")
+                return self._agg_call(node)
+            raise ResolveError(f"unknown function {node.name}")
+        if isinstance(node, (A.ScalarSubquery, A.ExistsOp)):
+            raise ResolveError("subquery handled by planner")
+        if isinstance(node, A.IntervalLit):
+            raise ResolveError("interval outside date arithmetic")
+        raise ResolveError(f"cannot resolve {node!r}")
+
+    def _binop(self, node: A.BinOp, allow_agg) -> E.Expr:
+        op = node.op
+        if op in ("and", "or"):
+            l = self.expr(node.left, allow_agg)
+            r = self.expr(node.right, allow_agg)
+            return E.and_(l, r) if op == "and" else E.or_(l, r)
+        if op in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            return E.Compare(
+                op,
+                self.expr(node.left, allow_agg),
+                self.expr(node.right, allow_agg),
+            )
+        # date +- interval folding
+        if op in ("+", "-") and isinstance(node.right, A.IntervalLit):
+            base = self.expr(node.left, allow_agg)
+            if isinstance(base, E.Literal) and base.dtype.kind.value == "date":
+                days = _interval_shift(base.value, node.right, op)
+                return E.Literal(days, DataType.date())
+            raise ResolveError("interval arithmetic on non-literal date")
+        return E.BinaryOp(
+            op, self.expr(node.left, allow_agg), self.expr(node.right, allow_agg)
+        )
+
+    def _agg_call(self, node: A.FuncCall) -> E.Expr:
+        fn = node.name
+        if fn == "count" and (not node.args or isinstance(node.args[0], A.Star)):
+            arg = None
+        else:
+            arg = self.expr(node.args[0])
+        if fn == "avg":
+            # avg(x) = sum(x) / count(x): count of NON-NULL x, per SQL
+            s = self._add_agg("sum", arg, False)
+            c = self._add_agg("count", arg, node.distinct)
+            return E.BinaryOp("/", E.ColRef(s), E.ColRef(c))
+        name = self._add_agg(fn, arg, node.distinct)
+        return E.ColRef(name)
+
+    def _add_agg(self, fn: str, arg: E.Expr | None, distinct: bool) -> str:
+        # dedupe identical aggregates
+        for name, f2, a2, d2 in self.agg_exprs:
+            if f2 == fn and a2 == arg and d2 == distinct:
+                return name
+        name = f"$agg{next(_counter)}"
+        self.agg_exprs.append((name, fn, arg, distinct))
+        return name
+
+
+def _interval_shift(days: int, iv: A.IntervalLit, op: str) -> int:
+    n = int(iv.value)
+    if op == "-":
+        n = -n
+    d = np.datetime64(int(days), "D")
+    if iv.unit.startswith("day"):
+        return int((d + np.timedelta64(n, "D")).astype(np.int64))
+    if iv.unit.startswith("month") or iv.unit.startswith("year"):
+        months = n if iv.unit.startswith("month") else 12 * n
+        m = d.astype("datetime64[M]") + np.timedelta64(months, "M")
+        dom = (d - d.astype("datetime64[M]")).astype(np.int64)
+        # clamp to the target month's last day (SQL/MySQL semantics:
+        # '1995-01-31' + 1 month = '1995-02-28', no overflow into March)
+        next_m = (m + np.timedelta64(1, "M")).astype("datetime64[D]")
+        last_dom = (next_m - m.astype("datetime64[D]")).astype(np.int64) - 1
+        dom = min(int(dom), int(last_dom))
+        return int((m.astype("datetime64[D]") + np.timedelta64(dom, "D")).astype(np.int64))
+    raise ResolveError(f"interval unit {iv.unit}")
+
+
+def _parse_type(tn: str) -> DataType:
+    tn = tn.lower()
+    if tn.startswith("decimal") or tn.startswith("numeric"):
+        if "(" in tn:
+            inner = tn[tn.index("(") + 1 : tn.index(")")]
+            p, *rest = inner.split(",")
+            return DataType.decimal(int(p), int(rest[0]) if rest else 0)
+        return DataType.decimal(18, 0)
+    if tn in ("int", "integer"):
+        return DataType.int32()
+    if tn == "bigint":
+        return DataType.int64()
+    if tn in ("float", "double", "real"):
+        return DataType.float64()
+    if tn == "date":
+        return DataType.date()
+    if tn in ("varchar", "char", "text"):
+        return DataType.varchar()
+    raise ResolveError(f"unknown type {tn}")

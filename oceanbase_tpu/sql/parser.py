@@ -1,0 +1,436 @@
+"""SQL lexer + recursive-descent parser.
+
+Reference surface: the flex/bison MySQL grammar + parse nodes
+(src/sql/parser/sql_parser_mysql_mode.y, parse_node.h) and the fast parser
+used for plan-cache keys (ob_fast_parser.h). The rebuild is a compact
+hand-written recursive-descent parser producing sql/ast.py nodes; parameter
+extraction for the plan cache is done on the token stream (see
+normalize_for_cache) — the fast-parser analog.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import ast as A
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<num>\d+\.\d+|\.\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<name>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.;=<>])
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "between", "like", "is",
+    "null", "exists", "case", "when", "then", "else", "end", "cast",
+    "extract", "substring", "for", "distinct", "join", "inner", "left",
+    "right", "full", "cross", "outer", "on", "date", "interval", "year",
+    "month", "day", "asc", "desc", "union", "all", "any", "some",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind  # num | str | name | kw | op | eof
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def tokenize(sql: str) -> list[Token]:
+    out = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise SyntaxError(f"bad character {sql[i]!r} at {i}")
+        i = m.end()
+        if m.lastgroup == "ws":
+            continue
+        v = m.group()
+        if m.lastgroup == "name":
+            lv = v.lower()
+            out.append(Token("kw" if lv in KEYWORDS else "name", lv, m.start()))
+        elif m.lastgroup == "str":
+            out.append(Token("str", v[1:-1].replace("''", "'"), m.start()))
+        elif m.lastgroup == "num":
+            out.append(Token("num", v, m.start()))
+        else:
+            out.append(Token("op", v, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+def normalize_for_cache(sql: str) -> tuple[str, tuple]:
+    """Fast-parser analog: replace literals with ? and collect parameters.
+    The normalized text is the plan-cache key (reference: ObPlanCache
+    parameterized keys, src/sql/plan_cache)."""
+    toks = tokenize(sql)
+    parts, params = [], []
+    for t in toks:
+        if t.kind in ("num", "str"):
+            parts.append("?")
+            params.append(t.value)
+        elif t.kind == "eof":
+            break
+        else:
+            parts.append(t.value)
+    return " ".join(parts), tuple(params)
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers --------------------------------------------------
+    def peek(self, k=0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, value: str) -> bool:
+        t = self.peek()
+        if t.kind in ("kw", "op") and t.value == value:
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, value: str) -> Token:
+        t = self.next()
+        if t.value != value:
+            raise SyntaxError(f"expected {value!r}, got {t.value!r} @{t.pos}")
+        return t
+
+    # -- entry ----------------------------------------------------------
+    def parse(self) -> A.Select:
+        s = self.select()
+        self.accept(";")
+        if self.peek().kind != "eof":
+            t = self.peek()
+            raise SyntaxError(f"trailing tokens at {t.pos}: {t.value!r}")
+        return s
+
+    def select(self) -> A.Select:
+        self.expect("select")
+        distinct = self.accept("distinct")
+        items = [self.select_item()]
+        while self.accept(","):
+            items.append(self.select_item())
+        from_ = ()
+        if self.accept("from"):
+            from_ = [self.table_expr()]
+            while self.accept(","):
+                from_.append(self.table_expr())
+        where = self.expr() if self.accept("where") else None
+        group_by = ()
+        if self.accept("group"):
+            self.expect("by")
+            group_by = [self.expr()]
+            while self.accept(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.accept("having") else None
+        order_by = []
+        if self.accept("order"):
+            self.expect("by")
+            order_by = [self.order_item()]
+            while self.accept(","):
+                order_by.append(self.order_item())
+        limit = offset = None
+        if self.accept("limit"):
+            limit = int(self.next().value)
+            if self.accept("offset"):
+                offset = int(self.next().value)
+        return A.Select(
+            items=tuple(items),
+            from_=tuple(from_),
+            where=where,
+            group_by=tuple(group_by),
+            having=having,
+            order_by=tuple(order_by),
+            limit=limit,
+            offset=offset,
+            distinct=distinct,
+        )
+
+    def select_item(self) -> A.SelectItem:
+        if self.peek().value == "*" and self.peek().kind == "op":
+            self.next()
+            return A.SelectItem(A.Star())
+        e = self.expr()
+        alias = None
+        if self.accept("as"):
+            alias = self.next().value
+        elif self.peek().kind == "name":
+            alias = self.next().value
+        return A.SelectItem(e, alias)
+
+    def order_item(self) -> A.OrderItem:
+        e = self.expr()
+        desc = False
+        if self.accept("desc"):
+            desc = True
+        else:
+            self.accept("asc")
+        return A.OrderItem(e, desc)
+
+    # -- FROM -----------------------------------------------------------
+    def table_expr(self) -> A.Node:
+        left = self.table_primary()
+        while True:
+            kind = None
+            if self.accept("inner"):
+                kind = "inner"
+            elif self.accept("left"):
+                self.accept("outer")
+                kind = "left"
+            elif self.accept("right"):
+                self.accept("outer")
+                kind = "right"
+            elif self.accept("full"):
+                self.accept("outer")
+                kind = "full"
+            elif self.accept("cross"):
+                kind = "cross"
+            elif self.peek().value == "join":
+                kind = "inner"
+            if kind is None:
+                return left
+            self.expect("join")
+            right = self.table_primary()
+            on = None
+            if kind != "cross" and self.accept("on"):
+                on = self.expr()
+            left = A.Join(kind, left, right, on)
+
+    def table_primary(self) -> A.Node:
+        if self.accept("("):
+            sub = self.select()
+            self.expect(")")
+            self.accept("as")
+            alias = self.next().value
+            return A.SubqueryRef(sub, alias)
+        name = self.next()
+        if name.kind not in ("name", "kw"):
+            raise SyntaxError(f"expected table name, got {name.value!r}")
+        alias = None
+        if self.accept("as"):
+            alias = self.next().value
+        elif self.peek().kind == "name":
+            alias = self.next().value
+        return A.TableRef(name.value, alias)
+
+    # -- expressions ----------------------------------------------------
+    def expr(self) -> A.Node:
+        return self.or_expr()
+
+    def or_expr(self) -> A.Node:
+        e = self.and_expr()
+        while self.accept("or"):
+            e = A.BinOp("or", e, self.and_expr())
+        return e
+
+    def and_expr(self) -> A.Node:
+        e = self.not_expr()
+        while self.accept("and"):
+            e = A.BinOp("and", e, self.not_expr())
+        return e
+
+    def not_expr(self) -> A.Node:
+        if self.accept("not"):
+            return A.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> A.Node:
+        e = self.additive()
+        negated = False
+        if self.peek().value == "not" and self.peek(1).value in (
+            "between", "in", "like",
+        ):
+            self.next()
+            negated = True
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            # ANY/ALL/SOME subquery comparisons
+            if self.peek().value in ("any", "all", "some"):
+                raise NotImplementedError("quantified comparisons")
+            return A.BinOp(t.value, e, self.additive())
+        if self.accept("between"):
+            low = self.additive()
+            self.expect("and")
+            high = self.additive()
+            return A.BetweenOp(e, low, high, negated)
+        if self.accept("in"):
+            self.expect("(")
+            if self.peek().value == "select":
+                sub = self.select()
+                self.expect(")")
+                return A.InOp(e, None, sub, negated)
+            items = [self.expr()]
+            while self.accept(","):
+                items.append(self.expr())
+            self.expect(")")
+            return A.InOp(e, tuple(items), None, negated)
+        if self.accept("like"):
+            return A.LikeOp(e, self.additive(), negated)
+        if self.accept("is"):
+            neg = self.accept("not")
+            self.expect("null")
+            return A.IsNullOp(e, neg)
+        return e
+
+    def additive(self) -> A.Node:
+        e = self.multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                self.next()
+                e = A.BinOp(t.value, e, self.multiplicative())
+            else:
+                return e
+
+    def multiplicative(self) -> A.Node:
+        e = self.unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                e = A.BinOp(t.value, e, self.unary())
+            else:
+                return e
+
+    def unary(self) -> A.Node:
+        if self.peek().value == "-" and self.peek().kind == "op":
+            self.next()
+            return A.UnaryOp("-", self.unary())
+        if self.peek().value == "+" and self.peek().kind == "op":
+            self.next()
+            return self.unary()
+        return self.atom()
+
+    def atom(self) -> A.Node:
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            return A.NumberLit(t.value)
+        if t.kind == "str":
+            self.next()
+            return A.StringLit(t.value)
+        if t.value == "(":
+            self.next()
+            if self.peek().value == "select":
+                sub = self.select()
+                self.expect(")")
+                return A.ScalarSubquery(sub)
+            e = self.expr()
+            self.expect(")")
+            return e
+        if t.value == "date" and self.peek(1).kind == "str":
+            self.next()
+            return A.DateLit(self.next().value)
+        if t.value == "interval":
+            self.next()
+            v = self.next().value  # quoted or bare number
+            unit = self.next().value
+            return A.IntervalLit(str(v), unit)
+        if t.value == "exists":
+            self.next()
+            self.expect("(")
+            sub = self.select()
+            self.expect(")")
+            return A.ExistsOp(sub)
+        if t.value == "case":
+            return self.case_expr()
+        if t.value == "cast":
+            self.next()
+            self.expect("(")
+            e = self.expr()
+            self.expect("as")
+            tn = self.type_name()
+            self.expect(")")
+            return A.CastOp(e, tn)
+        if t.value == "extract":
+            self.next()
+            self.expect("(")
+            fld = self.next().value
+            self.expect("from")
+            e = self.expr()
+            self.expect(")")
+            return A.ExtractOp(fld, e)
+        if t.value == "substring":
+            self.next()
+            self.expect("(")
+            e = self.expr()
+            if self.accept("from"):
+                start = self.expr()
+                length = self.expr() if self.accept("for") else None
+            else:
+                self.expect(",")
+                start = self.expr()
+                length = self.expr() if self.accept(",") else None
+            self.expect(")")
+            return A.SubstringOp(e, start, length)
+        if t.kind in ("name", "kw"):
+            self.next()
+            # function call?
+            if self.peek().value == "(" and self.peek().kind == "op":
+                self.next()
+                distinct = self.accept("distinct")
+                if self.peek().value == "*" and self.peek().kind == "op":
+                    self.next()
+                    args = (A.Star(),)
+                else:
+                    args = []
+                    if self.peek().value != ")":
+                        args = [self.expr()]
+                        while self.accept(","):
+                            args.append(self.expr())
+                    args = tuple(args)
+                self.expect(")")
+                return A.FuncCall(t.value, args, distinct)
+            parts = [t.value]
+            while self.peek().value == "." and self.peek().kind == "op":
+                self.next()
+                parts.append(self.next().value)
+            return A.Name(tuple(parts))
+        raise SyntaxError(f"unexpected token {t.value!r} @{t.pos}")
+
+    def case_expr(self) -> A.Node:
+        self.expect("case")
+        whens = []
+        while self.accept("when"):
+            c = self.expr()
+            self.expect("then")
+            v = self.expr()
+            whens.append((c, v))
+        default = self.expr() if self.accept("else") else None
+        self.expect("end")
+        return A.CaseOp(tuple(whens), default)
+
+    def type_name(self) -> str:
+        base = self.next().value
+        if self.accept("("):
+            args = [self.next().value]
+            while self.accept(","):
+                args.append(self.next().value)
+            self.expect(")")
+            return f"{base}({','.join(args)})"
+        return base
+
+
+def parse(sql: str) -> A.Select:
+    return Parser(sql).parse()

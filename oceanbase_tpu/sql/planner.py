@@ -1,0 +1,427 @@
+"""Query planner: AST -> resolved, rewritten, join-ordered logical plan.
+
+Reference surfaces:
+- rewrite: the 82-rule transformer (src/sql/rewrite/ob_transformer_impl.h).
+  Round-1 rules: conjunct splitting, equi-join extraction, predicate
+  pushdown to scans, projection pruning, constant-comparison folding.
+- optimizer: CBO join ordering (src/sql/optimizer/ob_join_order.h) —
+  here a greedy connected-subgraph heuristic on estimated filtered
+  cardinalities (dimension tables join first, build side = smaller input),
+  which reproduces the canonical TPC-H plans without a full DP search.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core.dtypes import Schema
+from ..expr import ir as E
+from . import ast as A
+from .logical import (
+    Aggregate,
+    Distinct,
+    Filter,
+    JoinOp,
+    Limit,
+    LogicalOp,
+    Project,
+    ResolveError,
+    Resolver,
+    Scan,
+    Sort,
+    output_schema,
+)
+
+
+@dataclass
+class PlannedQuery:
+    plan: LogicalOp
+    output_names: tuple[str, ...]
+
+
+def split_conjuncts(e: E.Expr | None) -> list[E.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, E.BoolOp) and e.op == "and":
+        out = []
+        for a in e.args:
+            out.extend(split_conjuncts(a))
+        return out
+    return [e]
+
+
+def hoist_common_or_conjuncts(e: E.Expr) -> list[E.Expr]:
+    """OR(a&b&c, a&d) -> [a, OR(b&c, d)] — factors conjuncts common to every
+    OR branch so join keys and single-table filters buried in OR arms (TPC-H
+    Q19 shape) become visible to pushdown/join extraction. (Reference: the
+    or-expansion transform family, sql/rewrite/ob_transform_or_expansion.*.)
+    """
+    if not (isinstance(e, E.BoolOp) and e.op == "or"):
+        return [e]
+    branches = [split_conjuncts(b) for b in e.args]
+    common = [c for c in branches[0] if all(c in b for b in branches[1:])]
+    if not common:
+        return [e]
+    rest_branches = []
+    for b in branches:
+        rest = [c for c in b if c not in common]
+        rest_branches.append(
+            E.and_(*rest) if rest else E.lit(True)
+        )
+    if any(isinstance(rb, E.Literal) for rb in rest_branches):
+        return common
+    return common + [E.or_(*rest_branches)]
+
+
+def _tables_of(e: E.Expr) -> set[str]:
+    return {n.split(".", 1)[0] for n in E.referenced_columns(e)}
+
+
+def _is_equi_join(e: E.Expr) -> tuple[E.ColRef, E.ColRef] | None:
+    if (
+        isinstance(e, E.Compare)
+        and e.op in ("=", "==")
+        and isinstance(e.left, E.ColRef)
+        and isinstance(e.right, E.ColRef)
+    ):
+        lt = e.left.name.split(".", 1)[0]
+        rt = e.right.name.split(".", 1)[0]
+        if lt != rt:
+            return e.left, e.right
+    return None
+
+
+class Planner:
+    def __init__(self, catalog, stats=None):
+        self.catalog = catalog  # name -> Table
+        self.stats = stats or {}
+
+    # -- cardinality guesses ------------------------------------------
+    def _scan_rows(self, scan: Scan) -> float:
+        base = self.catalog[scan.table].nrows or 1
+        if scan.pushed_filter is not None:
+            n_conj = len(split_conjuncts(scan.pushed_filter))
+            base = base * (0.25 ** min(n_conj, 3))
+        return max(base, 1.0)
+
+    def plan(self, sel: A.Select, outer: Resolver | None = None) -> PlannedQuery:
+        r = Resolver({n: t for n, t in self.catalog.items()}, outer)
+
+        # ---- FROM: collect scans + structured join conditions --------
+        scans: list[Scan] = []
+        join_conds: list[E.Expr] = []
+
+        def add_from(node: A.Node):
+            if isinstance(node, A.TableRef):
+                alias = node.alias or node.name
+                scans.append(r.add_table(node.name, alias))
+            elif isinstance(node, A.Join):
+                if node.kind != "inner":
+                    raise ResolveError(
+                        f"{node.kind} join not yet supported by the planner"
+                    )
+                add_from(node.left)
+                add_from(node.right)
+                if node.on is not None:
+                    join_conds.extend(split_conjuncts(r.expr(node.on)))
+            elif isinstance(node, A.SubqueryRef):
+                raise ResolveError("FROM subqueries not yet supported")
+            else:
+                raise ResolveError(f"bad FROM item {node!r}")
+
+        for f in sel.from_:
+            add_from(f)
+
+        # ---- WHERE ----------------------------------------------------
+        where_conjs = join_conds + (
+            split_conjuncts(r.expr(sel.where)) if sel.where is not None else []
+        )
+        where_conjs = [
+            h for c in where_conjs for h in hoist_common_or_conjuncts(c)
+        ]
+
+        # classify: single-table -> pushdown; equi-join; residual
+        by_alias = {s.alias: s for s in scans}
+        equi: list[tuple[E.ColRef, E.ColRef]] = []
+        residual: list[E.Expr] = []
+        for c in where_conjs:
+            tabs = _tables_of(c)
+            ej = _is_equi_join(c)
+            if ej is not None:
+                equi.append(ej)
+            elif len(tabs) == 1 and next(iter(tabs)) in by_alias:
+                s = by_alias[next(iter(tabs))]
+                s.pushed_filter = (
+                    c
+                    if s.pushed_filter is None
+                    else E.and_(s.pushed_filter, c)
+                )
+            else:
+                residual.append(c)
+
+        # ---- join order (greedy, smallest filtered input first) -------
+        plan = self._order_joins(scans, equi, residual)
+
+        # ---- GROUP BY / aggregates ------------------------------------
+        alias_map: dict[str, E.Expr] = {}
+        group_nodes = list(sel.group_by)
+        has_agg_in_select = _select_has_agg(sel)
+        agg_order_keys: list[tuple[E.Expr, bool]] | None = None
+        if group_nodes or has_agg_in_select or sel.having is not None:
+            key_exprs = []
+            for i, g in enumerate(group_nodes):
+                ge = r.expr(g)
+                name = (
+                    ge.name
+                    if isinstance(ge, E.ColRef)
+                    else f"$gkey{i}"
+                )
+                key_exprs.append((name, ge))
+            # resolve select items, having AND order-by with aggregates
+            # allowed BEFORE building the Aggregate node, so every agg call
+            # anywhere in the query lands in r.agg_exprs.
+            out_items = []
+            for i, item in enumerate(sel.items):
+                e = r.expr(item.expr, allow_agg=True)
+                name = item.alias or _default_name(item.expr, i)
+                out_items.append((name, e))
+                alias_map[name] = e
+            having_e = (
+                r.expr(sel.having, allow_agg=True)
+                if sel.having is not None
+                else None
+            )
+            agg_order_keys = []
+            for oi in sel.order_by:
+                if (
+                    isinstance(oi.expr, A.Name)
+                    and len(oi.expr.parts) == 1
+                    and oi.expr.parts[0] in alias_map
+                ):
+                    agg_order_keys.append((E.ColRef(oi.expr.parts[0]), oi.descending))
+                elif isinstance(oi.expr, A.NumberLit):
+                    agg_order_keys.append(
+                        (E.ColRef(out_items[int(oi.expr.value) - 1][0]), oi.descending)
+                    )
+                else:
+                    oe = r.expr(oi.expr, allow_agg=True)
+                    matched = [n for n, e2 in out_items if e2 == oe]
+                    agg_order_keys.append(
+                        (E.ColRef(matched[0]) if matched else oe, oi.descending)
+                    )
+            plan = Aggregate(plan, tuple(key_exprs), tuple(r.agg_exprs))
+            # rewrite out_items/having over the aggregate's output schema:
+            # group keys keep their names; $aggN are columns now.
+            sub = {e: E.ColRef(n) for n, e in key_exprs}
+            out_items = [(n, _substitute(e, sub)) for n, e in out_items]
+            if having_e is not None:
+                having_e = _substitute(having_e, sub)
+                plan = Filter(plan, having_e)
+        else:
+            out_items = []
+            for i, item in enumerate(sel.items):
+                if isinstance(item.expr, A.Star):
+                    s = output_schema(plan)
+                    for f in s.fields:
+                        short = f.name.split(".", 1)[1] if "." in f.name else f.name
+                        out_items.append((short, E.ColRef(f.name)))
+                        alias_map[short] = E.ColRef(f.name)
+                    continue
+                e = r.expr(item.expr)
+                name = item.alias or _default_name(item.expr, i)
+                out_items.append((name, e))
+                alias_map[name] = e
+
+        # ---- ORDER BY (resolves select aliases, then input columns) ---
+        if agg_order_keys is not None:
+            order_keys = [
+                (_substitute_out(e, out_items), d) for e, d in agg_order_keys
+            ]
+        else:
+            order_keys = []
+            for oi in sel.order_by:
+                if (
+                    isinstance(oi.expr, A.Name)
+                    and len(oi.expr.parts) == 1
+                    and oi.expr.parts[0] in alias_map
+                ):
+                    oe = E.ColRef(oi.expr.parts[0])
+                elif isinstance(oi.expr, A.NumberLit):
+                    oe = E.ColRef(out_items[int(oi.expr.value) - 1][0])
+                else:
+                    oe = r.expr(oi.expr)
+                    matched = [n for n, e in out_items if e == oe]
+                    oe = E.ColRef(matched[0]) if matched else oe
+                order_keys.append((oe, oi.descending))
+
+        # order-by exprs not expressible over the projected outputs ride as
+        # hidden projection columns (dropped from the visible result)
+        visible = tuple(n for n, _ in out_items)
+        fixed_order = []
+        for i, (oe, d) in enumerate(order_keys):
+            if isinstance(oe, E.ColRef) and any(n == oe.name for n, _ in out_items):
+                fixed_order.append((oe, d))
+            else:
+                if sel.distinct:
+                    # a hidden sort column would become part of the DISTINCT
+                    # key and silently un-dedupe rows (SQL standard requires
+                    # ORDER BY items to appear in the DISTINCT select list)
+                    raise ResolveError(
+                        "ORDER BY expression must appear in the select list "
+                        "of a SELECT DISTINCT"
+                    )
+                hidden = f"$ord{i}"
+                out_items.append((hidden, oe))
+                fixed_order.append((E.ColRef(hidden), d))
+        order_keys = fixed_order
+
+        plan = Project(plan, tuple(out_items))
+        if sel.distinct:
+            plan = Distinct(plan)
+        if order_keys:
+            plan = Sort(plan, tuple(order_keys))
+        if sel.limit is not None:
+            plan = Limit(plan, sel.limit, sel.offset or 0)
+
+        return PlannedQuery(plan, visible)
+
+    def _order_joins(
+        self,
+        scans: list[Scan],
+        equi: list[tuple[E.ColRef, E.ColRef]],
+        residual: list[E.Expr],
+    ) -> LogicalOp:
+        if not scans:
+            raise ResolveError("SELECT without FROM is not supported")
+        if len(scans) == 1:
+            plan: LogicalOp = scans[0]
+            return plan
+        remaining = {s.alias: s for s in scans}
+        sizes = {s.alias: self._scan_rows(s) for s in scans}
+        # start from the largest table (the fact side stays the probe side)
+        start = max(sizes, key=lambda a: sizes[a])
+        joined = {start}
+        plan = remaining.pop(start)
+        pending_equi = list(equi)
+        while remaining:
+            # candidate tables connected to the joined set
+            best = None
+            for alias, s in remaining.items():
+                keys = [
+                    (l, r_)
+                    for l, r_ in pending_equi
+                    if (
+                        l.name.split(".")[0] in joined
+                        and r_.name.split(".")[0] == alias
+                    )
+                    or (
+                        r_.name.split(".")[0] in joined
+                        and l.name.split(".")[0] == alias
+                    )
+                ]
+                if not keys:
+                    continue
+                if best is None or sizes[alias] < sizes[best[0]]:
+                    best = (alias, keys)
+            if best is None:
+                # cross join fallback: smallest remaining
+                alias = min(remaining, key=lambda a: sizes[a])
+                plan = JoinOp("cross", plan, remaining.pop(alias))
+                joined.add(alias)
+                continue
+            alias, keys = best
+            lkeys, rkeys = [], []
+            for l, r_ in keys:
+                if l.name.split(".")[0] == alias:
+                    l, r_ = r_, l
+                lkeys.append(l)
+                rkeys.append(r_)
+                pending_equi.remove(
+                    (l, r_) if (l, r_) in pending_equi else (r_, l)
+                )
+            plan = JoinOp(
+                "inner",
+                plan,
+                remaining.pop(alias),
+                tuple(lkeys),
+                tuple(rkeys),
+            )
+            joined.add(alias)
+        # leftover equi conds (cycles) + residuals become filters on top
+        leftover = [E.Compare("=", l, r_) for l, r_ in pending_equi] + residual
+        for c in leftover:
+            plan = Filter(plan, c)
+        return plan
+
+
+def _select_has_agg(sel: A.Select) -> bool:
+    def walk(n) -> bool:
+        if isinstance(n, A.FuncCall) and n.name in (
+            "sum", "count", "min", "max", "avg",
+        ):
+            return True
+        for attr in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, attr)
+            if isinstance(v, A.Node) and walk(v):
+                return True
+            if isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, A.Node) and walk(x):
+                        return True
+                    if (
+                        isinstance(x, tuple)
+                        and any(isinstance(y, A.Node) and walk(y) for y in x)
+                    ):
+                        return True
+        return False
+
+    return any(walk(i.expr) for i in sel.items)
+
+
+def _substitute_out(e: E.Expr, out_items: list[tuple[str, E.Expr]]) -> E.Expr:
+    """Rewrite an agg-schema expr into projection-output space where an
+    identical expression is already projected."""
+    for n, oe in out_items:
+        if e == oe:
+            return E.ColRef(n)
+    return e
+
+
+def _default_name(node: A.Node, i: int) -> str:
+    if isinstance(node, A.Name):
+        return node.parts[-1]
+    return f"$col{i}"
+
+
+def _substitute(e: E.Expr, sub: dict[E.Expr, E.Expr]) -> E.Expr:
+    if e in sub:
+        return sub[e]
+    if isinstance(e, E.BinaryOp):
+        return E.BinaryOp(e.op, _substitute(e.left, sub), _substitute(e.right, sub))
+    if isinstance(e, E.Compare):
+        return E.Compare(e.op, _substitute(e.left, sub), _substitute(e.right, sub))
+    if isinstance(e, E.BoolOp):
+        return E.BoolOp(e.op, tuple(_substitute(a, sub) for a in e.args))
+    if isinstance(e, E.Not):
+        return E.Not(_substitute(e.arg, sub))
+    if isinstance(e, E.Cast):
+        return E.Cast(_substitute(e.arg, sub), e.dtype)
+    if isinstance(e, E.Case):
+        return E.Case(
+            tuple((_substitute(c, sub), _substitute(v, sub)) for c, v in e.whens),
+            _substitute(e.default, sub) if e.default is not None else None,
+        )
+    if isinstance(e, E.Func):
+        return E.Func(e.name, tuple(_substitute(a, sub) for a in e.args))
+    if isinstance(e, E.Between):
+        return E.Between(
+            _substitute(e.arg, sub),
+            _substitute(e.low, sub),
+            _substitute(e.high, sub),
+            e.negated,
+        )
+    if isinstance(e, E.InList):
+        return E.InList(_substitute(e.arg, sub), e.values, e.negated)
+    if isinstance(e, E.IsNull):
+        return E.IsNull(_substitute(e.arg, sub), e.negated)
+    return e

@@ -1,0 +1,238 @@
+"""SQL frontend end-to-end: parse -> plan -> execute TPC-H queries, checked
+against independent numpy oracles over the generated tables."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.engine import Session
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import QUERIES, SUPPORTED, UNIQUE_KEYS
+
+
+@pytest.fixture(scope="module")
+def db():
+    tables = datagen.generate(sf=0.01)
+    return tables, Session(tables, unique_keys=UNIQUE_KEYS)
+
+
+def _dec(t, col):
+    return t.data[col].astype(np.float64) / 100
+
+
+def test_parse_all_supported():
+    from oceanbase_tpu.sql.parser import parse
+
+    for q in SUPPORTED:
+        parse(QUERIES[q])
+
+
+def test_q6_sql(db):
+    tables, sess = db
+    rs = sess.sql(QUERIES[6])
+    li = tables["lineitem"]
+    d = li.data
+    d0 = int(np.datetime64("1994-01-01", "D").astype(int))
+    d1 = int(np.datetime64("1995-01-01", "D").astype(int))
+    m = (
+        (d["l_shipdate"] >= d0) & (d["l_shipdate"] < d1)
+        & (d["l_discount"] >= 5) & (d["l_discount"] <= 7)
+        & (d["l_quantity"] < 2400)
+    )
+    want = np.sum(_dec(li, "l_extendedprice")[m] * _dec(li, "l_discount")[m])
+    assert rs.nrows == 1
+    assert rs.columns["revenue"][0] == pytest.approx(want, rel=1e-9)
+
+
+def test_q1_sql(db):
+    tables, sess = db
+    rs = sess.sql(QUERIES[1])
+    li = tables["lineitem"]
+    d = li.data
+    cutoff = int(np.datetime64("1998-09-02", "D").astype(int))
+    m = d["l_shipdate"] <= cutoff
+    rf = np.asarray(li.dicts["l_returnflag"].decode(d["l_returnflag"]), dtype=object)
+    ls = np.asarray(li.dicts["l_linestatus"].decode(d["l_linestatus"]), dtype=object)
+    assert rs.nrows == 4
+    for i in range(rs.nrows):
+        g = m & (rf == rs.columns["l_returnflag"][i]) & (ls == rs.columns["l_linestatus"][i])
+        assert rs.columns["count_order"][i] == g.sum()
+        assert rs.columns["sum_qty"][i] == pytest.approx(_dec(li, "l_quantity")[g].sum())
+        assert rs.columns["avg_disc"][i] == pytest.approx(
+            _dec(li, "l_discount")[g].mean(), rel=1e-9
+        )
+        dp = _dec(li, "l_extendedprice")[g] * (1 - _dec(li, "l_discount")[g])
+        assert rs.columns["sum_disc_price"][i] == pytest.approx(dp.sum(), rel=1e-9)
+        ch = dp * (1 + _dec(li, "l_tax")[g])
+        assert rs.columns["sum_charge"][i] == pytest.approx(ch.sum(), rel=1e-6)
+    # ordering
+    keys = list(zip(rs.columns["l_returnflag"], rs.columns["l_linestatus"]))
+    assert keys == sorted(keys)
+
+
+def test_q3_sql(db):
+    tables, sess = db
+    rs = sess.sql(QUERIES[3])
+    li, od, cu = tables["lineitem"], tables["orders"], tables["customer"]
+    cut = int(np.datetime64("1995-03-15", "D").astype(int))
+    seg = np.asarray(cu.dicts["c_mktsegment"].decode(cu.data["c_mktsegment"]), dtype=object)
+    cust_ok = set(cu.data["c_custkey"][seg == "BUILDING"].tolist())
+    om = (od.data["o_orderdate"] < cut) & np.fromiter(
+        (int(c) in cust_ok for c in od.data["o_custkey"]), bool, od.nrows
+    )
+    ord_info = {
+        int(k): (int(dt), int(sp))
+        for k, dt, sp in zip(
+            od.data["o_orderkey"][om],
+            od.data["o_orderdate"][om],
+            od.data["o_shippriority"][om],
+        )
+    }
+    lm = li.data["l_shipdate"] > cut
+    rev = {}
+    for k, price, disc, keep in zip(
+        li.data["l_orderkey"], _dec(li, "l_extendedprice"), _dec(li, "l_discount"), lm
+    ):
+        if keep and int(k) in ord_info:
+            rev[int(k)] = rev.get(int(k), 0.0) + price * (1 - disc)
+    want = sorted(
+        ((v, ord_info[k][0], k) for k, v in rev.items()),
+        key=lambda t: (-t[0], t[1]),
+    )[:10]
+    got = list(
+        zip(rs.columns["revenue"], rs.columns["o_orderdate"], rs.columns["l_orderkey"])
+    )
+    assert len(got) == len(want)
+    for (gv, gd, gk), (wv, wd, wk) in zip(got, want):
+        assert gv == pytest.approx(wv, rel=1e-9)
+        assert int(gd) == wd
+
+
+def test_q12_sql(db):
+    tables, sess = db
+    rs = sess.sql(QUERIES[12])
+    li, od = tables["lineitem"], tables["orders"]
+    d = li.data
+    d0 = int(np.datetime64("1994-01-01", "D").astype(int))
+    d1 = int(np.datetime64("1995-01-01", "D").astype(int))
+    mode = np.asarray(li.dicts["l_shipmode"].decode(d["l_shipmode"]), dtype=object)
+    m = (
+        np.isin(mode, ["MAIL", "SHIP"])
+        & (d["l_commitdate"] < d["l_receiptdate"])
+        & (d["l_shipdate"] < d["l_commitdate"])
+        & (d["l_receiptdate"] >= d0)
+        & (d["l_receiptdate"] < d1)
+    )
+    prio = np.asarray(od.dicts["o_orderpriority"].decode(od.data["o_orderpriority"]), dtype=object)
+    prio_of = dict(zip(od.data["o_orderkey"].tolist(), prio))
+    want = {}
+    for k, mo in zip(d["l_orderkey"][m], mode[m]):
+        p = prio_of[int(k)]
+        hi, lo = want.get(mo, (0, 0))
+        if p in ("1-URGENT", "2-HIGH"):
+            hi += 1
+        else:
+            lo += 1
+        want[mo] = (hi, lo)
+    assert rs.nrows == len(want)
+    for i in range(rs.nrows):
+        mo = rs.columns["l_shipmode"][i]
+        assert (
+            rs.columns["high_line_count"][i],
+            rs.columns["low_line_count"][i],
+        ) == want[mo]
+
+
+def test_q14_sql(db):
+    tables, sess = db
+    rs = sess.sql(QUERIES[14])
+    li, pa = tables["lineitem"], tables["part"]
+    d = li.data
+    d0 = int(np.datetime64("1995-09-01", "D").astype(int))
+    d1 = int(np.datetime64("1995-10-01", "D").astype(int))
+    m = (d["l_shipdate"] >= d0) & (d["l_shipdate"] < d1)
+    ptype = np.asarray(pa.dicts["p_type"].decode(pa.data["p_type"]), dtype=object)
+    promo_of = dict(
+        zip(pa.data["p_partkey"].tolist(), [t.startswith("PROMO") for t in ptype])
+    )
+    dp = _dec(li, "l_extendedprice") * (1 - _dec(li, "l_discount"))
+    num = den = 0.0
+    for k, v, keep in zip(d["l_partkey"], dp, m):
+        if keep:
+            den += v
+            if promo_of[int(k)]:
+                num += v
+    want = 100.0 * num / den
+    assert rs.columns["promo_revenue"][0] == pytest.approx(want, rel=1e-6)
+
+
+def test_q5_q10_q19_run(db):
+    tables, sess = db
+    r5 = sess.sql(QUERIES[5])
+    assert r5.nrows >= 1 and list(r5.columns["revenue"]) == sorted(
+        r5.columns["revenue"], reverse=True
+    )
+    r10 = sess.sql(QUERIES[10])
+    assert r10.nrows == 20
+    r19 = sess.sql(QUERIES[19])
+    assert r19.nrows == 1
+    # Q19 oracle
+    li, pa = tables["lineitem"], tables["part"]
+    d = li.data
+    brand = np.asarray(pa.dicts["p_brand"].decode(pa.data["p_brand"]), dtype=object)
+    cont = np.asarray(pa.dicts["p_container"].decode(pa.data["p_container"]), dtype=object)
+    size = pa.data["p_size"]
+    pk = pa.data["p_partkey"]
+    part_row = {int(k): i for i, k in enumerate(pk)}
+    mode = np.asarray(li.dicts["l_shipmode"].decode(d["l_shipmode"]), dtype=object)
+    inst = np.asarray(
+        li.dicts["l_shipinstruct"].decode(d["l_shipinstruct"]), dtype=object
+    )
+    qty = _dec(li, "l_quantity")
+    dp = _dec(li, "l_extendedprice") * (1 - _dec(li, "l_discount"))
+    total = 0.0
+    groups = [
+        ("Brand#12", {"SM CASE", "SM BOX", "SM PACK", "SM PKG"}, 1, 11, 1, 5),
+        ("Brand#23", {"MED BAG", "MED BOX", "MED PKG", "MED PACK"}, 10, 20, 1, 10),
+        ("Brand#34", {"LG CASE", "LG BOX", "LG PACK", "LG PKG"}, 20, 30, 1, 15),
+    ]
+    for i in range(li.nrows):
+        if mode[i] not in ("AIR", "AIR REG") or inst[i] != "DELIVER IN PERSON":
+            continue
+        j = part_row[int(d["l_partkey"][i])]
+        for b, cs, q0, q1, s0, s1 in groups:
+            if (
+                brand[j] == b and cont[j] in cs
+                and q0 <= qty[i] <= q1 and s0 <= size[j] <= s1
+            ):
+                total += dp[i]
+    assert r19.columns["revenue"][0] == pytest.approx(total, rel=1e-9)
+
+
+def test_count_col_and_avg_skip_nulls():
+    """COUNT(col)/AVG(col) must skip NULLs (SQL semantics)."""
+    import numpy as np
+
+    from oceanbase_tpu.core import DataType, Schema, Table
+    from oceanbase_tpu.core.dtypes import Field
+
+    schema = Schema(
+        fields=(
+            Field("k", DataType.int32()),
+            Field("x", DataType.int32(nullable=True)),
+        )
+    )
+    t = Table("t", schema, {
+        "k": np.array([1, 1, 2, 2], np.int32),
+        "x": np.array([10, 20, 30, 40], np.int32),
+    })
+    t.valid["x"] = np.array([True, False, True, True])
+    sess = Session({"t": t})
+    rs = sess.sql(
+        "select k, count(*) as c_star, count(x) as c_x, avg(x) as a, sum(x) as s "
+        "from t group by k order by k"
+    )
+    assert list(rs.columns["c_star"]) == [2, 2]
+    assert list(rs.columns["c_x"]) == [1, 2]
+    assert list(rs.columns["s"]) == [10, 70]
+    assert rs.columns["a"][0] == pytest.approx(10.0)
+    assert rs.columns["a"][1] == pytest.approx(35.0)
