@@ -15,6 +15,19 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# A sitecustomize hook may have force-registered an accelerator backend at
+# interpreter startup, overriding JAX_PLATFORMS. jax.config overrides a
+# *registered* backend, but is a silent no-op once a backend is
+# *initialized* — assert so tests fail loudly instead of running on a
+# 1-device accelerator mesh.
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
+    f"test env needs 8 virtual CPU devices, got {jax.devices()}; a backend "
+    "was initialized before conftest ran"
+)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
