@@ -21,14 +21,14 @@ SHARD_AXIS = "shard"
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
-        if n_devices is not None:
-            if len(devices) < n_devices:
-                raise ValueError(
-                    f"mesh needs {n_devices} devices but the platform "
-                    f"'{devices[0].platform}' exposes only {len(devices)}; "
-                    "silently shrinking would break exchange capacity math"
-                )
-            devices = devices[:n_devices]
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"mesh needs {n_devices} devices but only {len(devices)} "
+                "are available; silently shrinking would break exchange "
+                "capacity math"
+            )
+        devices = devices[:n_devices]
     return Mesh(np.array(devices), (SHARD_AXIS,))
 
 

@@ -23,10 +23,13 @@ import jax  # noqa: E402
 # *initialized* — assert so tests fail loudly instead of running on a
 # 1-device accelerator mesh.
 jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
-    f"test env needs 8 virtual CPU devices, got {jax.devices()}; a backend "
-    "was initialized before conftest ran"
-)
+if not (jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8):
+    # Not a bare assert: that would be compiled out under python -O and
+    # silently run tests on a 1-device accelerator mesh.
+    raise RuntimeError(
+        f"test env needs 8 virtual CPU devices, got {jax.devices()}; a "
+        "backend was initialized before conftest ran"
+    )
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
