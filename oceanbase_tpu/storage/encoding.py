@@ -1,0 +1,254 @@
+"""Per-column micro-block encodings (host side).
+
+Reference surface: storage/blocksstable/encoding + cs_encoding — per-column
+lightweight encodings chosen per micro block (raw/dict/RLE/const/delta...)
+with SIMD decoders. This rebuild keeps four byte-aligned encodings — RAW,
+CONST, FOR (frame-of-reference at byte width), RLE — chosen by a one-pass
+cost model, implemented twice with an identical wire format:
+
+  * native C++ (oceanbase_tpu/native/codec.cpp), used when a toolchain is
+    available — the decode loop is a widening add that autovectorizes;
+  * numpy (this file), always available.
+
+Floats are stored RAW (or CONST); integers/dates/dict-codes/decimals go
+through the integer encodings. Validity (null) bitmaps are packed little-
+endian with np.packbits(bitorder="little").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..native import load as load_native
+
+ENC_RAW = 0
+ENC_CONST = 1
+ENC_FOR = 2
+ENC_RLE = 3
+
+_INT_DTYPES = {
+    np.dtype(np.int8): "int8_t",
+    np.dtype(np.int16): "int16_t",
+    np.dtype(np.int32): "int32_t",
+    np.dtype(np.int64): "int64_t",
+}
+
+
+def _lib():
+    lib = load_native("codec")
+    if lib is not None and not getattr(lib, "_ob_configured", False):
+        lib.ob_crc32.restype = ctypes.c_uint32
+        lib.ob_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+        for cname in _INT_DTYPES.values():
+            fe = getattr(lib, f"ob_for_encode_{cname}")
+            fe.restype = ctypes.c_int64
+            fe.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_int, ctypes.c_void_p, ctypes.c_int64]
+            fd = getattr(lib, f"ob_for_decode_{cname}")
+            fd.restype = ctypes.c_int64
+            fd.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_int, ctypes.c_void_p]
+            re_ = getattr(lib, f"ob_rle_encode_{cname}")
+            re_.restype = ctypes.c_int64
+            re_.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                            ctypes.c_int64]
+            rd = getattr(lib, f"ob_rle_decode_{cname}")
+            rd.restype = ctypes.c_int64
+            rd.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                           ctypes.c_int64]
+        lib.ob_analyze_i64.restype = None
+        lib.ob_analyze_i64.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_void_p]
+        lib._ob_configured = True
+    return lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def crc32(data: bytes | np.ndarray, seed: int = 0) -> int:
+    b = data.tobytes() if isinstance(data, np.ndarray) else data
+    return zlib.crc32(b, seed) & 0xFFFFFFFF
+
+
+@dataclass(frozen=True)
+class ColumnStats:
+    vmin: int
+    vmax: int
+    nruns: int
+
+
+def analyze_ints(a: np.ndarray) -> ColumnStats:
+    """min/max/run-count in one pass (cost model input + zone map)."""
+    if len(a) == 0:
+        return ColumnStats(0, 0, 0)
+    lib = _lib()
+    if lib is not None and a.dtype == np.int64 and a.flags.c_contiguous:
+        mn = ctypes.c_int64()
+        mx = ctypes.c_int64()
+        runs = ctypes.c_int64()
+        lib.ob_analyze_i64(_ptr(a), len(a), ctypes.byref(mn), ctypes.byref(mx),
+                           ctypes.byref(runs))
+        return ColumnStats(mn.value, mx.value, runs.value)
+    vmin = int(a.min())
+    vmax = int(a.max())
+    nruns = int(1 + np.count_nonzero(a[1:] != a[:-1])) if len(a) > 1 else 1
+    return ColumnStats(vmin, vmax, nruns)
+
+
+def _for_width(span: int) -> int:
+    if span < (1 << 8):
+        return 1
+    if span < (1 << 16):
+        return 2
+    if span < (1 << 32):
+        return 4
+    return 8
+
+
+def choose_encoding(a: np.ndarray, stats: ColumnStats) -> tuple[int, dict]:
+    """Pick the cheapest encoding; returns (enc, params)."""
+    n = len(a)
+    if not np.issubdtype(a.dtype, np.integer):
+        if n and bool(np.all(a == a.flat[0])):
+            return ENC_CONST, {}
+        return ENC_RAW, {}
+    if n == 0:
+        return ENC_RAW, {}
+    if stats.vmin == stats.vmax:
+        return ENC_CONST, {}
+    span = stats.vmax - stats.vmin
+    width = _for_width(span)
+    for_bytes = n * width
+    rle_bytes = 4 + stats.nruns * (4 + a.dtype.itemsize)
+    raw_bytes = n * a.dtype.itemsize
+    best = min(for_bytes, rle_bytes, raw_bytes)
+    if best == rle_bytes:
+        return ENC_RLE, {}
+    if best == for_bytes and for_bytes < raw_bytes:
+        return ENC_FOR, {"min": stats.vmin, "width": width}
+    return ENC_RAW, {}
+
+
+# ------------------------------------------------------------- encoders
+
+def encode_column(a: np.ndarray, enc: int, params: dict) -> bytes:
+    a = np.ascontiguousarray(a)
+    if enc == ENC_RAW:
+        return a.tobytes()
+    if enc == ENC_CONST:
+        return a[:1].tobytes()
+    if enc == ENC_FOR:
+        return _for_encode(a, params["min"], params["width"])
+    if enc == ENC_RLE:
+        return _rle_encode(a)
+    raise ValueError(f"unknown encoding {enc}")
+
+
+def decode_column(buf: memoryview | bytes, enc: int, params: dict,
+                  dtype: np.dtype, n: int) -> np.ndarray:
+    if enc == ENC_RAW:
+        return np.frombuffer(buf, dtype=dtype, count=n).copy()
+    if enc == ENC_CONST:
+        v = np.frombuffer(buf, dtype=dtype, count=1)
+        return np.full(n, v[0], dtype=dtype)
+    if enc == ENC_FOR:
+        return _for_decode(buf, params["min"], params["width"], dtype, n)
+    if enc == ENC_RLE:
+        return _rle_decode(buf, dtype, n)
+    raise ValueError(f"unknown encoding {enc}")
+
+
+def _for_encode(a: np.ndarray, vmin: int, width: int) -> bytes:
+    lib = _lib()
+    cname = _INT_DTYPES.get(a.dtype)
+    out = np.empty(len(a) * width, dtype=np.uint8)
+    if lib is not None and cname is not None:
+        wrote = getattr(lib, f"ob_for_encode_{cname}")(
+            _ptr(a), len(a), vmin, width, _ptr(out), len(out))
+        if wrote != len(out):
+            raise RuntimeError(f"native FOR encode failed: {wrote}")
+        return out.tobytes()
+    udt = np.dtype(f"u{width}")
+    deltas = (a.astype(np.int64) - vmin).astype(udt)
+    return deltas.tobytes()
+
+
+def _for_decode(buf, vmin: int, width: int, dtype: np.dtype, n: int) -> np.ndarray:
+    lib = _lib()
+    cname = _INT_DTYPES.get(np.dtype(dtype))
+    if lib is not None and cname is not None:
+        src = np.frombuffer(buf, dtype=np.uint8, count=n * width)
+        out = np.empty(n, dtype=dtype)
+        got = getattr(lib, f"ob_for_decode_{cname}")(
+            _ptr(np.ascontiguousarray(src)), n, vmin, width, _ptr(out))
+        if got != n:
+            raise RuntimeError(f"native FOR decode failed: {got}")
+        return out
+    udt = np.dtype(f"u{width}")
+    deltas = np.frombuffer(buf, dtype=udt, count=n).astype(np.int64)
+    return (deltas + vmin).astype(dtype)
+
+
+def _rle_encode(a: np.ndarray) -> bytes:
+    lib = _lib()
+    cname = _INT_DTYPES.get(a.dtype)
+    if lib is not None and cname is not None:
+        cap = 4 + len(a) * (4 + a.dtype.itemsize) + 16
+        out = np.empty(cap, dtype=np.uint8)
+        wrote = getattr(lib, f"ob_rle_encode_{cname}")(_ptr(a), len(a),
+                                                       _ptr(out), cap)
+        if wrote < 0:
+            raise RuntimeError(f"native RLE encode failed: {wrote}")
+        return out[:wrote].tobytes()
+    # numpy: vectorized run detection
+    if len(a) == 0:
+        return np.uint32(0).tobytes()
+    starts = np.flatnonzero(np.concatenate(([True], a[1:] != a[:-1])))
+    lens = np.diff(np.concatenate((starts, [len(a)]))).astype(np.uint32)
+    vals = a[starts]
+    nruns = np.uint32(len(starts))
+    # interleave {u32 len, value} pairs
+    pair = np.dtype([("len", np.uint32), ("val", a.dtype)], align=False)
+    runs = np.empty(len(starts), dtype=pair)
+    runs["len"] = lens
+    runs["val"] = vals
+    return nruns.tobytes() + runs.tobytes()
+
+
+def _rle_decode(buf, dtype: np.dtype, n: int) -> np.ndarray:
+    lib = _lib()
+    dtype = np.dtype(dtype)
+    cname = _INT_DTYPES.get(dtype)
+    raw = np.frombuffer(buf, dtype=np.uint8)
+    if lib is not None and cname is not None:
+        out = np.empty(n, dtype=dtype)
+        got = getattr(lib, f"ob_rle_decode_{cname}")(
+            _ptr(np.ascontiguousarray(raw)), len(raw), _ptr(out), n)
+        if got != n:
+            raise RuntimeError(f"native RLE decode failed: {got} != {n}")
+        return out
+    nruns = int(np.frombuffer(raw, dtype=np.uint32, count=1)[0])
+    pair = np.dtype([("len", np.uint32), ("val", dtype)], align=False)
+    runs = np.frombuffer(raw, dtype=pair, count=nruns, offset=4)
+    out = np.repeat(runs["val"], runs["len"].astype(np.int64))
+    if len(out) != n:
+        raise ValueError(f"RLE decoded {len(out)} rows, expected {n}")
+    return out
+
+
+# ----------------------------------------------------- validity bitmaps
+
+def pack_validity(valid: np.ndarray) -> bytes:
+    return np.packbits(valid.astype(np.bool_), bitorder="little").tobytes()
+
+
+def unpack_validity(buf, n: int) -> np.ndarray:
+    bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")
+    return bits[:n].astype(np.bool_)

@@ -1,0 +1,348 @@
+"""Storage engine tests: codecs, blocks, sstables, MVCC, merge, compaction.
+
+Mirrors the reference's tier-1 strategy (SURVEY.md §4): pure-kernel unit
+tests with generated data, plus property-style roundtrips. Codec tests run
+against BOTH implementations (native C++ and numpy) to pin the shared wire
+format.
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core.dtypes import DataType, Schema
+from oceanbase_tpu.storage import (
+    Memtable,
+    OP_DELETE,
+    OP_PUT,
+    SSTable,
+    Tablet,
+    WriteConflict,
+    freeze_to_mini,
+    major_compact,
+    minor_compact,
+    scan_merge,
+    write_sstable,
+)
+from oceanbase_tpu.storage import encoding as enc
+from oceanbase_tpu.storage.microblock import BlockReader, write_block
+
+
+SCHEMA = Schema.of(
+    k=DataType.int64(),
+    a=DataType.int32(),
+    b=DataType.float64(),
+)
+
+
+def _toggle_native(monkeypatch, native: bool):
+    if not native:
+        monkeypatch.setenv("OCEANBASE_TPU_NO_NATIVE", "1")
+
+
+@pytest.fixture(params=["native", "numpy"])
+def codec_mode(request, monkeypatch):
+    _toggle_native(monkeypatch, request.param == "native")
+    if request.param == "native":
+        from oceanbase_tpu.native import load
+
+        if load("codec") is None:
+            pytest.skip("no native toolchain")
+    return request.param
+
+
+INT_DTYPES = [np.int8, np.int16, np.int32, np.int64]
+
+
+class TestCodecs:
+    @pytest.mark.parametrize("dt", INT_DTYPES)
+    def test_for_roundtrip(self, codec_mode, dt, rng):
+        info = np.iinfo(dt)
+        a = rng.integers(info.min // 2, info.max // 2, 1000).astype(dt)
+        stats = enc.analyze_ints(a)
+        span = stats.vmax - stats.vmin
+        width = enc._for_width(span)
+        buf = enc.encode_column(a, enc.ENC_FOR, {"min": stats.vmin, "width": width})
+        back = enc.decode_column(buf, enc.ENC_FOR, {"min": stats.vmin, "width": width}, np.dtype(dt), len(a))
+        np.testing.assert_array_equal(back, a)
+
+    @pytest.mark.parametrize("dt", INT_DTYPES)
+    def test_rle_roundtrip(self, codec_mode, dt, rng):
+        a = np.repeat(rng.integers(-5, 5, 50), rng.integers(1, 30, 50)).astype(dt)
+        buf = enc.encode_column(a, enc.ENC_RLE, {})
+        back = enc.decode_column(buf, enc.ENC_RLE, {}, np.dtype(dt), len(a))
+        np.testing.assert_array_equal(back, a)
+
+    def test_native_numpy_same_bytes(self, rng, monkeypatch):
+        """The two implementations must produce IDENTICAL bytes."""
+        from oceanbase_tpu.native import load
+
+        if load("codec") is None:
+            pytest.skip("no native toolchain")
+        a = rng.integers(-(10**6), 10**6, 4096).astype(np.int64)
+        r = np.repeat(rng.integers(0, 4, 64), 64).astype(np.int32)
+        stats = enc.analyze_ints(a)
+        w = enc._for_width(stats.vmax - stats.vmin)
+        native_for = enc.encode_column(a, enc.ENC_FOR, {"min": stats.vmin, "width": w})
+        native_rle = enc.encode_column(r, enc.ENC_RLE, {})
+        monkeypatch.setenv("OCEANBASE_TPU_NO_NATIVE", "1")
+        np_for = enc.encode_column(a, enc.ENC_FOR, {"min": stats.vmin, "width": w})
+        np_rle = enc.encode_column(r, enc.ENC_RLE, {})
+        assert native_for == np_for
+        assert native_rle == np_rle
+
+    def test_choose_encoding(self, rng):
+        n = 1000
+        const = np.full(n, 7, np.int64)
+        assert enc.choose_encoding(const, enc.analyze_ints(const))[0] == enc.ENC_CONST
+        runs = np.repeat([1, 2, 3], [400, 300, 300]).astype(np.int64)
+        assert enc.choose_encoding(runs, enc.analyze_ints(runs))[0] == enc.ENC_RLE
+        small_span = rng.integers(0, 200, n)
+        assert enc.choose_encoding(small_span, enc.analyze_ints(small_span))[0] == enc.ENC_FOR
+        f = rng.normal(size=n)
+        assert enc.choose_encoding(f, enc.ColumnStats(0, 0, 0))[0] == enc.ENC_RAW
+
+
+class TestMicroBlock:
+    def test_roundtrip_with_nulls(self, codec_mode, rng):
+        n = 500
+        cols = [
+            rng.integers(-1000, 1000, n).astype(np.int64),
+            rng.normal(size=n).astype(np.float64),
+            rng.integers(0, 3, n).astype(np.int8),
+        ]
+        valid = np.ones(n, dtype=bool)
+        valid[::7] = False
+        blob, zones = write_block(cols, [None, valid, None])
+        r = BlockReader.open(blob)
+        assert r.nrows == n and r.ncols == 3
+        for i, c in enumerate(cols):
+            vals, v = r.column(i)
+            np.testing.assert_array_equal(vals, c)
+            if i == 1:
+                np.testing.assert_array_equal(v, valid)
+            else:
+                assert v is None
+        assert zones[0].vmin == cols[0].min() and zones[0].vmax == cols[0].max()
+
+    def test_crc_detects_corruption(self, rng):
+        blob, _ = write_block([rng.integers(0, 10, 64).astype(np.int64)], [None])
+        bad = bytearray(blob)
+        bad[len(bad) // 2] ^= 0xFF
+        with pytest.raises(ValueError, match="crc"):
+            BlockReader.open(bytes(bad))
+
+
+def _make_sstable(rng, n=5000, block_rows=512):
+    keys = np.sort(rng.choice(10**6, n, replace=False)).astype(np.int64)
+    data = {
+        "k": keys,
+        "a": rng.integers(0, 100, n).astype(np.int32),
+        "b": rng.normal(size=n),
+    }
+    versions = np.full(n, 10, np.int64)
+    ops = np.zeros(n, np.int8)
+    blob = write_sstable(SCHEMA, ["k"], data, versions, ops,
+                         end_version=10, block_rows=block_rows)
+    return SSTable(blob, SCHEMA, ["k"]), data
+
+
+class TestSSTable:
+    def test_scan_roundtrip(self, codec_mode, rng):
+        st, data = _make_sstable(rng)
+        got = st.scan(["k", "a", "b"], with_hidden=False)
+        for c in data:
+            np.testing.assert_array_equal(got[c], data[c])
+
+    def test_zone_map_pruning(self, rng):
+        st, data = _make_sstable(rng, block_rows=256)
+        lo, hi = 100_000, 200_000
+        kept = st.prune_blocks({"k": (lo, hi)})
+        assert 0 < len(kept) < st.nblocks
+        got = st.read_blocks(kept, ["k"])
+        # pruning keeps every qualifying row (may keep extra boundary rows)
+        want = data["k"][(data["k"] >= lo) & (data["k"] <= hi)]
+        have = got["k"][(got["k"] >= lo) & (got["k"] <= hi)]
+        np.testing.assert_array_equal(have, want)
+
+    def test_bloom(self, rng):
+        st, data = _make_sstable(rng, n=2000)
+        present = data["k"][:100].reshape(-1, 1)
+        assert st.may_contain_keys(present).all()
+        absent = (data["k"][:500] + 10**7).reshape(-1, 1)
+        fp = st.may_contain_keys(absent).mean()
+        assert fp < 0.1  # ~1% expected at 10 bits/key
+
+
+class TestMemtable:
+    def _mt(self):
+        return Memtable(SCHEMA, ["k"])
+
+    def test_mvcc_visibility(self):
+        mt = self._mt()
+        mt.stage(tx_id=1, read_snapshot=0, key=(5,), op=OP_PUT, values=(5, 10, 1.5))
+        assert mt.get((5,), snapshot=100) is None  # uncommitted invisible
+        assert mt.get((5,), snapshot=0, tx_id=1) == (OP_PUT, (5, 10, 1.5))
+        mt.commit(1, commit_version=50)
+        assert mt.get((5,), snapshot=49) is None
+        assert mt.get((5,), snapshot=50) == (OP_PUT, (5, 10, 1.5))
+        mt.stage(tx_id=2, read_snapshot=60, key=(5,), op=OP_PUT, values=(5, 11, 2.5))
+        mt.commit(2, commit_version=70)
+        assert mt.get((5,), snapshot=60)[1][1] == 10
+        assert mt.get((5,), snapshot=70)[1][1] == 11
+
+    def test_write_write_conflict(self):
+        mt = self._mt()
+        mt.stage(1, 0, (7,), OP_PUT, (7, 1, 0.0))
+        with pytest.raises(WriteConflict, match="locked"):
+            mt.stage(2, 0, (7,), OP_PUT, (7, 2, 0.0))
+        mt.commit(1, 10)
+        with pytest.raises(WriteConflict, match="snapshot"):
+            mt.stage(3, 5, (7,), OP_PUT, (7, 3, 0.0))  # stale snapshot
+        mt.stage(3, 10, (7,), OP_PUT, (7, 3, 0.0))  # fresh snapshot ok
+
+    def test_abort_rolls_back(self):
+        mt = self._mt()
+        mt.stage(1, 0, (1,), OP_PUT, (1, 1, 0.0))
+        mt.abort(1)
+        assert mt.get((1,), 100) is None
+        assert mt.nkeys == 0
+
+    def test_dump_order(self):
+        mt = self._mt()
+        for i, k in enumerate([3, 1, 2]):
+            mt.stage(1, 0, (k,), OP_PUT, (k, i, 0.0))
+        mt.commit(1, 10)
+        mt.stage(2, 10, (1,), OP_DELETE, None)
+        mt.commit(2, 20)
+        mt.freeze()
+        data, vers, ops = mt.dump()
+        np.testing.assert_array_equal(data["k"], [1, 1, 2, 3])
+        np.testing.assert_array_equal(vers, [20, 10, 10, 10])
+        np.testing.assert_array_equal(ops, [OP_DELETE, OP_PUT, OP_PUT, OP_PUT])
+
+
+class TestScanMergeAndCompaction:
+    def _seed_tablet(self, rng):
+        t = Tablet(1, SCHEMA, ["k"])
+        n = 300
+        keys = rng.choice(1000, n, replace=False)
+        for k in keys:
+            t.stage(1, 0, (int(k),), OP_PUT, (int(k), int(k) % 97, float(k) * 0.5))
+        t.active.commit(1, 10)
+        return t, set(int(k) for k in keys)
+
+    def test_merge_updates_and_deletes(self, rng):
+        t, keys = self._seed_tablet(rng)
+        t.freeze()
+        t.dump_mini()
+        some = sorted(keys)[:50]
+        # updates in new memtable
+        for k in some[:25]:
+            t.stage(2, 10, (k,), OP_PUT, (k, 999, -1.0))
+        t.active.commit(2, 20)
+        for k in some[25:]:
+            t.stage(3, 20, (k,), OP_DELETE, None)
+        t.active.commit(3, 30)
+
+        got = t.scan(snapshot=30)
+        gk = set(got["k"].tolist())
+        assert gk == keys - set(some[25:])
+        upd = np.isin(got["k"], some[:25])
+        assert (got["a"][upd] == 999).all()
+        # old snapshot still sees original values
+        got10 = t.scan(snapshot=10)
+        assert set(got10["k"].tolist()) == keys
+        assert (got10["a"][np.isin(got10["k"], some[:25])] != 999).any() or len(some) == 0
+
+    def test_compaction_preserves_results(self, rng):
+        t, keys = self._seed_tablet(rng)
+        t.freeze()
+        t.dump_mini()
+        for k in sorted(keys)[:30]:
+            t.stage(2, 10, (k,), OP_PUT, (k, 500, 0.0))
+        t.active.commit(2, 20)
+        t.freeze()
+        t.dump_mini()
+        for k in sorted(keys)[30:60]:
+            t.stage(3, 20, (k,), OP_DELETE, None)
+        t.active.commit(3, 30)
+        t.freeze()
+        t.dump_mini()
+
+        before = t.scan(snapshot=30)
+        assert len(t.deltas) == 3
+        t.minor_compact()
+        assert len(t.deltas) == 1
+        mid = t.scan(snapshot=30)
+        np.testing.assert_array_equal(mid["k"], before["k"])
+        np.testing.assert_array_equal(mid["a"], before["a"])
+        t.major_compact(snapshot=30)
+        assert len(t.deltas) == 0 and t.base is not None
+        after = t.scan(snapshot=30)
+        np.testing.assert_array_equal(after["k"], before["k"])
+        np.testing.assert_array_equal(after["a"], before["a"])
+        np.testing.assert_array_equal(after["b"], before["b"])
+
+    def test_major_drops_tombstones_keeps_one_version(self, rng):
+        t, keys = self._seed_tablet(rng)
+        k0 = sorted(keys)[0]
+        t.stage(2, 10, (k0,), OP_DELETE, None)
+        t.active.commit(2, 20)
+        t.freeze()
+        t.dump_mini()
+        st = t.major_compact(snapshot=20)
+        assert st.nrows == len(keys) - 1
+
+    def test_point_get_sees_tombstone_across_sstables(self, rng):
+        """A tombstone in a NEWER sstable must hide the PUT in the base."""
+        t = Tablet(2, SCHEMA, ["k"])
+        t.stage(1, 0, (5,), OP_PUT, (5, 42, 1.0))
+        t.active.commit(1, 10)
+        t.freeze()
+        t.dump_mini()
+        t.major_compact(snapshot=10)
+        t.stage(2, 10, (5,), OP_DELETE, None)
+        t.active.commit(2, 20)
+        t.freeze()
+        t.dump_mini()
+        assert len(t.scan(snapshot=20)["k"]) == 0
+        assert t.get((5,), snapshot=20) is None
+        assert t.get((5,), snapshot=10) is not None
+
+    def test_empty_prune_keeps_dtypes(self, rng):
+        st, data = _make_sstable(rng, n=100, block_rows=64)
+        got = scan_merge(SCHEMA, ["k"], [st], [], snapshot=10,
+                         ranges={"k": (-100.0, -1.0)})
+        assert got["a"].dtype == np.int32
+        assert got["b"].dtype == np.float64
+        assert len(got["k"]) == 0
+
+    def test_key_range_pruning_multi_source(self, rng):
+        """Key-column ranges prune even with deltas present, and results
+        match the unpruned scan."""
+        t, keys = self._seed_tablet(rng)
+        t.freeze()
+        t.dump_mini()
+        for k in sorted(keys)[:20]:
+            t.stage(2, 10, (k,), OP_PUT, (k, 7, 0.0))
+        t.active.commit(2, 20)
+        t.freeze()
+        t.dump_mini()
+        lo, hi = 200.0, 600.0
+        got = t.scan(snapshot=20, ranges={"k": (lo, hi)})
+        full = t.scan(snapshot=20)
+        m = (full["k"] >= lo) & (full["k"] <= hi)
+        sub = {c: full[c][m] for c in full}
+        gm = (got["k"] >= lo) & (got["k"] <= hi)
+        np.testing.assert_array_equal(got["k"][gm], sub["k"])
+        np.testing.assert_array_equal(got["a"][gm], sub["a"])
+
+    def test_point_get_through_lsm(self, rng):
+        t, keys = self._seed_tablet(rng)
+        t.freeze()
+        t.dump_mini()
+        t.major_compact(snapshot=10)
+        k = sorted(keys)[5]
+        hit = t.get((k,), snapshot=10)
+        assert hit is not None and hit[1][0] == k
+        assert t.get((10**6 + 5,), snapshot=10) is None
