@@ -1,0 +1,33 @@
+"""Replicated log service (PALF-lite) + transports.
+
+Layer map (SURVEY.md §2.4 -> rebuild):
+  transport.py  message bus w/ virtual clock + fault injection (obrpc analog)
+  palf.py       leader-based replicated log: sliding window, majority commit,
+                lease election, log reconciliation
+"""
+
+from .palf import (
+    AppendAck,
+    AppendReq,
+    LogEntry,
+    PalfReplica,
+    Role,
+    VoteReq,
+    VoteResp,
+    leader_of,
+    run_until,
+)
+from .transport import LocalBus
+
+__all__ = [
+    "LocalBus",
+    "LogEntry",
+    "PalfReplica",
+    "Role",
+    "AppendReq",
+    "AppendAck",
+    "VoteReq",
+    "VoteResp",
+    "run_until",
+    "leader_of",
+]

@@ -1,0 +1,388 @@
+"""PALF-lite: leader-based replicated append-only log.
+
+Reference surface: logservice/palf — PalfHandleImpl::submit_log
+(palf_handle_impl.cpp:411) appends into a LogSlidingWindow
+(log_sliding_window.h:203) that groups entries, replicates via
+LogNetService push/ack, advances committed_end_lsn on majority ack, and
+hands committed logs to apply/replay services; roles come from LogStateMgr
+with lease-based election (palf/election). PALF is leader-based consensus
+with proposal-id-stamped logs — functionally raft-shaped — and the rebuild
+implements exactly that shape:
+
+  * dense LSNs; entries stamped with the leader's term (proposal id);
+  * a bounded sliding window of in-flight entries (group replication);
+  * majority ack -> commit_lsn advance -> apply callback (ordered);
+  * lease election: followers refuse votes while the leader lease is live
+    (prevents disruption); candidates need up-to-date logs to win;
+  * log reconciliation on divergence (conflicting suffix truncated).
+
+The state machine is pure event/tick driven — no threads, no wall clock —
+so consensus invariants are tested deterministically (tests/test_palf.py);
+a runtime wrapper drives it from real time in deployments.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .transport import LocalBus
+
+
+class Role(enum.Enum):
+    LEADER = "leader"
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    lsn: int
+    term: int
+    scn: int  # commit timestamp hint (monotonic per log)
+    payload: bytes
+
+
+# ---- messages -----------------------------------------------------------
+@dataclass(frozen=True)
+class AppendReq:
+    term: int
+    leader_id: int
+    prev_lsn: int
+    prev_term: int
+    entries: tuple[LogEntry, ...]
+    commit_lsn: int
+
+
+@dataclass(frozen=True)
+class AppendAck:
+    term: int
+    ack_lsn: int  # highest lsn the follower has matched, -1 on mismatch
+    success: bool
+
+
+@dataclass(frozen=True)
+class VoteReq:
+    term: int
+    candidate_id: int
+    last_lsn: int
+    last_term: int
+    # leadership transfer: bypass the lease check (sent only by a candidate
+    # that the old leader explicitly handed off to via TimeoutNow)
+    force: bool = False
+
+
+@dataclass(frozen=True)
+class TimeoutNow:
+    """Leader -> chosen successor: start an election immediately (the
+    leadership-transfer handshake; successor's log is already caught up)."""
+
+    term: int
+
+
+@dataclass(frozen=True)
+class VoteResp:
+    term: int
+    granted: bool
+
+
+HEARTBEAT_IVL = 0.05
+LEASE_TIMEOUT = 0.25
+ELECTION_JITTER = 0.05
+MAX_INFLIGHT = 1024  # sliding-window cap (entries per follower burst)
+
+
+@dataclass
+class PalfReplica:
+    """One replica of one log stream."""
+
+    node_id: int
+    peers: list[int]  # all member ids including self
+    bus: LocalBus
+    on_commit: Callable[[LogEntry], None] | None = None
+    role: Role = Role.FOLLOWER
+    term: int = 0
+    voted_for: int | None = None
+    log: list[LogEntry] = field(default_factory=list)
+    commit_lsn: int = -1
+    applied_lsn: int = -1
+    leader_id: int | None = None
+    lease_until: float = 0.0
+    next_election_at: float = 0.0
+    next_heartbeat_at: float = 0.0
+    _match_lsn: dict[int, int] = field(default_factory=dict)
+    _next_lsn: dict[int, int] = field(default_factory=dict)
+    _votes: set[int] = field(default_factory=set)
+    _scn: int = 0
+    _term_start_lsn: int = 0
+    _last_ack: dict[int, float] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.bus.register(self.node_id, self._on_message)
+        self.next_election_at = (
+            self.bus.now + LEASE_TIMEOUT + self._jitter()
+        )
+
+    # ------------------------------------------------------------ utils
+    def _jitter(self) -> float:
+        # deterministic per (node, term) spread so elections don't collide
+        return ELECTION_JITTER * (1 + ((self.node_id * 2654435761 + self.term) % 97) / 97)
+
+    def _majority(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    def _last(self) -> tuple[int, int]:
+        if not self.log:
+            return -1, 0
+        e = self.log[-1]
+        return e.lsn, e.term
+
+    def quorum_alive_hint(self) -> bool:
+        return self.role is Role.LEADER
+
+    # -------------------------------------------------------- public API
+    def submit_log(self, payload: bytes, scn: int | None = None) -> int | None:
+        """Leader appends; returns lsn or None if not leader (caller retries
+        at the real leader — the analog of OB_NOT_MASTER)."""
+        if self.role is not Role.LEADER:
+            return None
+        lsn = len(self.log)
+        self._scn = max(self._scn + 1, scn or 0)
+        self.log.append(LogEntry(lsn, self.term, self._scn, payload))
+        self._advance_commit()  # single-replica groups commit immediately
+        return lsn
+
+    def tick(self) -> None:
+        """Advance timers against the bus's virtual clock."""
+        now = self.bus.now
+        if self.role is Role.LEADER:
+            # leader lease self-check: without acks from a majority within
+            # the lease window, step down (the failure-detector demotion —
+            # a partitioned/dead-network leader must not keep serving)
+            alive = 1 + sum(
+                1 for p, t in self._last_ack.items() if now - t < LEASE_TIMEOUT
+            )
+            if alive < self._majority():
+                self._step_down(self.term, None)
+                return
+            if now >= self.next_heartbeat_at:
+                self._broadcast_appends()
+                self.next_heartbeat_at = now + HEARTBEAT_IVL
+        else:
+            lease_live = now < self.lease_until
+            if not lease_live and now >= self.next_election_at:
+                self._start_election()
+
+    # ---------------------------------------------------------- election
+    def _start_election(self, force: bool = False) -> None:
+        self.role = Role.CANDIDATE
+        self.term += 1
+        self.voted_for = self.node_id
+        self._votes = {self.node_id}
+        self.leader_id = None
+        last_lsn, last_term = self._last()
+        for p in self.peers:
+            if p != self.node_id:
+                self.bus.send(
+                    self.node_id, p,
+                    VoteReq(self.term, self.node_id, last_lsn, last_term, force),
+                )
+        self.next_election_at = self.bus.now + LEASE_TIMEOUT + self._jitter()
+        if len(self.peers) == 1:
+            self._become_leader()
+
+    def transfer_leader(self, target: int) -> bool:
+        """Hand leadership to `target` (must be caught up). Returns False if
+        not leader or target is behind — caller keeps driving and retries."""
+        if self.role is not Role.LEADER or target == self.node_id:
+            return False
+        if self._match_lsn.get(target, -1) != len(self.log) - 1:
+            self._send_append_to(target)  # catch it up first
+            return False
+        self.bus.send(self.node_id, target, TimeoutNow(self.term))
+        return True
+
+    def _become_leader(self) -> None:
+        self.role = Role.LEADER
+        self.leader_id = self.node_id
+        nxt = len(self.log)
+        self._next_lsn = {p: nxt for p in self.peers if p != self.node_id}
+        self._match_lsn = {p: -1 for p in self.peers if p != self.node_id}
+        self._last_ack = {p: self.bus.now for p in self.peers if p != self.node_id}
+        # A leader may only count replicas for entries of its own term
+        # (prior-term entries commit transitively), so append a no-op to
+        # unblock commitment of everything inherited from old leaders.
+        self._scn += 1
+        self._term_start_lsn = len(self.log)
+        self.log.append(LogEntry(len(self.log), self.term, self._scn, b""))
+        self._advance_commit()  # single-replica groups commit immediately
+        self.next_heartbeat_at = self.bus.now  # heartbeat immediately
+        self.tick()
+
+    @property
+    def is_ready_leader(self) -> bool:
+        """Leader that committed its own-term no-op AND applied everything —
+        only then are reads served (a fresh leader must finish replaying
+        inherited entries first; the reference's role-change protocol waits
+        the same way before the new leader goes active)."""
+        return (
+            self.role is Role.LEADER
+            and self.commit_lsn >= self._term_start_lsn
+            and self.applied_lsn == self.commit_lsn
+        )
+
+    def _step_down(self, term: int, leader: int | None) -> None:
+        self.role = Role.FOLLOWER
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+        if leader is not None:
+            self.leader_id = leader
+        self.next_election_at = self.bus.now + LEASE_TIMEOUT + self._jitter()
+
+    # ------------------------------------------------------- replication
+    def _broadcast_appends(self) -> None:
+        for p in self.peers:
+            if p != self.node_id:
+                self._send_append_to(p)
+
+    def _advance_commit(self) -> None:
+        # highest lsn replicated on a majority AND from the current term
+        for lsn in range(len(self.log) - 1, self.commit_lsn, -1):
+            if self.log[lsn].term != self.term:
+                break
+            acked = 1 + sum(1 for m in self._match_lsn.values() if m >= lsn)
+            if acked >= self._majority():
+                self.commit_lsn = lsn
+                break
+        self._apply()
+
+    def _apply(self) -> None:
+        while self.applied_lsn < self.commit_lsn:
+            self.applied_lsn += 1
+            if self.on_commit is not None:
+                self.on_commit(self.log[self.applied_lsn])
+
+    # ------------------------------------------------------ msg handling
+    def _on_message(self, src: int, msg: Any) -> None:
+        if isinstance(msg, AppendReq):
+            self._on_append(src, msg)
+        elif isinstance(msg, AppendAck):
+            self._on_append_ack(src, msg)
+        elif isinstance(msg, VoteReq):
+            self._on_vote_req(src, msg)
+        elif isinstance(msg, VoteResp):
+            self._on_vote_resp(src, msg)
+        elif isinstance(msg, TimeoutNow):
+            if msg.term == self.term and self.role is not Role.LEADER:
+                self._start_election(force=True)
+
+    def _on_append(self, src: int, m: AppendReq) -> None:
+        if m.term < self.term:
+            self.bus.send(self.node_id, src, AppendAck(self.term, -1, False))
+            return
+        # valid leader for this term: refresh lease
+        self._step_down(m.term, m.leader_id)
+        self.lease_until = self.bus.now + LEASE_TIMEOUT
+        # log matching
+        if m.prev_lsn >= 0:
+            if m.prev_lsn >= len(self.log) or self.log[m.prev_lsn].term != m.prev_term:
+                self.bus.send(self.node_id, src, AppendAck(self.term, -1, False))
+                return
+        # append, truncating any conflicting suffix
+        for e in m.entries:
+            if e.lsn < len(self.log):
+                if self.log[e.lsn].term != e.term:
+                    if e.lsn <= self.commit_lsn:
+                        raise AssertionError(
+                            f"node {self.node_id}: conflicting entry at committed lsn {e.lsn}"
+                        )
+                    del self.log[e.lsn :]
+                    self.log.append(e)
+                # else: duplicate, keep
+            else:
+                self.log.append(e)
+        new_commit = min(m.commit_lsn, len(self.log) - 1)
+        if new_commit > self.commit_lsn:
+            self.commit_lsn = new_commit
+        self._apply()
+        ack_lsn = m.prev_lsn + len(m.entries)
+        self.bus.send(self.node_id, src, AppendAck(self.term, ack_lsn, True))
+
+    def _on_append_ack(self, src: int, m: AppendAck) -> None:
+        if self.role is not Role.LEADER:
+            return
+        if m.term > self.term:
+            self._step_down(m.term, None)
+            return
+        self._last_ack[src] = self.bus.now
+        if m.success:
+            self._match_lsn[src] = max(self._match_lsn.get(src, -1), m.ack_lsn)
+            self._next_lsn[src] = self._match_lsn[src] + 1
+            self._advance_commit()
+            if self._next_lsn[src] < len(self.log):
+                # more to stream: push immediately instead of next heartbeat
+                self._send_append_to(src)
+        else:
+            # back off one step and retry (log reconciliation)
+            self._next_lsn[src] = max(0, self._next_lsn.get(src, len(self.log)) - 1)
+            self._send_append_to(src)
+
+    def _send_append_to(self, p: int) -> None:
+        nxt = self._next_lsn.get(p, len(self.log))
+        prev_lsn = nxt - 1
+        prev_term = self.log[prev_lsn].term if 0 <= prev_lsn < len(self.log) else 0
+        entries = tuple(self.log[nxt : nxt + MAX_INFLIGHT])
+        self.bus.send(
+            self.node_id, p,
+            AppendReq(self.term, self.node_id, prev_lsn, prev_term, entries, self.commit_lsn),
+        )
+
+    def _on_vote_req(self, src: int, m: VoteReq) -> None:
+        if self.bus.now < self.lease_until and not m.force:
+            # lease election: current leader still holds a live lease
+            self.bus.send(self.node_id, src, VoteResp(self.term, False))
+            return
+        if m.term > self.term:
+            self._step_down(m.term, None)
+        granted = False
+        if m.term == self.term and self.voted_for in (None, m.candidate_id):
+            last_lsn, last_term = self._last()
+            up_to_date = (m.last_term, m.last_lsn) >= (last_term, last_lsn)
+            if up_to_date:
+                granted = True
+                self.voted_for = m.candidate_id
+                self.next_election_at = self.bus.now + LEASE_TIMEOUT + self._jitter()
+        self.bus.send(self.node_id, src, VoteResp(self.term, granted))
+
+    def _on_vote_resp(self, src: int, m: VoteResp) -> None:
+        if self.role is not Role.CANDIDATE:
+            return
+        if m.term > self.term:
+            self._step_down(m.term, None)
+            return
+        if m.granted and m.term == self.term:
+            self._votes.add(src)
+            if len(self._votes) >= self._majority():
+                self._become_leader()
+
+
+def run_until(bus: LocalBus, replicas: list[PalfReplica], cond, max_time: float = 30.0,
+              dt: float = 0.01) -> bool:
+    """Drive ticks + delivery until cond() or timeout. Test harness helper."""
+    deadline = bus.now + max_time
+    while bus.now < deadline:
+        for r in replicas:
+            r.tick()
+        bus.advance(dt)
+        if cond():
+            return True
+    return False
+
+
+def leader_of(replicas: list[PalfReplica]) -> PalfReplica | None:
+    leaders = [r for r in replicas if r.role is Role.LEADER]
+    if not leaders:
+        return None
+    return max(leaders, key=lambda r: r.term)

@@ -1,0 +1,106 @@
+"""Message transport for replication: in-process bus with fault injection.
+
+Reference surface: the RPC plane PALF rides on — obrpc typed async proxies
+(deps/oblib/src/rpc/obrpc) and LogNetService push/ack/fetch
+(logservice/palf/log_net_service.h:38) — and the ERRSIM tracepoint style of
+fault injection (deps/oblib/src/lib/utility/ob_tracepoint_def.h).
+
+The rebuild separates the consensus state machine from time and wires: the
+LocalBus delivers messages between in-process replicas under an explicit
+virtual clock, with programmable drop/delay/partition faults. This makes the
+3-replica tests deterministic (no sleeps, no flakes) — the same pattern the
+reference gets from forking three observers (mittest/multi_replica) but
+simulable. A TCP transport with the same interface slots in for real
+multi-process deployment (cluster services layer).
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class Envelope:
+    src: int
+    dst: int
+    msg: Any
+    deliver_at: float
+
+
+@dataclass
+class LocalBus:
+    """Deterministic in-process message bus with a virtual clock."""
+
+    now: float = 0.0
+    latency: float = 0.001
+    drop_prob: float = 0.0
+    seed: int = 0
+    _queue: list[Envelope] = field(default_factory=list)
+    _handlers: dict[int, Callable[[int, Any], None]] = field(default_factory=dict)
+    _partitions: set[frozenset] = field(default_factory=set)
+    _down: set[int] = field(default_factory=set)
+    _rng: random.Random = None  # type: ignore[assignment]
+    stats: dict[str, int] = field(default_factory=lambda: defaultdict(int))
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def register(self, node_id: int, handler: Callable[[int, Any], None]) -> None:
+        self._handlers[node_id] = handler
+
+    # ------------------------------------------------------------ faults
+    def partition(self, group_a: set[int], group_b: set[int]) -> None:
+        for a in group_a:
+            for b in group_b:
+                self._partitions.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self._partitions.clear()
+
+    def kill(self, node_id: int) -> None:
+        self._down.add(node_id)
+
+    def revive(self, node_id: int) -> None:
+        self._down.discard(node_id)
+
+    def _blocked(self, a: int, b: int) -> bool:
+        return (
+            a in self._down
+            or b in self._down
+            or frozenset((a, b)) in self._partitions
+        )
+
+    # ---------------------------------------------------------- delivery
+    def send(self, src: int, dst: int, msg: Any) -> None:
+        self.stats["sent"] += 1
+        if self._blocked(src, dst):
+            self.stats["dropped"] += 1
+            return
+        if self.drop_prob and self._rng.random() < self.drop_prob:
+            self.stats["dropped"] += 1
+            return
+        self._queue.append(Envelope(src, dst, msg, self.now + self.latency))
+
+    def advance(self, dt: float) -> int:
+        """Advance virtual time, delivering everything due. Returns count."""
+        self.now += dt
+        delivered = 0
+        while True:
+            due = [e for e in self._queue if e.deliver_at <= self.now]
+            if not due:
+                break
+            self._queue = [e for e in self._queue if e.deliver_at > self.now]
+            due.sort(key=lambda e: e.deliver_at)
+            for e in due:
+                if self._blocked(e.src, e.dst):
+                    self.stats["dropped"] += 1
+                    continue
+                h = self._handlers.get(e.dst)
+                if h is not None:
+                    h(e.src, e.msg)
+                    delivered += 1
+        self.stats["delivered"] += delivered
+        return delivered

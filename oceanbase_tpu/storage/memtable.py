@@ -83,6 +83,29 @@ class Memtable:
             self._min_version = min(self._min_version, commit_version)
             self._max_version = max(self._max_version, commit_version)
 
+    def replay(self, key: tuple, op: int, values: tuple | None, version: int) -> None:
+        """Follower replay: insert an already-committed node directly.
+
+        Apply order is serialized by the log (one applier per log stream,
+        the analog of ObTxReplayExecutor), so no conflict checks — just keep
+        the chain ordered newest-first.
+        """
+        with self._lock:
+            chain = self._rows.setdefault(key, [])
+            node = _Version(version, op, values or (), 0)
+            i = 0
+            while i < len(chain) and (chain[i].tx_id != 0 or chain[i].version > version):
+                i += 1
+            if i < len(chain) and chain[i].tx_id == 0 and chain[i].version == version:
+                # same tx wrote the key twice: later mutation wins, exactly
+                # one node per (key, version) — matches the leader's staged
+                # chain where stage() overwrote in place
+                chain[i] = node
+            else:
+                chain.insert(i, node)
+            self._min_version = min(self._min_version, version)
+            self._max_version = max(self._max_version, version)
+
     def abort(self, tx_id: int) -> None:
         with self._lock:
             dead = []

@@ -1,0 +1,194 @@
+"""PALF-lite consensus tests: deterministic 3/5-replica simulations.
+
+Mirrors the reference's multi-replica tier (SURVEY.md §4: mittest/
+multi_replica forks three observers as three zones) — here three replica
+state machines share a virtual-clock bus, so leader kill, partition and
+message-loss scenarios are deterministic and fast.
+"""
+
+import pytest
+
+from oceanbase_tpu.log import (
+    LocalBus,
+    PalfReplica,
+    Role,
+    leader_of,
+    run_until,
+)
+
+
+def make_cluster(n=3, drop_prob=0.0, seed=0):
+    bus = LocalBus(drop_prob=drop_prob, seed=seed)
+    peers = list(range(n))
+    committed: dict[int, list[bytes]] = {i: [] for i in peers}
+    reps = [
+        PalfReplica(
+            i, peers, bus,
+            # skip leadership no-op entries (empty payload)
+            on_commit=(lambda e, i=i: committed[i].append(e.payload) if e.payload else None),
+        )
+        for i in peers
+    ]
+    return bus, reps, committed
+
+
+def elect(bus, reps):
+    ok = run_until(bus, reps, lambda: leader_of(reps) is not None, max_time=10)
+    assert ok, "no leader elected"
+    return leader_of(reps)
+
+
+class TestElection:
+    def test_elects_exactly_one_leader(self):
+        bus, reps, _ = make_cluster(3)
+        leader = elect(bus, reps)
+        # settle, then check stability: one leader, same term everywhere
+        run_until(bus, reps, lambda: False, max_time=2)
+        leaders = [r for r in reps if r.role is Role.LEADER]
+        assert len(leaders) == 1
+        assert leaders[0].node_id == leader.node_id
+        assert all(r.leader_id == leader.node_id for r in reps)
+
+    def test_reelection_after_leader_death(self):
+        bus, reps, _ = make_cluster(3)
+        l0 = elect(bus, reps)
+        bus.kill(l0.node_id)
+        rest = [r for r in reps if r.node_id != l0.node_id]
+        ok = run_until(bus, reps, lambda: leader_of(rest) is not None, max_time=10)
+        assert ok, "no re-election after leader death"
+        l1 = leader_of(rest)
+        assert l1.node_id != l0.node_id
+        assert l1.term > l0.term
+
+    def test_minority_partition_cannot_elect(self):
+        bus, reps, _ = make_cluster(3)
+        l0 = elect(bus, reps)
+        # isolate one follower: it must not become leader
+        iso = next(r for r in reps if r.role is not Role.LEADER)
+        bus.partition({iso.node_id}, {r.node_id for r in reps if r.node_id != iso.node_id})
+        run_until(bus, reps, lambda: False, max_time=3)
+        assert iso.role is not Role.LEADER
+        assert leader_of(reps).node_id == l0.node_id
+
+    def test_lease_prevents_disruption(self):
+        """A disconnected-then-healed replica with a stale term must not
+        depose a live leader whose lease is being refreshed."""
+        bus, reps, _ = make_cluster(3)
+        l0 = elect(bus, reps)
+        iso = next(r for r in reps if r.role is not Role.LEADER)
+        others = {r.node_id for r in reps if r.node_id != iso.node_id}
+        bus.partition({iso.node_id}, others)
+        run_until(bus, reps, lambda: False, max_time=2)  # iso bumps its term
+        bus.heal()
+        run_until(bus, reps, lambda: False, max_time=3)
+        l1 = leader_of(reps)
+        assert l1 is not None  # cluster converged to exactly one leader
+
+
+class TestReplication:
+    def test_commit_on_majority_and_apply_order(self):
+        bus, reps, committed = make_cluster(3)
+        leader = elect(bus, reps)
+        payloads = [f"e{i}".encode() for i in range(50)]
+        for p in payloads:
+            assert leader.submit_log(p) is not None
+        ok = run_until(
+            bus, reps,
+            lambda: all(len(committed[r.node_id]) == 50 for r in reps),
+            max_time=10,
+        )
+        assert ok, {r.node_id: len(committed[r.node_id]) for r in reps}
+        for r in reps:
+            assert committed[r.node_id] == payloads  # identical order
+
+    def test_submit_on_follower_rejected(self):
+        bus, reps, _ = make_cluster(3)
+        leader = elect(bus, reps)
+        follower = next(r for r in reps if r.node_id != leader.node_id)
+        assert follower.submit_log(b"x") is None
+
+    def test_no_committed_loss_across_failover(self):
+        """Committed entries survive leader kill + re-election (RPO=0)."""
+        bus, reps, committed = make_cluster(3)
+        l0 = elect(bus, reps)
+        for i in range(20):
+            l0.submit_log(f"a{i}".encode())
+        run_until(bus, reps, lambda: len(committed[l0.node_id]) >= 20, max_time=10)
+        bus.kill(l0.node_id)
+        rest = [r for r in reps if r.node_id != l0.node_id]
+        run_until(bus, reps, lambda: leader_of(rest) is not None, max_time=10)
+        l1 = leader_of(rest)
+        for i in range(10):
+            l1.submit_log(f"b{i}".encode())
+        ok = run_until(
+            bus, reps,
+            lambda: all(len(committed[r.node_id]) >= 30 for r in rest),
+            max_time=10,
+        )
+        assert ok
+        want = [f"a{i}".encode() for i in range(20)] + [f"b{i}".encode() for i in range(10)]
+        for r in rest:
+            assert committed[r.node_id][:30] == want
+
+    def test_uncommitted_suffix_overwritten_after_partition(self):
+        """Entries accepted only by a deposed leader are discarded; the new
+        leader's log wins (no divergence)."""
+        bus, reps, committed = make_cluster(3)
+        l0 = elect(bus, reps)
+        others = {r.node_id for r in reps if r.node_id != l0.node_id}
+        # commit a baseline first
+        l0.submit_log(b"base")
+        run_until(bus, reps, lambda: len(committed[l0.node_id]) >= 1, max_time=5)
+        # cut the leader off, it accepts entries it can never commit
+        bus.partition({l0.node_id}, others)
+        for i in range(5):
+            l0.submit_log(f"lost{i}".encode())
+        rest = [r for r in reps if r.node_id != l0.node_id]
+        run_until(bus, reps, lambda: leader_of(rest) is not None
+                  and leader_of(rest).term > l0.term, max_time=10)
+        l1 = leader_of(rest)
+        l1.submit_log(b"kept")
+        run_until(bus, reps, lambda: len(committed[l1.node_id]) >= 2, max_time=5)
+        bus.heal()
+        ok = run_until(
+            bus, reps,
+            lambda: committed[l0.node_id] == committed[l1.node_id]
+            and len(committed[l0.node_id]) >= 2,
+            max_time=10,
+        )
+        assert ok, (committed[l0.node_id], committed[l1.node_id])
+        assert committed[l1.node_id][:2] == [b"base", b"kept"]
+        assert not any(p.startswith(b"lost") for p in committed[l1.node_id])
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_progress_under_message_loss(self, seed):
+        """20% message loss: liveness degrades, safety never."""
+        bus, reps, committed = make_cluster(3, drop_prob=0.2, seed=seed)
+        ok = run_until(bus, reps, lambda: leader_of(reps) is not None, max_time=60)
+        assert ok
+        leader = leader_of(reps)
+        for i in range(10):
+            leader_of(reps).submit_log(f"x{i}".encode())
+            run_until(bus, reps, lambda: False, max_time=0.2)
+        ok = run_until(
+            bus, reps,
+            lambda: max(len(committed[r.node_id]) for r in reps) >= 10,
+            max_time=120,
+        )
+        assert ok
+        # safety: all committed prefixes agree
+        logs = sorted((committed[r.node_id] for r in reps), key=len)
+        for a, b in zip(logs, logs[1:]):
+            assert b[: len(a)] == a
+
+    def test_five_replicas_two_failures(self):
+        bus, reps, committed = make_cluster(5)
+        l0 = elect(bus, reps)
+        l0.submit_log(b"1")
+        run_until(bus, reps, lambda: len(committed[l0.node_id]) >= 1, max_time=5)
+        followers = [r for r in reps if r.node_id != l0.node_id]
+        bus.kill(followers[0].node_id)
+        bus.kill(followers[1].node_id)
+        l0.submit_log(b"2")
+        ok = run_until(bus, reps, lambda: len(committed[l0.node_id]) >= 2, max_time=10)
+        assert ok  # 3/5 still a majority

@@ -1,0 +1,215 @@
+"""Transaction layer tests over the in-process 3-node cluster.
+
+Tier-2/4 analog (SURVEY.md §4): full tx + consensus + storage stack in one
+process under a virtual clock — commit visibility, follower replay
+convergence, 2PC atomicity across log streams, aborts, conflicts, failover.
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core.dtypes import DataType, Schema
+from oceanbase_tpu.log import Role, leader_of
+from oceanbase_tpu.storage import OP_DELETE, OP_PUT, WriteConflict
+from oceanbase_tpu.tx import LocalCluster, TxState
+
+SCHEMA = Schema.of(k=DataType.int64(), v=DataType.int32())
+
+
+def make_cluster(n_ls=1, n_nodes=3):
+    c = LocalCluster(n_nodes=n_nodes)
+    for ls in range(1, n_ls + 1):
+        c.create_ls(ls)
+        c.create_tablet(ls, ls * 100, SCHEMA, ["k"])
+    c.finalize()
+    return c
+
+
+def put(svc, ctx, ls, tablet, k, v):
+    svc.write(ctx, ls, tablet, (k,), OP_PUT, (k, v))
+
+
+class TestSingleLS:
+    def test_commit_becomes_visible_at_version(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 1, 10)
+        put(svc, ctx, 1, 100, 2, 20)
+        c.commit_sync(svc, ctx)
+        assert ctx.state is TxState.COMMITTED and ctx.commit_version > 0
+        ctx2 = svc.begin()
+        got = svc.read(ctx2, 1, 100)
+        np.testing.assert_array_equal(np.sort(got["k"]), [1, 2])
+        # snapshot taken before commit does not see it
+        assert ctx2.read_snapshot >= ctx.commit_version
+
+    def test_uncommitted_invisible_to_others_visible_to_self(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 7, 70)
+        own = svc.read(ctx, 1, 100)
+        assert own["k"].tolist() == [7]
+        other = svc.begin()
+        assert svc.read(other, 1, 100)["k"].tolist() == []
+
+    def test_followers_replay_to_same_state(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        for k in range(20):
+            put(svc, ctx, 1, 100, k, k * 2)
+        c.commit_sync(svc, ctx)
+        ctx3 = svc.begin()
+        c.settle(1.0)  # let followers apply
+        want = svc.read(ctx3, 1, 100)
+        for node, rep in c.ls_groups[1].items():
+            got = rep.tablets[100].scan(ctx3.read_snapshot)
+            np.testing.assert_array_equal(got["k"], want["k"])
+            np.testing.assert_array_equal(got["v"], want["v"])
+
+    def test_abort_leaves_no_trace(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 5, 50)
+        svc.abort(ctx)
+        assert ctx.state is TxState.ABORTED
+        ctx2 = svc.begin()
+        assert svc.read(ctx2, 1, 100)["k"].tolist() == []
+
+    def test_write_write_conflict_aborts(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        a = svc.begin()
+        put(svc, a, 1, 100, 9, 1)
+        b = svc.begin()
+        with pytest.raises(WriteConflict):
+            put(svc, b, 1, 100, 9, 2)
+        assert b.state is TxState.ABORTED
+        c.commit_sync(svc, a)
+        assert a.state is TxState.COMMITTED
+
+    def test_delete_and_snapshot_reads(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        t1 = svc.begin()
+        put(svc, t1, 1, 100, 1, 11)
+        c.commit_sync(svc, t1)
+        t2 = svc.begin()
+        svc.write(t2, 1, 100, (1,), OP_DELETE, None)
+        c.commit_sync(svc, t2)
+        t3 = svc.begin()
+        assert svc.read(t3, 1, 100)["k"].tolist() == []
+
+
+class TestTwoPhaseCommit:
+    def test_2pc_commits_atomically(self):
+        c = make_cluster(n_ls=2)
+        svc = c.service_for(1, 2)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 1, 10)
+        put(svc, ctx, 2, 200, 2, 20)
+        c.commit_sync(svc, ctx)
+        assert ctx.state is TxState.COMMITTED
+        r = svc.begin()
+        g1 = svc.read(r, 1, 100)
+        g2 = svc.read(r, 2, 200)
+        assert g1["k"].tolist() == [1] and g2["k"].tolist() == [2]
+        # both sides committed at the SAME version
+        c.settle(0.5)
+        for ls, tablet in ((1, 100), (2, 200)):
+            rep = c.ls_groups[ls][c.leader_node(ls)]
+            mt = rep.tablets[tablet].active
+            _, vmax = mt.version_range
+            assert vmax == ctx.commit_version
+
+    def test_2pc_abort_cleans_both(self):
+        c = make_cluster(n_ls=2)
+        svc = c.service_for(1, 2)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 1, 10)
+        put(svc, ctx, 2, 200, 2, 20)
+        svc.abort(ctx)
+        r = svc.begin()
+        assert svc.read(r, 1, 100)["k"].tolist() == []
+        assert svc.read(r, 2, 200)["k"].tolist() == []
+
+    def test_followers_converge_after_2pc(self):
+        c = make_cluster(n_ls=2)
+        svc = c.service_for(1, 2)
+        ctx = svc.begin()
+        for k in range(10):
+            put(svc, ctx, 1, 100, k, k)
+            put(svc, ctx, 2, 200, k + 100, k)
+        c.commit_sync(svc, ctx)
+        c.settle(1.0)
+        r = svc.begin()
+        for ls, tablet in ((1, 100), (2, 200)):
+            want = svc.read(r, ls, tablet)
+            for rep in c.ls_groups[ls].values():
+                got = rep.tablets[tablet].scan(r.read_snapshot)
+                np.testing.assert_array_equal(got["k"], want["k"])
+
+
+class TestFailover:
+    def test_commit_survives_leader_change(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        for k in range(5):
+            put(svc, ctx, 1, 100, k, k)
+        c.commit_sync(svc, ctx)
+        old = c.leader_node(1)
+        c.bus.kill(c.ls_groups[1][old].palf.node_id)
+        rest = [r.palf for n, r in c.ls_groups[1].items() if n != old]
+        ok = c.drive_until(lambda: leader_of(rest) is not None, max_time=15)
+        assert ok
+        new_node = c.leader_node(1)
+        assert new_node != old
+        svc2 = c.services[new_node]
+        r = svc2.begin()
+        got = svc2.read(r, 1, 100)
+        np.testing.assert_array_equal(np.sort(got["k"]), np.arange(5))
+
+    def test_new_leader_accepts_writes(self):
+        c = make_cluster()
+        old = c.leader_node(1)
+        target = (old + 1) % c.n_nodes
+        c.transfer_leader(1, target)
+        assert c.leader_node(1) == target
+        svc = c.services[target]
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 42, 1)
+        c.commit_sync(svc, ctx)
+        assert ctx.state is TxState.COMMITTED
+
+    def test_single_node_cluster_commits(self):
+        """1-replica groups commit without peers (the SQL engine's embedded
+        single-process deployment)."""
+        c = make_cluster(n_nodes=1)
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 1, 2)
+        c.commit_sync(svc, ctx)
+        assert ctx.state is TxState.COMMITTED
+        r = svc.begin()
+        assert svc.read(r, 1, 100)["k"].tolist() == [1]
+
+    def test_abort_refused_once_committing(self):
+        c = make_cluster()
+        svc = c.service_for(1)
+        ctx = svc.begin()
+        put(svc, ctx, 1, 100, 1, 1)
+        svc.commit(ctx)
+        if not ctx.is_done:  # decisive record in flight
+            with pytest.raises(RuntimeError, match="in flight"):
+                svc.abort(ctx)
+        c.drive_until(lambda: ctx.is_done)
+        assert ctx.state is TxState.COMMITTED
+
+    def test_gts_timestamps_strictly_increase(self):
+        c = make_cluster()
+        ts = [c.gts.next_ts() for _ in range(1000)]
+        assert all(b > a for a, b in zip(ts, ts[1:]))
