@@ -35,7 +35,12 @@ import numpy as np
 from ..core.column import ColumnBatch, batch_to_host
 from ..core.dtypes import DataType, Field, Schema, TypeKind
 from ..expr import ir as E
-from ..expr.compile import compile_predicate, evaluate, infer_type
+from ..expr.compile import (
+    compile_predicate,
+    derive_dict_column,
+    evaluate,
+    infer_type,
+)
 from ..ops.hashagg import assign_group_slots, _apply_agg
 from ..ops.hashing import next_pow2, pack_keys
 from ..ops.join import (
@@ -204,8 +209,12 @@ class Executor:
             if isinstance(op, JoinOp):
                 l = est_rows(op.left)
                 r = est_rows(op.right)
-                if not op.left_keys:  # cross join
-                    return l * r
+                if op.kind in ("semi", "anti"):
+                    return max(l * 0.5, 1.0)
+                if op.kind == "left":
+                    return l * 2
+                if not op.left_keys:  # cross / scalar broadcast
+                    return l if self._is_scalar_relation(op.right) else l * r
                 if self._join_build_unique(op):
                     return l
                 return max(l, r) * 2
@@ -226,9 +235,21 @@ class Executor:
                 params.groupby_size[nid] = next_pow2(
                     int(2 * min(est_rows(op.child), 1 << 21)) + 16
                 )
-            if isinstance(op, JoinOp) and not self._join_build_unique(op):
-                cap = int(est_rows(op)) * 2 + 1024
-                params.join_cap[nid] = -(-cap // 1024) * 1024
+            if isinstance(op, JoinOp):
+                needs_cap = (
+                    (op.kind in ("inner", "cross") and not self._join_build_unique(op))
+                    or (op.kind in ("semi", "anti") and op.residual is not None)
+                    or op.kind == "left"
+                )
+                if needs_cap:
+                    if op.kind in ("semi", "anti", "left"):
+                        # candidate-pair capacity, not output rows
+                        cap = int(
+                            max(est_rows(op.left), est_rows(op.right)) * 2
+                        ) + 1024
+                    else:
+                        cap = int(est_rows(op)) * 2 + 1024
+                    params.join_cap[nid] = -(-cap // 1024) * 1024
         return params
 
     @staticmethod
@@ -237,20 +258,56 @@ class Executor:
 
         return split_conjuncts(e)
 
-    def _join_build_unique(self, op: JoinOp) -> bool:
-        """True if the build (right) side's join keys cover a unique key of
-        its base table (possibly under filters/projections)."""
-        node = op.right
+    @staticmethod
+    def _is_scalar_relation(node: LogicalOp) -> bool:
+        """True for a guaranteed-1-row relation (grand aggregate, possibly
+        under projections/filters) — the broadcast side of a scalar-subquery
+        join."""
         while isinstance(node, (Filter, Project)):
             node = node.child
-        if not isinstance(node, Scan):
-            return False
-        uks = self.unique_keys.get(node.table, ())
-        key_cols = set()
+        return isinstance(node, Aggregate) and not node.group_keys
+
+    def _join_build_unique(self, op: JoinOp) -> bool:
+        """True if the build (right) side's join keys cover a unique key of
+        its source: a base table's declared unique key, an Aggregate's full
+        group-key set, or a Distinct's full column set — seen through
+        Filter/Project (renames followed)."""
+        if self._is_scalar_relation(op.right):
+            return True
+        names = []
         for e in op.right_keys:
-            if isinstance(e, E.ColRef) and e.name.startswith(node.alias + "."):
-                key_cols.add(e.name.split(".", 1)[1])
-        return any(set(uk) <= key_cols for uk in uks)
+            if not isinstance(e, E.ColRef):
+                return False
+            names.append(e.name)
+        node = op.right
+        while True:
+            if isinstance(node, Filter):
+                node = node.child
+            elif isinstance(node, Project):
+                rename = {n: ex for n, ex in node.exprs}
+                nxt = []
+                for n in names:
+                    ex = rename.get(n)
+                    if not isinstance(ex, E.ColRef):
+                        return False
+                    nxt.append(ex.name)
+                names = nxt
+                node = node.child
+            else:
+                break
+        if isinstance(node, Aggregate):
+            gk = {n for n, _ in node.group_keys}
+            return bool(gk) and gk <= set(names)
+        if isinstance(node, Distinct):
+            cols = set(output_schema(node).names())
+            return cols <= set(names)
+        if isinstance(node, Scan):
+            uks = self.unique_keys.get(node.table, ())
+            key_cols = {
+                n.split(".", 1)[1] for n in names if n.startswith(node.alias + ".")
+            }
+            return any(set(uk) <= key_cols for uk in uks)
+        return False
 
     # ---- tracing ------------------------------------------------------
     def compile(self, plan: LogicalOp, params: PhysicalParams):
@@ -301,7 +358,13 @@ class Executor:
                 child, ovf = emit(op.child, inputs)
                 cols, valid, dicts, fields = {}, {}, {}, []
                 for name, e in op.exprs:
-                    v, vv = evaluate(e, child)
+                    derived = derive_dict_column(e, child)
+                    if derived is not None:
+                        # string transform (substr): new dict column
+                        v, vv, d2 = derived
+                        dicts[name] = d2
+                    else:
+                        v, vv = evaluate(e, child)
                     cols[name] = v
                     if vv is not None:
                         valid[name] = vv
@@ -399,6 +462,10 @@ class Executor:
 
     # ---- join emission -------------------------------------------------
     def _emit_join(self, op: JoinOp, nid, inputs, emit, params):
+        if op.kind in ("semi", "anti"):
+            return self._emit_semi_anti(op, nid, inputs, emit, params)
+        if op.kind == "left":
+            return self._emit_left(op, nid, inputs, emit, params)
         left, lovf = emit(op.left, inputs)
         right, rovf = emit(op.right, inputs)
         ovf = {**lovf, **rovf}
@@ -406,7 +473,8 @@ class Executor:
         rkeys = [evaluate(e, right)[0] for e in op.right_keys]
         if not lkeys:
             # cross join: constant key makes every probe row match every
-            # build row through the expand path (capacity = |L|x|R| estimate)
+            # build row; a 1-row build (scalar subquery) rides the unique
+            # hash path as a broadcast, general cross uses expand
             lkeys = [jnp.zeros(left.capacity, dtype=jnp.int32)]
             rkeys = [jnp.zeros(right.capacity, dtype=jnp.int32)]
         merged_dicts = {**left.dicts, **right.dicts}
@@ -469,6 +537,128 @@ class Executor:
             ovf[nid] = jnp.maximum(total - cap, 0)
         if op.residual is not None:
             out = out.with_sel(compile_predicate(op.residual, out))
+        return out, ovf
+
+    def _emit_semi_anti(self, op: JoinOp, nid, inputs, emit, params):
+        """Semi/anti join: output = left rows with (without) a matching right
+        row. No residual: a single hash-probe existence test (duplicate build
+        keys are fine — one witness per key suffices, and the probe
+        exact-verifies key columns). With residual: expand candidate pairs,
+        evaluate the residual per pair, scatter-or a has-match bit per left
+        row."""
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ovf = {**lovf, **rovf}
+        lkeys = [evaluate(e, left)[0] for e in op.left_keys]
+        rkeys = [evaluate(e, right)[0] for e in op.right_keys]
+        if op.residual is None:
+            nb = rkeys[0].shape[0]
+            ts = next_pow2(max(2 * nb, 16))
+            slot_key, slot_row = build_hash_table(rkeys, right.sel, ts)
+            match = hash_join_probe(slot_key, slot_row, rkeys, lkeys, left.sel)
+            has = match >= 0
+        else:
+            cap = params.join_cap[nid]
+            skeys, order = sort_build_side(rkeys, right.sel)
+            pr, br, valid_rows, total = expand_join(
+                skeys, order, right.nrows, lkeys, left.sel, cap
+            )
+            pair_sel = valid_rows
+            if len(op.left_keys) > 1:
+                for le, re_ in zip(op.left_keys, op.right_keys):
+                    lv, _ = evaluate(le, left)
+                    rv, _ = evaluate(re_, right)
+                    pair_sel = pair_sel & (lv[pr] == rv[br])
+            # pair batch: left cols gathered by pr, right cols by br
+            pair_cols = {n: c[pr] for n, c in left.cols.items()}
+            pair_cols.update({n: c[br] for n, c in right.cols.items()})
+            pair_valid = {n: v[pr] for n, v in left.valid.items()}
+            pair_valid.update({n: v[br] for n, v in right.valid.items()})
+            pair_batch = ColumnBatch(
+                cols=pair_cols,
+                valid=pair_valid,
+                sel=pair_sel,
+                nrows=jnp.sum(pair_sel, dtype=jnp.int64),
+                schema=_join_schema(left.schema, right.schema),
+                dicts={**left.dicts, **right.dicts},
+            )
+            pair_ok = compile_predicate(op.residual, pair_batch)
+            n = left.capacity
+            has = (
+                jnp.zeros(n, dtype=jnp.bool_)
+                .at[pr]
+                .max(pair_ok, mode="drop")
+            )
+            ovf = dict(ovf)
+            ovf[nid] = jnp.maximum(total - cap, 0)
+        sel = left.sel & (has if op.kind == "semi" else ~has)
+        return left.with_sel(sel), ovf
+
+    def _emit_left(self, op: JoinOp, nid, inputs, emit, params):
+        """Left outer join via expansion: matched pairs plus, appended at a
+        left-capacity tail, one all-NULL-right row for every unmatched left
+        row. Right columns gain validity masks (they are nullable now)."""
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ovf = {**lovf, **rovf}
+        lkeys = [evaluate(e, left)[0] for e in op.left_keys]
+        rkeys = [evaluate(e, right)[0] for e in op.right_keys]
+        cap = params.join_cap[nid]
+        skeys, order = sort_build_side(rkeys, right.sel)
+        pr, br, valid_rows, total = expand_join(
+            skeys, order, right.nrows, lkeys, left.sel, cap
+        )
+        pair_sel = valid_rows
+        if len(op.left_keys) > 1:
+            for le, re_ in zip(op.left_keys, op.right_keys):
+                lv, _ = evaluate(le, left)
+                rv, _ = evaluate(re_, right)
+                pair_sel = pair_sel & (lv[pr] == rv[br])
+        merged_dicts = {**left.dicts, **right.dicts}
+        if op.residual is not None:
+            pair_cols = {n: c[pr] for n, c in left.cols.items()}
+            pair_cols.update({n: c[br] for n, c in right.cols.items()})
+            pair_valid = {n: v[pr] for n, v in left.valid.items()}
+            pair_valid.update({n: v[br] for n, v in right.valid.items()})
+            pair_batch = ColumnBatch(
+                cols=pair_cols,
+                valid=pair_valid,
+                sel=pair_sel,
+                nrows=jnp.sum(pair_sel, dtype=jnp.int64),
+                schema=_join_schema(left.schema, right.schema),
+                dicts=merged_dicts,
+            )
+            pair_sel = compile_predicate(op.residual, pair_batch)
+        nl = left.capacity
+        has = jnp.zeros(nl, dtype=jnp.bool_).at[pr].max(pair_sel, mode="drop")
+        # output = [cap matched-pair slots] ++ [nl unmatched-left slots]
+        cols, valid = {}, {}
+        for n, c in left.cols.items():
+            cols[n] = jnp.concatenate([c[pr], c])
+        for n, v in left.valid.items():
+            valid[n] = jnp.concatenate([v[pr], v])
+        for n, c in right.cols.items():
+            cols[n] = jnp.concatenate([c[br], jnp.zeros_like(c, shape=(nl,))])
+            rv = right.valid.get(n)
+            matched_valid = rv[br] if rv is not None else jnp.ones(cap, jnp.bool_)
+            valid[n] = jnp.concatenate([matched_valid, jnp.zeros(nl, jnp.bool_)])
+        sel = jnp.concatenate([pair_sel, left.sel & ~has])
+        rs_nullable = Schema(
+            tuple(
+                Field(f.name, f.dtype.with_nullable(True))
+                for f in right.schema.fields
+            )
+        )
+        out = ColumnBatch(
+            cols=cols,
+            valid=valid,
+            sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=_join_schema(left.schema, rs_nullable),
+            dicts=merged_dicts,
+        )
+        ovf = dict(ovf)
+        ovf[nid] = jnp.maximum(total - cap, 0)
         return out, ovf
 
     # ---- aggregate emission --------------------------------------------
@@ -545,15 +735,19 @@ class Executor:
             ovf = dict(ovf)
             ovf[nid] = pend
         else:
-            # scalar aggregate: single-row output, per-agg masks
+            # scalar aggregate: single-row output, per-agg masks; SQL
+            # semantics: sum/min/max over ZERO rows is NULL (count is 0)
             from ..ops.hashagg import scalar_aggregate
 
             cols = {}
+            out_valid = {}
             for (name, _, _, _), aop, av, am in zip(
                 op.aggs, agg_ops, agg_vals, agg_masks
             ):
                 (v,) = scalar_aggregate(am, [aop], [av])
                 cols[name] = v[None]
+                if aop != "count":
+                    out_valid[name] = jnp.any(am)[None]
             sel = jnp.ones(1, dtype=jnp.bool_)
 
         dicts = {}
@@ -562,7 +756,7 @@ class Executor:
                 dicts[name] = child.dicts[e.name]
         out = ColumnBatch(
             cols=cols,
-            valid={},
+            valid=(out_valid if not op.group_keys else {}),
             sel=sel,
             nrows=jnp.sum(sel, dtype=jnp.int64),
             schema=out_schema,

@@ -115,6 +115,8 @@ def infer_type(e: Expr, schema: Schema) -> DataType:
             for a in e.args[1:]:
                 t = common_numeric_type(t, infer_type(a, schema))
             return t
+        if e.name == "substr":
+            return DataType.varchar(infer_type(e.args[0], schema).nullable)
         raise NotImplementedError(f"function {e.name}")
     raise NotImplementedError(type(e))
 
@@ -408,6 +410,17 @@ def _eval_compare(e: Compare, batch: ColumnBatch):
             flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
             op = flip.get(e.op, e.op)
             return _dict_compare(e.right, op, e.left.value, batch)
+        # string transforms (substr) vs literal: boolean LUT over the view
+        if isinstance(e.right, Literal):
+            view = _string_view(e.left, batch)
+            if view is not None:
+                codes, valid, vals = view
+                lut = np.fromiter(
+                    (_CMP[e.op](v, e.right.value) for v in vals),
+                    dtype=np.bool_, count=len(vals),
+                )
+                n = max(len(vals) - 1, 0)
+                return jnp.asarray(lut)[jnp.clip(codes, 0, n)], valid
         if lt.kind is TypeKind.VARCHAR and rt.kind is TypeKind.VARCHAR:
             # col-vs-col code comparison is only sound when both columns
             # share one dictionary object (e.g. post-join copies); distinct
@@ -499,14 +512,16 @@ def _eval_case(e: Case, batch: ColumnBatch):
 
 def _eval_in_list(e: InList, batch: ColumnBatch):
     t = infer_type(e.arg, batch.schema)
-    if t.kind is TypeKind.VARCHAR and isinstance(e.arg, ColRef):
-        d = batch.dicts[e.arg.name]
+    if t.kind is TypeKind.VARCHAR:
+        view = _string_view(e.arg, batch)
+        if view is None:
+            raise NotImplementedError(f"IN over varchar expr {e.arg}")
+        codes, valid, vals = view
         members = set(e.values)
         lut = np.fromiter(
-            (v in members for v in d.values()), dtype=np.bool_, count=len(d)
+            (v in members for v in vals), dtype=np.bool_, count=len(vals)
         )
-        codes, valid = evaluate(e.arg, batch)
-        out = jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))]
+        out = jnp.asarray(lut)[jnp.clip(codes, 0, max(len(vals) - 1, 0))]
         return (~out if e.negated else out), valid
     v, valid = evaluate(e.arg, batch)
     out = jnp.zeros(batch.capacity, dtype=jnp.bool_)
@@ -532,6 +547,57 @@ def _civil_from_days(days):
     m = mp + jnp.where(mp < 10, 3, -9)
     y = y + (m <= 2)
     return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _string_view(e: Expr, batch: ColumnBatch):
+    """A 'string view' of an expression: (codes, valid, per-code values).
+
+    Works for a dictionary-encoded column or a host-computable string
+    transform of one (substr with literal bounds). The per-code value list
+    lets predicates become boolean LUTs indexed by code — the TPU-friendly
+    compile of string functions (strings never reach the device; this is the
+    global-dictionary analog of the reference's dict-encoded pushdowns,
+    storage/blocksstable/encoding/ob_dict_decoder_simd.cpp).
+    """
+    if isinstance(e, ColRef):
+        d = batch.dicts.get(e.name)
+        if d is None:
+            return None
+        codes, valid = evaluate(e, batch)
+        return codes, valid, list(d.values())
+    if isinstance(e, Func) and e.name == "substr":
+        base = _string_view(e.args[0], batch)
+        if base is None:
+            return None
+        codes, valid, vals = base
+        if not (isinstance(e.args[1], Literal) and isinstance(e.args[2], Literal)):
+            return None
+        s0 = int(e.args[1].value) - 1  # SQL is 1-based
+        length = int(e.args[2].value)
+        if length >= 0:
+            vals2 = [v[s0 : s0 + length] for v in vals]
+        else:
+            vals2 = [v[s0:] for v in vals]
+        return codes, valid, vals2
+    return None
+
+
+def derive_dict_column(e: Expr, batch: ColumnBatch):
+    """Materialize a string-transform expr as a NEW dict column:
+    (codes, valid, Dictionary). Used by projections so downstream operators
+    (group-by, joins, output decode) see an ordinary dict column."""
+    from ..core.dictionary import Dictionary
+
+    if not (isinstance(e, Func) and e.name == "substr"):
+        return None
+    view = _string_view(e, batch)
+    if view is None:
+        return None
+    codes, valid, vals = view
+    d2, mapping = Dictionary.from_strings_bulk(np.asarray(vals, dtype=str))
+    lut = jnp.asarray(mapping.astype(np.int32))
+    n = max(len(vals) - 1, 0)
+    return lut[jnp.clip(codes, 0, n)], valid, d2
 
 
 def _eval_func(e: Func, batch: ColumnBatch):

@@ -186,3 +186,4 @@ class Select(Node):
     limit: int | None = None
     offset: int | None = None
     distinct: bool = False
+    ctes: tuple[tuple[str, "Select"], ...] = ()  # WITH name AS (...)

@@ -177,6 +177,8 @@ class Resolver:
                     q = f"{a}.{col}"
                     if q in s:
                         return q
+            if self.outer is not None:
+                return self.outer.resolve_name(parts)
             raise ResolveError(f"unknown column {'.'.join(parts)}")
         col = parts[0]
         matches = []

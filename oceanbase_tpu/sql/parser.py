@@ -31,7 +31,7 @@ KEYWORDS = {
     "null", "exists", "case", "when", "then", "else", "end", "cast",
     "extract", "substring", "for", "distinct", "join", "inner", "left",
     "right", "full", "cross", "outer", "on", "date", "interval", "year",
-    "month", "day", "asc", "desc", "union", "all", "any", "some",
+    "month", "day", "asc", "desc", "union", "all", "any", "some", "with",
 }
 
 
@@ -117,7 +117,24 @@ class Parser:
 
     # -- entry ----------------------------------------------------------
     def parse(self) -> A.Select:
+        ctes = []
+        if self.accept("with"):
+            while True:
+                name = self.next().value
+                self.expect("as")
+                self.expect("(")
+                ctes.append((name, self.select()))
+                self.expect(")")
+                if not self.accept(","):
+                    break
         s = self.select()
+        if ctes:
+            s = A.Select(
+                items=s.items, from_=s.from_, where=s.where,
+                group_by=s.group_by, having=s.having, order_by=s.order_by,
+                limit=s.limit, offset=s.offset, distinct=s.distinct,
+                ctes=tuple(ctes),
+            )
         self.accept(";")
         if self.peek().kind != "eof":
             t = self.peek()

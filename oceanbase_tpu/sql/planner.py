@@ -2,16 +2,24 @@
 
 Reference surfaces:
 - rewrite: the 82-rule transformer (src/sql/rewrite/ob_transformer_impl.h).
-  Round-1 rules: conjunct splitting, equi-join extraction, predicate
-  pushdown to scans, projection pruning, constant-comparison folding.
-- optimizer: CBO join ordering (src/sql/optimizer/ob_join_order.h) —
-  here a greedy connected-subgraph heuristic on estimated filtered
-  cardinalities (dimension tables join first, build side = smaller input),
-  which reproduces the canonical TPC-H plans without a full DP search.
+  Implemented rules: conjunct splitting, equi-join extraction, predicate
+  pushdown to scans, OR-common-conjunct hoisting (or-expansion analog),
+  subquery unnesting (ob_transform_subquery_coalesce/aggr_subquery):
+    EXISTS / IN-subquery        -> semi / anti join with lifted correlation
+    correlated scalar aggregate -> group-by over correlation keys + join
+    uncorrelated scalar agg     -> 1-row aggregate broadcast-joined
+  DISTINCT-aggregate expansion (distinct pre-dedup, the two-phase analog of
+  the reference's distinct-agg hash infra).
+- optimizer: CBO join ordering (src/sql/optimizer/ob_join_order.h) — greedy
+  connected-subgraph heuristic on estimated filtered cardinalities.
+
+Derived tables (FROM subqueries) and CTEs plan their block recursively and
+join as relations whose outputs are renamed into the block's namespace.
 """
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass
 
 from ..core.dtypes import Schema
@@ -32,11 +40,27 @@ from .logical import (
     output_schema,
 )
 
+_sub_counter = itertools.count()
+
 
 @dataclass
 class PlannedQuery:
     plan: LogicalOp
     output_names: tuple[str, ...]
+
+
+@dataclass
+class Relation:
+    """One FROM item: a base scan or a planned derived table."""
+
+    alias: str
+    plan: LogicalOp
+    is_scan: bool
+
+    @property
+    def scan(self) -> Scan:
+        assert isinstance(self.plan, Scan)
+        return self.plan
 
 
 def split_conjuncts(e: E.Expr | None) -> list[E.Expr]:
@@ -48,6 +72,14 @@ def split_conjuncts(e: E.Expr | None) -> list[E.Expr]:
             out.extend(split_conjuncts(a))
         return out
     return [e]
+
+
+def split_ast_conjuncts(node: A.Node | None) -> list[A.Node]:
+    if node is None:
+        return []
+    if isinstance(node, A.BinOp) and node.op == "and":
+        return split_ast_conjuncts(node.left) + split_ast_conjuncts(node.right)
+    return [node]
 
 
 def hoist_common_or_conjuncts(e: E.Expr) -> list[E.Expr]:
@@ -65,9 +97,7 @@ def hoist_common_or_conjuncts(e: E.Expr) -> list[E.Expr]:
     rest_branches = []
     for b in branches:
         rest = [c for c in b if c not in common]
-        rest_branches.append(
-            E.and_(*rest) if rest else E.lit(True)
-        )
+        rest_branches.append(E.and_(*rest) if rest else E.lit(True))
     if any(isinstance(rb, E.Literal) for rb in rest_branches):
         return common
     return common + [E.or_(*rest_branches)]
@@ -91,10 +121,31 @@ def _is_equi_join(e: E.Expr) -> tuple[E.ColRef, E.ColRef] | None:
     return None
 
 
+def _contains_subquery(node: A.Node) -> bool:
+    if isinstance(node, (A.ScalarSubquery, A.ExistsOp)):
+        return True
+    if isinstance(node, A.InOp) and node.subquery is not None:
+        return True
+    for attr in getattr(node, "__dataclass_fields__", {}):
+        v = getattr(node, attr)
+        if isinstance(v, A.Node) and _contains_subquery(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, A.Node) and _contains_subquery(x):
+                    return True
+                if isinstance(x, tuple) and any(
+                    isinstance(y, A.Node) and _contains_subquery(y) for y in x
+                ):
+                    return True
+    return False
+
+
 class Planner:
     def __init__(self, catalog, stats=None):
         self.catalog = catalog  # name -> Table
         self.stats = stats or {}
+        self.ctes: dict[str, A.Select] = {}
 
     # -- cardinality guesses ------------------------------------------
     def _scan_rows(self, scan: Scan) -> float:
@@ -104,93 +155,177 @@ class Planner:
             base = base * (0.25 ** min(n_conj, 3))
         return max(base, 1.0)
 
+    def _rel_rows(self, rel: Relation) -> float:
+        if rel.is_scan:
+            return self._scan_rows(rel.scan)
+
+        def est(op) -> float:
+            if isinstance(op, Scan):
+                return self._scan_rows(op)
+            if isinstance(op, Filter):
+                return max(est(op.child) * 0.5, 1.0)
+            if isinstance(op, Aggregate):
+                return max(est(op.child) * 0.1, 1.0)
+            if isinstance(op, JoinOp):
+                return max(est(op.left), est(op.right))
+            if isinstance(op, (Project, Sort, Distinct)):
+                return est(op.child)
+            if isinstance(op, Limit):
+                return float(op.n)
+            return 1e4
+
+        return est(rel.plan)
+
+    # ================================================================ API
     def plan(self, sel: A.Select, outer: Resolver | None = None) -> PlannedQuery:
+        for name, csel in getattr(sel, "ctes", ()):
+            self.ctes[name] = csel
+        plan, r, out_items, visible = self._plan_block(sel, outer)
+        return PlannedQuery(plan, visible)
+
+    # ======================================================== block core
+    def _plan_block(self, sel: A.Select, outer: Resolver | None):
+        """Plan one SELECT block. Returns (plan, resolver, out_items, visible)."""
         r = Resolver({n: t for n, t in self.catalog.items()}, outer)
 
-        # ---- FROM: collect scans + structured join conditions --------
-        scans: list[Scan] = []
+        relations: list[Relation] = []
         join_conds: list[E.Expr] = []
+        outer_join_specs: list[tuple[str, str, A.Node | None]] = []  # (kind, right_alias, on)
 
-        def add_from(node: A.Node):
+        def add_relation_from(node: A.Node):
             if isinstance(node, A.TableRef):
                 alias = node.alias or node.name
-                scans.append(r.add_table(node.name, alias))
-            elif isinstance(node, A.Join):
-                if node.kind != "inner":
-                    raise ResolveError(
-                        f"{node.kind} join not yet supported by the planner"
-                    )
-                add_from(node.left)
-                add_from(node.right)
-                if node.on is not None:
-                    join_conds.extend(split_conjuncts(r.expr(node.on)))
-            elif isinstance(node, A.SubqueryRef):
-                raise ResolveError("FROM subqueries not yet supported")
-            else:
-                raise ResolveError(f"bad FROM item {node!r}")
+                if node.name in self.ctes:
+                    relations.append(self._plan_derived(self.ctes[node.name], alias, r))
+                else:
+                    relations.append(Relation(alias, r.add_table(node.name, alias), True))
+                return alias
+            if isinstance(node, A.SubqueryRef):
+                relations.append(self._plan_derived(node.subquery, node.alias, r))
+                return node.alias
+            if isinstance(node, A.Join):
+                if node.kind == "inner" or node.kind == "cross":
+                    add_relation_from(node.left)
+                    add_relation_from(node.right)
+                    if node.on is not None:
+                        join_conds.extend(split_conjuncts(r.expr(node.on)))
+                    return None
+                if node.kind == "left":
+                    add_relation_from(node.left)
+                    ra = add_relation_from(node.right)
+                    if ra is None:
+                        raise ResolveError("left join right side must be a relation")
+                    outer_join_specs.append(("left", ra, node.on))
+                    return None
+                raise ResolveError(f"{node.kind} join not yet supported")
+            raise ResolveError(f"bad FROM item {node!r}")
 
         for f in sel.from_:
-            add_from(f)
+            add_relation_from(f)
 
-        # ---- WHERE ----------------------------------------------------
-        where_conjs = join_conds + (
-            split_conjuncts(r.expr(sel.where)) if sel.where is not None else []
-        )
-        where_conjs = [
-            h for c in where_conjs for h in hoist_common_or_conjuncts(c)
-        ]
+        # ---- WHERE: split AST conjuncts; subquery conjuncts unnest -----
+        semi_specs = []  # (kind, sub_plan_rel, keys, residual)
+        post_join_filters: list[E.Expr] = []
+        where_conjs: list[E.Expr] = []
+        for ast_c in split_ast_conjuncts(sel.where):
+            if isinstance(ast_c, A.ExistsOp):
+                semi_specs.append(self._plan_exists(ast_c.subquery, ast_c.negated, r))
+            elif isinstance(ast_c, A.UnaryOp) and ast_c.op == "not" and isinstance(ast_c.operand, A.ExistsOp):
+                semi_specs.append(
+                    self._plan_exists(ast_c.operand.subquery, not ast_c.operand.negated, r)
+                )
+            elif isinstance(ast_c, A.InOp) and ast_c.subquery is not None:
+                semi_specs.append(self._plan_in_subquery(ast_c, r))
+            elif _contains_subquery(ast_c):
+                rel, rewritten = self._plan_scalar_conjunct(ast_c, r)
+                semi_specs.append(rel)
+                post_join_filters.append(rewritten)
+            else:
+                where_conjs.extend(split_conjuncts(r.expr(ast_c)))
 
-        # classify: single-table -> pushdown; equi-join; residual
-        by_alias = {s.alias: s for s in scans}
+        where_conjs = join_conds + where_conjs
+        where_conjs = [h for c in where_conjs for h in hoist_common_or_conjuncts(c)]
+
+        # classify: single-relation -> pushdown; equi-join; residual
+        by_alias = {rel.alias: rel for rel in relations}
+        outer_right = {ra for _, ra, _ in outer_join_specs}
         equi: list[tuple[E.ColRef, E.ColRef]] = []
         residual: list[E.Expr] = []
         for c in where_conjs:
             tabs = _tables_of(c)
             ej = _is_equi_join(c)
-            if ej is not None:
+            if ej is not None and not (
+                {ej[0].name.split(".")[0], ej[1].name.split(".")[0]} & outer_right
+            ):
                 equi.append(ej)
-            elif len(tabs) == 1 and next(iter(tabs)) in by_alias:
-                s = by_alias[next(iter(tabs))]
-                s.pushed_filter = (
-                    c
-                    if s.pushed_filter is None
-                    else E.and_(s.pushed_filter, c)
-                )
+            elif (
+                len(tabs) == 1
+                and next(iter(tabs)) in by_alias
+                and next(iter(tabs)) not in outer_right
+            ):
+                rel = by_alias[next(iter(tabs))]
+                self._push_filter(rel, c)
             else:
                 residual.append(c)
 
-        # ---- join order (greedy, smallest filtered input first) -------
-        plan = self._order_joins(scans, equi, residual)
+        # ---- join order over inner relations; outer joins apply after --
+        inner_rels = [rel for rel in relations if rel.alias not in outer_right]
+        plan = self._order_joins(inner_rels, equi, residual)
+        for kind, ra, on_ast in outer_join_specs:
+            rel = by_alias[ra]
+            on_conjs = split_conjuncts(r.expr(on_ast)) if on_ast is not None else []
+            lkeys, rkeys, resid = [], [], []
+            for c in on_conjs:
+                ej = _is_equi_join(c)
+                if ej is not None and (ra in (ej[0].name.split(".")[0], ej[1].name.split(".")[0])):
+                    l_, r_ = ej
+                    if l_.name.split(".")[0] == ra:
+                        l_, r_ = r_, l_
+                    lkeys.append(l_)
+                    rkeys.append(r_)
+                elif _tables_of(c) == {ra}:
+                    # right-side-only ON condition filters the build input
+                    self._push_filter(rel, c)
+                else:
+                    resid.append(c)
+            plan = JoinOp(
+                kind, plan, rel.plan, tuple(lkeys), tuple(rkeys),
+                E.and_(*resid) if resid else None,
+            )
+
+        # ---- semi/anti/scalar joins on top of the join tree ------------
+        for spec in semi_specs:
+            kind, sub_plan, lkeys, rkeys, resid = spec
+            plan = JoinOp(kind, plan, sub_plan, tuple(lkeys), tuple(rkeys), resid)
+        for f in post_join_filters:
+            plan = Filter(plan, f)
 
         # ---- GROUP BY / aggregates ------------------------------------
         alias_map: dict[str, E.Expr] = {}
         group_nodes = list(sel.group_by)
         has_agg_in_select = _select_has_agg(sel)
         agg_order_keys: list[tuple[E.Expr, bool]] | None = None
+        scalar_join_after_agg: list[tuple] = []
         if group_nodes or has_agg_in_select or sel.having is not None:
             key_exprs = []
             for i, g in enumerate(group_nodes):
                 ge = r.expr(g)
-                name = (
-                    ge.name
-                    if isinstance(ge, E.ColRef)
-                    else f"$gkey{i}"
-                )
+                name = ge.name if isinstance(ge, E.ColRef) else f"$gkey{i}"
                 key_exprs.append((name, ge))
-            # resolve select items, having AND order-by with aggregates
-            # allowed BEFORE building the Aggregate node, so every agg call
-            # anywhere in the query lands in r.agg_exprs.
             out_items = []
             for i, item in enumerate(sel.items):
                 e = r.expr(item.expr, allow_agg=True)
                 name = item.alias or _default_name(item.expr, i)
                 out_items.append((name, e))
                 alias_map[name] = e
-            having_e = (
-                r.expr(sel.having, allow_agg=True)
-                if sel.having is not None
-                else None
-            )
+            having_e = None
+            if sel.having is not None:
+                having_ast = sel.having
+                if _contains_subquery(having_ast):
+                    having_ast, scalar_join_after_agg = self._extract_having_subqueries(
+                        having_ast, r
+                    )
+                having_e = r.expr(having_ast, allow_agg=True)
             agg_order_keys = []
             for oi in sel.order_by:
                 if (
@@ -209,13 +344,12 @@ class Planner:
                     agg_order_keys.append(
                         (E.ColRef(matched[0]) if matched else oe, oi.descending)
                     )
-            plan = Aggregate(plan, tuple(key_exprs), tuple(r.agg_exprs))
-            # rewrite out_items/having over the aggregate's output schema:
-            # group keys keep their names; $aggN are columns now.
-            sub = {e: E.ColRef(n) for n, e in key_exprs}
-            out_items = [(n, _substitute(e, sub)) for n, e in out_items]
+            plan, agg_out_sub = self._build_aggregate(plan, key_exprs, r.agg_exprs)
+            out_items = [(n, _substitute(e, agg_out_sub)) for n, e in out_items]
+            for kind, sub_plan, lkeys, rkeys, resid in scalar_join_after_agg:
+                plan = JoinOp(kind, plan, sub_plan, tuple(lkeys), tuple(rkeys), resid)
             if having_e is not None:
-                having_e = _substitute(having_e, sub)
+                having_e = _substitute(having_e, agg_out_sub)
                 plan = Filter(plan, having_e)
         else:
             out_items = []
@@ -254,8 +388,6 @@ class Planner:
                     oe = E.ColRef(matched[0]) if matched else oe
                 order_keys.append((oe, oi.descending))
 
-        # order-by exprs not expressible over the projected outputs ride as
-        # hidden projection columns (dropped from the visible result)
         visible = tuple(n for n, _ in out_items)
         fixed_order = []
         for i, (oe, d) in enumerate(order_keys):
@@ -263,9 +395,6 @@ class Planner:
                 fixed_order.append((oe, d))
             else:
                 if sel.distinct:
-                    # a hidden sort column would become part of the DISTINCT
-                    # key and silently un-dedupe rows (SQL standard requires
-                    # ORDER BY items to appear in the DISTINCT select list)
                     raise ResolveError(
                         "ORDER BY expression must appear in the select list "
                         "of a SELECT DISTINCT"
@@ -283,30 +412,363 @@ class Planner:
         if sel.limit is not None:
             plan = Limit(plan, sel.limit, sel.offset or 0)
 
-        return PlannedQuery(plan, visible)
+        return plan, r, out_items, visible
 
+    # ------------------------------------------------- aggregate helper
+    def _build_aggregate(self, plan, key_exprs, agg_exprs):
+        """Build the Aggregate node; expands DISTINCT aggregates into a
+        pre-dedup (Distinct over keys+arg) + plain aggregate."""
+        distinct_aggs = [a for a in agg_exprs if a[3]]
+        if distinct_aggs:
+            if len(agg_exprs) != len(distinct_aggs) or len(distinct_aggs) != 1:
+                raise ResolveError(
+                    "mixing DISTINCT and plain aggregates is not supported yet"
+                )
+            name, fn, arg, _ = distinct_aggs[0]
+            if fn != "count":
+                raise ResolveError(f"{fn}(DISTINCT) not supported yet")
+            proj = [(n, e) for n, e in key_exprs] + [("$darg", arg)]
+            plan = Distinct(Project(plan, tuple(proj)))
+            key_refs = [(n, E.ColRef(n)) for n, _ in key_exprs]
+            plan = Aggregate(
+                plan, tuple(key_refs),
+                ((name, "count", E.ColRef("$darg"), False),),
+            )
+            sub = {e: E.ColRef(n) for n, e in key_exprs}
+            return plan, sub
+        plan = Aggregate(plan, tuple(key_exprs), tuple(agg_exprs))
+        sub = {e: E.ColRef(n) for n, e in key_exprs}
+        return plan, sub
+
+    # ------------------------------------------------- derived tables
+    def _plan_derived(self, sub_sel: A.Select, alias: str, r: Resolver) -> Relation:
+        sub_plan, _, out_items, visible = self._plan_block(sub_sel, None)
+        # rename outputs into this block's namespace: alias.col
+        renamed = tuple((f"{alias}.{n}", E.ColRef(n)) for n in visible)
+        plan = Project(sub_plan, renamed)
+        r.scopes.append((alias, output_schema(plan)))
+        return Relation(alias, plan, False)
+
+    def _push_filter(self, rel: Relation, c: E.Expr) -> None:
+        if rel.is_scan:
+            s = rel.scan
+            s.pushed_filter = c if s.pushed_filter is None else E.and_(s.pushed_filter, c)
+        else:
+            rel.plan = Filter(rel.plan, c)
+
+    # --------------------------------------------- subquery unnesting
+    def _assemble_sub_block(self, sub_sel, sub, relations, join_conds,
+                            where_conjs, correlated, local_aliases):
+        by_alias = {rel.alias: rel for rel in relations}
+        equi, residual = [], []
+        for c in join_conds + where_conjs:
+            for h in hoist_common_or_conjuncts(c):
+                tabs = _tables_of(h)
+                ej = _is_equi_join(h)
+                if ej is not None and tabs <= local_aliases:
+                    equi.append(ej)
+                elif len(tabs) == 1 and next(iter(tabs)) in by_alias:
+                    self._push_filter(by_alias[next(iter(tabs))], h)
+                elif tabs <= local_aliases:
+                    residual.append(h)
+                else:
+                    correlated.append(h)
+        plan = self._order_joins(relations, equi, residual)
+        return plan, sub, correlated
+
+    def _split_correlation(self, correlated, local_aliases):
+        """Split correlated conjuncts into equi key pairs (outer_col,
+        inner_col) and residual correlated conditions."""
+        keys, resid = [], []
+        for c in correlated:
+            ej = None
+            if isinstance(c, E.Compare) and c.op in ("=", "=="):
+                if isinstance(c.left, E.ColRef) and isinstance(c.right, E.ColRef):
+                    lt = c.left.name.split(".")[0]
+                    rt = c.right.name.split(".")[0]
+                    if lt in local_aliases and rt not in local_aliases:
+                        ej = (c.right, c.left)  # (outer, inner)
+                    elif rt in local_aliases and lt not in local_aliases:
+                        ej = (c.left, c.right)
+            if ej is not None:
+                keys.append(ej)
+            else:
+                resid.append(c)
+        return keys, resid
+
+    def _plan_exists(self, sub_sel: A.Select, negated: bool, r: Resolver):
+        """EXISTS/NOT EXISTS -> semi/anti join spec."""
+        plan, sub, correlated = self._plan_sub_block_simple(sub_sel, r)
+        local_aliases = {a for a, _ in sub.scopes}
+        keys, resid = self._split_correlation(correlated, local_aliases)
+        if not keys:
+            raise ResolveError("EXISTS without equi correlation is unsupported")
+        sid = f"$sub{next(_sub_counter)}"
+        # project inner columns referenced by keys/residual under new names
+        inner_cols: dict[str, str] = {}
+        proj = []
+        rkeys = []
+        for i, (oc, ic) in enumerate(keys):
+            nn = f"{sid}.k{i}"
+            inner_cols[ic.name] = nn
+            proj.append((nn, ic))
+            rkeys.append(E.ColRef(nn))
+        resid2 = []
+        for c in resid:
+            for col in E.referenced_columns(c):
+                if col.split(".")[0] in local_aliases and col not in inner_cols:
+                    nn = f"{sid}.r{len(inner_cols)}"
+                    inner_cols[col] = nn
+                    proj.append((nn, E.ColRef(col)))
+            resid2.append(_rename_cols(c, inner_cols))
+        sub_plan = Project(plan, tuple(proj))
+        kind = "anti" if negated else "semi"
+        lkeys = [oc for oc, _ in keys]
+        return (kind, sub_plan, lkeys, rkeys, E.and_(*resid2) if resid2 else None)
+
+    def _plan_in_subquery(self, node: A.InOp, r: Resolver):
+        """expr IN (SELECT item FROM ...) -> semi/anti join on equality."""
+        outer_e = r.expr(node.expr)
+        plan, sub, correlated = self._plan_sub_block_simple(node.subquery, r)
+        local_aliases = {a for a, _ in sub.scopes}
+        keys, resid = self._split_correlation(correlated, local_aliases)
+        if len(node.subquery.items) != 1:
+            raise ResolveError("IN subquery must select exactly one column")
+        # resolve the selected item in the sub scope (may itself be grouped)
+        plan_out, item_ref = self._sub_output_expr(node.subquery, plan, sub)
+        sid = f"$sub{next(_sub_counter)}"
+        proj = [(f"{sid}.v", item_ref)]
+        rkeys = [E.ColRef(f"{sid}.v")]
+        lkeys = [outer_e]
+        inner_cols = {}
+        for i, (oc, ic) in enumerate(keys):
+            nn = f"{sid}.k{i+1}"
+            inner_cols[ic.name] = nn
+            proj.append((nn, ic))
+            rkeys.append(E.ColRef(nn))
+            lkeys.append(oc)
+        resid2 = [_rename_cols(c, inner_cols) for c in resid]
+        sub_plan = Project(plan_out, tuple(proj))
+        kind = "anti" if node.negated else "semi"
+        return (kind, sub_plan, lkeys, rkeys, E.and_(*resid2) if resid2 else None)
+
+    def _sub_output_expr(self, sub_sel: A.Select, plan, sub: Resolver):
+        """Resolve the single select item of an IN subquery over its plan.
+        Handles grouped subqueries (Q18: group by + having) by planning the
+        aggregate inside."""
+        item = sub_sel.items[0]
+        if sub_sel.group_by or _select_has_agg(sub_sel) or sub_sel.having is not None:
+            key_exprs = []
+            for i, g in enumerate(sub_sel.group_by):
+                ge = sub.expr(g)
+                name = ge.name if isinstance(ge, E.ColRef) else f"$gkey{i}"
+                key_exprs.append((name, ge))
+            e = sub.expr(item.expr, allow_agg=True)
+            having_e = (
+                sub.expr(sub_sel.having, allow_agg=True)
+                if sub_sel.having is not None
+                else None
+            )
+            plan, agg_sub = self._build_aggregate(plan, key_exprs, sub.agg_exprs)
+            e = _substitute(e, agg_sub)
+            if having_e is not None:
+                plan = Filter(plan, _substitute(having_e, agg_sub))
+            return plan, e
+        return plan, sub.expr(item.expr)
+
+    def _plan_sub_block_simple(self, sub_sel: A.Select, r: Resolver):
+        """Plan a correlated sub block's FROM+WHERE (no select processing).
+        Nested subqueries inside its WHERE unnest recursively."""
+        sub = Resolver({n: t for n, t in self.catalog.items()}, outer=r)
+        relations: list[Relation] = []
+        join_conds: list[E.Expr] = []
+
+        def add_from(node):
+            if isinstance(node, A.TableRef):
+                alias = node.alias or node.name
+                if node.name in self.ctes:
+                    relations.append(self._plan_derived(self.ctes[node.name], alias, sub))
+                else:
+                    relations.append(Relation(alias, sub.add_table(node.name, alias), True))
+            elif isinstance(node, A.Join) and node.kind in ("inner", "cross"):
+                add_from(node.left)
+                add_from(node.right)
+                if node.on is not None:
+                    join_conds.extend(split_conjuncts(sub.expr(node.on)))
+            else:
+                raise ResolveError("unsupported FROM in correlated subquery")
+
+        for f in sub_sel.from_:
+            add_from(f)
+        local_aliases = {rel.alias for rel in relations}
+
+        nested_specs = []
+        nested_filters = []
+        correlated: list[E.Expr] = []
+        where_conjs: list[E.Expr] = []
+        for ast_c in split_ast_conjuncts(sub_sel.where):
+            if isinstance(ast_c, A.ExistsOp):
+                nested_specs.append(self._plan_exists(ast_c.subquery, ast_c.negated, sub))
+            elif isinstance(ast_c, A.InOp) and ast_c.subquery is not None:
+                nested_specs.append(self._plan_in_subquery(ast_c, sub))
+            elif _contains_subquery(ast_c):
+                spec, rewritten = self._plan_scalar_conjunct(ast_c, sub)
+                nested_specs.append(spec)
+                nested_filters.append(rewritten)
+            else:
+                c = sub.expr(ast_c)
+                if _tables_of(c) <= local_aliases:
+                    where_conjs.append(c)
+                else:
+                    correlated.append(c)
+
+        plan, sub, correlated2 = self._assemble_sub_block(
+            sub_sel, sub, relations, join_conds, where_conjs, correlated, local_aliases
+        )
+        for spec in nested_specs:
+            kind, sp, lk, rk, resid = spec
+            plan = JoinOp(kind, plan, sp, tuple(lk), tuple(rk), resid)
+        for f in nested_filters:
+            plan = Filter(plan, f)
+        return plan, sub, correlated2
+
+    def _plan_scalar_conjunct(self, ast_c: A.Node, r: Resolver):
+        """A WHERE conjunct containing a scalar subquery: plan the subquery
+        as a joinable relation and rewrite the conjunct over its output.
+
+        Returns (join spec, rewritten conjunct expr). Inner-join semantics:
+        an empty subquery result yields NULL, which fails any comparison, so
+        dropping unmatched outer rows is equivalent for comparison conjuncts.
+        """
+        subs: list[A.ScalarSubquery] = []
+
+        def find(n):
+            if isinstance(n, A.ScalarSubquery):
+                subs.append(n)
+                return
+            for attr in getattr(n, "__dataclass_fields__", {}):
+                v = getattr(n, attr)
+                if isinstance(v, A.Node):
+                    find(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, A.Node):
+                            find(x)
+
+        find(ast_c)
+        if len(subs) != 1:
+            raise ResolveError("exactly one scalar subquery per conjunct supported")
+        sub_sel = subs[0].subquery
+        spec, value_name = self._plan_scalar_subquery(sub_sel, r)
+
+        # rewrite the AST conjunct replacing the subquery with a column ref
+        def rewrite(n):
+            if isinstance(n, A.ScalarSubquery):
+                return A.Name((value_name.split(".")[0], value_name.split(".")[1]))
+            if not isinstance(n, A.Node):
+                return n
+            kwargs = {}
+            for attr in getattr(n, "__dataclass_fields__", {}):
+                v = getattr(n, attr)
+                if isinstance(v, A.Node):
+                    kwargs[attr] = rewrite(v)
+                elif isinstance(v, tuple):
+                    kwargs[attr] = tuple(
+                        rewrite(x) if isinstance(x, A.Node) else x for x in v
+                    )
+                else:
+                    kwargs[attr] = v
+            return type(n)(**kwargs)
+
+        rewritten_ast = rewrite(ast_c)
+        rewritten = r.expr(rewritten_ast)
+        return spec, rewritten
+
+    def _plan_scalar_subquery(self, sub_sel: A.Select, r: Resolver):
+        """Scalar aggregate subquery -> join spec.
+
+        Uncorrelated: 1-row scalar Aggregate broadcast-joined (no keys).
+        Correlated (equi): Aggregate grouped by correlation keys, inner join.
+        """
+        plan, sub, correlated = self._plan_sub_block_simple(sub_sel, r)
+        local_aliases = {a for a, _ in sub.scopes}
+        keys, resid = self._split_correlation(correlated, local_aliases)
+        if resid:
+            raise ResolveError("non-equi correlation in scalar subquery")
+        if len(sub_sel.items) != 1:
+            raise ResolveError("scalar subquery must select one expression")
+        if not _select_has_agg(sub_sel) or sub_sel.group_by:
+            raise ResolveError("scalar subquery must be a single aggregate")
+        sid = f"$sub{next(_sub_counter)}"
+        value_expr = sub.expr(sub_sel.items[0].expr, allow_agg=True)
+        if keys:
+            key_exprs = [(f"{sid}.k{i}", ic) for i, (_, ic) in enumerate(keys)]
+            plan = Aggregate(plan, tuple(key_exprs), tuple(sub.agg_exprs))
+            proj = [(n, E.ColRef(n)) for n, _ in key_exprs]
+            proj.append((f"{sid}.v", value_expr))
+            plan = Project(plan, tuple(proj))
+            lkeys = [oc for oc, _ in keys]
+            rkeys = [E.ColRef(n) for n, _ in key_exprs]
+            # the sub's output joins the outer block: make it resolvable
+            r.scopes.append((sid, output_schema(plan)))
+            return ("inner", plan, lkeys, rkeys, None), f"{sid}.v"
+        plan = Aggregate(plan, (), tuple(sub.agg_exprs))
+        plan = Project(plan, ((f"{sid}.v", value_expr),))
+        r.scopes.append((sid, output_schema(plan)))
+        # broadcast: no keys; executor routes through the 1-row build path
+        return ("inner", plan, [], [], None), f"{sid}.v"
+
+    def _extract_having_subqueries(self, having_ast: A.Node, r: Resolver):
+        """HAVING with scalar subqueries: plan each as a broadcast join to
+        apply above the Aggregate; returns (rewritten AST, join specs)."""
+        specs = []
+
+        def rewrite(n):
+            if isinstance(n, A.ScalarSubquery):
+                spec, value_name = self._plan_scalar_subquery(n.subquery, r)
+                specs.append(spec)
+                a, b = value_name.split(".")
+                return A.Name((a, b))
+            if not isinstance(n, A.Node):
+                return n
+            kwargs = {}
+            for attr in getattr(n, "__dataclass_fields__", {}):
+                v = getattr(n, attr)
+                if isinstance(v, A.Node):
+                    kwargs[attr] = rewrite(v)
+                elif isinstance(v, tuple):
+                    kwargs[attr] = tuple(
+                        rewrite(x) if isinstance(x, A.Node) else x for x in v
+                    )
+                else:
+                    kwargs[attr] = v
+            return type(n)(**kwargs)
+
+        return rewrite(having_ast), specs
+
+    # -------------------------------------------------------- join order
     def _order_joins(
         self,
-        scans: list[Scan],
+        relations: list[Relation],
         equi: list[tuple[E.ColRef, E.ColRef]],
         residual: list[E.Expr],
     ) -> LogicalOp:
-        if not scans:
+        if not relations:
             raise ResolveError("SELECT without FROM is not supported")
-        if len(scans) == 1:
-            plan: LogicalOp = scans[0]
+        if len(relations) == 1:
+            plan = relations[0].plan
+            for c in residual:
+                plan = Filter(plan, c)
             return plan
-        remaining = {s.alias: s for s in scans}
-        sizes = {s.alias: self._scan_rows(s) for s in scans}
-        # start from the largest table (the fact side stays the probe side)
+        remaining = {rel.alias: rel for rel in relations}
+        sizes = {rel.alias: self._rel_rows(rel) for rel in relations}
         start = max(sizes, key=lambda a: sizes[a])
         joined = {start}
-        plan = remaining.pop(start)
+        plan = remaining.pop(start).plan
         pending_equi = list(equi)
         while remaining:
-            # candidate tables connected to the joined set
             best = None
-            for alias, s in remaining.items():
+            for alias in remaining:
                 keys = [
                     (l, r_)
                     for l, r_ in pending_equi
@@ -324,9 +786,8 @@ class Planner:
                 if best is None or sizes[alias] < sizes[best[0]]:
                     best = (alias, keys)
             if best is None:
-                # cross join fallback: smallest remaining
                 alias = min(remaining, key=lambda a: sizes[a])
-                plan = JoinOp("cross", plan, remaining.pop(alias))
+                plan = JoinOp("cross", plan, remaining.pop(alias).plan)
                 joined.add(alias)
                 continue
             alias, keys = best
@@ -342,20 +803,28 @@ class Planner:
             plan = JoinOp(
                 "inner",
                 plan,
-                remaining.pop(alias),
+                remaining.pop(alias).plan,
                 tuple(lkeys),
                 tuple(rkeys),
             )
             joined.add(alias)
-        # leftover equi conds (cycles) + residuals become filters on top
         leftover = [E.Compare("=", l, r_) for l, r_ in pending_equi] + residual
         for c in leftover:
             plan = Filter(plan, c)
         return plan
 
 
+def _rename_cols(e: E.Expr, mapping: dict[str, str]) -> E.Expr:
+    sub = {E.ColRef(old): E.ColRef(new) for old, new in mapping.items()}
+    return _substitute(e, sub)
+
+
 def _select_has_agg(sel: A.Select) -> bool:
     def walk(n) -> bool:
+        if isinstance(n, (A.ScalarSubquery, A.ExistsOp)):
+            return False  # nested subqueries have their own scope
+        if isinstance(n, A.InOp) and n.subquery is not None:
+            return False
         if isinstance(n, A.FuncCall) and n.name in (
             "sum", "count", "min", "max", "avg",
         ):
@@ -379,8 +848,6 @@ def _select_has_agg(sel: A.Select) -> bool:
 
 
 def _substitute_out(e: E.Expr, out_items: list[tuple[str, E.Expr]]) -> E.Expr:
-    """Rewrite an agg-schema expr into projection-output space where an
-    identical expression is already projected."""
     for n, oe in out_items:
         if e == oe:
             return E.ColRef(n)

@@ -1,0 +1,133 @@
+"""All 22 TPC-H queries: engine results vs an independent sqlite oracle.
+
+The oracle loads the SAME generated tables into sqlite (decimals decoded to
+floats, dates to ISO strings, dictionary columns to strings) and runs a
+lightly transliterated query text (date literals folded, extract/substring
+spelled the sqlite way). Results compare as multisets of rounded row tuples
+— ORDER BY ties make positional comparison ill-defined, and both engines'
+float sums carry rounding noise.
+"""
+
+import math
+import re
+import sqlite3
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.engine import Session
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import QUERIES, SUPPORTED, UNIQUE_KEYS
+
+
+@pytest.fixture(scope="module")
+def db():
+    tables = datagen.generate(sf=0.01)
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    conn = sqlite3.connect(":memory:")
+    for name, t in tables.items():
+        cols = t.schema.names()
+        decoded = {}
+        for c in cols:
+            dt = t.schema[c]
+            if dt.kind.value == "varchar":
+                decoded[c] = t.dicts[c].decode(t.data[c])
+            elif dt.is_decimal:
+                decoded[c] = (t.data[c] / dt.decimal_factor).tolist()
+            elif dt.kind.value == "date":
+                base = np.datetime64("1970-01-01", "D")
+                decoded[c] = [str(base + int(v)) for v in t.data[c]]
+            else:
+                decoded[c] = t.data[c].tolist()
+        conn.execute(f"create table {name} ({', '.join(cols)})")
+        rows = list(zip(*[decoded[c] for c in cols]))
+        ph = ",".join("?" * len(cols))
+        conn.executemany(f"insert into {name} values ({ph})", rows)
+    conn.commit()
+    return tables, sess, conn
+
+
+_DATE_ARITH = re.compile(
+    r"date\s+'(\d{4}-\d{2}-\d{2})'\s*([-+])\s*interval\s+'(\d+)'\s+(day|month|year)"
+)
+_DATE_LIT = re.compile(r"date\s+'(\d{4}-\d{2}-\d{2})'")
+_EXTRACT = re.compile(r"extract\s*\(\s*year\s+from\s+([A-Za-z_][\w.]*)\s*\)")
+_SUBSTRING = re.compile(
+    r"substring\s*\(\s*([A-Za-z_][\w.]*)\s+from\s+(\d+)\s+for\s+(\d+)\s*\)"
+)
+
+
+def _fold_date(m: re.Match) -> str:
+    d = np.datetime64(m.group(1), "D")
+    n = int(m.group(3)) * (-1 if m.group(2) == "-" else 1)
+    unit = m.group(4)
+    if unit == "day":
+        d = d + np.timedelta64(n, "D")
+    else:
+        months = n * (12 if unit == "year" else 1)
+        mo = d.astype("datetime64[M]") + np.timedelta64(months, "M")
+        dom = (d - d.astype("datetime64[M]")).astype(int)
+        nxt = (mo + np.timedelta64(1, "M")).astype("datetime64[D]")
+        last = (nxt - mo.astype("datetime64[D]")).astype(int) - 1
+        d = mo.astype("datetime64[D]") + np.timedelta64(min(int(dom), int(last)), "D")
+    return f"'{d}'"
+
+
+def to_sqlite(sql: str) -> str:
+    sql = _DATE_ARITH.sub(_fold_date, sql)
+    sql = _DATE_LIT.sub(lambda m: f"'{m.group(1)}'", sql)
+    sql = _EXTRACT.sub(lambda m: f"cast(substr({m.group(1)}, 1, 4) as integer)", sql)
+    sql = _SUBSTRING.sub(lambda m: f"substr({m.group(1)}, {m.group(2)}, {m.group(3)})", sql)
+    return sql
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, (float, np.floating)):
+        if math.isnan(v):
+            return None  # engine surfaces SQL NULL as NaN for floats
+        # round to 4 significant-ish decimals for stable comparison
+        return round(float(v), 2)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    return v
+
+
+def _norm_engine_value(v, name):
+    # engine returns dates as int days; sqlite as ISO strings
+    if isinstance(v, (int, np.integer)) and ("date" in name):
+        return str(np.datetime64("1970-01-01", "D") + int(v))
+    return _norm(v)
+
+
+@pytest.mark.parametrize("qid", SUPPORTED)
+def test_tpch_vs_sqlite(db, qid):
+    tables, sess, conn = db
+    rs = sess.sql(QUERIES[qid])
+    cur = conn.execute(to_sqlite(QUERIES[qid]))
+    want = [tuple(_norm(v) for v in row) for row in cur.fetchall()]
+    got = []
+    for i in range(rs.nrows):
+        got.append(
+            tuple(
+                _norm_engine_value(rs.columns[n][i], n) for n in rs.names
+            )
+        )
+    assert len(got) == len(want), (qid, len(got), len(want), got[:3], want[:3])
+    # multiset comparison with float tolerance: sort then pairwise-compare
+    def keyf(row):
+        return tuple(
+            (x if not isinstance(x, float) else round(x, 0)) if x is not None else ""
+            for x in row
+        )
+
+    for g, w in zip(sorted(got, key=repr), sorted(want, key=repr)):
+        assert len(g) == len(w)
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float) or isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=1e-4, abs=1e-2), (qid, g, w)
+            else:
+                assert gv == wv, (qid, g, w)
