@@ -450,8 +450,14 @@ class Executor:
 
             raise NotImplementedError(type(op))
 
-        def run(inputs: dict[str, ColumnBatch]):
-            out, ovf = emit(plan, inputs)
+        def run(inputs: dict[str, ColumnBatch], qparams: tuple = ()):
+            from ..expr import compile as expr_compile
+
+            prev = expr_compile.set_params(qparams if qparams else None)
+            try:
+                out, ovf = emit(plan, inputs)
+            finally:
+                expr_compile.set_params(prev)
             ovf_vec = [
                 ovf.get(nid, jnp.zeros((), jnp.int64)) for nid in overflow_nodes
             ]
@@ -788,13 +794,13 @@ class PreparedPlan:
         self.input_spec = input_spec
         self.overflow_nodes = overflow_nodes
 
-    def run(self, max_retries: int = 3):
+    def run(self, max_retries: int = 3, qparams: tuple = ()):
         for attempt in range(max_retries + 1):
             inputs = {
                 alias: self.executor.table_batch(table, cols)
                 for alias, table, cols in self.input_spec
             }
-            out, ovf_vec = self.jitted(inputs)
+            out, ovf_vec = self.jitted(inputs, qparams)
             overflows = {
                 nid: int(v)
                 for nid, v in zip(self.overflow_nodes, ovf_vec)

@@ -17,6 +17,13 @@ import numpy as np
 from ..core.column import batch_to_host
 from ..core.table import Table
 from ..sql import parser as P
+from ..sql.plan_cache import (
+    CacheEntry,
+    PlanCache,
+    bind,
+    parameterize,
+    plan_fingerprint,
+)
 from ..sql.planner import Planner
 from .executor import Executor
 
@@ -39,26 +46,36 @@ class ResultSet:
 
 
 class Session:
-    def __init__(self, catalog: dict[str, Table], unique_keys=None):
+    def __init__(self, catalog: dict[str, Table], unique_keys=None,
+                 plan_cache: PlanCache | None = None):
         self.catalog = catalog
         self.planner = Planner(catalog)
         self.executor = Executor(catalog, unique_keys=unique_keys)
-        self._plan_cache: dict[str, tuple] = {}
+        # shareable across sessions (the reference's cache is per-tenant,
+        # not per-session: ob_plan_cache.h:227)
+        self.plan_cache = plan_cache if plan_cache is not None else PlanCache()
 
     def sql(self, text: str) -> ResultSet:
-        key, _params = P.normalize_for_cache(text)
-        cached = self._plan_cache.get(key)
-        if cached is None or cached[0] != text:
-            # (round-1 cache: exact text only; parameterized plans replace
-            # this once the executor takes literals as runtime args)
-            ast = P.parse(text)
-            planned = self.planner.plan(ast)
-            prepared = self.executor.prepare(planned.plan)
-            cached = (text, planned, prepared)
-            self._plan_cache[key] = cached
-        _, planned, prepared = cached
-        out_batch = prepared.run()
+        norm_key, _ = P.normalize_for_cache(text)
+        # parse + logical plan always run (host-cheap, the fast-parser
+        # analog); the cache skips trace + XLA compile (the expensive part)
+        ast = P.parse(text)
+        planned = self.planner.plan(ast)
+        pz = parameterize(planned.plan)
+        # id(catalog) scopes entries to one table set (cache sharing is per
+        # tenant = per catalog; entries pin their executor -> catalog, so the
+        # id cannot be recycled while the entry lives); the plan fingerprint
+        # catches literals consumed at plan time (ORDER BY ordinals etc.)
+        key = (id(self.catalog), norm_key, pz.sig, pz.baked,
+               plan_fingerprint(pz.plan))
+        entry = self.plan_cache.get(key)
+        if entry is None:
+            prepared = self.executor.prepare(pz.plan)
+            entry = CacheEntry(prepared, planned.output_names, pz.dtypes)
+            self.plan_cache.put(key, entry)
+        qparams = bind(pz.values, entry.dtypes)
+        out_batch = entry.prepared.run(qparams=qparams)
         host = batch_to_host(out_batch)
         # order columns per select list
-        cols = {n: host[n] for n in planned.output_names}
-        return ResultSet(planned.output_names, cols)
+        cols = {n: host[n] for n in entry.output_names}
+        return ResultSet(entry.output_names, cols)

@@ -60,6 +60,39 @@ MAX_DECIMAL_SCALE = 6
 
 
 # ---------------------------------------------------------------------------
+# query parameters (plan-cache parameterized literals)
+# ---------------------------------------------------------------------------
+
+# Traced scalars for slotted Literals, active only while an executor traces /
+# runs a parameterized plan. Reference: parameter frames bound into ObEvalCtx
+# at execution (sql/plan_cache parameterization); here the "frame" is a tuple
+# of 0-d device arrays passed as an extra jit argument.
+_ACTIVE_PARAMS: tuple | None = None
+
+
+def set_params(params: tuple | None):
+    """Install the active parameter tuple; returns the previous one."""
+    global _ACTIVE_PARAMS
+    prev = _ACTIVE_PARAMS
+    _ACTIVE_PARAMS = params
+    return prev
+
+
+def bind_value(value, dtype: DataType) -> np.generic:
+    """Convert a python literal to its physical storage scalar (host side).
+
+    Mirrors _literal_as so a bound parameter lands in exactly the domain the
+    trace assumed: decimals as scaled ints, dates as int32 days."""
+    if dtype.kind is TypeKind.DATE:
+        if isinstance(value, str):
+            value = _parse_date(value)
+        return np.int32(value)
+    if dtype.is_decimal:
+        return dtype.storage_np.type(int(round(float(value) * dtype.decimal_factor)))
+    return dtype.storage_np.type(value)
+
+
+# ---------------------------------------------------------------------------
 # type inference
 # ---------------------------------------------------------------------------
 
@@ -164,19 +197,15 @@ def _rescale_decimal(vals, from_scale: int, to_scale: int):
 
 
 def _literal_as(value, target: DataType, batch: ColumnBatch, col_name: str | None):
-    """Materialize a python literal in the physical domain of `target`."""
+    """Materialize a python literal in the physical domain of `target`.
+
+    Single source of truth is bind_value: traced constants and bound
+    plan-cache parameters MUST land in bit-identical physical domains."""
     if value is None:
         return None
-    if target.kind is TypeKind.DATE and isinstance(value, str):
-        return jnp.asarray(_parse_date(value), dtype=jnp.int32)
     if target.kind is TypeKind.VARCHAR:
         raise AssertionError("string literals handled by dictionary paths")
-    np_dt = target.storage_np
-    if target.is_decimal:
-        return jnp.asarray(
-            int(round(float(value) * target.decimal_factor)), dtype=np_dt
-        )
-    return jnp.asarray(value, dtype=np_dt)
+    return jnp.asarray(bind_value(value, target))
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +228,10 @@ def evaluate(e: Expr, batch: ColumnBatch):
                 jnp.zeros(cap, dtype=t.storage_np),
                 jnp.zeros(cap, dtype=jnp.bool_),
             )
+        if e.slot is not None and _ACTIVE_PARAMS is not None:
+            # parameterized plan: the value is a traced scalar already in
+            # the literal's physical storage domain (bind_value)
+            return _ACTIVE_PARAMS[e.slot], None
         if t.kind is TypeKind.VARCHAR:
             raise NotImplementedError(
                 "bare string literal outside a dictionary comparison"

@@ -35,11 +35,30 @@ class ColRef(Expr):
 
 @dataclass(frozen=True)
 class Literal(Expr):
+    """A constant. When `slot` is set, the literal is a plan-cache parameter:
+    its value arrives at run time as a traced scalar (expr/compile.py
+    bind_params), so one XLA executable serves every literal value of the
+    same type — the TPU analog of ObPlanCache's parameterized plans
+    (sql/plan_cache/ob_plan_cache.h:227), where recompilation is seconds,
+    not microseconds. `value` keeps the first-seen constant for host-side
+    decisions and unparameterized evaluation."""
+
     value: object  # python int/float/str/bool/None
     dtype: DataType
+    slot: int | None = None
 
     def __str__(self):
+        if self.slot is not None:
+            return f"?{self.slot}"
         return repr(self.value)
+
+    def __repr__(self):
+        # slotted literals must repr independent of their first-seen value:
+        # plan fingerprints (sql/plan_cache.plan_fingerprint) feed on repr,
+        # and a value leak would defeat parameterized plan sharing
+        if self.slot is not None:
+            return f"Literal(?{self.slot}, {self.dtype})"
+        return f"Literal({self.value!r}, {self.dtype})"
 
 
 @dataclass(frozen=True)

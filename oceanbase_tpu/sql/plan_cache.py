@@ -1,0 +1,273 @@
+"""Plan cache with literal parameterization.
+
+Reference surface: ObPlanCache + the fast-parser parameterization pipeline
+(src/sql/plan_cache/ob_plan_cache.h:227, sql/parser/ob_fast_parser.h). The
+reference caches physical plans keyed by literal-normalized SQL so repeated
+statements skip the compiler; a "plan set" under each key matches incoming
+parameter types to a compiled plan.
+
+On TPU the cached artifact is the jitted XLA executable, and a recompile
+costs seconds — so parameterization is not an optimization but the thing
+that makes a plan cache meaningful at all:
+
+- numeric / decimal / date literals become runtime scalars (Literal.slot)
+  fed to the jitted program as an extra argument; one executable serves
+  every value.
+- string literals, LIKE patterns, IN lists and function arguments stay
+  baked: they drive host-side dictionary lookup tables at trace time (the
+  reference marks the analogous cases "must be checked" fixed consts). Their
+  values join the cache key, so a different pattern compiles a new plan
+  rather than reusing a wrong one.
+
+Eviction is LRU by entry count (the reference evicts by memory watermark,
+ob_plan_cache.h evict_expired_plan; entry count is the honest proxy here
+because the dominant cost is one XLA executable per entry).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field, replace as dc_replace
+
+from ..core.dtypes import TypeKind
+from ..expr import ir as E
+from .logical import (
+    Aggregate,
+    Distinct,
+    Filter,
+    JoinOp,
+    Limit,
+    LogicalOp,
+    Project,
+    Scan,
+    Sort,
+)
+
+# literal kinds whose values may become runtime parameters
+_PARAM_KINDS = {
+    TypeKind.INT8,
+    TypeKind.INT16,
+    TypeKind.INT32,
+    TypeKind.INT64,
+    TypeKind.FLOAT32,
+    TypeKind.FLOAT64,
+    TypeKind.DECIMAL,
+    TypeKind.DATE,
+}
+
+
+@dataclass
+class ParamizeResult:
+    plan: LogicalOp
+    values: list  # python values per slot, in slot order
+    dtypes: list  # DataType per slot
+    sig: tuple  # parameter type signature (part of the cache key)
+    baked: tuple  # non-parameterizable literal values (part of the cache key)
+
+
+class _Paramizer:
+    def __init__(self):
+        self.values = []
+        self.dtypes = []
+        self.baked = []
+
+    # ---- expressions -----------------------------------------------------
+    def expr(self, e: E.Expr | None, in_func: bool = False) -> E.Expr | None:
+        if e is None:
+            return None
+        if isinstance(e, E.Literal):
+            if (
+                not in_func
+                and e.value is not None
+                and e.dtype.kind in _PARAM_KINDS
+            ):
+                slot = len(self.values)
+                self.values.append(e.value)
+                self.dtypes.append(e.dtype)
+                return E.Literal(e.value, e.dtype, slot=slot)
+            self.baked.append(e.value)
+            return e
+        if isinstance(e, E.ColRef):
+            return e
+        if isinstance(e, E.BinaryOp):
+            return E.BinaryOp(e.op, self.expr(e.left, in_func), self.expr(e.right, in_func))
+        if isinstance(e, E.Compare):
+            return E.Compare(e.op, self.expr(e.left, in_func), self.expr(e.right, in_func))
+        if isinstance(e, E.BoolOp):
+            return E.BoolOp(e.op, tuple(self.expr(a, in_func) for a in e.args))
+        if isinstance(e, E.Not):
+            return E.Not(self.expr(e.arg, in_func))
+        if isinstance(e, E.IsNull):
+            return E.IsNull(self.expr(e.arg, in_func), e.negated)
+        if isinstance(e, E.Cast):
+            return E.Cast(self.expr(e.arg, in_func), e.dtype)
+        if isinstance(e, E.Case):
+            whens = tuple(
+                (self.expr(c, in_func), self.expr(v, in_func)) for c, v in e.whens
+            )
+            return E.Case(whens, self.expr(e.default, in_func))
+        if isinstance(e, E.InList):
+            # membership sets become boolean LUTs / unrolled comparisons at
+            # trace time; keep them baked and key-relevant
+            self.baked.extend(e.values)
+            return E.InList(self.expr(e.arg, in_func), e.values, e.negated)
+        if isinstance(e, E.Between):
+            return E.Between(
+                self.expr(e.arg, in_func),
+                self.expr(e.low, in_func),
+                self.expr(e.high, in_func),
+                e.negated,
+            )
+        if isinstance(e, E.Func):
+            # function args (LIKE patterns, substr bounds) drive host-side
+            # dictionary transforms during tracing: never parameterize
+            return E.Func(e.name, tuple(self.expr(a, True) for a in e.args))
+        raise NotImplementedError(type(e))
+
+    # ---- plan nodes ------------------------------------------------------
+    def plan(self, op: LogicalOp) -> LogicalOp:
+        if isinstance(op, Scan):
+            return dc_replace(op, pushed_filter=self.expr(op.pushed_filter))
+        if isinstance(op, Filter):
+            return dc_replace(op, child=self.plan(op.child), pred=self.expr(op.pred))
+        if isinstance(op, Project):
+            return dc_replace(
+                op,
+                child=self.plan(op.child),
+                exprs=tuple((n, self.expr(e)) for n, e in op.exprs),
+            )
+        if isinstance(op, JoinOp):
+            return dc_replace(
+                op,
+                left=self.plan(op.left),
+                right=self.plan(op.right),
+                left_keys=tuple(self.expr(e) for e in op.left_keys),
+                right_keys=tuple(self.expr(e) for e in op.right_keys),
+                residual=self.expr(op.residual),
+            )
+        if isinstance(op, Aggregate):
+            return dc_replace(
+                op,
+                child=self.plan(op.child),
+                group_keys=tuple((n, self.expr(e)) for n, e in op.group_keys),
+                aggs=tuple(
+                    (n, fn, self.expr(a), d) for n, fn, a, d in op.aggs
+                ),
+            )
+        if isinstance(op, Sort):
+            return dc_replace(
+                op,
+                child=self.plan(op.child),
+                keys=tuple((self.expr(e), d) for e, d in op.keys),
+            )
+        if isinstance(op, Limit):
+            # limit/offset shape the static output capacity: structural
+            self.baked.append(("limit", op.n, op.offset))
+            return dc_replace(op, child=self.plan(op.child))
+        if isinstance(op, Distinct):
+            return dc_replace(op, child=self.plan(op.child))
+        raise NotImplementedError(type(op))
+
+
+def parameterize(plan: LogicalOp) -> ParamizeResult:
+    p = _Paramizer()
+    plan2 = p.plan(plan)
+    sig = tuple(str(t) for t in p.dtypes)
+    return ParamizeResult(plan2, p.values, p.dtypes, sig, tuple(map(repr, p.baked)))
+
+
+_GENSYM_RE = None
+
+
+def plan_fingerprint(plan: LogicalOp) -> str:
+    """Structural digest of a (parameterized) plan.
+
+    Part of the cache key: literals the PLANNER consumes (ORDER BY ordinals,
+    hoisted conjuncts, unnesting choices) leave no Literal node behind, so
+    normalized SQL + params alone can collide across genuinely different
+    plans. The dataclass repr covers node types, column refs, sort keys,
+    limits and slot numbers deterministically; md5 keeps the key small.
+
+    Gensym names ($agg3, $sub1, ...) come from global counters so two
+    plannings of the SAME query get different numbers — canonicalize them
+    by first occurrence before hashing."""
+    import hashlib
+    import re
+
+    global _GENSYM_RE
+    if _GENSYM_RE is None:
+        _GENSYM_RE = re.compile(r"\$([a-z]+)\d+")
+    mapping: dict[str, str] = {}
+
+    def canon(m):
+        tok = m.group(0)
+        if tok not in mapping:
+            mapping[tok] = f"${m.group(1)}#{len(mapping)}"
+        return mapping[tok]
+
+    r = _GENSYM_RE.sub(canon, repr(plan))
+    return hashlib.md5(r.encode()).hexdigest()
+
+
+def bind(values, dtypes) -> tuple:
+    """Host-convert literal values to physical scalars for the jit call."""
+    import jax.numpy as jnp
+
+    from ..expr.compile import bind_value
+
+    return tuple(
+        jnp.asarray(bind_value(v, t)) for v, t in zip(values, dtypes)
+    )
+
+
+@dataclass
+class CacheEntry:
+    prepared: object  # engine.executor.PreparedPlan
+    output_names: tuple[str, ...]
+    dtypes: list
+    hits: int = 0
+
+
+@dataclass
+class PlanCacheStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class PlanCache:
+    """LRU cache: (normalized SQL, param signature, baked literals) ->
+    compiled plan. One entry = one XLA executable."""
+
+    def __init__(self, capacity: int = 128):
+        self.capacity = capacity
+        self._entries: OrderedDict[tuple, CacheEntry] = OrderedDict()
+        self.stats = PlanCacheStats()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def get(self, key: tuple) -> CacheEntry | None:
+        ent = self._entries.get(key)
+        if ent is not None:
+            self._entries.move_to_end(key)
+            ent.hits += 1
+            self.stats.hits += 1
+        else:
+            self.stats.misses += 1
+        return ent
+
+    def put(self, key: tuple, entry: CacheEntry):
+        self._entries[key] = entry
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self.stats.evictions += 1
+
+    def flush(self):
+        self._entries.clear()
