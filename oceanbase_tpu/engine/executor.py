@@ -174,6 +174,11 @@ class Executor:
         rec(plan)
         return needed
 
+    def invalidate_table(self, name: str) -> None:
+        """Drop cached device batches of one table (its data changed)."""
+        for key in [k for k in self._batch_cache if k[0] == name]:
+            del self._batch_cache[key]
+
     def table_batch(self, name: str, cols: tuple[str, ...]) -> ColumnBatch:
         key = (name, cols)
         if key not in self._batch_cache:

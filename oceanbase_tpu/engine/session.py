@@ -32,6 +32,7 @@ from .executor import Executor
 class ResultSet:
     names: tuple[str, ...]
     columns: dict[str, object]  # name -> np.ndarray | list
+    affected: int = 0  # DML-affected row count (0 for queries)
 
     @property
     def nrows(self) -> int:
@@ -47,27 +48,44 @@ class ResultSet:
 
 class Session:
     def __init__(self, catalog: dict[str, Table], unique_keys=None,
-                 plan_cache: PlanCache | None = None):
+                 plan_cache: PlanCache | None = None, key_extra_fn=None):
         self.catalog = catalog
         self.planner = Planner(catalog)
         self.executor = Executor(catalog, unique_keys=unique_keys)
         # shareable across sessions (the reference's cache is per-tenant,
         # not per-session: ob_plan_cache.h:227)
         self.plan_cache = plan_cache if plan_cache is not None else PlanCache()
+        # hook: extra cache-key material per referenced table set (the
+        # DML-backed catalog keys entries on table dict versions, since
+        # string literals bake dictionary lookups at trace time)
+        self.key_extra_fn = key_extra_fn
 
     def sql(self, text: str) -> ResultSet:
         norm_key, _ = P.normalize_for_cache(text)
         # parse + logical plan always run (host-cheap, the fast-parser
         # analog); the cache skips trace + XLA compile (the expensive part)
         ast = P.parse(text)
+        return self.run_ast(ast, norm_key)
+
+    def run_ast(self, ast, norm_key: str) -> ResultSet:
+        """Plan + execute an already-parsed SELECT under the plan cache.
+
+        Shared by text queries and internal consumers (the DML layer's
+        UPDATE/DELETE qualification scans, virtual-table queries)."""
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
+        extra = ()
+        if self.key_extra_fn is not None:
+            tables = tuple(sorted(
+                {s.table for s in self.executor._collect_scans(pz.plan)}
+            ))
+            extra = self.key_extra_fn(tables)
         # id(catalog) scopes entries to one table set (cache sharing is per
         # tenant = per catalog; entries pin their executor -> catalog, so the
         # id cannot be recycled while the entry lives); the plan fingerprint
         # catches literals consumed at plan time (ORDER BY ordinals etc.)
         key = (id(self.catalog), norm_key, pz.sig, pz.baked,
-               plan_fingerprint(pz.plan))
+               plan_fingerprint(pz.plan), extra)
         entry = self.plan_cache.get(key)
         if entry is None:
             prepared = self.executor.prepare(pz.plan)

@@ -1,0 +1,535 @@
+"""Database: full-statement SQL over a replicated cluster (observer analog).
+
+Reference surface:
+  * statement dispatch: ObMPQuery::process -> ObSql::stmt_query
+    (observer/mysql/obmp_query.cpp:53, sql/ob_sql.cpp:153) — here
+    DbSession.sql() parsing any statement and dispatching DDL / DML / query;
+  * DML operators + DAS write path: ObTableModifyOp -> ObDMLService ->
+    ObAccessService -> ObMemtable::set (sql/engine/dml/ob_table_modify_op.h:190,
+    storage/memtable/ob_memtable.cpp:540) — here UPDATE/DELETE qualify rows
+    by running a generated SELECT through the TPU engine, then stage
+    mutations through TransService into leader memtables;
+  * tx control: ObSqlTransControl (sql/ob_sql_trans_control.cpp:229) —
+    BEGIN/COMMIT/ROLLBACK with snapshot-isolation reads.
+
+HTAP loop: writes go through MVCC memtables + the replicated log; reads
+materialize a snapshot via scan_merge into a core Table and ship it to the
+device once per data version (the marshalling point the north star names).
+VARCHAR columns store APPEND-ORDER dictionary codes (stable under inserts,
+so logged rows never need re-encoding); at snapshot materialization the
+codes are remapped through a cached sorted dictionary so the engine's
+code-order == string-order invariant holds on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.dictionary import Dictionary
+from ..core.dtypes import DataType, Field, Schema, TypeKind
+from ..core.table import Table
+from ..engine.session import ResultSet, Session
+from ..sql import ast as A
+from ..sql import parser as P
+from ..sql.logical import _parse_type
+from ..sql.plan_cache import PlanCache
+from ..storage import OP_DELETE, OP_PUT
+from ..tx.cluster import LocalCluster
+
+
+class SqlError(Exception):
+    pass
+
+
+@dataclass
+class TableInfo:
+    """Schema-service record of one user table (one tablet shard for now)."""
+
+    name: str
+    schema: Schema
+    key_cols: list[str]
+    ls_id: int
+    tablet_id: int
+    # append-order dictionaries: code assignment is insertion order, so
+    # logged/stored codes stay valid as strings arrive (the sorted view is
+    # derived at read time)
+    dicts: dict[str, Dictionary] = field(default_factory=dict)
+    data_version: int = 0  # bumped on every committed DML batch
+    schema_version: int = 0  # set at create time (schema service analog)
+    # snapshot-materialization caches
+    cached_data_version: int = -1
+    cached_table: Table | None = None
+    # per-column (dict length at build time, sorted Dictionary, remap array)
+    _sorted_cache: dict[str, tuple[int, Dictionary, np.ndarray]] = field(
+        default_factory=dict
+    )
+
+    @property
+    def dict_sig(self) -> tuple:
+        """Dictionary-state signature. Append-order dictionaries only grow,
+        so length IS the version — derived, not book-kept, which makes it
+        immune to failed statements that encoded strings before erroring."""
+        return tuple(sorted((c, len(d)) for c, d in self.dicts.items()))
+
+    def sorted_dict(self, col: str) -> tuple[Dictionary, np.ndarray]:
+        """Sorted view + old-code -> sorted-code remap, cached per length.
+
+        Returning the SAME Dictionary object while the length is unchanged
+        matters: dictionaries are static metadata of device batches, so a
+        stable object keeps the jit cache warm across data refreshes."""
+        d = self.dicts[col]
+        hit = self._sorted_cache.get(col)
+        if hit is not None and hit[0] == len(d):
+            return hit[1], hit[2]
+        codes = np.arange(len(d), dtype=np.int32)
+        sd, remap = d.finalize_sorted(codes)
+        self._sorted_cache[col] = (len(d), sd, remap)
+        return sd, remap
+
+
+class Database:
+    """An in-process replicated database: schema + cluster + analytic engine.
+
+    One Database ~ one tenant of the reference: a catalog, a plan cache, a
+    set of log streams with tablets, and sessions issuing any SQL.
+    """
+
+    def __init__(self, n_nodes: int = 3, n_ls: int = 2,
+                 extra_catalog: dict[str, Table] | None = None):
+        self.cluster = LocalCluster(n_nodes=n_nodes)
+        for ls in range(1, n_ls + 1):
+            self.cluster.create_ls(ls)
+        self.cluster.finalize()
+        self.n_ls = n_ls
+        self.tables: dict[str, TableInfo] = {}
+        # analytic catalog: table name -> snapshot Table (plus any read-only
+        # preloaded tables, e.g. benchmark data)
+        self.catalog: dict[str, Table] = dict(extra_catalog or {})
+        self._preloaded = set(self.catalog)
+        self.plan_cache = PlanCache()
+        self._unique_keys: dict[str, tuple[str, ...]] = {}
+        self.engine = Session(
+            self.catalog,
+            unique_keys=self._unique_keys,
+            plan_cache=self.plan_cache,
+            key_extra_fn=self._key_extra,
+        )
+        self._next_tablet = 200001
+        self._next_ls_rr = 0
+        self._ddl_lock = threading.RLock()
+        self._schema_version = 0
+
+    # ------------------------------------------------------------ schema
+    def _key_extra(self, table_names: tuple[str, ...]) -> tuple:
+        """Plan-cache key material: schema + dictionary versions of the
+        referenced DML-backed tables (string literals bake dictionary
+        lookups at trace time; a grown dictionary needs a fresh trace)."""
+        out = []
+        for t in table_names:
+            ti = self.tables.get(t)
+            if ti is not None:
+                out.append((t, ti.schema_version, ti.dict_sig))
+        return tuple(out)
+
+    def create_table(self, stmt: A.CreateTable) -> None:
+        with self._ddl_lock:
+            if stmt.name in self.tables or stmt.name in self.catalog:
+                if stmt.if_not_exists:
+                    return
+                raise SqlError(f"table {stmt.name} already exists")
+            fields = []
+            for c in stmt.columns:
+                dt = _parse_type(c.type_name)
+                if not c.not_null:
+                    dt = dt.with_nullable(True)
+                fields.append(Field(c.name, dt))
+            schema = Schema(tuple(fields))
+            pk = list(stmt.primary_key) or [stmt.columns[0].name]
+            for k in pk:
+                if k not in schema:
+                    raise SqlError(f"primary key column {k} not in table")
+                # key columns are implicitly NOT NULL (MySQL semantics)
+                i = schema.index(k)
+                fields[i] = Field(k, fields[i].dtype.with_nullable(False))
+            schema = Schema(tuple(fields))
+            ls_id = 1 + (self._next_ls_rr % self.n_ls)
+            self._next_ls_rr += 1
+            tablet_id = self._next_tablet
+            self._next_tablet += 1
+            self.cluster.create_tablet(ls_id, tablet_id, schema, pk)
+            self._schema_version += 1
+            ti = TableInfo(stmt.name, schema, pk, ls_id, tablet_id,
+                           schema_version=self._schema_version)
+            for f in schema.fields:
+                if f.dtype.kind is TypeKind.VARCHAR:
+                    ti.dicts[f.name] = Dictionary()
+            self.tables[stmt.name] = ti
+            self._unique_keys[stmt.name] = tuple(pk)
+            self.catalog[stmt.name] = Table(stmt.name, schema, {
+                f.name: np.zeros(0, f.dtype.storage_np) for f in schema.fields
+            })
+
+    def drop_table(self, stmt: A.DropTable) -> None:
+        with self._ddl_lock:
+            ti = self.tables.pop(stmt.name, None)
+            if ti is None:
+                if stmt.if_exists:
+                    return
+                raise SqlError(f"no such table {stmt.name}")
+            self.catalog.pop(stmt.name, None)
+            self._unique_keys.pop(stmt.name, None)
+            self.engine.executor.invalidate_table(stmt.name)
+            self._schema_version += 1
+            for rep in self.cluster.ls_groups[ti.ls_id].values():
+                rep.tablets.pop(ti.tablet_id, None)
+
+    # ---------------------------------------------------------- snapshots
+    def _leader_replica(self, ti: TableInfo):
+        node = self.cluster.leader_node(ti.ls_id)
+        return self.cluster.ls_groups[ti.ls_id][node]
+
+    def refresh_catalog(self, names, tx=None) -> None:
+        """Bring catalog snapshot Tables of the given tables up to date.
+
+        Inside an open tx every tablet table reads at the tx's BEGIN-time
+        snapshot (repeatable reads across the whole statement set); tables
+        the tx wrote additionally see their own staged rows via tx_id. Tx
+        views are never left in the committed cache."""
+        for name in names:
+            ti = self.tables.get(name)
+            if ti is None:
+                continue  # preloaded read-only table
+            in_tx = tx is not None and tx.ctx is not None
+            if not in_tx and ti.cached_data_version == ti.data_version:
+                continue
+            if in_tx:
+                touched = name in tx.touched_tables
+                rep = (tx.svc.replicas[ti.ls_id] if touched
+                       else self._leader_replica(ti))
+                data = rep.tablets[ti.tablet_id].scan(
+                    tx.ctx.read_snapshot,
+                    tx_id=tx.ctx.tx_id if touched else 0,
+                )
+            else:
+                rep = self._leader_replica(ti)
+                data = rep.tablets[ti.tablet_id].scan(self.cluster.gts.current())
+            dicts = {}
+            for col in ti.dicts:
+                sd, remap = ti.sorted_dict(col)
+                if len(data[col]):
+                    data[col] = remap[data[col]]
+                dicts[col] = sd
+            self.catalog[name] = Table(name, ti.schema, data, dicts)
+            self.engine.executor.invalidate_table(name)
+            if in_tx:
+                ti.cached_data_version = -1  # force rebuild after tx ends
+            else:
+                ti.cached_data_version = ti.data_version
+
+    # ------------------------------------------------------------ session
+    def session(self) -> "DbSession":
+        return DbSession(self)
+
+
+class _OpenTx:
+    """Client-side state of an open transaction."""
+
+    def __init__(self, db: Database):
+        self.db = db
+        self.svc = db.cluster.services[0]
+        self.ctx = self.svc.begin()
+        self.touched_tables: set[str] = set()
+
+    def ensure_leader(self, ls_id: int) -> None:
+        """Co-locate the LS leader with this tx's coordinating node (the
+        analog of routing the statement to a server leading the
+        participants)."""
+        if not self.svc.replicas[ls_id].is_ready:
+            self.db.cluster.transfer_leader(ls_id, self.svc.node_id)
+
+
+class DbSession:
+    """One client session: statement dispatch + transaction state."""
+
+    def __init__(self, db: Database):
+        self.db = db
+        self._tx: _OpenTx | None = None
+
+    # ------------------------------------------------------------ public
+    def sql(self, text: str) -> ResultSet:
+        stmt = P.parse_statement(text)
+        if isinstance(stmt, A.Select):
+            return self._select(stmt, P.normalize_for_cache(text)[0])
+        if isinstance(stmt, A.CreateTable):
+            self.db.create_table(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.DropTable):
+            self.db.drop_table(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.Begin):
+            if self._tx is not None:
+                raise SqlError("transaction already open")
+            self._tx = _OpenTx(self.db)
+            return ResultSet((), {})
+        if isinstance(stmt, A.Commit):
+            self._end_tx(commit=True)
+            return ResultSet((), {})
+        if isinstance(stmt, A.Rollback):
+            self._end_tx(commit=False)
+            return ResultSet((), {})
+        if isinstance(stmt, A.Insert):
+            return self._dml(lambda tx: self._insert(stmt, tx))
+        if isinstance(stmt, A.Update):
+            return self._dml(lambda tx: self._update(stmt, tx))
+        if isinstance(stmt, A.Delete):
+            return self._dml(lambda tx: self._delete(stmt, tx))
+        raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------ select
+    def _select(self, ast: A.Select, norm_key: str) -> ResultSet:
+        names = _tables_in_ast(ast)
+        self.db.refresh_catalog(names, tx=self._tx)
+        return self.db.engine.run_ast(ast, norm_key)
+
+    # --------------------------------------------------------------- tx
+    def _dml(self, body) -> ResultSet:
+        auto = self._tx is None
+        if auto:
+            self._tx = _OpenTx(self.db)
+        try:
+            affected = body(self._tx)
+        except Exception:
+            if auto:
+                self._end_tx(commit=False)
+            raise
+        if auto:
+            self._end_tx(commit=True)
+        return ResultSet((), {}, affected=affected)
+
+    def _end_tx(self, commit: bool) -> None:
+        tx = self._tx
+        self._tx = None
+        if tx is None or tx.ctx is None:
+            return
+        touched = tx.touched_tables
+        try:
+            if commit:
+                if touched:
+                    self.db.cluster.commit_sync(tx.svc, tx.ctx)
+                else:
+                    tx.svc.commit(tx.ctx)  # empty tx: finishes immediately
+            else:
+                tx.svc.abort(tx.ctx)
+        finally:
+            for name in touched:
+                ti = self.db.tables.get(name)
+                if ti is not None:
+                    if commit:
+                        ti.data_version += 1
+                    ti.cached_data_version = -1
+
+    # --------------------------------------------------------------- DML
+    def _stage_all(self, tx: _OpenTx, ti: TableInfo,
+                   muts: list[tuple[tuple, int, tuple | None]]) -> int:
+        """Stage a fully-validated mutation batch (statement atomicity: no
+        row reaches the memtable until the whole statement has resolved, so
+        a failed statement inside an explicit tx leaves no partial writes).
+        A WriteConflict during staging still aborts the whole tx — that is
+        transaction, not statement, semantics (first-committer-wins)."""
+        if muts:
+            tx.ensure_leader(ti.ls_id)
+            for key, op, vals in muts:
+                tx.svc.write(tx.ctx, ti.ls_id, ti.tablet_id, key, op, vals)
+            tx.touched_tables.add(ti.name)
+        return len(muts)
+
+    def _insert(self, st: A.Insert, tx: _OpenTx) -> int:
+        ti = self.db.tables.get(st.table)
+        if ti is None:
+            raise SqlError(f"no such table {st.table}")
+        names = list(st.columns) if st.columns else ti.schema.names()
+        for n in names:
+            if n not in ti.schema:
+                raise SqlError(f"unknown column {n}")
+        missing = [n for n in ti.schema.names() if n not in names]
+        if missing:
+            raise SqlError(f"insert must provide all columns (missing {missing})")
+
+        if st.select is not None:
+            rs = self._select(st.select, _norm_stmt(f"$ins:{st.table}", st.select))
+            src = [rs.columns[c] for c in rs.names]
+            py_rows = list(zip(*src)) if src else []
+        else:
+            py_rows = [tuple(_eval_const(e) for e in row) for row in st.rows]
+
+        order = [names.index(n) for n in ti.schema.names()]
+        tx.ensure_leader(ti.ls_id)
+        rep = tx.svc.replicas[ti.ls_id]
+        muts: list[tuple[tuple, int, tuple | None]] = []
+        seen: set[tuple] = set()
+        for row in py_rows:
+            if len(row) != len(names):
+                raise SqlError("value count does not match column count")
+            vals = tuple(
+                _coerce(row[order[i]], f.dtype, ti.dicts.get(f.name), f.name)
+                for i, f in enumerate(ti.schema.fields)
+            )
+            key = tuple(int(vals[ti.schema.index(k)]) for k in ti.key_cols)
+            if key in seen or rep.tablets[ti.tablet_id].get(
+                key, tx.ctx.read_snapshot, tx_id=tx.ctx.tx_id
+            ) is not None:
+                raise SqlError(f"duplicate primary key {key} in {st.table}")
+            seen.add(key)
+            muts.append((key, OP_PUT, vals))
+        return self._stage_all(tx, ti, muts)
+
+    def _qualify(self, st, ti: TableInfo, cols: list[str],
+                 set_exprs: tuple[tuple[str, A.Node], ...] = ()) -> ResultSet:
+        """Run the qualification scan for UPDATE/DELETE through the engine:
+        SELECT <cols> [, set-exprs] FROM t WHERE <pred> — the rebuild
+        analog of the DML operator's child scan."""
+        items = [A.SelectItem(A.Name((ti.name, c)), c) for c in cols]
+        for i, (_col, e) in enumerate(set_exprs):
+            items.append(A.SelectItem(e, f"$set{i}"))
+        sel = A.Select(
+            items=tuple(items),
+            from_=(A.TableRef(ti.name),),
+            where=st.where,
+        )
+        return self._select(sel, _norm_stmt(f"$dml:{ti.name}", st))
+
+    def _update(self, st: A.Update, tx: _OpenTx) -> int:
+        ti = self.db.tables.get(st.table)
+        if ti is None:
+            raise SqlError(f"no such table {st.table}")
+        for col, _ in st.assignments:
+            if col not in ti.schema:
+                raise SqlError(f"unknown column {col}")
+            if col in ti.key_cols:
+                raise SqlError(f"updating key column {col} not supported")
+        # constant assignments evaluate on host (a bare string literal has
+        # no device representation); computed ones ride the qualification
+        # scan as extra projections
+        const_sets: dict[str, object] = {}
+        computed: list[tuple[str, A.Node]] = []
+        for col, e in st.assignments:
+            try:
+                const_sets[col] = _eval_const(e)
+            except SqlError:
+                computed.append((col, e))
+        rs = self._qualify(st, ti, ti.schema.names(), tuple(computed))
+        set_cols = {col: rs.columns[f"$set{i}"]
+                    for i, (col, _) in enumerate(computed)}
+        muts: list[tuple[tuple, int, tuple | None]] = []
+        for r in range(rs.nrows):
+            vals = []
+            for f in ti.schema.fields:
+                if f.name in const_sets:
+                    v = const_sets[f.name]
+                else:
+                    src = set_cols.get(f.name)
+                    v = src[r] if src is not None else rs.columns[f.name][r]
+                vals.append(_coerce(v, f.dtype, ti.dicts.get(f.name), f.name))
+            vals = tuple(vals)
+            key = tuple(int(vals[ti.schema.index(k)]) for k in ti.key_cols)
+            muts.append((key, OP_PUT, vals))
+        return self._stage_all(tx, ti, muts)
+
+    def _delete(self, st: A.Delete, tx: _OpenTx) -> int:
+        ti = self.db.tables.get(st.table)
+        if ti is None:
+            raise SqlError(f"no such table {st.table}")
+        rs = self._qualify(st, ti, list(ti.key_cols))
+        muts: list[tuple[tuple, int, tuple | None]] = []
+        for r in range(rs.nrows):
+            key = tuple(
+                int(_coerce(rs.columns[k][r], ti.schema[k],
+                            ti.dicts.get(k), k))
+                for k in ti.key_cols
+            )
+            muts.append((key, OP_DELETE, None))
+        return self._stage_all(tx, ti, muts)
+
+
+# ---- helpers ---------------------------------------------------------------
+
+_LIT_MASK_RE = None
+
+
+def _norm_stmt(tag: str, st) -> str:
+    """Literal-normalized cache key for a generated DML qualification scan.
+
+    Numeric/date literals become runtime parameters during parameterize(),
+    so masking them here lets point UPDATE/DELETE loops share one compiled
+    plan (string literals stay: they are baked and already key material)."""
+    global _LIT_MASK_RE
+    if _LIT_MASK_RE is None:
+        import re
+
+        _LIT_MASK_RE = re.compile(r"(NumberLit|DateLit)\(value='[^']*'\)")
+    return tag + ":" + _LIT_MASK_RE.sub(r"\1(value='?')", repr(st))
+
+
+def _eval_const(node: A.Node):
+    """Evaluate a literal/constant VALUES expression on the host."""
+    if isinstance(node, A.NumberLit):
+        t = node.value
+        return float(t) if ("." in t or "e" in t or "E" in t) else int(t)
+    if isinstance(node, A.StringLit):
+        return node.value
+    if isinstance(node, A.DateLit):
+        return node.value
+    if isinstance(node, A.Name) and node.parts == ("null",):
+        raise SqlError("NULL values not supported in DML yet")
+    if isinstance(node, A.UnaryOp) and node.op == "-":
+        return -_eval_const(node.operand)
+    if isinstance(node, A.BinOp):
+        l, r = _eval_const(node.left), _eval_const(node.right)
+        return {"+": l + r, "-": l - r, "*": l * r, "/": l / r}[node.op]
+    raise SqlError(f"unsupported VALUES expression {node!r}")
+
+
+def _coerce(v, dt: DataType, d: Dictionary | None, col: str):
+    """Host value -> storage representation for one column."""
+    if v is None:
+        raise SqlError(f"NULL for column {col} not supported in DML yet")
+    if dt.kind is TypeKind.VARCHAR:
+        assert d is not None
+        return d.encode_one(str(v))
+    if dt.kind is TypeKind.DATE:
+        if isinstance(v, str):
+            return int(np.datetime64(v, "D").astype(np.int64))
+        return int(v)
+    if dt.is_decimal:
+        return int(round(float(v) * dt.decimal_factor))
+    if dt.is_integer:
+        iv = int(v)
+        if iv != v:
+            raise SqlError(f"non-integer value {v!r} for column {col}")
+        return iv
+    if dt.is_float:
+        return float(v)
+    raise SqlError(f"unsupported column type {dt} for DML")
+
+
+def _tables_in_ast(node) -> set[str]:
+    """All table names referenced anywhere in a statement AST."""
+    import dataclasses
+
+    out: set[str] = set()
+
+    def walk(n):
+        if isinstance(n, A.TableRef):
+            out.add(n.name)
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            for f in dataclasses.fields(n):
+                walk(getattr(n, f.name))
+        elif isinstance(n, (tuple, list)):
+            for x in n:
+                walk(x)
+
+    walk(node)
+    return out

@@ -187,3 +187,69 @@ class Select(Node):
     offset: int | None = None
     distinct: bool = False
     ctes: tuple[tuple[str, "Select"], ...] = ()  # WITH name AS (...)
+
+
+# ---- statements (DDL / DML / tx control) ----------------------------------
+# Reference surface: the DDL/DML resolvers under src/sql/resolver/{ddl,dml}
+# (ObCreateTableStmt, ObInsertStmt, ObUpdateStmt, ObDeleteStmt) and the tx
+# control statements handled by ObSqlTransControl (sql/ob_sql_trans_control).
+
+
+@dataclass(frozen=True)
+class ColumnDef(Node):
+    name: str
+    type_name: str  # as written: 'bigint' | 'decimal(12,2)' | 'varchar' ...
+    not_null: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTable(Node):
+    name: str
+    columns: tuple[ColumnDef, ...]
+    primary_key: tuple[str, ...]  # empty -> first column
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropTable(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Insert(Node):
+    table: str
+    columns: tuple[str, ...]  # empty -> full schema order
+    rows: tuple[tuple[Node, ...], ...] = ()  # literal/expr tuples
+    select: "Select | None" = None  # INSERT ... SELECT
+
+
+@dataclass(frozen=True)
+class Update(Node):
+    table: str
+    assignments: tuple[tuple[str, Node], ...]  # (column, expr)
+    where: Node | None = None
+
+
+@dataclass(frozen=True)
+class Delete(Node):
+    table: str
+    where: Node | None = None
+
+
+@dataclass(frozen=True)
+class Begin(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class Commit(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class Rollback(Node):
+    pass
+
+
+Statement = Node  # any of the above or Select

@@ -367,7 +367,10 @@ def _parse_type(tn: str) -> DataType:
             p, *rest = inner.split(",")
             return DataType.decimal(int(p), int(rest[0]) if rest else 0)
         return DataType.decimal(18, 0)
-    if tn in ("int", "integer"):
+    if "(" in tn:
+        tn = tn[: tn.index("(")]  # varchar(25), char(1), int(11): length
+        # modifiers don't change the physical type
+    if tn in ("int", "integer", "smallint", "tinyint", "mediumint"):
         return DataType.int32()
     if tn == "bigint":
         return DataType.int64()

@@ -32,6 +32,10 @@ KEYWORDS = {
     "extract", "substring", "for", "distinct", "join", "inner", "left",
     "right", "full", "cross", "outer", "on", "date", "interval", "year",
     "month", "day", "asc", "desc", "union", "all", "any", "some", "with",
+    # statements
+    "create", "drop", "table", "primary", "key", "if", "insert", "into",
+    "values", "update", "set", "delete", "begin", "start", "transaction",
+    "commit", "rollback",
 }
 
 
@@ -116,6 +120,132 @@ class Parser:
         return t
 
     # -- entry ----------------------------------------------------------
+    def parse_statement(self) -> A.Node:
+        """Any statement: SELECT (incl. WITH), DDL, DML, tx control."""
+        t = self.peek()
+        handlers = {
+            "create": self._create,
+            "drop": self._drop,
+            "insert": self._insert,
+            "update": self._update,
+            "delete": self._delete,
+            "begin": self._tx_begin,
+            "start": self._tx_begin,
+            "commit": lambda: (self.next(), A.Commit())[1],
+            "rollback": lambda: (self.next(), A.Rollback())[1],
+        }
+        h = handlers.get(t.value) if t.kind == "kw" else None
+        if h is None:
+            return self.parse()
+        stmt = h()
+        self.accept(";")
+        if self.peek().kind != "eof":
+            tk = self.peek()
+            raise SyntaxError(f"trailing tokens at {tk.pos}: {tk.value!r}")
+        return stmt
+
+    def _tx_begin(self) -> A.Begin:
+        if self.next().value == "start":
+            self.expect("transaction")
+        return A.Begin()
+
+    def _create(self) -> A.CreateTable:
+        self.expect("create")
+        self.expect("table")
+        if_not_exists = False
+        if self.accept("if"):
+            self.expect("not")
+            self.expect("exists")
+            if_not_exists = True
+        name = self.next().value
+        self.expect("(")
+        cols: list[A.ColumnDef] = []
+        pk: tuple[str, ...] = ()
+        while True:
+            if self.peek().value == "primary":
+                self.next()
+                self.expect("key")
+                self.expect("(")
+                pkl = [self.next().value]
+                while self.accept(","):
+                    pkl.append(self.next().value)
+                self.expect(")")
+                pk = tuple(pkl)
+            else:
+                cname = self.next().value
+                tname = self.type_name()
+                not_null = False
+                if self.accept("not"):
+                    self.expect("null")
+                    not_null = True
+                elif self.accept("null"):
+                    pass
+                if self.accept("primary"):
+                    self.expect("key")
+                    pk = (cname,)
+                cols.append(A.ColumnDef(cname, tname, not_null))
+            if not self.accept(","):
+                break
+        self.expect(")")
+        return A.CreateTable(name, tuple(cols), pk, if_not_exists)
+
+    def _drop(self) -> A.DropTable:
+        self.expect("drop")
+        self.expect("table")
+        if_exists = False
+        if self.accept("if"):
+            self.expect("exists")
+            if_exists = True
+        return A.DropTable(self.next().value, if_exists)
+
+    def _insert(self) -> A.Insert:
+        self.expect("insert")
+        self.expect("into")
+        name = self.next().value
+        columns: tuple[str, ...] = ()
+        if self.peek().value == "(":
+            self.next()
+            cl = [self.next().value]
+            while self.accept(","):
+                cl.append(self.next().value)
+            self.expect(")")
+            columns = tuple(cl)
+        if self.accept("values"):
+            rows = []
+            while True:
+                self.expect("(")
+                row = [self.expr()]
+                while self.accept(","):
+                    row.append(self.expr())
+                self.expect(")")
+                rows.append(tuple(row))
+                if not self.accept(","):
+                    break
+            return A.Insert(name, columns, tuple(rows))
+        # INSERT ... SELECT
+        return A.Insert(name, columns, (), self.select())
+
+    def _update(self) -> A.Update:
+        self.expect("update")
+        name = self.next().value
+        self.expect("set")
+        assigns = []
+        while True:
+            col = self.next().value
+            self.expect("=")
+            assigns.append((col, self.expr()))
+            if not self.accept(","):
+                break
+        where = self.expr() if self.accept("where") else None
+        return A.Update(name, tuple(assigns), where)
+
+    def _delete(self) -> A.Delete:
+        self.expect("delete")
+        self.expect("from")
+        name = self.next().value
+        where = self.expr() if self.accept("where") else None
+        return A.Delete(name, where)
+
     def parse(self) -> A.Select:
         ctes = []
         if self.accept("with"):
@@ -447,6 +577,11 @@ class Parser:
             self.expect(")")
             return f"{base}({','.join(args)})"
         return base
+
+
+def parse_statement(sql: str) -> A.Node:
+    """Parse any statement (SELECT, DDL, DML, tx control)."""
+    return Parser(sql).parse_statement()
 
 
 def parse(sql: str) -> A.Select:
