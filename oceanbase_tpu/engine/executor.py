@@ -798,6 +798,7 @@ class PreparedPlan:
         self.jitted = jitted
         self.input_spec = input_spec
         self.overflow_nodes = overflow_nodes
+        self.retries = 0  # lifetime overflow-recompile count (plan monitor)
 
     def run(self, max_retries: int = 3, qparams: tuple = ()):
         for attempt in range(max_retries + 1):
@@ -817,6 +818,7 @@ class PreparedPlan:
                 raise RuntimeError(
                     f"capacity overflow after {max_retries} retries: {overflows}"
                 )
+            self.retries += 1
             self.params.bump(overflows)
             self.jitted, self.input_spec, self.overflow_nodes = (
                 self.executor.compile(self.plan, self.params)

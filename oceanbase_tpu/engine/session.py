@@ -10,6 +10,7 @@ cache IS the compile cache.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -48,7 +49,8 @@ class ResultSet:
 
 class Session:
     def __init__(self, catalog: dict[str, Table], unique_keys=None,
-                 plan_cache: PlanCache | None = None, key_extra_fn=None):
+                 plan_cache: PlanCache | None = None, key_extra_fn=None,
+                 cache_enabled_fn=None, plan_monitor=None):
         self.catalog = catalog
         self.planner = Planner(catalog)
         self.executor = Executor(catalog, unique_keys=unique_keys)
@@ -59,6 +61,10 @@ class Session:
         # DML-backed catalog keys entries on table dict versions, since
         # string literals bake dictionary lookups at trace time)
         self.key_extra_fn = key_extra_fn
+        # hook: ob_enable_plan_cache (a disabled cache compiles every time)
+        self.cache_enabled_fn = cache_enabled_fn
+        # hook: server/diag.PlanMonitor (per-plan compile/exec stats)
+        self.plan_monitor = plan_monitor
 
     def sql(self, text: str) -> ResultSet:
         norm_key, _ = P.normalize_for_cache(text)
@@ -67,11 +73,14 @@ class Session:
         ast = P.parse(text)
         return self.run_ast(ast, norm_key)
 
-    def run_ast(self, ast, norm_key: str) -> ResultSet:
+    def run_ast(self, ast, norm_key: str, use_cache: bool | None = None) -> ResultSet:
         """Plan + execute an already-parsed SELECT under the plan cache.
 
         Shared by text queries and internal consumers (the DML layer's
-        UPDATE/DELETE qualification scans, virtual-table queries)."""
+        UPDATE/DELETE qualification scans, virtual-table queries).
+        use_cache=False bypasses the plan cache entirely (virtual-table
+        statements: their per-materialization dictionaries make entries
+        never reusable, and caching them would evict user plans)."""
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
         extra = ()
@@ -86,14 +95,30 @@ class Session:
         # catches literals consumed at plan time (ORDER BY ordinals etc.)
         key = (id(self.catalog), norm_key, pz.sig, pz.baked,
                plan_fingerprint(pz.plan), extra)
-        entry = self.plan_cache.get(key)
+        if use_cache is None:
+            use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
+        entry = self.plan_cache.get(key) if use_cache else None
         if entry is None:
+            t0 = time.perf_counter()
             prepared = self.executor.prepare(pz.plan)
+            compile_s = time.perf_counter() - t0
             entry = CacheEntry(prepared, planned.output_names, pz.dtypes)
-            self.plan_cache.put(key, entry)
+            if self.plan_monitor is not None and self.plan_monitor.enabled:
+                entry.monitor = self.plan_monitor.register(norm_key, compile_s)
+            if use_cache:
+                self.plan_cache.put(key, entry)
         qparams = bind(pz.values, entry.dtypes)
+        t0 = time.perf_counter()
         out_batch = entry.prepared.run(qparams=qparams)
+        exec_s = time.perf_counter() - t0
         host = batch_to_host(out_batch)
         # order columns per select list
         cols = {n: host[n] for n in entry.output_names}
-        return ResultSet(entry.output_names, cols)
+        rs = ResultSet(entry.output_names, cols)
+        mon = getattr(entry, "monitor", None)
+        if mon is not None:
+            mon.runs += 1
+            mon.total_exec_s += exec_s
+            mon.last_rows = rs.nrows
+            mon.overflow_retries = entry.prepared.retries
+        return rs

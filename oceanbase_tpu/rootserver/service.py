@@ -1,0 +1,91 @@
+"""RootService-lite: bootstrap, DDL orchestration, placement.
+
+Reference surface: src/rootserver — cluster bootstrap (ob_bootstrap.cpp),
+the DDL service through which every schema change flows
+(ob_ddl_service.h:99), and load balancing (rootserver/balance). The
+rebuild's RootService owns:
+
+  * bootstrap: create the log streams and elect initial leaders;
+  * DDL: allocate tablet ids, create tablets on every replica, publish the
+    new schema through the multi-version SchemaService;
+  * placement: least-loaded-LS choice for new tablets + a balance report
+    (the decision side of the reference's balance groups; replica movement
+    itself is the HA layer's job).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from ..share.schema_service import SchemaError, SchemaService
+from ..tx.cluster import LocalCluster
+
+
+class RootService:
+    def __init__(self, cluster: LocalCluster, schema: SchemaService):
+        self.cluster = cluster
+        self.schema = schema
+        self._tablet_ids = itertools.count(200001)
+        self._lock = threading.RLock()
+
+    # ---------------------------------------------------------- bootstrap
+    @staticmethod
+    def bootstrap(n_nodes: int, n_ls: int) -> tuple[LocalCluster, "RootService"]:
+        cluster = LocalCluster(n_nodes=n_nodes)
+        for ls in range(1, n_ls + 1):
+            cluster.create_ls(ls)
+        cluster.finalize()
+        return cluster, RootService(cluster, SchemaService())
+
+    # ---------------------------------------------------------- placement
+    def tablet_counts(self) -> dict[int, int]:
+        """Tablets per LS (from any replica; groups are symmetric)."""
+        out = {}
+        for ls_id, group in self.cluster.ls_groups.items():
+            rep = next(iter(group.values()))
+            out[ls_id] = len(rep.tablets)
+        return out
+
+    def choose_ls(self) -> int:
+        counts = self.tablet_counts()
+        return min(sorted(counts), key=lambda ls: counts[ls])
+
+    # ---------------------------------------------------------------- DDL
+    def create_table(self, info_factory) -> object:
+        """Run a CREATE TABLE: pick placement, build the TableInfo via
+        `info_factory(ls_id, tablet_id)`, create tablets on all replicas,
+        publish the schema version. Returns the TableInfo."""
+        with self._lock:
+            ls_id = self.choose_ls()
+            tablet_id = next(self._tablet_ids)
+            ti = info_factory(ls_id, tablet_id)
+
+            def mutate(tables: dict):
+                if ti.name in tables:
+                    raise SchemaError(f"table {ti.name} already exists")
+                tables[ti.name] = ti
+
+            self.cluster.create_tablet(ls_id, tablet_id, ti.schema, ti.key_cols)
+            try:
+                ti.schema_version = self.schema.apply_ddl(mutate)
+            except SchemaError:
+                for rep in self.cluster.ls_groups[ls_id].values():
+                    rep.tablets.pop(tablet_id, None)
+                raise
+            return ti
+
+    def drop_table(self, name: str) -> object:
+        with self._lock:
+            dropped = {}
+
+            def mutate(tables: dict):
+                if name not in tables:
+                    raise SchemaError(f"no such table {name}")
+                dropped["ti"] = tables.pop(name)
+
+            self.schema.apply_ddl(mutate)
+            ti = dropped["ti"]
+            for rep in self.cluster.ls_groups[ti.ls_id].values():
+                rep.tablets.pop(ti.tablet_id, None)
+            return ti

@@ -32,12 +32,14 @@ from ..core.dictionary import Dictionary
 from ..core.dtypes import DataType, Field, Schema, TypeKind
 from ..core.table import Table
 from ..engine.session import ResultSet, Session
+from ..rootserver import RootService
+from ..share import Config, LocationService
+from ..share.schema_service import SchemaError
 from ..sql import ast as A
 from ..sql import parser as P
 from ..sql.logical import _parse_type
 from ..sql.plan_cache import PlanCache
 from ..storage import OP_DELETE, OP_PUT
-from ..tx.cluster import LocalCluster
 
 
 class SqlError(Exception):
@@ -59,9 +61,8 @@ class TableInfo:
     dicts: dict[str, Dictionary] = field(default_factory=dict)
     data_version: int = 0  # bumped on every committed DML batch
     schema_version: int = 0  # set at create time (schema service analog)
-    # snapshot-materialization caches
+    # last data version materialized into the analytic catalog (-1 = stale)
     cached_data_version: int = -1
-    cached_table: Table | None = None
     # per-column (dict length at build time, sorted Dictionary, remap array)
     _sorted_cache: dict[str, tuple[int, Dictionary, np.ndarray]] = field(
         default_factory=dict
@@ -99,28 +100,59 @@ class Database:
 
     def __init__(self, n_nodes: int = 3, n_ls: int = 2,
                  extra_catalog: dict[str, Table] | None = None):
-        self.cluster = LocalCluster(n_nodes=n_nodes)
-        for ls in range(1, n_ls + 1):
-            self.cluster.create_ls(ls)
-        self.cluster.finalize()
-        self.n_ls = n_ls
-        self.tables: dict[str, TableInfo] = {}
+        self.cluster, self.rootservice = RootService.bootstrap(n_nodes, n_ls)
+        self.schema_service = self.rootservice.schema
+        self.config = Config()
+        self.location = LocationService(
+            self.cluster.leader_node,
+            ttl=10.0,
+            clock=lambda: self.cluster.bus.now,
+        )
         # analytic catalog: table name -> snapshot Table (plus any read-only
         # preloaded tables, e.g. benchmark data)
         self.catalog: dict[str, Table] = dict(extra_catalog or {})
-        self._preloaded = set(self.catalog)
-        self.plan_cache = PlanCache()
+        self.plan_cache = PlanCache(capacity=self.config["plan_cache_capacity"])
+        self.config.on_change(
+            "plan_cache_capacity",
+            lambda _n, _o, v: setattr(self.plan_cache, "capacity", v),
+        )
+        # diagnostics (observer/virtual_table surface)
+        from .diag import AshSampler, PlanMonitor, SqlAudit, Tracer
+
+        self.tracer = Tracer()
+        self.audit = SqlAudit(
+            capacity=max(64, self.config["sql_audit_memory_limit"] // 4096)
+        )
+        self.plan_monitor = PlanMonitor()
+        self.ash = AshSampler()
+        self.audit.enabled = self.config["enable_sql_audit"]
+        self.plan_monitor.enabled = self.config["enable_perf_event"]
+        self.config.on_change(
+            "enable_sql_audit",
+            lambda _n, _o, v: setattr(self.audit, "enabled", v))
+        self.config.on_change(
+            "enable_perf_event",
+            lambda _n, _o, v: setattr(self.plan_monitor, "enabled", v))
+        self.config.on_change(
+            "sql_audit_memory_limit",
+            lambda _n, _o, v: self.audit.set_capacity(max(64, v // 4096)))
+        self._session_ids = __import__("itertools").count(1)
+
         self._unique_keys: dict[str, tuple[str, ...]] = {}
         self.engine = Session(
             self.catalog,
             unique_keys=self._unique_keys,
             plan_cache=self.plan_cache,
             key_extra_fn=self._key_extra,
+            cache_enabled_fn=lambda: self.config["ob_enable_plan_cache"],
+            plan_monitor=self.plan_monitor,
         )
-        self._next_tablet = 200001
-        self._next_ls_rr = 0
         self._ddl_lock = threading.RLock()
-        self._schema_version = 0
+
+    @property
+    def tables(self):
+        """Current-version schema view (name -> TableInfo)."""
+        return self.schema_service.guard().tables
 
     # ------------------------------------------------------------ schema
     def _key_extra(self, table_names: tuple[str, ...]) -> tuple:
@@ -128,11 +160,28 @@ class Database:
         referenced DML-backed tables (string literals bake dictionary
         lookups at trace time; a grown dictionary needs a fresh trace)."""
         out = []
+        tables = self.tables
         for t in table_names:
-            ti = self.tables.get(t)
+            ti = tables.get(t)
             if ti is not None:
                 out.append((t, ti.schema_version, ti.dict_sig))
         return tuple(out)
+
+    def refresh_virtual(self, names) -> bool:
+        """Materialize referenced __all_virtual_* tables for this statement.
+        Returns True if any were referenced (such statements bypass the plan
+        cache: per-materialization dictionaries make entries unreusable)."""
+        from .virtual_tables import PROVIDERS
+
+        any_vt = False
+        for name in names:
+            p = PROVIDERS.get(name)
+            if p is None:
+                continue
+            self.catalog[name] = p(self)
+            self.engine.executor.invalidate_table(name)
+            any_vt = True
+        return any_vt
 
     def create_table(self, stmt: A.CreateTable) -> None:
         with self._ddl_lock:
@@ -155,18 +204,18 @@ class Database:
                 i = schema.index(k)
                 fields[i] = Field(k, fields[i].dtype.with_nullable(False))
             schema = Schema(tuple(fields))
-            ls_id = 1 + (self._next_ls_rr % self.n_ls)
-            self._next_ls_rr += 1
-            tablet_id = self._next_tablet
-            self._next_tablet += 1
-            self.cluster.create_tablet(ls_id, tablet_id, schema, pk)
-            self._schema_version += 1
-            ti = TableInfo(stmt.name, schema, pk, ls_id, tablet_id,
-                           schema_version=self._schema_version)
-            for f in schema.fields:
-                if f.dtype.kind is TypeKind.VARCHAR:
-                    ti.dicts[f.name] = Dictionary()
-            self.tables[stmt.name] = ti
+
+            def factory(ls_id: int, tablet_id: int) -> TableInfo:
+                ti = TableInfo(stmt.name, schema, pk, ls_id, tablet_id)
+                for f in schema.fields:
+                    if f.dtype.kind is TypeKind.VARCHAR:
+                        ti.dicts[f.name] = Dictionary()
+                return ti
+
+            try:
+                self.rootservice.create_table(factory)
+            except SchemaError as e:
+                raise SqlError(str(e)) from None
             self._unique_keys[stmt.name] = tuple(pk)
             self.catalog[stmt.name] = Table(stmt.name, schema, {
                 f.name: np.zeros(0, f.dtype.storage_np) for f in schema.fields
@@ -174,22 +223,27 @@ class Database:
 
     def drop_table(self, stmt: A.DropTable) -> None:
         with self._ddl_lock:
-            ti = self.tables.pop(stmt.name, None)
-            if ti is None:
+            try:
+                self.rootservice.drop_table(stmt.name)
+            except SchemaError:
                 if stmt.if_exists:
                     return
-                raise SqlError(f"no such table {stmt.name}")
+                raise SqlError(f"no such table {stmt.name}") from None
             self.catalog.pop(stmt.name, None)
             self._unique_keys.pop(stmt.name, None)
             self.engine.executor.invalidate_table(stmt.name)
-            self._schema_version += 1
-            for rep in self.cluster.ls_groups[ti.ls_id].values():
-                rep.tablets.pop(ti.tablet_id, None)
 
     # ---------------------------------------------------------- snapshots
     def _leader_replica(self, ti: TableInfo):
-        node = self.cluster.leader_node(ti.ls_id)
-        return self.cluster.ls_groups[ti.ls_id][node]
+        """Route through the location cache; one retry on a stale entry
+        (the NOT_MASTER feedback loop of the reference's DAS routing)."""
+        node = self.location.leader(ti.ls_id)
+        rep = self.cluster.ls_groups[ti.ls_id][node]
+        if not rep.is_ready:
+            self.location.invalidate(ti.ls_id)
+            node = self.location.leader(ti.ls_id)
+            rep = self.cluster.ls_groups[ti.ls_id][node]
+        return rep
 
     def refresh_catalog(self, names, tx=None) -> None:
         """Bring catalog snapshot Tables of the given tables up to date.
@@ -257,10 +311,44 @@ class DbSession:
     def __init__(self, db: Database):
         self.db = db
         self._tx: _OpenTx | None = None
+        self.session_id = next(db._session_ids)
+        self._last_stmt_type = ""
 
     # ------------------------------------------------------------ public
     def sql(self, text: str) -> ResultSet:
+        """Execute one statement, instrumented: trace span + ASH activity
+        around execution, one sql_audit record at completion."""
+        import time as _time
+
+        db = self.db
+        hits0 = db.plan_cache.stats.hits
+        t0 = _time.perf_counter()
+        err, rs = "", None
+        with db.tracer.span("sql", session=self.session_id) as sp:
+            with db.ash.activity(self.session_id, "EXECUTING", text,
+                                 sp.trace_id):
+                try:
+                    rs = self._dispatch(text)
+                except Exception as e:
+                    err = f"{type(e).__name__}: {e}"
+                    raise
+                finally:
+                    db.audit.record(
+                        session_id=self.session_id,
+                        trace_id=sp.trace_id,
+                        sql=text,
+                        stmt_type=self._last_stmt_type,
+                        elapsed_s=_time.perf_counter() - t0,
+                        rows=rs.nrows if rs is not None else 0,
+                        affected=rs.affected if rs is not None else 0,
+                        plan_cache_hit=db.plan_cache.stats.hits > hits0,
+                        error=err,
+                    )
+        return rs
+
+    def _dispatch(self, text: str) -> ResultSet:
         stmt = P.parse_statement(text)
+        self._last_stmt_type = type(stmt).__name__
         if isinstance(stmt, A.Select):
             return self._select(stmt, P.normalize_for_cache(text)[0])
         if isinstance(stmt, A.CreateTable):
@@ -280,6 +368,16 @@ class DbSession:
         if isinstance(stmt, A.Rollback):
             self._end_tx(commit=False)
             return ResultSet((), {})
+        if isinstance(stmt, A.AlterSystemSet):
+            from ..share.config import ConfigError
+
+            try:
+                self.db.config.set(stmt.name, stmt.value)
+            except ConfigError as e:
+                raise SqlError(str(e)) from None
+            return ResultSet((), {})
+        if isinstance(stmt, A.Show):
+            return self._show(stmt)
         if isinstance(stmt, A.Insert):
             return self._dml(lambda tx: self._insert(stmt, tx))
         if isinstance(stmt, A.Update):
@@ -288,11 +386,39 @@ class DbSession:
             return self._dml(lambda tx: self._delete(stmt, tx))
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
 
+    # -------------------------------------------------------------- show
+    def _show(self, st: A.Show) -> ResultSet:
+        if st.what == "parameters":
+            import fnmatch
+
+            pat = st.like.replace("%", "*").replace("_", "?") if st.like else None
+            names, values, types, scopes, infos = [], [], [], [], []
+            for n, v, p in self.db.config.snapshot():
+                if pat is not None and not fnmatch.fnmatch(n, pat):
+                    continue
+                names.append(n)
+                values.append(str(v))
+                types.append(p.type)
+                scopes.append(p.scope)
+                infos.append(p.info)
+            return ResultSet(
+                ("name", "value", "type", "scope", "info"),
+                {"name": names, "value": values, "type": types,
+                 "scope": scopes, "info": infos},
+            )
+        if st.what == "tables":
+            names = sorted(set(self.db.tables) | set(self.db.catalog))
+            return ResultSet(("table_name",), {"table_name": names})
+        raise SqlError(f"unsupported SHOW {st.what}")
+
     # ------------------------------------------------------------ select
     def _select(self, ast: A.Select, norm_key: str) -> ResultSet:
         names = _tables_in_ast(ast)
+        any_vt = self.db.refresh_virtual(names)
         self.db.refresh_catalog(names, tx=self._tx)
-        return self.db.engine.run_ast(ast, norm_key)
+        return self.db.engine.run_ast(
+            ast, norm_key, use_cache=False if any_vt else None
+        )
 
     # --------------------------------------------------------------- tx
     def _dml(self, body) -> ResultSet:

@@ -1,0 +1,244 @@
+"""Diagnostics: full-link tracing, sql_audit, plan monitor, ASH sampler.
+
+Reference surface:
+  * ObTrace full-link tracing with spans flowing through the request path
+    (deps/oblib/src/lib/trace/ob_trace.h);
+  * sql_audit request ring buffer (observer/mysql/ob_mysql_request_manager.h)
+    surfaced as __all_virtual_sql_audit;
+  * per-operator plan monitor (ObMonitorNode,
+    share/diagnosis/ob_sql_plan_monitor_node_list.h) -> GV$SQL_PLAN_MONITOR;
+  * ASH active-session sampling (lib/ash/ob_active_session_guard.h).
+
+TPU redesign note: a plan executes as ONE fused XLA program, so the
+reference's per-operator rdtsc windows have no physical analog on device —
+the honest monitoring unit is the plan run (compile time, device time,
+rows, overflow retries) plus host-side phase spans (parse/plan/compile),
+which is what the trace + plan monitor record here.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+# ---- full-link tracing ------------------------------------------------------
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_id: int
+    name: str
+    start: float
+    end: float = 0.0
+    tags: dict = field(default_factory=dict)
+
+    @property
+    def elapsed(self) -> float:
+        return (self.end or time.perf_counter()) - self.start
+
+
+class Tracer:
+    """Per-database tracer: thread-local span stacks, finished-span ring."""
+
+    def __init__(self, capacity: int = 4096, clock=time.perf_counter):
+        self._ids = itertools.count(1)
+        self._clock = clock
+        self._local = threading.local()
+        self._done: deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def _stack(self) -> list[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        st = self._stack()
+        parent = st[-1] if st else None
+        s = Span(
+            trace_id=parent.trace_id if parent else next(self._ids),
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent else 0,
+            name=name,
+            start=self._clock(),
+            tags=dict(tags),
+        )
+        st.append(s)
+        try:
+            yield s
+        finally:
+            s.end = self._clock()
+            st.pop()
+            with self._lock:
+                self._done.append(s)
+
+    def current_trace_id(self) -> int:
+        st = self._stack()
+        return st[-1].trace_id if st else 0
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._done)
+
+
+# ---- sql_audit --------------------------------------------------------------
+
+
+@dataclass
+class AuditRecord:
+    request_id: int
+    session_id: int
+    trace_id: int
+    sql: str
+    stmt_type: str
+    elapsed_s: float
+    rows: int
+    affected: int
+    plan_cache_hit: bool
+    error: str = ""
+    ts: float = 0.0
+
+
+class SqlAudit:
+    """Fixed-capacity ring of per-statement records (ob_mysql_request_manager
+    keeps a memory-bounded ring; entry count is the proxy here)."""
+
+    def __init__(self, capacity: int = 10000):
+        self._ring: deque[AuditRecord] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    def record(self, **kw) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(
+                AuditRecord(request_id=next(self._ids), ts=time.time(), **kw)
+            )
+
+    def records(self) -> list[AuditRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=capacity)
+
+
+# ---- plan monitor -----------------------------------------------------------
+
+
+@dataclass
+class PlanMonitorEntry:
+    """Per compiled plan (the TPU monitoring unit — one XLA executable)."""
+
+    plan_id: int
+    sql: str
+    compile_s: float = 0.0
+    runs: int = 0
+    total_exec_s: float = 0.0
+    last_rows: int = 0
+    overflow_retries: int = 0
+
+    @property
+    def avg_exec_s(self) -> float:
+        return self.total_exec_s / self.runs if self.runs else 0.0
+
+
+class PlanMonitor:
+    def __init__(self, capacity: int = 1024):
+        self._entries: deque[PlanMonitorEntry] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    def register(self, sql: str, compile_s: float) -> PlanMonitorEntry:
+        e = PlanMonitorEntry(next(self._ids), sql, compile_s=compile_s)
+        with self._lock:
+            self._entries.append(e)
+        return e
+
+    def entries(self) -> list[PlanMonitorEntry]:
+        with self._lock:
+            return list(self._entries)
+
+
+# ---- ASH (active session history) ------------------------------------------
+
+
+@dataclass
+class AshSample:
+    ts: float
+    session_id: int
+    activity: str
+    sql: str
+    trace_id: int
+
+
+class AshSampler:
+    """Samples what every active session is doing.
+
+    Sessions publish their current activity via `activity()` guards; the
+    sampler snapshots all active entries — on a timer thread in live
+    deployments (`start`), or on demand (`sample_once`) in deterministic
+    tests. History is a bounded ring like the reference's ASH buffer."""
+
+    def __init__(self, capacity: int = 90000, interval_s: float = 1.0):
+        self._active: dict[int, tuple[str, str, int]] = {}
+        self._ring: deque[AshSample] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._interval = interval_s
+        self._timer: threading.Timer | None = None
+
+    @contextmanager
+    def activity(self, session_id: int, activity: str, sql: str = "",
+                 trace_id: int = 0):
+        with self._lock:
+            self._active[session_id] = (activity, sql, trace_id)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._active.pop(session_id, None)
+
+    def sample_once(self, now: float | None = None) -> int:
+        ts = time.time() if now is None else now
+        with self._lock:
+            for sid, (act, sql, tid) in self._active.items():
+                self._ring.append(AshSample(ts, sid, act, sql, tid))
+            return len(self._active)
+
+    def start(self) -> None:
+        def tick():
+            self.sample_once()
+            with self._lock:
+                if self._timer is not None:
+                    self._timer = threading.Timer(self._interval, tick)
+                    self._timer.daemon = True
+                    self._timer.start()
+
+        with self._lock:
+            if self._timer is None:
+                self._timer = threading.Timer(self._interval, tick)
+                self._timer.daemon = True
+                self._timer.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            t, self._timer = self._timer, None
+        if t is not None:
+            t.cancel()
+
+    def samples(self) -> list[AshSample]:
+        with self._lock:
+            return list(self._ring)

@@ -1,0 +1,138 @@
+"""Virtual observability tables, queryable through the SQL engine.
+
+Reference surface: the ~240 __all_virtual_* tables implemented under
+src/observer/virtual_table (sql_audit, plan_cache_stat, ASH, trace,
+parameters, ls/tablet info...). The rebuild materializes each on demand as
+a host Table the moment a statement references it, so the full SQL surface
+(filters, joins, aggregates — on the device engine) works over
+observability data exactly like user data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import DataType, Field, Schema
+from ..core.table import Table
+
+
+def _t(name: str, cols: list[tuple[str, DataType, list]]) -> Table:
+    schema = Schema(tuple(Field(n, dt) for n, dt, _ in cols))
+    return Table.from_pydict(name, schema, {n: v for n, _dt, v in cols})
+
+
+def _parameters(db) -> Table:
+    snap = db.config.snapshot()
+    return _t("__all_virtual_parameters", [
+        ("name", DataType.varchar(), [n for n, _, _ in snap]),
+        ("value", DataType.varchar(), [str(v) for _, v, _ in snap]),
+        ("type", DataType.varchar(), [p.type for _, _, p in snap]),
+        ("scope", DataType.varchar(), [p.scope for _, _, p in snap]),
+        ("dynamic", DataType.int32(), [int(p.dynamic) for _, _, p in snap]),
+        ("info", DataType.varchar(), [p.info for _, _, p in snap]),
+    ])
+
+
+def _tables(db) -> Table:
+    tis = [db.tables[n] for n in sorted(db.tables)]
+    return _t("__all_virtual_table", [
+        ("table_name", DataType.varchar(), [ti.name for ti in tis]),
+        ("ls_id", DataType.int64(), [ti.ls_id for ti in tis]),
+        ("tablet_id", DataType.int64(), [ti.tablet_id for ti in tis]),
+        ("schema_version", DataType.int64(), [ti.schema_version for ti in tis]),
+        ("data_version", DataType.int64(), [ti.data_version for ti in tis]),
+        ("columns", DataType.int64(), [len(ti.schema.fields) for ti in tis]),
+    ])
+
+
+def _plan_cache_stat(db) -> Table:
+    st = db.plan_cache.stats
+    return _t("__all_virtual_plan_cache_stat", [
+        ("hits", DataType.int64(), [st.hits]),
+        ("misses", DataType.int64(), [st.misses]),
+        ("evictions", DataType.int64(), [st.evictions]),
+        ("entries", DataType.int64(), [len(db.plan_cache)]),
+        ("hit_rate_pct", DataType.float64(), [100.0 * st.hit_rate]),
+    ])
+
+
+def _sql_audit(db) -> Table:
+    recs = db.audit.records()
+    return _t("__all_virtual_sql_audit", [
+        ("request_id", DataType.int64(), [r.request_id for r in recs]),
+        ("session_id", DataType.int64(), [r.session_id for r in recs]),
+        ("trace_id", DataType.int64(), [r.trace_id for r in recs]),
+        ("stmt_type", DataType.varchar(), [r.stmt_type for r in recs]),
+        ("query_sql", DataType.varchar(), [r.sql for r in recs]),
+        ("elapsed_us", DataType.int64(),
+         [int(r.elapsed_s * 1e6) for r in recs]),
+        ("return_rows", DataType.int64(), [r.rows for r in recs]),
+        ("affected_rows", DataType.int64(), [r.affected for r in recs]),
+        ("is_hit_plan", DataType.int32(),
+         [int(r.plan_cache_hit) for r in recs]),
+        ("error", DataType.varchar(), [r.error for r in recs]),
+    ])
+
+
+def _plan_monitor(db) -> Table:
+    es = db.plan_monitor.entries()
+    return _t("__all_virtual_sql_plan_monitor", [
+        ("plan_id", DataType.int64(), [e.plan_id for e in es]),
+        ("query_sql", DataType.varchar(), [e.sql for e in es]),
+        ("compile_us", DataType.int64(), [int(e.compile_s * 1e6) for e in es]),
+        ("executions", DataType.int64(), [e.runs for e in es]),
+        ("total_exec_us", DataType.int64(),
+         [int(e.total_exec_s * 1e6) for e in es]),
+        ("avg_exec_us", DataType.int64(), [int(e.avg_exec_s * 1e6) for e in es]),
+        ("last_rows", DataType.int64(), [e.last_rows for e in es]),
+        ("overflow_retries", DataType.int64(), [e.overflow_retries for e in es]),
+    ])
+
+
+def _ash(db) -> Table:
+    ss = db.ash.samples()
+    return _t("__all_virtual_ash", [
+        ("sample_ts", DataType.float64(), [s.ts for s in ss]),
+        ("session_id", DataType.int64(), [s.session_id for s in ss]),
+        ("activity", DataType.varchar(), [s.activity for s in ss]),
+        ("query_sql", DataType.varchar(), [s.sql for s in ss]),
+        ("trace_id", DataType.int64(), [s.trace_id for s in ss]),
+    ])
+
+
+def _trace(db) -> Table:
+    sp = db.tracer.spans()
+    return _t("__all_virtual_trace_span", [
+        ("trace_id", DataType.int64(), [s.trace_id for s in sp]),
+        ("span_id", DataType.int64(), [s.span_id for s in sp]),
+        ("parent_id", DataType.int64(), [s.parent_id for s in sp]),
+        ("span_name", DataType.varchar(), [s.name for s in sp]),
+        ("elapsed_us", DataType.int64(), [int(s.elapsed * 1e6) for s in sp]),
+    ])
+
+
+def _ls(db) -> Table:
+    rows = []
+    for ls_id, group in sorted(db.cluster.ls_groups.items()):
+        for node, rep in sorted(group.items()):
+            rows.append((ls_id, node, rep.palf.role.name,
+                         int(rep.is_ready), len(rep.tablets)))
+    return _t("__all_virtual_ls", [
+        ("ls_id", DataType.int64(), [r[0] for r in rows]),
+        ("svr_node", DataType.int64(), [r[1] for r in rows]),
+        ("role", DataType.varchar(), [r[2] for r in rows]),
+        ("is_ready", DataType.int32(), [r[3] for r in rows]),
+        ("tablet_count", DataType.int64(), [r[4] for r in rows]),
+    ])
+
+
+PROVIDERS = {
+    "__all_virtual_parameters": _parameters,
+    "__all_virtual_table": _tables,
+    "__all_virtual_plan_cache_stat": _plan_cache_stat,
+    "__all_virtual_sql_audit": _sql_audit,
+    "__all_virtual_sql_plan_monitor": _plan_monitor,
+    "__all_virtual_ash": _ash,
+    "__all_virtual_trace_span": _trace,
+    "__all_virtual_ls": _ls,
+}

@@ -238,6 +238,22 @@ class Delete(Node):
 
 
 @dataclass(frozen=True)
+class AlterSystemSet(Node):
+    """ALTER SYSTEM SET name = value (config hot reload)."""
+
+    name: str
+    value: str
+
+
+@dataclass(frozen=True)
+class Show(Node):
+    """SHOW PARAMETERS [LIKE 'pat'] | SHOW TABLES."""
+
+    what: str
+    like: str | None = None
+
+
+@dataclass(frozen=True)
 class Begin(Node):
     pass
 

@@ -35,7 +35,7 @@ KEYWORDS = {
     # statements
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
-    "commit", "rollback",
+    "commit", "rollback", "alter", "system", "show", "parameters", "tables",
 }
 
 
@@ -94,6 +94,7 @@ def normalize_for_cache(sql: str) -> tuple[str, tuple]:
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
 
@@ -133,6 +134,8 @@ class Parser:
             "start": self._tx_begin,
             "commit": lambda: (self.next(), A.Commit())[1],
             "rollback": lambda: (self.next(), A.Rollback())[1],
+            "alter": self._alter,
+            "show": self._show,
         }
         h = handlers.get(t.value) if t.kind == "kw" else None
         if h is None:
@@ -143,6 +146,36 @@ class Parser:
             tk = self.peek()
             raise SyntaxError(f"trailing tokens at {tk.pos}: {tk.value!r}")
         return stmt
+
+    def _alter(self) -> A.AlterSystemSet:
+        self.expect("alter")
+        self.expect("system")
+        self.expect("set")
+        name = self.next().value
+        self.expect("=")
+        t = self.peek()
+        if t.kind == "str":
+            self.next()
+            return A.AlterSystemSet(name, t.value)
+        # unquoted value: take the RAW statement text (case preserved, so
+        # WARN stays WARN; suffixed values like 32M / 10s lex as several
+        # tokens but are one value)
+        start = t.pos
+        end = start
+        while self.peek().kind != "eof" and self.peek().value != ";":
+            tk = self.next()
+            end = tk.pos + len(str(tk.value))
+        if end == start:
+            raise SyntaxError(f"missing parameter value at {t.pos}")
+        return A.AlterSystemSet(name, self.sql[start:end].strip())
+
+    def _show(self) -> A.Show:
+        self.expect("show")
+        what = self.next().value
+        like = None
+        if self.accept("like"):
+            like = self.next().value
+        return A.Show(what, like)
 
     def _tx_begin(self) -> A.Begin:
         if self.next().value == "start":

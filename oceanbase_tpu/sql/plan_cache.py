@@ -226,6 +226,7 @@ class CacheEntry:
     output_names: tuple[str, ...]
     dtypes: list
     hits: int = 0
+    monitor: object = None  # server/diag.PlanMonitorEntry (if enabled)
 
 
 @dataclass

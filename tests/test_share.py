@@ -1,0 +1,196 @@
+"""Cluster services: config registry, schema versioning, location cache,
+rootservice placement — plus their SQL surface (ALTER SYSTEM / SHOW).
+
+Reference: share/parameter + share/config (typed params, hot reload),
+share/schema (multi-version guards), share/location_cache, rootserver.
+"""
+
+import pytest
+
+from oceanbase_tpu.share import Config, LocationService, SchemaService
+from oceanbase_tpu.share.config import ConfigError, parse_capacity, parse_time
+from oceanbase_tpu.share.schema_service import SchemaError
+
+
+# ---- config ---------------------------------------------------------------
+
+
+def test_capacity_and_time_parsing():
+    assert parse_capacity("2G") == 2 << 30
+    assert parse_capacity("512M") == 512 << 20
+    assert parse_capacity(4096) == 4096
+    assert parse_time("10s") == 10.0
+    assert parse_time("5m") == 300.0
+    assert parse_time("250ms") == 0.25
+
+
+def test_config_validation_and_hot_reload():
+    c = Config()
+    assert c["plan_cache_capacity"] == 128
+    with pytest.raises(ConfigError):
+        c.set("plan_cache_capacity", 0)  # below min
+    with pytest.raises(ConfigError):
+        c.set("no_such_param", 1)
+    with pytest.raises(ConfigError):
+        c.set("syslog_level", "LOUD")  # not in choices
+    seen = []
+    c.on_change("plan_cache_capacity", lambda n, o, v: seen.append((o, v)))
+    c.set("plan_cache_capacity", 256)
+    assert seen == [(128, 256)]
+    assert c["plan_cache_capacity"] == 256
+    assert c.version == 1
+
+
+def test_config_static_param_no_callback():
+    c = Config()
+    fired = []
+    c.on_change("lease_duration", lambda *a: fired.append(a))
+    c.set("lease_duration", "8s")  # static: recorded, no hot fire
+    assert c["lease_duration"] == 8.0
+    assert fired == []
+
+
+# ---- schema service -------------------------------------------------------
+
+
+def test_schema_versioned_guards():
+    svc = SchemaService()
+    g0 = svc.guard()
+    assert g0.version == 0 and g0.names() == []
+
+    svc.apply_ddl(lambda t: t.__setitem__("a", "schema_a"))
+    svc.apply_ddl(lambda t: t.__setitem__("b", "schema_b"))
+    g2 = svc.guard()
+    assert g2.version == 2 and g2.names() == ["a", "b"]
+    # old guard still sees the old world
+    assert "a" not in g0
+    # pin an old version explicitly
+    g1 = svc.guard(1)
+    assert g1.names() == ["a"]
+
+    svc.apply_ddl(lambda t: t.pop("a"))
+    assert svc.guard().names() == ["b"]
+    # failed DDL publishes nothing
+    with pytest.raises(KeyError):
+        svc.apply_ddl(lambda t: t.pop("nonexistent"))
+    assert svc.version == 3
+
+
+def test_schema_history_expiry():
+    svc = SchemaService(history_limit=2)
+    for i in range(5):
+        svc.apply_ddl(lambda t, i=i: t.__setitem__(f"t{i}", i))
+    with pytest.raises(SchemaError):
+        svc.guard(0)
+    assert svc.guard(svc.version - 2) is not None
+
+
+# ---- location cache -------------------------------------------------------
+
+
+def test_location_cache_ttl_and_invalidate():
+    clock = [0.0]
+    calls = []
+
+    def resolver(ls):
+        calls.append(ls)
+        return 100 + ls
+
+    loc = LocationService(resolver, ttl=5.0, clock=lambda: clock[0])
+    assert loc.leader(1) == 101
+    assert loc.leader(1) == 101  # cached
+    assert calls == [1]
+    clock[0] = 6.0  # TTL expired
+    assert loc.leader(1) == 101
+    assert calls == [1, 1]
+    loc.invalidate(1)
+    loc.leader(1)
+    assert calls == [1, 1, 1]
+
+
+# ---- rootservice + SQL surface -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def db():
+    from oceanbase_tpu.server import Database
+
+    return Database(n_nodes=3, n_ls=2)
+
+
+def test_placement_balances_across_ls(db):
+    s = db.session()
+    for i in range(4):
+        s.sql(f"create table bal_{i} (k bigint primary key)")
+    counts = db.rootservice.tablet_counts()
+    assert abs(counts[1] - counts[2]) <= 1
+    for i in range(4):
+        s.sql(f"drop table bal_{i}")
+
+
+def test_ddl_bumps_schema_version(db):
+    v0 = db.schema_service.version
+    s = db.session()
+    s.sql("create table sv_t (k bigint primary key)")
+    assert db.schema_service.version == v0 + 1
+    s.sql("drop table sv_t")
+    assert db.schema_service.version == v0 + 2
+
+
+def test_alter_system_and_show_parameters(db):
+    s = db.session()
+    s.sql("alter system set plan_cache_capacity = 64")
+    assert db.config["plan_cache_capacity"] == 64
+    assert db.plan_cache.capacity == 64  # hot-wired
+    rs = s.sql("show parameters like 'plan_cache%'")
+    assert rs.rows()[0][0] == "plan_cache_capacity"
+    assert rs.rows()[0][1] == "64"
+    from oceanbase_tpu.server.database import SqlError
+
+    with pytest.raises(SqlError):
+        s.sql("alter system set nonsense = 1")
+    s.sql("alter system set plan_cache_capacity = 128")
+
+
+def test_alter_system_unquoted_values(db):
+    s = db.session()
+    # case-preserving bare word
+    s.sql("alter system set syslog_level = WARN")
+    assert db.config["syslog_level"] == "WARN"
+    s.sql("alter system set syslog_level = INFO")
+    # suffixed capacity lexes as several tokens but is one value
+    s.sql("alter system set sql_audit_memory_limit = 32M")
+    assert db.config["sql_audit_memory_limit"] == 32 << 20
+    s.sql("alter system set sql_audit_memory_limit = 64M")
+
+
+def test_virtual_table_queries_bypass_plan_cache(db):
+    s = db.session()
+    n0 = len(db.plan_cache)
+    for _ in range(3):
+        s.sql("select count(*) as n from __all_virtual_plan_cache_stat")
+    assert len(db.plan_cache) == n0  # no unreusable entries inserted
+
+
+def test_show_tables(db):
+    s = db.session()
+    s.sql("create table st_t (k bigint primary key)")
+    rs = s.sql("show tables")
+    assert ("st_t",) in rs.rows()
+    s.sql("drop table st_t")
+
+
+def test_disable_plan_cache(db):
+    s = db.session()
+    s.sql("create table pcd_t (k bigint primary key, v bigint not null)")
+    s.sql("insert into pcd_t values (1, 1)")
+    s.sql("alter system set ob_enable_plan_cache = false")
+    m0 = db.plan_cache.stats.misses
+    h0 = db.plan_cache.stats.hits
+    s.sql("select v from pcd_t where k = 1")
+    s.sql("select v from pcd_t where k = 1")
+    # bypassed entirely: no hits recorded
+    assert db.plan_cache.stats.hits == h0
+    assert db.plan_cache.stats.misses == m0
+    s.sql("alter system set ob_enable_plan_cache = true")
+    s.sql("drop table pcd_t")
