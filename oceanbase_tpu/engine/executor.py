@@ -69,10 +69,12 @@ DIRECT_GROUPBY_MAX_DOMAIN = 1 << 12
 
 @dataclass
 class PhysicalParams:
-    """Static capacities per plan node (keyed by pre-order node index)."""
+    """Static capacities per plan node (keyed by pre-order node index;
+    exchange lanes use synthesized ids, see parallel/px.py)."""
 
     groupby_size: dict[int, int] = field(default_factory=dict)
     join_cap: dict[int, int] = field(default_factory=dict)
+    exchange_cap: dict[int, int] = field(default_factory=dict)
 
     def bump(self, overflows: dict[int, int]):
         for nid in overflows:
@@ -80,6 +82,8 @@ class PhysicalParams:
                 self.groupby_size[nid] *= 4
             if nid in self.join_cap:
                 self.join_cap[nid] *= 4
+            if nid in self.exchange_cap:
+                self.exchange_cap[nid] *= 4
 
 
 def _number_nodes(plan: LogicalOp) -> dict[int, LogicalOp]:
@@ -197,39 +201,43 @@ class Executor:
         return self._batch_cache[key]
 
     # ---- physical parameter seeding ----------------------------------
+    def _est_rows(self, op) -> float:
+        """Cardinality estimate driving static capacities (and the PX
+        layer's distribution-method choice)."""
+        est_rows = self._est_rows
+        if isinstance(op, Scan):
+            base = self.catalog[op.table].nrows or 1
+            if op.pushed_filter is not None:
+                base *= 0.25 ** min(
+                    len(self._conjuncts(op.pushed_filter)), 3
+                )
+            return max(base, 1.0)
+        if isinstance(op, Filter):
+            return max(est_rows(op.child) * 0.5, 1.0)
+        if isinstance(op, JoinOp):
+            l = est_rows(op.left)
+            r = est_rows(op.right)
+            if op.kind in ("semi", "anti"):
+                return max(l * 0.5, 1.0)
+            if op.kind == "left":
+                return l * 2
+            if not op.left_keys:  # cross / scalar broadcast
+                return l if self._is_scalar_relation(op.right) else l * r
+            if self._join_build_unique(op):
+                return l
+            return max(l, r) * 2
+        if isinstance(op, Aggregate):
+            return min(est_rows(op.child), float(self.default_rows_estimate))
+        if isinstance(op, (Project, Sort, Distinct)):
+            return est_rows(op.child)
+        if isinstance(op, Limit):
+            return float(op.n + op.offset)
+        return float(self.default_rows_estimate)
+
     def seed_params(self, plan: LogicalOp) -> PhysicalParams:
         params = PhysicalParams()
         nodes = _number_nodes(plan)
-
-        def est_rows(op) -> float:
-            if isinstance(op, Scan):
-                base = self.catalog[op.table].nrows or 1
-                if op.pushed_filter is not None:
-                    base *= 0.25 ** min(
-                        len(self._conjuncts(op.pushed_filter)), 3
-                    )
-                return max(base, 1.0)
-            if isinstance(op, Filter):
-                return max(est_rows(op.child) * 0.5, 1.0)
-            if isinstance(op, JoinOp):
-                l = est_rows(op.left)
-                r = est_rows(op.right)
-                if op.kind in ("semi", "anti"):
-                    return max(l * 0.5, 1.0)
-                if op.kind == "left":
-                    return l * 2
-                if not op.left_keys:  # cross / scalar broadcast
-                    return l if self._is_scalar_relation(op.right) else l * r
-                if self._join_build_unique(op):
-                    return l
-                return max(l, r) * 2
-            if isinstance(op, Aggregate):
-                return min(est_rows(op.child), float(self.default_rows_estimate))
-            if isinstance(op, (Project, Sort, Distinct)):
-                return est_rows(op.child)
-            if isinstance(op, Limit):
-                return float(op.n + op.offset)
-            return float(self.default_rows_estimate)
+        est_rows = self._est_rows
 
         for nid, op in nodes.items():
             if isinstance(op, Aggregate):
@@ -333,127 +341,7 @@ class Executor:
         )
 
         def emit(op, inputs) -> tuple[ColumnBatch, dict[int, jnp.ndarray]]:
-            nid = id_of[id(op)]
-            if isinstance(op, Scan):
-                b = inputs[op.alias]
-                # qualify names
-                qschema = Schema(
-                    tuple(
-                        Field(f"{op.alias}.{f.name}", f.dtype)
-                        for f in b.schema.fields
-                    )
-                )
-                qb = ColumnBatch(
-                    cols={f"{op.alias}.{n}": c for n, c in b.cols.items()},
-                    valid={f"{op.alias}.{n}": v for n, v in b.valid.items()},
-                    sel=b.sel,
-                    nrows=b.nrows,
-                    schema=qschema,
-                    dicts={f"{op.alias}.{n}": d for n, d in b.dicts.items()},
-                )
-                if op.pushed_filter is not None:
-                    qb = qb.with_sel(compile_predicate(op.pushed_filter, qb))
-                return qb, {}
-
-            if isinstance(op, Filter):
-                child, ovf = emit(op.child, inputs)
-                return child.with_sel(compile_predicate(op.pred, child)), ovf
-
-            if isinstance(op, Project):
-                child, ovf = emit(op.child, inputs)
-                cols, valid, dicts, fields = {}, {}, {}, []
-                for name, e in op.exprs:
-                    derived = derive_dict_column(e, child)
-                    if derived is not None:
-                        # string transform (substr): new dict column
-                        v, vv, d2 = derived
-                        dicts[name] = d2
-                    else:
-                        v, vv = evaluate(e, child)
-                    cols[name] = v
-                    if vv is not None:
-                        valid[name] = vv
-                    t = infer_type(e, child.schema)
-                    fields.append(Field(name, t))
-                    if isinstance(e, E.ColRef) and e.name in child.dicts:
-                        dicts[name] = child.dicts[e.name]
-                return (
-                    ColumnBatch(
-                        cols=cols,
-                        valid=valid,
-                        sel=child.sel,
-                        nrows=child.nrows,
-                        schema=Schema(tuple(fields)),
-                        dicts=dicts,
-                    ),
-                    ovf,
-                )
-
-            if isinstance(op, JoinOp):
-                return self._emit_join(op, nid, inputs, emit, params)
-
-            if isinstance(op, Aggregate):
-                return self._emit_aggregate(op, nid, inputs, emit, params)
-
-            if isinstance(op, Distinct):
-                child, ovf = emit(op.child, inputs)
-                keys = [child.cols[n] for n in child.schema.names()]
-                ts = params.groupby_size[nid]
-                row_slot, slot_used, slot_row = assign_group_slots(
-                    keys, child.sel, ts
-                )
-                pend = jnp.sum(
-                    child.sel & (row_slot < 0), dtype=jnp.int64
-                )
-                n = keys[0].shape[0]
-                rep = jnp.clip(slot_row, 0, n - 1)
-                cols = {
-                    name: jnp.where(slot_used, child.cols[name][rep], 0)
-                    for name in child.schema.names()
-                }
-                out = ColumnBatch(
-                    cols=cols,
-                    valid={},
-                    sel=slot_used,
-                    nrows=jnp.sum(slot_used, dtype=jnp.int64),
-                    schema=child.schema,
-                    dicts=child.dicts,
-                )
-                ovf = dict(ovf)
-                ovf[nid] = pend
-                return out, ovf
-
-            if isinstance(op, Sort):
-                child, ovf = emit(op.child, inputs)
-                keys, desc = [], []
-                for e, d in op.keys:
-                    v, _ = evaluate(e, child)
-                    keys.append(v)
-                    desc.append(d)
-                order = sort_indices(keys, desc, child.sel)
-                cols = {n: c[order] for n, c in child.cols.items()}
-                valid = {n: v[order] for n, v in child.valid.items()}
-                return (
-                    replace(
-                        child,
-                        cols=cols,
-                        valid=valid,
-                        sel=child.sel[order],
-                    ),
-                    ovf,
-                )
-
-            if isinstance(op, Limit):
-                child, ovf = emit(op.child, inputs)
-                pos = jnp.cumsum(child.sel.astype(jnp.int64)) - 1
-                keep = (
-                    child.sel
-                    & (pos >= op.offset)
-                    & (pos < op.offset + op.n)
-                )
-                return child.with_sel(keep), ovf
-
-            raise NotImplementedError(type(op))
+            return self._emit_node(op, inputs, emit, params, id_of)
 
         def run(inputs: dict[str, ColumnBatch], qparams: tuple = ()):
             from ..expr import compile as expr_compile
@@ -468,8 +356,138 @@ class Executor:
             ]
             return out, ovf_vec
 
-        jitted = jax.jit(run)
+        jitted = self._wrap_run(run)
         return jitted, input_spec, overflow_nodes
+
+    def _wrap_run(self, run):
+        """Compilation hook: single-chip jit here; shard_map in the PX
+        executor."""
+        return jax.jit(run)
+
+    def _emit_node(self, op, inputs, emit, params, id_of):
+        """Emit one plan node into the traced program (dispatch shared by
+        the single-chip and PX executors)."""
+        nid = id_of[id(op)]
+        if isinstance(op, Scan):
+            b = inputs[op.alias]
+            # qualify names
+            qschema = Schema(
+                tuple(
+                    Field(f"{op.alias}.{f.name}", f.dtype)
+                    for f in b.schema.fields
+                )
+            )
+            qb = ColumnBatch(
+                cols={f"{op.alias}.{n}": c for n, c in b.cols.items()},
+                valid={f"{op.alias}.{n}": v for n, v in b.valid.items()},
+                sel=b.sel,
+                nrows=b.nrows,
+                schema=qschema,
+                dicts={f"{op.alias}.{n}": d for n, d in b.dicts.items()},
+            )
+            if op.pushed_filter is not None:
+                qb = qb.with_sel(compile_predicate(op.pushed_filter, qb))
+            return qb, {}
+
+        if isinstance(op, Filter):
+            child, ovf = emit(op.child, inputs)
+            return child.with_sel(compile_predicate(op.pred, child)), ovf
+
+        if isinstance(op, Project):
+            child, ovf = emit(op.child, inputs)
+            cols, valid, dicts, fields = {}, {}, {}, []
+            for name, e in op.exprs:
+                derived = derive_dict_column(e, child)
+                if derived is not None:
+                    # string transform (substr): new dict column
+                    v, vv, d2 = derived
+                    dicts[name] = d2
+                else:
+                    v, vv = evaluate(e, child)
+                cols[name] = v
+                if vv is not None:
+                    valid[name] = vv
+                t = infer_type(e, child.schema)
+                fields.append(Field(name, t))
+                if isinstance(e, E.ColRef) and e.name in child.dicts:
+                    dicts[name] = child.dicts[e.name]
+            return (
+                ColumnBatch(
+                    cols=cols,
+                    valid=valid,
+                    sel=child.sel,
+                    nrows=child.nrows,
+                    schema=Schema(tuple(fields)),
+                    dicts=dicts,
+                ),
+                ovf,
+            )
+
+        if isinstance(op, JoinOp):
+            return self._emit_join(op, nid, inputs, emit, params)
+
+        if isinstance(op, Aggregate):
+            return self._emit_aggregate(op, nid, inputs, emit, params)
+
+        if isinstance(op, Distinct):
+            child, ovf = emit(op.child, inputs)
+            keys = [child.cols[n] for n in child.schema.names()]
+            ts = params.groupby_size[nid]
+            row_slot, slot_used, slot_row = assign_group_slots(
+                keys, child.sel, ts
+            )
+            pend = jnp.sum(
+                child.sel & (row_slot < 0), dtype=jnp.int64
+            )
+            n = keys[0].shape[0]
+            rep = jnp.clip(slot_row, 0, n - 1)
+            cols = {
+                name: jnp.where(slot_used, child.cols[name][rep], 0)
+                for name in child.schema.names()
+            }
+            out = ColumnBatch(
+                cols=cols,
+                valid={},
+                sel=slot_used,
+                nrows=jnp.sum(slot_used, dtype=jnp.int64),
+                schema=child.schema,
+                dicts=child.dicts,
+            )
+            ovf = dict(ovf)
+            ovf[nid] = pend
+            return out, ovf
+
+        if isinstance(op, Sort):
+            child, ovf = emit(op.child, inputs)
+            keys, desc = [], []
+            for e, d in op.keys:
+                v, _ = evaluate(e, child)
+                keys.append(v)
+                desc.append(d)
+            order = sort_indices(keys, desc, child.sel)
+            cols = {n: c[order] for n, c in child.cols.items()}
+            valid = {n: v[order] for n, v in child.valid.items()}
+            return (
+                replace(
+                    child,
+                    cols=cols,
+                    valid=valid,
+                    sel=child.sel[order],
+                ),
+                ovf,
+            )
+
+        if isinstance(op, Limit):
+            child, ovf = emit(op.child, inputs)
+            pos = jnp.cumsum(child.sel.astype(jnp.int64)) - 1
+            keep = (
+                child.sel
+                & (pos >= op.offset)
+                & (pos < op.offset + op.n)
+            )
+            return child.with_sel(keep), ovf
+
+        raise NotImplementedError(type(op))
 
     # ---- join emission -------------------------------------------------
     def _emit_join(self, op: JoinOp, nid, inputs, emit, params):

@@ -34,6 +34,7 @@ class ResultSet:
     names: tuple[str, ...]
     columns: dict[str, object]  # name -> np.ndarray | list
     affected: int = 0  # DML-affected row count (0 for queries)
+    plan_cache_hit: bool = False  # this statement reused a compiled plan
 
     @property
     def nrows(self) -> int:
@@ -98,6 +99,7 @@ class Session:
         if use_cache is None:
             use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
         entry = self.plan_cache.get(key) if use_cache else None
+        was_hit = entry is not None
         if entry is None:
             t0 = time.perf_counter()
             prepared = self.executor.prepare(pz.plan)
@@ -114,7 +116,7 @@ class Session:
         host = batch_to_host(out_batch)
         # order columns per select list
         cols = {n: host[n] for n in entry.output_names}
-        rs = ResultSet(entry.output_names, cols)
+        rs = ResultSet(entry.output_names, cols, plan_cache_hit=was_hit)
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1

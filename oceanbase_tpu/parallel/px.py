@@ -1,0 +1,463 @@
+"""PX: distributed plan execution as one SPMD program over a device mesh.
+
+Reference surface: the parallel-execution component (sql/engine/px) — the
+coordinator splits the plan into DFOs at TRANSMIT/RECEIVE pairs
+(ObDfoMgr::do_split, ob_dfo_mgr.cpp:462), dispatches SQCs to nodes, workers
+pull granules (ObGranuleIteratorOp) and rows cross DTL channels routed by
+ObSliceIdxCalc; admission bounds cluster DOP (ObPxAdmission,
+ob_px_target_mgr.h); join-filter pushdown ships build-side bloom filters to
+probe-side scans (ob_px_bloom_filter_simd.cpp).
+
+The TPU redesign collapses the DFO graph into ONE shard_map program:
+
+  * DFO boundary      -> an exchange INSIDE the traced program
+                         (all_to_all / all_gather collective, exchange.py)
+  * granule iterator  -> static row-block shard of each table (device
+                         sharding over the mesh axis IS the granule map)
+  * SQC/worker threads-> the mesh devices themselves
+  * DTL channel       -> collective lanes with static capacity + overflow
+                         retry (no credit flow control: the collective is
+                         the synchronization)
+  * datahub rollup    -> psum/pmin/pmax partial-aggregate merges
+  * join bloom filter -> build-side key bitset OR-reduced with psum,
+                         applied to the probe mask BEFORE the all_to_all
+                         (cuts exchanged rows, the pushdown's purpose)
+
+Every intermediate carries a distribution state, the DFO data-layout
+analog: SHARDED (rows split over the mesh axis) or REPLICATED (every
+device holds all rows). Placement rules:
+
+  scan -> SHARDED.  filter/project preserve.
+  join: build(right) REPLICATED -> local; small build -> broadcast build;
+        else hash-repartition both sides on the join keys.
+  group-by: small-domain direct aggregation -> local partials + merge
+        (REPLICATED out); generic hash group-by -> hash-repartition on the
+        group keys (SHARDED out); scalar aggregate -> partials + merge.
+  sort/limit/distinct: gather (REPLICATED), then identical local compute.
+  root: gathered if still SHARDED.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.column import ColumnBatch
+from ..core.dtypes import Schema
+from ..engine.executor import (
+    DIRECT_GROUPBY_MAX_DOMAIN,
+    Executor,
+    _dict_domain,
+    _number_nodes,
+)
+from ..expr.compile import evaluate
+from ..ops.hashing import hash_combine, next_pow2
+from ..sql.logical import Aggregate, Distinct, JoinOp, Limit, Scan, Sort
+from .exchange import broadcast_rows, dest_by_hash, repartition
+from .mesh import SHARD_AXIS
+
+SHARDED = "sharded"
+REPLICATED = "replicated"
+
+# synthesized PhysicalParams ids for exchange lanes (disjoint from plan
+# node ids, which are small pre-order indexes)
+_EXCH_BASE = 1_000_000
+
+
+def _exch_id(nid: int, slot: int) -> int:
+    return _EXCH_BASE + nid * 4 + slot
+
+
+_AGG_CHILD, _JOIN_LEFT, _JOIN_RIGHT = 0, 1, 2
+
+
+class PxAdmission:
+    """Cluster-wide DOP quota (ObPxAdmission / ObPxTargetMgr analog).
+
+    acquire() grants up to `dop` workers, degrading to whatever quota
+    remains (minimum 1, like the reference's min-DOP admission); release()
+    returns them. A query that cannot get even one worker raises."""
+
+    def __init__(self, target: int):
+        self.target = target
+        self._used = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, dop: int) -> int:
+        with self._lock:
+            free = self.target - self._used
+            if free <= 0:
+                raise RuntimeError(
+                    f"PX admission: no quota ({self._used}/{self.target} in use)"
+                )
+            granted = min(dop, free)
+            self._used += granted
+            return granted
+
+    def release(self, granted: int) -> None:
+        with self._lock:
+            self._used = max(0, self._used - granted)
+
+
+class PxExecutor(Executor):
+    """Compiles logical plans into shard_map SPMD programs over a mesh."""
+
+    def __init__(self, catalog, mesh: Mesh, unique_keys=None,
+                 default_rows_estimate=1 << 16,
+                 broadcast_threshold: int = 1 << 16,
+                 join_bloom: bool = True,
+                 bloom_max_bits: int = 1 << 20):
+        super().__init__(catalog, unique_keys=unique_keys,
+                         default_rows_estimate=default_rows_estimate)
+        self.mesh = mesh
+        self.nsh = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        self.broadcast_threshold = broadcast_threshold
+        self.join_bloom = join_bloom
+        self.bloom_max_bits = bloom_max_bits
+        self._dist: dict[int, str] = {}
+
+    # ------------------------------------------------------------ inputs
+    def table_batch(self, name: str, cols: tuple[str, ...]):
+        """Raw sharded input: cols/valid/sel arrays padded to a multiple of
+        nsh*1024 and placed with row sharding (the granule map)."""
+        key = (name, cols)
+        if key not in self._batch_cache:
+            from ..core.column import make_batch
+
+            t = self.catalog[name]
+            sub_schema = Schema(
+                tuple(f for f in t.schema.fields if f.name in cols)
+            )
+            unit = 1024 * self.nsh
+            cap = max(unit, -(-(t.nrows or 1) // unit) * unit)
+            b = make_batch(
+                {c: t.data[c] for c in sub_schema.names()},
+                sub_schema,
+                {c: d for c, d in t.dicts.items() if c in cols},
+                capacity=cap,
+                valid={c: v for c, v in t.valid.items() if c in cols},
+            )
+            shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+            raw = {
+                "cols": {n: jax.device_put(a, shard) for n, a in b.cols.items()},
+                "valid": {n: jax.device_put(a, shard) for n, a in b.valid.items()},
+                "sel": jax.device_put(b.sel, shard),
+            }
+            self._batch_cache[key] = raw
+        return self._batch_cache[key]
+
+    # ------------------------------------------------------- capacities
+    def seed_params(self, plan):
+        params = super().seed_params(plan)
+        nodes = _number_nodes(plan)
+        est = self._est_rows
+
+        def lane_cap(rows: float) -> int:
+            # per (src,dst) lane of an all_to_all: expected rows/nsh^2
+            # with 2x skew headroom
+            c = int(rows * 2 / (self.nsh * self.nsh)) + 512
+            return -(-c // 128) * 128
+
+        for nid, op in nodes.items():
+            if isinstance(op, JoinOp) and op.left_keys:
+                params.exchange_cap[_exch_id(nid, _JOIN_LEFT)] = lane_cap(
+                    est(op.left))
+                params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)] = lane_cap(
+                    est(op.right))
+            if isinstance(op, Aggregate) and op.group_keys:
+                params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
+                    est(op.child))
+        return params
+
+    # -------------------------------------------------------- exchanges
+    def _gather_batch(self, b: ColumnBatch) -> ColumnBatch:
+        """GATHER/BROADCAST: replicate all rows on every shard."""
+        payload = {f"c:{n}": a for n, a in b.cols.items()}
+        payload.update({f"v:{n}": a for n, a in b.valid.items()})
+        out, mask = broadcast_rows(payload, b.sel)
+        return ColumnBatch(
+            cols={n: out[f"c:{n}"] for n in b.cols},
+            valid={n: out[f"v:{n}"] for n in b.valid},
+            sel=mask,
+            nrows=jnp.sum(mask, dtype=jnp.int64),
+            schema=b.schema,
+            dicts=b.dicts,
+        )
+
+    def _exchange_hash(self, b: ColumnBatch, key_exprs, cap: int):
+        """HASH distribution: co-partition rows by key hash (all_to_all)."""
+        keys = [evaluate(e, b)[0] for e in key_exprs]
+        dest = dest_by_hash(keys, self.nsh)
+        payload = {f"c:{n}": a for n, a in b.cols.items()}
+        payload.update({f"v:{n}": a for n, a in b.valid.items()})
+        out, mask, ovf = repartition(payload, b.sel, dest, self.nsh, cap)
+        nb = ColumnBatch(
+            cols={n: out[f"c:{n}"] for n in b.cols},
+            valid={n: out[f"v:{n}"] for n in b.valid},
+            sel=mask,
+            nrows=jnp.sum(mask, dtype=jnp.int64),
+            schema=b.schema,
+            dicts=b.dicts,
+        )
+        return nb, ovf
+
+    def _bloom_prefilter(self, probe: ColumnBatch, probe_keys, build: ColumnBatch,
+                         build_keys, est_build: float) -> ColumnBatch:
+        """Join-filter pushdown: OR-reduce a build-side key bitset across
+        shards, drop probe rows that cannot match BEFORE the exchange."""
+        m = min(self.bloom_max_bits, next_pow2(max(int(4 * est_build), 1024)))
+        bk = [evaluate(e, build)[0] for e in build_keys]
+        h = (hash_combine(bk) % jnp.uint64(m)).astype(jnp.int32)
+        bits = jnp.zeros(m, dtype=jnp.int32).at[
+            jnp.where(build.sel, h, m)
+        ].set(1, mode="drop")
+        bits = lax.psum(bits, SHARD_AXIS) > 0
+        pk = [evaluate(e, probe)[0] for e in probe_keys]
+        ph = (hash_combine(pk) % jnp.uint64(m)).astype(jnp.int32)
+        return probe.with_sel(probe.sel & bits[ph])
+
+    # ------------------------------------------------------- emission
+    def _emit_node(self, op, inputs, emit, params, id_of):
+        nid = id_of[id(op)]
+
+        if isinstance(op, Scan):
+            out, ovf = super()._emit_node(op, inputs, emit, params, id_of)
+            self._dist[id(op)] = SHARDED
+            return out, ovf
+
+        if isinstance(op, JoinOp):
+            return self._emit_join_px(op, nid, inputs, emit, params, id_of)
+
+        if isinstance(op, Aggregate):
+            return self._emit_agg_px(op, nid, inputs, emit, params, id_of)
+
+        if isinstance(op, (Sort, Limit, Distinct)):
+            # order/offset/dedup need the global row set: gather first
+            # (distinct could also hash-repartition; gathered inputs at
+            # these plan positions are small)
+            child, covf = emit(op.child, inputs)
+            if self._dist[id(op.child)] == SHARDED:
+                child = self._gather_batch(child)
+            out, ovf = super()._emit_node(
+                op, inputs, _override(emit, op.child, (child, covf)),
+                params, id_of)
+            self._dist[id(op)] = REPLICATED
+            return out, ovf
+
+        # Filter / Project: local, distribution-preserving
+        out, ovf = super()._emit_node(op, inputs, emit, params, id_of)
+        child = getattr(op, "child", None)
+        self._dist[id(op)] = self._dist[id(child)] if child is not None else SHARDED
+        return out, ovf
+
+    # ---- joins ----------------------------------------------------------
+    def _emit_join_px(self, op, nid, inputs, emit, params, id_of):
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ld, rd = self._dist[id(op.left)], self._dist[id(op.right)]
+        ovf = {**lovf, **rovf}
+
+        # choose distribution method (the optimizer's exchange allocation)
+        if rd == REPLICATED:
+            method = "local"  # build already everywhere; probe drives output
+        elif not op.left_keys:
+            method = "broadcast"  # cross join: replicate the build side
+        elif ld == REPLICATED:
+            method = "broadcast"  # make both sides replicated
+        elif self._est_rows(op.right) <= self.broadcast_threshold:
+            method = "broadcast"
+        else:
+            method = "hash"
+
+        if method == "hash":
+            # bloom pushdown is only sound where dropping non-matching
+            # probe rows is a no-op: inner and semi joins (an anti/left
+            # join must KEEP unmatched probe rows)
+            if self.join_bloom and op.kind in ("inner", "cross", "semi"):
+                left = self._bloom_prefilter(
+                    left, op.left_keys, right, op.right_keys,
+                    self._est_rows(op.right))
+            left, xl = self._exchange_hash(
+                left, op.left_keys,
+                params.exchange_cap[_exch_id(nid, _JOIN_LEFT)])
+            right, xr = self._exchange_hash(
+                right, op.right_keys,
+                params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)])
+            ovf = dict(ovf)
+            ovf[_exch_id(nid, _JOIN_LEFT)] = xl
+            ovf[_exch_id(nid, _JOIN_RIGHT)] = xr
+            out_dist = SHARDED
+        elif method == "broadcast":
+            right = self._gather_batch(right)
+            out_dist = ld
+        else:
+            out_dist = ld
+
+        emit2 = _override(
+            _override(emit, op.left, (left, {})), op.right, (right, {}))
+        out, jovf = super()._emit_join(op, nid, inputs, emit2, params)
+        ovf.update({k: v for k, v in jovf.items() if k not in ovf})
+        self._dist[id(op)] = out_dist
+        return out, ovf
+
+    # ---- aggregation -----------------------------------------------------
+    def _emit_agg_px(self, op, nid, inputs, emit, params, id_of):
+        child, covf = emit(op.child, inputs)
+        cd = self._dist[id(op.child)]
+
+        if cd == REPLICATED:
+            out, ovf = super()._emit_aggregate(
+                op, nid, inputs, _override(emit, op.child, (child, covf)),
+                params)
+            self._dist[id(op)] = REPLICATED
+            return out, ovf
+
+        domains = [_dict_domain(child, e) for _, e in op.group_keys]
+        direct = (
+            bool(op.group_keys)
+            and all(d is not None for d in domains)
+            and int(np.prod([d for d in domains])) <= DIRECT_GROUPBY_MAX_DOMAIN
+        )
+
+        if direct or not op.group_keys:
+            # local partials + datahub-rollup merge: moves O(groups), not
+            # O(rows) — the right plan for small-domain group-bys (Q1) and
+            # scalar aggregates (Q6)
+            out, ovf = super()._emit_aggregate(
+                op, nid, inputs, _override(emit, op.child, (child, covf)),
+                params)
+            merged = dict(out.cols)
+            for name, fn, _arg, _d in op.aggs:
+                col = out.cols[name]
+                if fn in ("sum", "count"):
+                    merged[name] = lax.psum(col, SHARD_AXIS)
+                elif fn == "min":
+                    merged[name] = lax.pmin(col, SHARD_AXIS)
+                elif fn == "max":
+                    merged[name] = lax.pmax(col, SHARD_AXIS)
+                else:
+                    raise NotImplementedError(f"PX merge for {fn}")
+            sel = lax.psum(out.sel.astype(jnp.int32), SHARD_AXIS) > 0
+            valid = {
+                n: lax.psum(v.astype(jnp.int32), SHARD_AXIS) > 0
+                for n, v in out.valid.items()
+            }
+            out = replace(
+                out, cols=merged, valid=valid, sel=sel,
+                nrows=jnp.sum(sel, dtype=jnp.int64),
+            )
+            self._dist[id(op)] = REPLICATED
+            return out, ovf
+
+        # generic hash group-by: co-partition rows on the group keys, then
+        # each shard owns its key space entirely
+        cap = params.exchange_cap[_exch_id(nid, _AGG_CHILD)]
+        child2, xovf = self._exchange_hash(
+            child, [e for _, e in op.group_keys], cap)
+        out, ovf = super()._emit_aggregate(
+            op, nid, inputs, _override(emit, op.child, (child2, covf)), params)
+        ovf = dict(ovf)
+        ovf[_exch_id(nid, _AGG_CHILD)] = xovf
+        self._dist[id(op)] = SHARDED
+        return out, ovf
+
+    # ------------------------------------------------------ compilation
+    def compile(self, plan, params):
+        nodes = _number_nodes(plan)
+        id_of = {id(o): i for i, o in nodes.items()}
+        needed = self._needed_columns(plan)
+        scans = self._collect_scans(plan)
+        input_spec = []
+        side: dict[str, tuple[Schema, dict]] = {}
+        for s in scans:
+            cols = needed.get(s.alias, set())
+            if not cols:
+                cols = {self.catalog[s.table].schema.fields[0].name}
+            cols = tuple(sorted(cols))
+            input_spec.append((s.alias, s.table, cols))
+            t = self.catalog[s.table]
+            sub_schema = Schema(
+                tuple(f for f in t.schema.fields if f.name in cols))
+            side[s.alias] = (
+                sub_schema,
+                {c: d for c, d in t.dicts.items() if c in cols},
+            )
+
+        overflow_nodes = sorted(
+            set(params.groupby_size) | set(params.join_cap)
+            | set(params.exchange_cap)
+        )
+
+        def emit(op, inputs):
+            return self._emit_node(op, inputs, emit, params, id_of)
+
+        def run_local(raw_inputs, qparams):
+            from ..expr import compile as expr_compile
+
+            inputs = {}
+            for alias, raw in raw_inputs.items():
+                schema, dicts = side[alias]
+                sel = raw["sel"]
+                inputs[alias] = ColumnBatch(
+                    cols=dict(raw["cols"]),
+                    valid=dict(raw["valid"]),
+                    sel=sel,
+                    nrows=jnp.sum(sel, dtype=jnp.int64),
+                    schema=schema,
+                    dicts=dicts,
+                )
+            self._dist = {}
+            prev = expr_compile.set_params(qparams if qparams else None)
+            try:
+                out, ovf = emit(plan, inputs)
+            finally:
+                expr_compile.set_params(prev)
+            if self._dist[id(plan)] == SHARDED:
+                out = self._gather_batch(out)
+            # overflow counters must leave the shard_map replicated; psum
+            # may multiply already-replicated counters by nsh, which is
+            # harmless (the driver only tests >0)
+            ovf_vec = [
+                lax.psum(
+                    ovf.get(n, jnp.zeros((), jnp.int64)), SHARD_AXIS
+                )
+                for n in overflow_nodes
+            ]
+            return out, ovf_vec
+
+        def run(raw_inputs, qparams):
+            in_specs = (
+                jax.tree.map(lambda _: P(SHARD_AXIS), raw_inputs),
+                jax.tree.map(lambda _: P(), qparams),
+            )
+            # check_vma=False: replication of the outputs (all_gathered or
+            # psum-merged) is guaranteed by construction but not statically
+            # inferable through gather-then-local-compute chains; the PX
+            # test suite verifies it against single-chip results
+            return jax.shard_map(
+                run_local,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=P(),
+                check_vma=False,
+            )(raw_inputs, qparams)
+
+        return jax.jit(run), input_spec, overflow_nodes
+
+
+def _override(emit, node, result):
+    """An emit view that returns a precomputed (exchanged) batch for one
+    child node and delegates everything else."""
+
+    def emit2(op, inputs):
+        if op is node:
+            return result
+        return emit(op, inputs)
+
+    return emit2

@@ -23,6 +23,7 @@ code-order == string-order invariant holds on device.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from dataclasses import dataclass, field
 
@@ -136,7 +137,7 @@ class Database:
         self.config.on_change(
             "sql_audit_memory_limit",
             lambda _n, _o, v: self.audit.set_capacity(max(64, v // 4096)))
-        self._session_ids = __import__("itertools").count(1)
+        self._session_ids = itertools.count(1)
 
         self._unique_keys: dict[str, tuple[str, ...]] = {}
         self.engine = Session(
@@ -321,9 +322,9 @@ class DbSession:
         import time as _time
 
         db = self.db
-        hits0 = db.plan_cache.stats.hits
         t0 = _time.perf_counter()
         err, rs = "", None
+        self._last_stmt_type = ""  # "": did not parse
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
@@ -341,7 +342,8 @@ class DbSession:
                         elapsed_s=_time.perf_counter() - t0,
                         rows=rs.nrows if rs is not None else 0,
                         affected=rs.affected if rs is not None else 0,
-                        plan_cache_hit=db.plan_cache.stats.hits > hits0,
+                        plan_cache_hit=(rs.plan_cache_hit
+                                        if rs is not None else False),
                         error=err,
                     )
         return rs
@@ -416,9 +418,20 @@ class DbSession:
         names = _tables_in_ast(ast)
         any_vt = self.db.refresh_virtual(names)
         self.db.refresh_catalog(names, tx=self._tx)
-        return self.db.engine.run_ast(
-            ast, norm_key, use_cache=False if any_vt else None
-        )
+        try:
+            return self.db.engine.run_ast(
+                ast, norm_key, use_cache=False if any_vt else None
+            )
+        finally:
+            if any_vt:
+                # virtual snapshots are per-statement: release them so they
+                # neither pin memory nor appear as tables afterwards
+                from .virtual_tables import PROVIDERS
+
+                for n in names:
+                    if n in PROVIDERS:
+                        self.db.catalog.pop(n, None)
+                        self.db.engine.executor.invalidate_table(n)
 
     # --------------------------------------------------------------- tx
     def _dml(self, body) -> ResultSet:

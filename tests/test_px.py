@@ -1,0 +1,98 @@
+"""PX distributed execution: shard_map SPMD plans vs single-chip results.
+
+Mirrors the reference's PX unit tests (unittest/sql/engine/px) but at the
+whole-plan level: the same logical plan executed by the single-chip
+Executor and the 8-device PxExecutor must agree on TPC-H queries covering
+every distribution shape (partial+merge aggregates, hash repartition
+joins/group-bys, broadcast joins, semi/anti/left joins, gather sort/limit).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core.column import batch_to_host
+from oceanbase_tpu.engine.executor import Executor
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+from oceanbase_tpu.parallel.mesh import make_mesh
+from oceanbase_tpu.parallel.px import PxAdmission, PxExecutor
+from oceanbase_tpu.sql.parser import parse
+from oceanbase_tpu.sql.planner import Planner
+
+
+@pytest.fixture(scope="module")
+def env():
+    tables = datagen.generate(sf=0.01)
+    mesh = make_mesh(8)
+    return {
+        "tables": tables,
+        "planner": Planner(tables),
+        "single": Executor(tables, unique_keys=UNIQUE_KEYS),
+        "px": PxExecutor(tables, mesh, unique_keys=UNIQUE_KEYS),
+    }
+
+
+def _rows(batch, names):
+    host = batch_to_host(batch)
+    out = []
+    for i in range(len(next(iter(host.values())) if host else [])):
+        row = []
+        for n in names:
+            v = host[n][i]
+            if isinstance(v, float):
+                if math.isnan(v):
+                    v = None
+                else:
+                    v = round(v, 4)
+            elif isinstance(v, np.floating):
+                v = round(float(v), 4)
+            elif isinstance(v, np.integer):
+                v = int(v)
+            row.append(v)
+        out.append(tuple(row))
+    return sorted(out, key=lambda r: tuple((x is None, x) for x in r))
+
+
+_EMPTY_AT_SF001 = {20}  # Q20's nested filters select no suppliers at sf=0.01
+
+
+def _check(env, sql_text, expect_rows=True):
+    planned = env["planner"].plan(parse(sql_text))
+    names = planned.output_names
+    single_b = env["single"].execute(planned.plan)
+    px_b = env["px"].execute(planned.plan)
+    srows = _rows(single_b, names)
+    prows = _rows(px_b, names)
+    assert srows == prows, (
+        f"distributed mismatch: {len(srows)} vs {len(prows)} rows\n"
+        f"single={srows[:5]}\npx={prows[:5]}"
+    )
+    if expect_rows:
+        assert len(srows) > 0, "both executors empty: upstream data bug?"
+
+
+# every distribution shape, via the real TPC-H suite: all 22 queries
+@pytest.mark.parametrize("qid", list(range(1, 23)))
+def test_tpch_distributed(env, qid):
+    _check(env, QUERIES[qid], expect_rows=qid not in _EMPTY_AT_SF001)
+
+
+def test_small_groupby_is_merge_not_exchange(env):
+    """Q1-shaped aggregate must NOT move rows: output is replicated via
+    psum merge (checked structurally: result distribution is replicated =>
+    no gather node needed; we just verify correctness + that it runs)."""
+    _check(env, QUERIES[1])
+
+
+def test_admission_quota():
+    adm = PxAdmission(target=10)
+    g1 = adm.acquire(8)
+    assert g1 == 8
+    g2 = adm.acquire(8)  # degraded to remaining quota
+    assert g2 == 2
+    with pytest.raises(RuntimeError):
+        adm.acquire(1)
+    adm.release(g1)
+    assert adm.acquire(4) == 4
