@@ -129,6 +129,29 @@ def make_batch(
     )
 
 
+def batch_rows_normalized(
+    batch: ColumnBatch, names, ndigits: int = 4
+) -> list[tuple]:
+    """Result rows as a sorted list of comparable tuples: floats rounded,
+    NaN -> None, numpy scalars unboxed. The canonical form for comparing
+    two executions of the same plan (distributed vs single-chip checks,
+    oracle comparisons)."""
+    host = batch_to_host(batch)
+    n = len(next(iter(host.values()))) if host else 0
+    out = []
+    for i in range(n):
+        row = []
+        for nm in names:
+            v = host[nm][i]
+            if isinstance(v, (float, np.floating)):
+                v = None if np.isnan(v) else round(float(v), ndigits)
+            elif isinstance(v, np.integer):
+                v = int(v)
+            row.append(v)
+        out.append(tuple(row))
+    return sorted(out, key=lambda r: tuple((x is None, str(x)) for x in r))
+
+
 def batch_to_host(batch: ColumnBatch, decode_strings: bool = True) -> dict[str, np.ndarray | list]:
     """Pull live rows back to host (compacting out dead rows).
 

@@ -356,13 +356,7 @@ class Executor:
             ]
             return out, ovf_vec
 
-        jitted = self._wrap_run(run)
-        return jitted, input_spec, overflow_nodes
-
-    def _wrap_run(self, run):
-        """Compilation hook: single-chip jit here; shard_map in the PX
-        executor."""
-        return jax.jit(run)
+        return jax.jit(run), input_spec, overflow_nodes
 
     def _emit_node(self, op, inputs, emit, params, id_of):
         """Emit one plan node into the traced program (dispatch shared by

@@ -130,3 +130,72 @@ def broadcast_rows(
 def merge_partials(partials, axis_name: str = SHARD_AXIS):
     """Merge per-shard partial aggregates (datahub rollup analog)."""
     return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), partials)
+
+
+def sample_range_bounds(
+    key: jnp.ndarray,
+    mask: jnp.ndarray,
+    n_shards: int,
+    axis_name: str = SHARD_AXIS,
+    resolution: int = 4096,
+) -> jnp.ndarray:
+    """RANGE distribution support: n_shards-1 ascending split points chosen
+    so each range holds ~equal global row counts.
+
+    The reference samples rows through the datahub (dynamic-sample message,
+    px/datahub/components) to pick range boundaries for range-dist sort and
+    window exchanges; the SPMD analog builds a global psum histogram over
+    the key span — every shard derives identical bounds with no host round
+    trip. Integer keys only (dict codes, dates, ints)."""
+    k64 = key.astype(jnp.int64)
+    big = jnp.int64(jnp.iinfo(jnp.int64).max)
+    kmin = lax.pmin(jnp.min(jnp.where(mask, k64, big)), axis_name)
+    kmax = lax.pmax(jnp.max(jnp.where(mask, k64, -big - 1)), axis_name)
+    span = jnp.maximum(kmax - kmin + 1, 1)
+    # equal-width buckets of integer step: (k-kmin)//step never overflows,
+    # unlike (k-kmin)*resolution which wraps for spans beyond ~2^51
+    step = jnp.maximum((span + resolution - 1) // resolution, 1)
+    bucket = jnp.clip((k64 - kmin) // step, 0, resolution - 1).astype(jnp.int32)
+    hist = jnp.zeros(resolution, dtype=jnp.int64).at[
+        jnp.where(mask, bucket, resolution)
+    ].add(1, mode="drop")
+    hist = lax.psum(hist, axis_name)
+    cdf = jnp.cumsum(hist)
+    total = cdf[-1]
+    # bound i = smallest bucket whose cdf covers quantile (i+1)/n_shards
+    targets = (jnp.arange(1, n_shards, dtype=jnp.int64) * total) // n_shards
+    idx = jnp.searchsorted(cdf, targets, side="left")
+    # exclusive key-space upper bound of each chosen bucket (pairs with
+    # dest_by_range's side="right"); (idx+1)*step <= span+resolution, no
+    # overflow
+    return kmin + (idx + 1) * step
+
+
+def bc2host(
+    cols: dict[str, jnp.ndarray],
+    mask: jnp.ndarray,
+    per_host: int,
+    axis_name: str = SHARD_AXIS,
+):
+    """BC2HOST (SM_BROADCAST): one copy of every row per HOST, split across
+    that host's workers.
+
+    Mesh layout contract: consecutive runs of `per_host` shards form one
+    host (the natural ICI-within-DCN-across layout). Implemented as a full
+    all_gather followed by a lane filter — each host collectively holds all
+    rows exactly once, striped over its workers. On a 2-level topology XLA
+    lowers the gather hierarchically, which is the reference's intent
+    (broadcast per host, random within host)."""
+    out, m = broadcast_rows(cols, mask, axis_name)
+    lane = lax.axis_index(axis_name) % per_host
+    stripe = jnp.arange(m.shape[0], dtype=jnp.int32) % per_host
+    return out, m & (stripe == lane)
+
+
+def dest_by_partition(
+    part_ids: jnp.ndarray, owner_of_partition: jnp.ndarray
+) -> jnp.ndarray:
+    """PARTITION (PKEY) distribution: route each row to the shard owning
+    its partition (partial partition-wise join / PKEY DML). The owner map
+    is the location-cache's tablet->shard assignment shipped to device."""
+    return owner_of_partition[part_ids].astype(jnp.int32)

@@ -112,7 +112,8 @@ class PxExecutor(Executor):
                  default_rows_estimate=1 << 16,
                  broadcast_threshold: int = 1 << 16,
                  join_bloom: bool = True,
-                 bloom_max_bits: int = 1 << 20):
+                 bloom_max_bits: int = 1 << 20,
+                 hybrid_hash: bool = False):
         super().__init__(catalog, unique_keys=unique_keys,
                          default_rows_estimate=default_rows_estimate)
         self.mesh = mesh
@@ -120,6 +121,8 @@ class PxExecutor(Executor):
         self.broadcast_threshold = broadcast_threshold
         self.join_bloom = join_bloom
         self.bloom_max_bits = bloom_max_bits
+        # skew-adaptive hybrid-hash joins (HYBRID_HASH_BROADCAST/RANDOM)
+        self.hybrid_hash = hybrid_hash
         self._dist: dict[int, str] = {}
 
     # ------------------------------------------------------------ inputs
@@ -207,6 +210,64 @@ class PxExecutor(Executor):
         )
         return nb, ovf
 
+    def _concat_batches(self, a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
+        """Row-concatenate two same-schema batches (static capacities add)."""
+        cols = {n: jnp.concatenate([a.cols[n], b.cols[n]]) for n in a.cols}
+        valid = {n: jnp.concatenate([a.valid[n], b.valid[n]]) for n in a.valid}
+        sel = jnp.concatenate([a.sel, b.sel])
+        return ColumnBatch(
+            cols=cols, valid=valid, sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=a.schema, dicts=a.dicts,
+        )
+
+    def _hybrid_exchange(self, probe: ColumnBatch, probe_keys,
+                         build: ColumnBatch, build_keys,
+                         cap_probe: int, cap_build: int):
+        """HYBRID_HASH_BROADCAST/RANDOM: skew-adaptive repartition.
+
+        The reference samples probe keys through the datahub and routes
+        popular values BROADCAST (build side) / RANDOM-local (probe side)
+        while normal values go HASH (ob_sql_define.h:393, hybrid-hash with
+        the dynamic-sample msg). SPMD analog: a psum'd hash-bucket
+        histogram of probe keys picks the popular buckets identically on
+        every shard; popular probe rows stay local, popular build rows
+        all_gather, normal rows of both sides all_to_all by key hash."""
+        hb = 4096
+        pk = [evaluate(e, probe)[0] for e in probe_keys]
+        ph = (hash_combine(pk) % jnp.uint64(hb)).astype(jnp.int32)
+        bk = [evaluate(e, build)[0] for e in build_keys]
+        bh = (hash_combine(bk) % jnp.uint64(hb)).astype(jnp.int32)
+
+        def hot_buckets(h, sel):
+            cnt = jnp.zeros(hb, dtype=jnp.int64).at[
+                jnp.where(sel, h, hb)
+            ].add(1, mode="drop")
+            cnt = lax.psum(cnt, SHARD_AXIS)
+            # a bucket is popular when its rows would overload one shard's
+            # fair share by 2x
+            return cnt > jnp.maximum(jnp.sum(cnt) * 2 // self.nsh, 1)
+
+        # skew on EITHER side forces the hybrid route for that key: a
+        # heavily-duplicated build key would overload its hash lane exactly
+        # like a popular probe key would
+        popular = hot_buckets(ph, probe.sel) | hot_buckets(bh, build.sel)
+        p_pop = popular[ph] & probe.sel
+
+        probe_norm, ox_p = self._exchange_hash(
+            probe.with_sel(probe.sel & ~p_pop), probe_keys, cap_probe)
+        probe_loc = probe.with_sel(p_pop)
+        # align capacities: exchanged batch is nsh*cap rows; local popular
+        # rows keep their original capacity — concat handles both
+        new_probe = self._concat_batches(probe_norm, probe_loc)
+
+        b_pop = popular[bh] & build.sel
+        build_norm, ox_b = self._exchange_hash(
+            build.with_sel(build.sel & ~b_pop), build_keys, cap_build)
+        build_bc = self._gather_batch(build.with_sel(b_pop))
+        new_build = self._concat_batches(build_norm, build_bc)
+        return new_probe, new_build, ox_p, ox_b
+
     def _bloom_prefilter(self, probe: ColumnBatch, probe_keys, build: ColumnBatch,
                          build_keys, est_build: float) -> ColumnBatch:
         """Join-filter pushdown: OR-reduce a build-side key bitset across
@@ -283,12 +344,14 @@ class PxExecutor(Executor):
                 left = self._bloom_prefilter(
                     left, op.left_keys, right, op.right_keys,
                     self._est_rows(op.right))
-            left, xl = self._exchange_hash(
-                left, op.left_keys,
-                params.exchange_cap[_exch_id(nid, _JOIN_LEFT)])
-            right, xr = self._exchange_hash(
-                right, op.right_keys,
-                params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)])
+            cap_l = params.exchange_cap[_exch_id(nid, _JOIN_LEFT)]
+            cap_r = params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)]
+            if self.hybrid_hash and op.kind == "inner":
+                left, right, xl, xr = self._hybrid_exchange(
+                    left, op.left_keys, right, op.right_keys, cap_l, cap_r)
+            else:
+                left, xl = self._exchange_hash(left, op.left_keys, cap_l)
+                right, xr = self._exchange_hash(right, op.right_keys, cap_r)
             ovf = dict(ovf)
             ovf[_exch_id(nid, _JOIN_LEFT)] = xl
             ovf[_exch_id(nid, _JOIN_RIGHT)] = xr

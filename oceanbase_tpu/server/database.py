@@ -314,6 +314,7 @@ class DbSession:
         self._tx: _OpenTx | None = None
         self.session_id = next(db._session_ids)
         self._last_stmt_type = ""
+        self._stmt_cache_hit = False
 
     # ------------------------------------------------------------ public
     def sql(self, text: str) -> ResultSet:
@@ -325,6 +326,7 @@ class DbSession:
         t0 = _time.perf_counter()
         err, rs = "", None
         self._last_stmt_type = ""  # "": did not parse
+        self._stmt_cache_hit = False  # set by any inner _select
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
@@ -419,9 +421,13 @@ class DbSession:
         any_vt = self.db.refresh_virtual(names)
         self.db.refresh_catalog(names, tx=self._tx)
         try:
-            return self.db.engine.run_ast(
+            rs = self.db.engine.run_ast(
                 ast, norm_key, use_cache=False if any_vt else None
             )
+            # surfaces in the audit record; for DML the qualification
+            # scan's plan reuse IS the statement's plan-cache behavior
+            self._stmt_cache_hit = rs.plan_cache_hit
+            return rs
         finally:
             if any_vt:
                 # virtual snapshots are per-statement: release them so they
@@ -446,7 +452,8 @@ class DbSession:
             raise
         if auto:
             self._end_tx(commit=True)
-        return ResultSet((), {}, affected=affected)
+        return ResultSet((), {}, affected=affected,
+                         plan_cache_hit=self._stmt_cache_hit)
 
     def _end_tx(self, commit: bool) -> None:
         tx = self._tx

@@ -7,12 +7,9 @@ every distribution shape (partial+merge aggregates, hash repartition
 joins/group-bys, broadcast joins, semi/anti/left joins, gather sort/limit).
 """
 
-import math
-
-import numpy as np
 import pytest
 
-from oceanbase_tpu.core.column import batch_to_host
+from oceanbase_tpu.core.column import batch_rows_normalized
 from oceanbase_tpu.engine.executor import Executor
 from oceanbase_tpu.models.tpch import datagen
 from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
@@ -34,27 +31,6 @@ def env():
     }
 
 
-def _rows(batch, names):
-    host = batch_to_host(batch)
-    out = []
-    for i in range(len(next(iter(host.values())) if host else [])):
-        row = []
-        for n in names:
-            v = host[n][i]
-            if isinstance(v, float):
-                if math.isnan(v):
-                    v = None
-                else:
-                    v = round(v, 4)
-            elif isinstance(v, np.floating):
-                v = round(float(v), 4)
-            elif isinstance(v, np.integer):
-                v = int(v)
-            row.append(v)
-        out.append(tuple(row))
-    return sorted(out, key=lambda r: tuple((x is None, x) for x in r))
-
-
 _EMPTY_AT_SF001 = {20}  # Q20's nested filters select no suppliers at sf=0.01
 
 
@@ -63,8 +39,8 @@ def _check(env, sql_text, expect_rows=True):
     names = planned.output_names
     single_b = env["single"].execute(planned.plan)
     px_b = env["px"].execute(planned.plan)
-    srows = _rows(single_b, names)
-    prows = _rows(px_b, names)
+    srows = batch_rows_normalized(single_b, names)
+    prows = batch_rows_normalized(px_b, names)
     assert srows == prows, (
         f"distributed mismatch: {len(srows)} vs {len(prows)} rows\n"
         f"single={srows[:5]}\npx={prows[:5]}"
