@@ -1,0 +1,252 @@
+"""Spill operators: sort/join/group-by over data larger than one device
+batch, with host tmp-file runs between device passes.
+
+Reference surface: the spill paths of the vectorized operators — external
+merge sort via tmp files (sql/engine/sort), partitioned hash join
+(ObHJPartition, sql/engine/join/hash_join) and hash-agg partitioning
+(ob_hp_infras_vec_op.h), all backed by storage/tmp_file.
+
+TPU redesign: the device processes fixed-capacity chunks (sorted runs,
+hash partitions) and the host streams spilled segments — device compute
+stays static-shaped, host memory stays bounded by the chunk size:
+
+  external_sort       device-sorts chunks into runs, then streaming 2-way
+                      merges of page-sized blocks (classic external merge)
+  partitioned_groupby hash-partition rows to segment files, device
+                      group-by per partition, concatenate partitions
+  partitioned_join    hash-partition both sides, device join per
+                      partition pair (ObHJPartition analog)
+
+Keys are int64 (dict codes / dates / ints — the engine's universal key
+domain).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..storage.tmp_file import TmpFileManager
+from .hashing import next_pow2
+
+
+def pack_sort_key(cols: list[np.ndarray], descending: list[bool]) -> np.ndarray:
+    """Pack multiple int columns into one orderable uint64 composite.
+
+    Each column is offset to non-negative and bit-packed MSB-first; a
+    descending column packs its complement. Raises if the combined bit
+    width exceeds 64 (callers fall back to single-key sorts)."""
+    widths = []
+    shifted = []
+    for c, desc in zip(cols, descending):
+        c = c.astype(np.int64)
+        lo, hi = int(c.min()), int(c.max())
+        span = hi - lo
+        w = max(1, int(span).bit_length())
+        v = (c - lo).astype(np.uint64)
+        if desc:
+            v = np.uint64(span) - v
+        widths.append(w)
+        shifted.append(v)
+    if sum(widths) > 64:
+        raise ValueError(f"sort key too wide: {sum(widths)} bits")
+    out = np.zeros(len(cols[0]), dtype=np.uint64)
+    for v, w in zip(shifted, widths):
+        out = (out << np.uint64(w)) | v
+    return out
+
+
+@jax.jit
+def _device_sort_chunk(key: jnp.ndarray):
+    return jnp.argsort(key)
+
+
+def external_sort(
+    cols: dict[str, np.ndarray],
+    key: np.ndarray,
+    chunk_rows: int,
+    tmp: TmpFileManager,
+    page_rows: int | None = None,
+) -> dict[str, np.ndarray]:
+    """Sort columns by an int/uint key using bounded memory.
+
+    Device-sorts `chunk_rows`-sized runs, spills them, then streaming
+    2-way merges with `page_rows` pages until one run remains."""
+    n = len(key)
+    page_rows = page_rows or max(1024, chunk_rows // 8)
+    names = list(cols)
+
+    # phase 1: sorted runs (device argsort per chunk)
+    runs: list[str] = []
+    for s in range(0, n, chunk_rows):
+        e = min(s + chunk_rows, n)
+        order = np.asarray(_device_sort_chunk(jnp.asarray(key[s:e])))
+        seg = {"__key__": key[s:e][order]}
+        for c in names:
+            seg[c] = cols[c][s:e][order]
+        runs.append(tmp.write_segment(seg))
+    if not runs:
+        return {c: cols[c][:0] for c in names} | {"__key__": key[:0]}
+
+    # phase 2: streaming 2-way merges
+    def merge(pa: str, pb: str) -> str:
+        a = tmp.read_segment(pa)
+        b = tmp.read_segment(pb)
+        tmp.free_segment(pa)
+        tmp.free_segment(pb)
+        ka, kb = a["__key__"], b["__key__"]
+        na, nb = len(ka), len(kb)
+        ia = ib = 0
+        out_parts: list[dict[str, np.ndarray]] = []
+        while ia < na or ib < nb:
+            # take a page from the side with the smaller head, splitting at
+            # the other side's head key (vectorized run consumption)
+            if ib >= nb or (ia < na and ka[ia] <= kb[ib]):
+                cut = min(ia + page_rows, na)
+                if ib < nb:
+                    cut = min(cut, ia + int(np.searchsorted(
+                        ka[ia:cut], kb[ib], side="right")))
+                    cut = max(cut, ia + 1)
+                take = slice(ia, cut)
+                out_parts.append(
+                    {c: a[c][take] for c in names} | {"__key__": ka[take]}
+                )
+                ia = cut
+            else:
+                cut = min(ib + page_rows, nb)
+                if ia < na:
+                    cut = min(cut, ib + int(np.searchsorted(
+                        kb[ib:cut], ka[ia], side="right")))
+                    cut = max(cut, ib + 1)
+                take = slice(ib, cut)
+                out_parts.append(
+                    {c: b[c][take] for c in names} | {"__key__": kb[take]}
+                )
+                ib = cut
+        merged = {
+            k: np.concatenate([p[k] for p in out_parts])
+            for k in out_parts[0]
+        }
+        return tmp.write_segment(merged)
+
+    while len(runs) > 1:
+        nxt = []
+        for i in range(0, len(runs) - 1, 2):
+            nxt.append(merge(runs[i], runs[i + 1]))
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+
+    out = tmp.read_segment(runs[0])
+    tmp.free_segment(runs[0])
+    return out
+
+
+def _partition(
+    cols: dict[str, np.ndarray], key: np.ndarray, n_parts: int,
+    tmp: TmpFileManager,
+) -> list[list[str]]:
+    """Hash-partition rows into per-partition segment files."""
+    h = (key.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(33)
+    part = (h % np.uint64(n_parts)).astype(np.int64)
+    segs: list[list[str]] = [[] for _ in range(n_parts)]
+    for p in range(n_parts):
+        m = part == p
+        if m.any():
+            seg = {c: cols[c][m] for c in cols} | {"__key__": key[m]}
+            segs[p].append(tmp.write_segment(seg))
+    return segs
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _device_groupby_sum(key: jnp.ndarray, vals: jnp.ndarray, ts: int):
+    from .hashagg import assign_group_slots
+
+    sel = jnp.ones(key.shape[0], dtype=jnp.bool_)
+    row_slot, slot_used, slot_row = assign_group_slots([key], sel, ts)
+    sums = jnp.zeros(ts, dtype=jnp.int64).at[
+        jnp.where(sel, row_slot, ts)
+    ].add(vals.astype(jnp.int64), mode="drop")
+    cnts = jnp.zeros(ts, dtype=jnp.int64).at[
+        jnp.where(sel, row_slot, ts)
+    ].add(1, mode="drop")
+    rep = jnp.clip(slot_row, 0, key.shape[0] - 1)
+    keys_out = jnp.where(slot_used, key[rep], 0)
+    return keys_out, sums, cnts, slot_used
+
+
+def partitioned_groupby_sum(
+    key: np.ndarray, vals: np.ndarray, n_parts: int, tmp: TmpFileManager
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """SUM/COUNT group-by over arbitrary row counts: hash partitions spill
+    to tmp files, each partition aggregates on device. Returns (keys,
+    sums, counts)."""
+    segs = _partition({"v": vals}, key, n_parts, tmp)
+    ks, ss, cs = [], [], []
+    for plist in segs:
+        if not plist:
+            continue
+        seg = tmp.read_segment(plist[0])
+        tmp.free_segment(plist[0])
+        k, v = seg["__key__"], seg["v"]
+        ts = next_pow2(max(2 * len(np.unique(k)), 16))
+        ko, so, co, used = (np.asarray(x) for x in _device_groupby_sum(
+            jnp.asarray(k), jnp.asarray(v), ts))
+        ks.append(ko[used])
+        ss.append(so[used])
+        cs.append(co[used])
+    if not ks:
+        z = np.zeros(0, np.int64)
+        return z, z, z
+    return np.concatenate(ks), np.concatenate(ss), np.concatenate(cs)
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _device_join_sum(lk: jnp.ndarray, lv: jnp.ndarray, rk: jnp.ndarray,
+                     rv: jnp.ndarray, ts: int):
+    from .join import build_hash_table, hash_join_probe
+
+    rsel = jnp.ones(rk.shape[0], dtype=jnp.bool_)
+    lsel = jnp.ones(lk.shape[0], dtype=jnp.bool_)
+    slot_key, slot_row = build_hash_table([rk], rsel, ts)
+    match = hash_join_probe(slot_key, slot_row, [rk], [lk], lsel)
+    hit = match >= 0
+    idx = jnp.clip(match, 0, None)
+    prod = jnp.where(hit, lv.astype(jnp.int64) * rv[idx].astype(jnp.int64), 0)
+    return jnp.sum(prod), jnp.sum(hit, dtype=jnp.int64)
+
+
+def partitioned_join_sum(
+    lkey: np.ndarray, lval: np.ndarray,
+    rkey: np.ndarray, rval: np.ndarray,
+    n_parts: int, tmp: TmpFileManager,
+) -> tuple[int, int]:
+    """Unique-build hash join over arbitrary sizes: co-partition both
+    sides to tmp files, join each partition pair on device. Returns
+    (sum(lval*rval over matches), match count) — the aggregate form keeps
+    the demo self-checking; generalization follows the same partition
+    loop."""
+    lsegs = _partition({"v": lval}, lkey, n_parts, tmp)
+    rsegs = _partition({"v": rval}, rkey, n_parts, tmp)
+    total = np.int64(0)
+    matches = np.int64(0)
+    for p in range(n_parts):
+        if not lsegs[p] or not rsegs[p]:
+            for plist in (lsegs[p], rsegs[p]):
+                for path in plist:
+                    tmp.free_segment(path)
+            continue
+        ls = tmp.read_segment(lsegs[p][0])
+        rs = tmp.read_segment(rsegs[p][0])
+        tmp.free_segment(lsegs[p][0])
+        tmp.free_segment(rsegs[p][0])
+        ts = next_pow2(max(2 * len(rs["__key__"]), 16))
+        s, m = _device_join_sum(
+            jnp.asarray(ls["__key__"]), jnp.asarray(ls["v"]),
+            jnp.asarray(rs["__key__"]), jnp.asarray(rs["v"]), ts)
+        total += np.int64(s)
+        matches += np.int64(m)
+    return int(total), int(matches)

@@ -139,6 +139,23 @@ class Database:
             lambda _n, _o, v: self.audit.set_capacity(max(64, v // 4096)))
         self._session_ids = itertools.count(1)
 
+        # storage maintenance: block cache, dag scheduler, freeze loop
+        from ..share.cache import KVCache
+        from ..share.dag_scheduler import TenantDagScheduler
+        from ..storage.freezer import MaintenanceService
+
+        self.block_cache = KVCache(self.config["block_cache_size"])
+        self.config.on_change(
+            "block_cache_size",
+            lambda _n, _o, v: self.block_cache.set_capacity(v))
+        self.dag_scheduler = TenantDagScheduler()
+        self.maintenance = MaintenanceService(
+            self.dag_scheduler,
+            config=self.config,
+            tablets_fn=self._all_tablets,
+            snapshot_fn=lambda: self.cluster.gts.current(),
+        )
+
         self._unique_keys: dict[str, tuple[str, ...]] = {}
         self.engine = Session(
             self.catalog,
@@ -154,6 +171,21 @@ class Database:
     def tables(self):
         """Current-version schema view (name -> TableInfo)."""
         return self.schema_service.guard().tables
+
+    def _all_tablets(self):
+        """Every replica's tablets (each replica maintains its own LSM)."""
+        out = []
+        for group in self.cluster.ls_groups.values():
+            for rep in group.values():
+                out.extend(rep.tablets.values())
+        return out
+
+    def run_maintenance(self) -> dict:
+        """One deterministic freeze/compaction pass (tests and the
+        post-commit hook); live servers call maintenance.start()."""
+        out = self.maintenance.tick()
+        self.dag_scheduler.run_until_idle()
+        return out
 
     # ------------------------------------------------------------ schema
     def _key_extra(self, table_names: tuple[str, ...]) -> tuple:
@@ -214,9 +246,11 @@ class Database:
                 return ti
 
             try:
-                self.rootservice.create_table(factory)
+                ti = self.rootservice.create_table(factory)
             except SchemaError as e:
                 raise SqlError(str(e)) from None
+            for rep in self.cluster.ls_groups[ti.ls_id].values():
+                rep.tablets[ti.tablet_id].cache = self.block_cache
             self._unique_keys[stmt.name] = tuple(pk)
             self.catalog[stmt.name] = Table(stmt.name, schema, {
                 f.name: np.zeros(0, f.dtype.storage_np) for f in schema.fields
@@ -476,6 +510,10 @@ class DbSession:
                     if commit:
                         ti.data_version += 1
                     ti.cached_data_version = -1
+            if commit and touched:
+                # post-commit freeze/compaction check (the tenant freezer's
+                # write-path trigger; cheap when under the memstore limit)
+                self.db.run_maintenance()
 
     # --------------------------------------------------------------- DML
     def _stage_all(self, tx: _OpenTx, ti: TableInfo,

@@ -1,0 +1,69 @@
+"""KV cache: memory-budgeted LRU for decoded storage blocks.
+
+Reference surface: ObKVGlobalCache (share/cache) — a tenant-aware cache
+framework whose main users are the block cache (decoded micro blocks) and
+row cache; eviction is by memory watermark.
+
+The rebuild caches decoded column arrays keyed by (sstable uid, block,
+column). Byte-accounted LRU; hit/miss stats surface through virtual
+tables. One instance per Database (= tenant); storage readers take the
+cache as an optional collaborator so unit tests can run cacheless.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class KVCache:
+    def __init__(self, capacity_bytes: int = 256 << 20):
+        self.capacity_bytes = capacity_bytes
+        self._map: OrderedDict[tuple, np.ndarray] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: tuple):
+        with self._lock:
+            v = self._map.get(key)
+            if v is None:
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return v
+
+    def put(self, key: tuple, value: np.ndarray) -> None:
+        nbytes = int(value.nbytes)
+        if nbytes > self.capacity_bytes:
+            return  # larger than the whole budget: bypass
+        with self._lock:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._bytes -= int(old.nbytes)
+            self._map[key] = value
+            self._bytes += nbytes
+            while self._bytes > self.capacity_bytes and self._map:
+                _, ev = self._map.popitem(last=False)
+                self._bytes -= int(ev.nbytes)
+                self.evictions += 1
+
+    def set_capacity(self, capacity_bytes: int) -> None:
+        with self._lock:
+            self.capacity_bytes = capacity_bytes
+            while self._bytes > self.capacity_bytes and self._map:
+                _, ev = self._map.popitem(last=False)
+                self._bytes -= int(ev.nbytes)
+                self.evictions += 1
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._map)

@@ -138,6 +138,8 @@ def default_params() -> list[Param]:
         Param("syslog_level", "str", "INFO", "server log level",
               choices=("DEBUG", "TRACE", "INFO", "WARN", "ERROR")),
         # storage
+        Param("block_cache_size", "capacity", 256 << 20,
+              "budget for decoded micro-block column cache"),
         Param("default_compress_func", "str", "for",
               "preferred micro-block codec family",
               choices=("raw", "for", "rle", "auto")),

@@ -50,6 +50,14 @@ class Memtable:
     _lock: threading.RLock = field(default_factory=threading.RLock)
     _min_version: int = 2**63 - 1
     _max_version: int = 0
+    # incremental byte accounting (O(1) freezer checks): ~48B node overhead
+    # + 16B per cell, maintained on stage/replay/abort
+    _bytes: int = 0
+    _staged: int = 0  # undecided staged node count (O(1) has_uncommitted)
+
+    @property
+    def _node_cost(self) -> int:
+        return 48 + 16 * len(self.schema)
 
     # ---------------------------------------------------------- writes
     def stage(self, tx_id: int, read_snapshot: int, key: tuple, op: int,
@@ -72,16 +80,36 @@ class Memtable:
                 chain[0] = _Version(0, op, values or (), tx_id)
             else:
                 chain.insert(0, _Version(0, op, values or (), tx_id))
+                self._bytes += self._node_cost
+                self._staged += 1
+
+    @property
+    def has_uncommitted(self) -> bool:
+        """True while any staged (un-committed/un-aborted) row remains —
+        a frozen memtable must not dump to sstable until every tx that
+        wrote it decided (the reference blocks mini merge on active tx
+        ref counts)."""
+        return self._staged > 0
+
+    @property
+    def bytes_estimate(self) -> int:
+        """Approximate resident bytes (tenant-freezer accounting),
+        maintained incrementally so freezer checks are O(1)."""
+        return max(self._bytes, 0)
 
     def commit(self, tx_id: int, commit_version: int) -> None:
         """Publish all nodes staged by tx_id at commit_version."""
         with self._lock:
+            touched = False
             for chain in self._rows.values():
                 if chain and chain[0].tx_id == tx_id:
                     chain[0].version = commit_version
                     chain[0].tx_id = 0
-            self._min_version = min(self._min_version, commit_version)
-            self._max_version = max(self._max_version, commit_version)
+                    self._staged -= 1
+                    touched = True
+            if touched:
+                self._min_version = min(self._min_version, commit_version)
+                self._max_version = max(self._max_version, commit_version)
 
     def replay(self, key: tuple, op: int, values: tuple | None, version: int) -> None:
         """Follower replay: insert an already-committed node directly.
@@ -103,6 +131,7 @@ class Memtable:
                 chain[i] = node
             else:
                 chain.insert(i, node)
+                self._bytes += self._node_cost
             self._min_version = min(self._min_version, version)
             self._max_version = max(self._max_version, version)
 
@@ -112,6 +141,8 @@ class Memtable:
             for key, chain in self._rows.items():
                 if chain and chain[0].tx_id == tx_id:
                     chain.pop(0)
+                    self._bytes -= self._node_cost
+                    self._staged -= 1
                     if not chain:
                         dead.append(key)
             for key in dead:

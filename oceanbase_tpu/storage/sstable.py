@@ -19,6 +19,7 @@ numpy columns which the engine ships to the device once.
 
 from __future__ import annotations
 
+import itertools
 import os
 import struct
 from dataclasses import dataclass
@@ -184,9 +185,16 @@ def write_sstable(
 
 
 class SSTable:
-    """Reader over an sstable blob (mmap-able file or bytes)."""
+    """Reader over an sstable blob (mmap-able file or bytes).
 
-    def __init__(self, buf, schema: Schema, key_cols: list[str]):
+    `cache` (share/cache.KVCache) memoizes decoded block columns — the
+    block-cache analog: repeated snapshot scans skip codec work."""
+
+    _uids = itertools.count(1)
+
+    def __init__(self, buf, schema: Schema, key_cols: list[str], cache=None):
+        self.uid = next(SSTable._uids)
+        self.cache = cache
         self.buf = memoryview(buf)
         self.schema = schema
         self.key_cols = list(key_cols)
@@ -247,10 +255,22 @@ class SSTable:
         """Decode the requested columns of the given blocks, concatenated."""
         parts: dict[str, list[np.ndarray]] = {c: [] for c in columns}
         for b in block_ids:
-            start = int(self.offsets[b])
-            reader = BlockReader.open(self.buf[start : start + int(self.lens[b])])
+            reader = None
             for c in columns:
+                if self.cache is not None:
+                    ck = (self.uid, int(b), c)
+                    hit = self.cache.get(ck)
+                    if hit is not None:
+                        parts[c].append(hit)
+                        continue
+                if reader is None:
+                    start = int(self.offsets[b])
+                    reader = BlockReader.open(
+                        self.buf[start : start + int(self.lens[b])]
+                    )
                 vals, _ = reader.column(self._col_index[c])
+                if self.cache is not None:
+                    self.cache.put((self.uid, int(b), c), vals)
                 parts[c].append(vals)
         return {
             c: (np.concatenate(v) if v else np.zeros(0, dtype=self._col_dtype[c]))

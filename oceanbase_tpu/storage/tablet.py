@@ -48,6 +48,7 @@ class Tablet:
     frozen: list[Memtable] = field(default_factory=list)
     deltas: list[SSTable] = field(default_factory=list)  # oldest -> newest
     base: SSTable | None = None
+    cache: object = None  # share/cache.KVCache for decoded blocks
     _meta_lock: threading.RLock = field(default_factory=threading.RLock)
     # serializes whole maintenance operations (dump/minor/major) so two dag
     # workers cannot dump the same frozen memtable or compact the same
@@ -66,6 +67,21 @@ class Tablet:
             mt = self.active
         mt.stage(tx_id, read_snapshot, key, op, values)
         return mt
+
+    def commit_tx(self, tx_id: int, commit_version: int) -> None:
+        """Publish a tx's staged rows wherever they live — the ACTIVE
+        memtable or one FROZEN while the tx was open (a freeze must never
+        strand undecided rows)."""
+        with self._meta_lock:
+            mts = [self.active] + list(self.frozen)
+        for mt in mts:
+            mt.commit(tx_id, commit_version)
+
+    def abort_tx(self, tx_id: int) -> None:
+        with self._meta_lock:
+            mts = [self.active] + list(self.frozen)
+        for mt in mts:
+            mt.abort(tx_id)
 
     # ------------------------------------------------------------- read
     def scan(
@@ -143,7 +159,7 @@ class Tablet:
                     return None
                 mt = self.frozen[0]
             blob = freeze_to_mini(mt)
-            st = SSTable(blob, self.schema, self.key_cols)
+            st = SSTable(blob, self.schema, self.key_cols, cache=self.cache)
             with self._meta_lock:
                 self.deltas.append(st)
                 self.frozen.remove(mt)
@@ -156,7 +172,7 @@ class Tablet:
             if len(victims) < 2:
                 return None
             blob = minor_compact(self.schema, self.key_cols, victims, recycle_version)
-            st = SSTable(blob, self.schema, self.key_cols)
+            st = SSTable(blob, self.schema, self.key_cols, cache=self.cache)
             with self._meta_lock:
                 kept = [d for d in self.deltas if d not in victims]
                 self.deltas = [st] + kept
@@ -168,7 +184,7 @@ class Tablet:
             with self._meta_lock:
                 srcs = ([self.base] if self.base else []) + list(self.deltas)
             blob = major_compact(self.schema, self.key_cols, srcs, snapshot)
-            st = SSTable(blob, self.schema, self.key_cols)
+            st = SSTable(blob, self.schema, self.key_cols, cache=self.cache)
             with self._meta_lock:
                 self.deltas = [d for d in self.deltas if d not in srcs]
                 self.base = st

@@ -1,0 +1,75 @@
+"""Tmp-file manager: paged spill storage for larger-than-device operators.
+
+Reference surface: storage/tmp_file — the paged temp-file system backing
+SQL spill (sort runs, hash-join partitions, hash-agg partitions) with
+per-tenant accounting.
+
+The rebuild spills numpy column chunks to .npz segments under a spill
+directory, tracks bytes, and cleans up deterministically. The device-side
+consumers live in ops/spill.py.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+
+import numpy as np
+
+
+class TmpFileManager:
+    def __init__(self, root: str | None = None, limit_bytes: int = 8 << 30):
+        self._own_root = root is None
+        self.root = root or tempfile.mkdtemp(prefix="ob_tpu_spill_")
+        os.makedirs(self.root, exist_ok=True)
+        self.limit_bytes = limit_bytes
+        self._bytes = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def write_segment(self, cols: dict[str, np.ndarray]) -> str:
+        """Spill one segment (a dict of equal-length column arrays)."""
+        with self._lock:
+            self._seq += 1
+            path = os.path.join(self.root, f"seg_{self._seq:06d}.npz")
+        np.savez(path, **cols)
+        sz = os.path.getsize(path)
+        with self._lock:
+            self._bytes += sz
+            if self._bytes > self.limit_bytes:
+                self._bytes -= sz
+                os.unlink(path)
+                raise RuntimeError(
+                    f"spill limit exceeded: {self._bytes + sz} > {self.limit_bytes}"
+                )
+        return path
+
+    def read_segment(self, path: str) -> dict[str, np.ndarray]:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+    def free_segment(self, path: str) -> None:
+        try:
+            sz = os.path.getsize(path)
+            os.unlink(path)
+            with self._lock:
+                self._bytes -= sz
+        except FileNotFoundError:
+            pass
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        if self._own_root:
+            shutil.rmtree(self.root, ignore_errors=True)
+        self._bytes = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -75,7 +75,7 @@ class LSReplica:
 
     def abort_locally(self, tx_id: int) -> None:
         for t in self.tablets.values():
-            t.active.abort(tx_id)
+            t.abort_tx(tx_id)
         self._locally_staged.discard(tx_id)
         self.tx_table.pop(tx_id, None)
 
@@ -91,7 +91,7 @@ class LSReplica:
         if rec.rtype is RecordType.REDO_COMMIT:
             if staged:
                 for t in self.tablets.values():
-                    t.active.commit(rec.tx_id, rec.commit_version)
+                    t.commit_tx(rec.tx_id, rec.commit_version)
                 self._locally_staged.discard(rec.tx_id)
             else:
                 self._replay_mutations(rec.mutations, rec.commit_version)
@@ -110,7 +110,7 @@ class LSReplica:
         elif rec.rtype is RecordType.COMMIT:
             if staged:
                 for t in self.tablets.values():
-                    t.active.commit(rec.tx_id, rec.commit_version)
+                    t.commit_tx(rec.tx_id, rec.commit_version)
                 self._locally_staged.discard(rec.tx_id)
             else:
                 self._replay_mutations(
