@@ -1,0 +1,87 @@
+"""Log archive: continuous copy of committed palf entries to durable files.
+
+Reference surface: logservice/archiveservice — per-LS continuous archive of
+palf logs to object storage in segment files, with a persisted progress
+point so archiving resumes where it stopped; consumed by restore
+(logservice/restoreservice) and PITR.
+
+Segment format: fixed header per entry
+  <q lsn> <q term> <q scn> <I payload_len> <I crc32(payload)> payload
+Progress file holds the next LSN to archive. Segments rotate by entry
+count so restores can skip ahead cheaply.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+_ENTRY = struct.Struct("<qqqII")
+SEGMENT_ENTRIES = 4096
+
+
+class ArchiveWriter:
+    def __init__(self, root: str, ls_id: int):
+        self.dir = os.path.join(root, f"ls_{ls_id}")
+        os.makedirs(self.dir, exist_ok=True)
+        self._progress_path = os.path.join(self.dir, "progress")
+        self.next_lsn = 0
+        if os.path.exists(self._progress_path):
+            with open(self._progress_path) as f:
+                self.next_lsn = int(f.read().strip() or 0)
+
+    def _segment_path(self, lsn: int) -> str:
+        return os.path.join(self.dir, f"seg_{lsn // SEGMENT_ENTRIES:08d}.alog")
+
+    def archive_from(self, palf) -> int:
+        """Archive newly COMMITTED entries from a palf replica; returns the
+        number archived. Only the committed prefix is durable truth —
+        uncommitted tail entries may be rewritten by a new leader."""
+        hi = palf.commit_lsn
+        n = 0
+        while self.next_lsn <= hi:
+            e = palf.log[self.next_lsn]
+            rec = _ENTRY.pack(
+                e.lsn, e.term, e.scn, len(e.payload),
+                zlib.crc32(e.payload) & 0xFFFFFFFF,
+            ) + e.payload
+            with open(self._segment_path(e.lsn), "ab") as f:
+                f.write(rec)
+            self.next_lsn += 1
+            n += 1
+        if n:
+            tmp = self._progress_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self.next_lsn))
+            os.replace(tmp, self._progress_path)
+        return n
+
+
+class ArchiveReader:
+    def __init__(self, root: str, ls_id: int):
+        self.dir = os.path.join(root, f"ls_{ls_id}")
+
+    def entries(self, from_lsn: int = 0, to_scn: int | None = None):
+        """Yield (lsn, term, scn, payload) in LSN order."""
+        if not os.path.isdir(self.dir):
+            return
+        segs = sorted(
+            f for f in os.listdir(self.dir) if f.endswith(".alog")
+        )
+        for seg in segs:
+            with open(os.path.join(self.dir, seg), "rb") as f:
+                buf = f.read()
+            pos = 0
+            while pos + _ENTRY.size <= len(buf):
+                lsn, term, scn, plen, crc = _ENTRY.unpack_from(buf, pos)
+                pos += _ENTRY.size
+                payload = buf[pos : pos + plen]
+                pos += plen
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    raise IOError(f"archive corruption at lsn {lsn} in {seg}")
+                if lsn < from_lsn:
+                    continue
+                if to_scn is not None and scn > to_scn:
+                    return
+                yield lsn, term, scn, payload

@@ -1,0 +1,102 @@
+"""CDC: change-data-capture over the replicated log.
+
+Reference surface: logservice/libobcdc — the CDC client fetches palf logs,
+reassembles transactions from redo/prepare/commit records, and emits
+ordered row messages to downstream consumers (binlog-style).
+
+The rebuild's CdcClient tails either a live palf replica or an
+ArchiveReader, parses TxRecords, and assembles:
+
+  REDO_COMMIT           -> one-phase tx: emit immediately
+  PREPARE               -> stash this participant's redo
+  COMMIT                -> emit stashed redo with the final commit version
+  ABORT                 -> drop stashed redo (aborted txs never surface)
+
+Events carry (tx_id, commit_version, row ops). Within one LS the emission
+order is the log (= apply) order; cross-LS consumers merge by
+commit_version like the reference's sequencer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..tx.records import RecordType, TxRecord
+
+
+@dataclass(frozen=True)
+class RowChange:
+    tablet_id: int
+    op: str  # "put" | "delete"
+    key: tuple
+    values: tuple | None
+
+
+@dataclass(frozen=True)
+class TxChange:
+    tx_id: int
+    commit_version: int
+    ls_id: int
+    rows: tuple[RowChange, ...]
+    # (tablet_id, column, code, string): dictionary growth logged with the
+    # tx, letting consumers decode VARCHAR codes without leader state
+    dict_appends: tuple = ()
+
+
+@dataclass
+class CdcClient:
+    """Tail one LS's log and emit committed transaction changes."""
+
+    ls_id: int
+    next_lsn: int = 0
+    _pending: dict[int, tuple] = field(default_factory=dict)  # tx -> (redo, dicts)
+
+    def _events_from(self, records) -> list[TxChange]:
+        out: list[TxChange] = []
+        for rec in records:
+            if rec.rtype is RecordType.REDO_COMMIT:
+                out.append(self._tx_change(rec.tx_id, rec.commit_version,
+                                           rec.mutations, rec.dict_appends))
+            elif rec.rtype is RecordType.PREPARE:
+                self._pending[rec.tx_id] = (rec.mutations, rec.dict_appends)
+            elif rec.rtype is RecordType.COMMIT:
+                muts, da = self._pending.pop(rec.tx_id, ((), ()))
+                out.append(self._tx_change(rec.tx_id, rec.commit_version,
+                                           muts, da))
+            elif rec.rtype is RecordType.ABORT:
+                self._pending.pop(rec.tx_id, None)
+        return out
+
+    def _tx_change(self, tx_id, version, mutations, dict_appends) -> TxChange:
+        rows = tuple(
+            RowChange(m.tablet_id, "put" if m.op == 0 else "delete",
+                      m.key, m.values)
+            for m in mutations
+        )
+        return TxChange(tx_id, version, self.ls_id, rows,
+                        tuple(dict_appends))
+
+    def poll_palf(self, palf) -> list[TxChange]:
+        """Consume newly committed entries from a live replica."""
+        recs = []
+        while self.next_lsn <= palf.commit_lsn:
+            payload = palf.log[self.next_lsn].payload
+            self.next_lsn += 1
+            if payload:
+                recs.append(TxRecord.from_bytes(payload))
+        return self._events_from(recs)
+
+    def poll_archive(self, reader, to_scn: int | None = None) -> list[TxChange]:
+        """Consume archived entries (restore/offline pipelines)."""
+        recs = []
+        for lsn, _term, _scn, payload in reader.entries(self.next_lsn, to_scn):
+            self.next_lsn = lsn + 1
+            if payload:
+                recs.append(TxRecord.from_bytes(payload))
+        return self._events_from(recs)
+
+
+def merge_streams(changes: list[TxChange]) -> list[TxChange]:
+    """Order changes from multiple LS streams by commit version (the
+    cross-LS sequencer analog; ties break by tx id for determinism)."""
+    return sorted(changes, key=lambda c: (c.commit_version, c.tx_id))

@@ -64,6 +64,47 @@ def _device_sort_chunk(key: jnp.ndarray):
     return jnp.argsort(key)
 
 
+class _RunCursor:
+    """Streams one sorted run (a list of page segment files) page by page;
+    holds at most one page in memory."""
+
+    def __init__(self, pages: list[str], tmp: TmpFileManager):
+        self.pages = pages
+        self.tmp = tmp
+        self.cur: dict[str, np.ndarray] | None = None
+        self.pos = 0
+        self._advance()
+
+    def _advance(self):
+        while self.pages and (
+            self.cur is None or self.pos >= len(self.cur["__key__"])
+        ):
+            path = self.pages.pop(0)
+            self.cur = self.tmp.read_segment(path)
+            self.tmp.free_segment(path)
+            self.pos = 0
+        if self.cur is not None and self.pos >= len(self.cur["__key__"]):
+            self.cur = None
+
+    @property
+    def head(self):
+        return None if self.cur is None else self.cur["__key__"][self.pos]
+
+    def take_until(self, limit_key, max_rows: int) -> dict[str, np.ndarray]:
+        """Consume up to max_rows rows with key <= limit_key (or all
+        remaining in the current page if limit_key is None)."""
+        k = self.cur["__key__"]
+        end = min(self.pos + max_rows, len(k))
+        if limit_key is not None:
+            end = min(end, self.pos + int(np.searchsorted(
+                k[self.pos:end], limit_key, side="right")))
+            end = max(end, self.pos + 1)
+        out = {c: v[self.pos:end] for c, v in self.cur.items()}
+        self.pos = end
+        self._advance()
+        return out
+
+
 def external_sort(
     cols: dict[str, np.ndarray],
     key: np.ndarray,
@@ -71,66 +112,60 @@ def external_sort(
     tmp: TmpFileManager,
     page_rows: int | None = None,
 ) -> dict[str, np.ndarray]:
-    """Sort columns by an int/uint key using bounded memory.
+    """Sort columns by an int/uint key using bounded working memory.
 
-    Device-sorts `chunk_rows`-sized runs, spills them, then streaming
-    2-way merges with `page_rows` pages until one run remains."""
+    Device-sorts `chunk_rows`-sized runs spilled as page files, then
+    streaming 2-way merges that hold O(page_rows) rows per input run and
+    flush output pages as they fill — classic external merge sort. (The
+    returned dict materializes the final order; callers sorting beyond
+    host memory consume the final run's pages instead.)"""
     n = len(key)
     page_rows = page_rows or max(1024, chunk_rows // 8)
     names = list(cols)
 
-    # phase 1: sorted runs (device argsort per chunk)
-    runs: list[str] = []
+    # phase 1: sorted runs (device argsort per chunk), paged on disk
+    runs: list[list[str]] = []
     for s in range(0, n, chunk_rows):
         e = min(s + chunk_rows, n)
         order = np.asarray(_device_sort_chunk(jnp.asarray(key[s:e])))
-        seg = {"__key__": key[s:e][order]}
-        for c in names:
-            seg[c] = cols[c][s:e][order]
-        runs.append(tmp.write_segment(seg))
+        pages = []
+        for ps in range(0, e - s, page_rows):
+            pe = min(ps + page_rows, e - s)
+            pidx = order[ps:pe]
+            seg = {"__key__": key[s:e][pidx]}
+            for c in names:
+                seg[c] = cols[c][s:e][pidx]
+            pages.append(tmp.write_segment(seg))
+        runs.append(pages)
     if not runs:
         return {c: cols[c][:0] for c in names} | {"__key__": key[:0]}
 
-    # phase 2: streaming 2-way merges
-    def merge(pa: str, pb: str) -> str:
-        a = tmp.read_segment(pa)
-        b = tmp.read_segment(pb)
-        tmp.free_segment(pa)
-        tmp.free_segment(pb)
-        ka, kb = a["__key__"], b["__key__"]
-        na, nb = len(ka), len(kb)
-        ia = ib = 0
-        out_parts: list[dict[str, np.ndarray]] = []
-        while ia < na or ib < nb:
-            # take a page from the side with the smaller head, splitting at
-            # the other side's head key (vectorized run consumption)
-            if ib >= nb or (ia < na and ka[ia] <= kb[ib]):
-                cut = min(ia + page_rows, na)
-                if ib < nb:
-                    cut = min(cut, ia + int(np.searchsorted(
-                        ka[ia:cut], kb[ib], side="right")))
-                    cut = max(cut, ia + 1)
-                take = slice(ia, cut)
-                out_parts.append(
-                    {c: a[c][take] for c in names} | {"__key__": ka[take]}
-                )
-                ia = cut
+    def merge(pa: list[str], pb: list[str]) -> list[str]:
+        a, b = _RunCursor(pa, tmp), _RunCursor(pb, tmp)
+        out_pages: list[str] = []
+        buf: list[dict[str, np.ndarray]] = []
+        buffered = 0
+
+        def flush():
+            nonlocal buf, buffered
+            if buf:
+                merged = {
+                    k: np.concatenate([p[k] for p in buf]) for k in buf[0]
+                }
+                out_pages.append(tmp.write_segment(merged))
+                buf, buffered = [], 0
+
+        while a.head is not None or b.head is not None:
+            if b.head is None or (a.head is not None and a.head <= b.head):
+                part = a.take_until(b.head, page_rows)
             else:
-                cut = min(ib + page_rows, nb)
-                if ia < na:
-                    cut = min(cut, ib + int(np.searchsorted(
-                        kb[ib:cut], ka[ia], side="right")))
-                    cut = max(cut, ib + 1)
-                take = slice(ib, cut)
-                out_parts.append(
-                    {c: b[c][take] for c in names} | {"__key__": kb[take]}
-                )
-                ib = cut
-        merged = {
-            k: np.concatenate([p[k] for p in out_parts])
-            for k in out_parts[0]
-        }
-        return tmp.write_segment(merged)
+                part = b.take_until(a.head, page_rows)
+            buf.append(part)
+            buffered += len(part["__key__"])
+            if buffered >= page_rows:
+                flush()
+        flush()
+        return out_pages
 
     while len(runs) > 1:
         nxt = []
@@ -140,9 +175,11 @@ def external_sort(
             nxt.append(runs[-1])
         runs = nxt
 
-    out = tmp.read_segment(runs[0])
-    tmp.free_segment(runs[0])
-    return out
+    parts = []
+    for path in runs[0]:
+        parts.append(tmp.read_segment(path))
+        tmp.free_segment(path)
+    return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
 
 
 def _partition(

@@ -69,6 +69,12 @@ class TableInfo:
         default_factory=dict
     )
 
+    # per-column dictionary length already carried by COMMITTED records:
+    # codes beyond this must ride the next commit's dict_appends (codes
+    # created by aborted txs stay unlogged and are re-logged by the next
+    # committer that references them)
+    logged_dict_len: dict[str, int] = field(default_factory=dict)
+
     @property
     def dict_sig(self) -> tuple:
         """Dictionary-state signature. Append-order dictionaries only grow,
@@ -504,18 +510,44 @@ class DbSession:
             else:
                 tx.svc.abort(tx.ctx)
         finally:
+            by_tablet = {}
             for name in touched:
                 ti = self.db.tables.get(name)
                 if ti is not None:
+                    by_tablet[ti.tablet_id] = ti
                     if commit:
                         ti.data_version += 1
                     ti.cached_data_version = -1
+            if commit:
+                # the appends are durable now: later commits need not
+                # re-log them
+                for tab_id, col, code, _s in tx.ctx.dict_appends:
+                    ti = by_tablet.get(tab_id)
+                    if ti is not None:
+                        ti.logged_dict_len[col] = max(
+                            ti.logged_dict_len.get(col, 0), code + 1
+                        )
             if commit and touched:
                 # post-commit freeze/compaction check (the tenant freezer's
                 # write-path trigger; cheap when under the memstore limit)
                 self.db.run_maintenance()
 
     # --------------------------------------------------------------- DML
+    @staticmethod
+    def _note_dict_appends(tx: _OpenTx, ti: TableInfo) -> None:
+        """Attach every not-yet-durably-logged dictionary entry to this tx
+        (log self-description for CDC/PITR). Based on logged_dict_len, not
+        statement-local growth: entries created by an earlier aborted tx or
+        a concurrent open tx get (re-)logged by the next committer, so the
+        committed log always covers every code it references."""
+        for col, d in ti.dicts.items():
+            n0 = ti.logged_dict_len.get(col, 0)
+            if len(d) > n0:
+                tx.ctx.dict_appends.extend(
+                    (ti.tablet_id, col, code, d.decode_one(code))
+                    for code in range(n0, len(d))
+                )
+
     def _stage_all(self, tx: _OpenTx, ti: TableInfo,
                    muts: list[tuple[tuple, int, tuple | None]]) -> int:
         """Stage a fully-validated mutation batch (statement atomicity: no
@@ -568,6 +600,7 @@ class DbSession:
                 raise SqlError(f"duplicate primary key {key} in {st.table}")
             seen.add(key)
             muts.append((key, OP_PUT, vals))
+        self._note_dict_appends(tx, ti)
         return self._stage_all(tx, ti, muts)
 
     def _qualify(self, st, ti: TableInfo, cols: list[str],
@@ -620,6 +653,7 @@ class DbSession:
             vals = tuple(vals)
             key = tuple(int(vals[ti.schema.index(k)]) for k in ti.key_cols)
             muts.append((key, OP_PUT, vals))
+        self._note_dict_appends(tx, ti)
         return self._stage_all(tx, ti, muts)
 
     def _delete(self, st: A.Delete, tx: _OpenTx) -> int:

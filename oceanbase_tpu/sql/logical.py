@@ -361,6 +361,8 @@ def _interval_shift(days: int, iv: A.IntervalLit, op: str) -> int:
 
 def _parse_type(tn: str) -> DataType:
     tn = tn.lower()
+    if tn.endswith("?"):  # DataType.__str__ nullable marker round-trip
+        return _parse_type(tn[:-1]).with_nullable(True)
     if tn.startswith("decimal") or tn.startswith("numeric"):
         if "(" in tn:
             inner = tn[tn.index("(") + 1 : tn.index(")")]
@@ -370,14 +372,25 @@ def _parse_type(tn: str) -> DataType:
     if "(" in tn:
         tn = tn[: tn.index("(")]  # varchar(25), char(1), int(11): length
         # modifiers don't change the physical type
-    if tn in ("int", "integer", "smallint", "tinyint", "mediumint"):
+    # accepts both SQL spellings and DataType.__str__ round-trip forms
+    if tn in ("int", "integer", "smallint", "tinyint", "mediumint", "int32"):
         return DataType.int32()
-    if tn == "bigint":
+    if tn in ("bigint", "int64"):
         return DataType.int64()
-    if tn in ("float", "double", "real"):
+    if tn == "int8":
+        return DataType.int8()
+    if tn == "int16":
+        return DataType.int16()
+    if tn in ("float", "double", "real", "float64"):
         return DataType.float64()
+    if tn == "float32":
+        return DataType.float32()
+    if tn == "bool":
+        return DataType.bool_()
     if tn == "date":
         return DataType.date()
+    if tn == "timestamp":
+        return DataType.timestamp()
     if tn in ("varchar", "char", "text"):
         return DataType.varchar()
     raise ResolveError(f"unknown type {tn}")

@@ -35,3 +35,9 @@ class GtsService:
         """A read snapshot: >= every previously issued ts, without burning
         the sequence forward more than necessary."""
         return self.next_ts()
+
+    def advance_to(self, ts: int) -> None:
+        """Fast-forward past restored/replayed history so new timestamps
+        never collide below it (restore-time invariant)."""
+        with self._lock:
+            self._last = max(self._last, ts)

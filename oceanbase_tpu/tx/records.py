@@ -41,16 +41,22 @@ class TxRecord:
     commit_version: int = 0
     coordinator_ls: int = 0
     participants: tuple[int, ...] = ()
+    # dictionary growth caused by this tx: (tablet_id, column, code,
+    # string). VARCHAR cells in mutations store dictionary CODES; logging
+    # the appends makes the log self-describing for CDC and PITR restore
+    # (the multi-data-source analog: non-row state atomically logged with
+    # the tx, storage/multi_data_source).
+    dict_appends: tuple = ()
 
     def to_bytes(self) -> bytes:
         return bytes([self.rtype]) + pickle.dumps(
             (self.tx_id, self.mutations, self.commit_version,
-             self.coordinator_ls, self.participants),
+             self.coordinator_ls, self.participants, self.dict_appends),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
 
     @staticmethod
     def from_bytes(b: bytes) -> "TxRecord":
         rtype = RecordType(b[0])
-        tx_id, mutations, cv, coord, parts = pickle.loads(b[1:])
-        return TxRecord(rtype, tx_id, mutations, cv, coord, parts)
+        fields = pickle.loads(b[1:])
+        return TxRecord(rtype, *fields)

@@ -55,6 +55,8 @@ class TxContext:
     read_snapshot: int
     state: TxState = TxState.ACTIVE
     mutations: dict[int, list[Mutation]] = field(default_factory=dict)  # ls_id ->
+    # dictionary appends to log with the commit (see TxRecord.dict_appends)
+    dict_appends: list = field(default_factory=list)
     commit_version: int = 0
     _prepared: set[int] = field(default_factory=set)
     _committed_ls: set[int] = field(default_factory=set)
@@ -148,7 +150,8 @@ class TransService:
         if len(parts) == 1:
             ls = parts[0]
             rec = TxRecord(RecordType.REDO_COMMIT, ctx.tx_id,
-                           tuple(ctx.mutations[ls]), self.gts.next_ts())
+                           tuple(ctx.mutations[ls]), self.gts.next_ts(),
+                           dict_appends=tuple(ctx.dict_appends))
             # state moves BEFORE submit: apply can fire synchronously inside
             # submit_record (single-replica groups commit immediately) and
             # must find the ctx in COMMITTING to finish it
@@ -165,7 +168,8 @@ class TransService:
         logged: list[int] = []
         for ls in parts:
             rec = TxRecord(RecordType.PREPARE, ctx.tx_id,
-                           tuple(ctx.mutations[ls]), 0, coord, tuple(parts))
+                           tuple(ctx.mutations[ls]), 0, coord, tuple(parts),
+                           dict_appends=tuple(ctx.dict_appends))
             if self.replicas[ls].submit_record(rec) is None:
                 # some participants have a PREPARE in their log: log ABORT
                 # there so replicas clean pending redo + tx tables

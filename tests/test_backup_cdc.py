@@ -1,0 +1,181 @@
+"""Log archive, CDC, physical backup, restore, and PITR.
+
+Reference: logservice/archiveservice, libobcdc, storage/backup,
+storage/restore + restoreservice.
+"""
+
+import os
+
+import pytest
+
+from oceanbase_tpu.log.archive import ArchiveReader, ArchiveWriter
+from oceanbase_tpu.log.cdc import CdcClient, merge_streams
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.storage.backup import (
+    archive_database,
+    backup_database,
+    restore_database,
+)
+
+
+@pytest.fixture()
+def db():
+    d = Database(n_nodes=3, n_ls=2)
+    s = d.session()
+    s.sql("""
+        create table acc (
+            id bigint primary key,
+            bal decimal(10,2) not null,
+            who varchar(16) not null
+        )
+    """)
+    s.sql("insert into acc values (1, 10.00, 'ann'), (2, 20.00, 'bob')")
+    s.sql("update acc set bal = bal + 5 where id = 1")
+    return d
+
+
+def _leader_palf(db, ls_id):
+    node = db.cluster.leader_node(ls_id)
+    return db.cluster.ls_groups[ls_id][node].palf
+
+
+def test_archive_roundtrip_and_resume(db, tmp_path):
+    root = str(tmp_path / "arch")
+    ti = db.tables["acc"]
+    palf = _leader_palf(db, ti.ls_id)
+    w = ArchiveWriter(root, ti.ls_id)
+    n1 = w.archive_from(palf)
+    assert n1 > 0
+    # nothing new -> no-op
+    assert w.archive_from(palf) == 0
+    # more commits -> incremental archive, and a NEW writer resumes from
+    # the persisted progress point
+    db.session().sql("insert into acc values (3, 30.00, 'cyd')")
+    w2 = ArchiveWriter(root, ti.ls_id)
+    assert w2.next_lsn == n1
+    assert w2.archive_from(palf) > 0
+    entries = list(ArchiveReader(root, ti.ls_id).entries())
+    assert [e[0] for e in entries] == list(range(len(entries)))  # dense LSNs
+    assert len(entries) == w2.next_lsn
+
+
+def test_cdc_emits_committed_changes_only(db):
+    ti = db.tables["acc"]
+    cdc = CdcClient(ti.ls_id)
+    changes = cdc.poll_palf(_leader_palf(db, ti.ls_id))
+    puts = [r for c in changes for r in c.rows if r.tablet_id == ti.tablet_id]
+    # 2 inserts + 1 update = 3 put row-changes so far
+    assert len([r for r in puts if r.op == "put"]) == 3
+    # a rolled-back tx must not surface
+    s = db.session()
+    s.sql("begin")
+    s.sql("insert into acc values (9, 9.00, 'ghost')")
+    s.sql("rollback")
+    s.sql("delete from acc where id = 2")
+    more = cdc.poll_palf(_leader_palf(db, ti.ls_id))
+    rows = [r for c in more for r in c.rows if r.tablet_id == ti.tablet_id]
+    assert all(r.key != (9,) for r in rows)
+    assert any(r.op == "delete" and r.key == (2,) for r in rows)
+    # versions are monotone in emission order within the stream
+    vs = [c.commit_version for c in changes + more]
+    assert vs == sorted(vs)
+
+
+def test_cdc_2pc_assembly(db):
+    """A multi-LS tx surfaces on each LS only at COMMIT with the final
+    version; merged streams order by commit version."""
+    s = db.session()
+    s.sql("create table side (k bigint primary key, v bigint not null)")
+    side = db.tables["side"]
+    acc = db.tables["acc"]
+    assert side.ls_id != acc.ls_id  # placed on the other LS
+    c1, c2 = CdcClient(acc.ls_id), CdcClient(side.ls_id)
+    c1.poll_palf(_leader_palf(db, acc.ls_id))  # drain history
+    c2.poll_palf(_leader_palf(db, side.ls_id))
+    s.sql("begin")
+    s.sql("insert into acc values (50, 5.00, 'tx2pc')")
+    s.sql("insert into side values (50, 500)")
+    s.sql("commit")
+    a = c1.poll_palf(_leader_palf(db, acc.ls_id))
+    b = c2.poll_palf(_leader_palf(db, side.ls_id))
+    assert len(a) == 1 and len(b) == 1
+    assert a[0].commit_version == b[0].commit_version  # one atomic point
+    assert a[0].tx_id == b[0].tx_id
+    merged = merge_streams(a + b)
+    assert {r.key for c in merged for r in c.rows} == {(50,)}
+
+
+def test_backup_restore_roundtrip(db, tmp_path):
+    root = str(tmp_path / "bak")
+    scn = backup_database(db, root)
+    assert scn > 0 and os.path.exists(os.path.join(root, "meta.json"))
+    db2 = restore_database(root, n_nodes=3, n_ls=2)
+    s2 = db2.session()
+    rs = s2.sql("select id, bal, who from acc order by id")
+    assert rs.rows() == [(1, 15.00, "ann"), (2, 20.00, "bob")]
+    # restored database accepts new writes with non-colliding timestamps
+    s2.sql("insert into acc values (7, 70.00, 'new')")
+    assert s2.sql("select count(*) as c from acc").rows() == [(3,)]
+
+
+def test_restore_nullable_column_types(db, tmp_path):
+    s = db.session()
+    s.sql("create table nl (k bigint primary key, v bigint)")  # nullable v
+    s.sql("insert into nl values (1, 5)")
+    root = str(tmp_path / "bak_nl")
+    backup_database(db, root)
+    db2 = restore_database(root, 3, 2)
+    assert db2.session().sql("select v from nl where k = 1").rows() == [(5,)]
+    db.session().sql("drop table nl")
+
+
+def test_pitr_dict_appends_out_of_order_and_aborted_tx(db, tmp_path):
+    """Two adversarial dictionary scenarios the log must survive:
+    (a) a tx that appended a LOWER code commits AFTER one that appended a
+        higher code (commit order != code order);
+    (b) an aborted tx created a code that a later committed tx reuses."""
+    bak = str(tmp_path / "bak2")
+    arch = str(tmp_path / "arch2")
+    backup_database(db, bak)
+    s1, s2 = db.session(), db.session()
+    # (b) aborted tx creates 'ghost' in the append dictionary
+    s1.sql("begin")
+    s1.sql("insert into acc values (60, 1.00, 'ghost')")
+    s1.sql("rollback")
+    # (a) s1 opens and encodes 'alpha' (lower code), s2 commits 'beta'
+    # (higher code) FIRST, then s1 commits
+    s1.sql("begin")
+    s1.sql("insert into acc values (61, 1.00, 'alpha')")
+    s2.sql("insert into acc values (62, 2.00, 'beta')")  # autocommit, first
+    s1.sql("commit")
+    # committed reuse of the aborted tx's string
+    s2.sql("insert into acc values (63, 3.00, 'ghost')")
+    archive_database(db, arch)
+    restored = restore_database(bak, 3, 2, archive_root=arch)
+    rs = restored.session().sql(
+        "select id, who from acc where id >= 61 order by id")
+    assert rs.rows() == [(61, "alpha"), (62, "beta"), (63, "ghost")]
+
+
+def test_pitr_backup_plus_archive(db, tmp_path):
+    bak = str(tmp_path / "bak")
+    arch = str(tmp_path / "arch")
+    backup_scn = backup_database(db, bak)
+    s = db.session()
+    s.sql("insert into acc values (4, 40.00, 'dee')")  # after backup
+    mid_scn = db.cluster.gts.current()
+    s.sql("update acc set bal = 0 where id = 1")  # the "mistake" to undo
+    s.sql("delete from acc where id = 2")
+    archive_database(db, arch)
+
+    # full roll-forward: everything replays
+    full = restore_database(bak, 3, 2, archive_root=arch)
+    rs = full.session().sql("select id, bal from acc order by id")
+    assert rs.rows() == [(1, 0.00), (4, 40.00)]
+
+    # point-in-time: stop before the mistake
+    pitr = restore_database(bak, 3, 2, archive_root=arch, restore_scn=mid_scn)
+    rs = pitr.session().sql("select id, bal, who from acc order by id")
+    assert rs.rows() == [(1, 15.00, "ann"), (2, 20.00, "bob"),
+                         (4, 40.00, "dee")]
+    assert backup_scn < mid_scn
