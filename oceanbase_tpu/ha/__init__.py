@@ -1,0 +1,16 @@
+"""High availability & failure handling.
+
+coordinator.py  FailureDetector + LeaderCoordinator (demote sick leaders)
+detect.py       NetKeepAlive (peer-death detection) + DetectManager
+                (GC of orphaned distributed state)
+"""
+
+from .coordinator import FailureDetector, LeaderCoordinator
+from .detect import DetectManager, NetKeepAlive
+
+__all__ = [
+    "FailureDetector",
+    "LeaderCoordinator",
+    "NetKeepAlive",
+    "DetectManager",
+]

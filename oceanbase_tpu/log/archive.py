@@ -30,6 +30,27 @@ class ArchiveWriter:
         if os.path.exists(self._progress_path):
             with open(self._progress_path) as f:
                 self.next_lsn = int(f.read().strip() or 0)
+        self._recover()
+
+    def _recover(self) -> None:
+        """Crash recovery: entries may have been appended after the last
+        progress write; scan the TAIL segment (bounded work) and resume
+        past whatever is actually on disk, so resume never duplicates."""
+        segs = sorted(
+            f for f in os.listdir(self.dir) if f.endswith(".alog")
+        )
+        if not segs:
+            return
+        last = os.path.join(self.dir, segs[-1])
+        with open(last, "rb") as f:
+            buf = f.read()
+        pos, max_lsn = 0, -1
+        while pos + _ENTRY.size <= len(buf):
+            lsn, _t, _s, plen, _c = _ENTRY.unpack_from(buf, pos)
+            pos += _ENTRY.size + plen
+            if pos <= len(buf):
+                max_lsn = max(max_lsn, lsn)
+        self.next_lsn = max(self.next_lsn, max_lsn + 1)
 
     def _segment_path(self, lsn: int) -> str:
         return os.path.join(self.dir, f"seg_{lsn // SEGMENT_ENTRIES:08d}.alog")
@@ -69,6 +90,12 @@ class ArchiveReader:
         segs = sorted(
             f for f in os.listdir(self.dir) if f.endswith(".alog")
         )
+        # whole-segment skip: seg_<i> holds LSNs [i*SEGMENT_ENTRIES, ...)
+        first_seg = from_lsn // SEGMENT_ENTRIES
+        segs = [
+            s for s in segs
+            if int(s[len("seg_"):-len(".alog")]) >= first_seg
+        ]
         for seg in segs:
             with open(os.path.join(self.dir, seg), "rb") as f:
                 buf = f.read()

@@ -162,6 +162,10 @@ class Database:
             snapshot_fn=lambda: self.cluster.gts.current(),
         )
 
+        from ..tx.tablelock import LockManager
+
+        self.lock_mgr = LockManager()
+
         self._unique_keys: dict[str, tuple[str, ...]] = {}
         self.engine = Session(
             self.catalog,
@@ -334,16 +338,31 @@ class _OpenTx:
 
     def __init__(self, db: Database):
         self.db = db
-        self.svc = db.cluster.services[0]
+        # home the tx where leadership currently lives (location cache):
+        # after a failover/demotion new txs follow the leaders instead of
+        # dragging leadership back to a fixed node
+        try:
+            home = db.location.leader(min(db.cluster.ls_groups))
+        except Exception:
+            home = 0
+        self.svc = db.cluster.services[home]
         self.ctx = self.svc.begin()
         self.touched_tables: set[str] = set()
 
     def ensure_leader(self, ls_id: int) -> None:
         """Co-locate the LS leader with this tx's coordinating node (the
         analog of routing the statement to a server leading the
-        participants)."""
-        if not self.svc.replicas[ls_id].is_ready:
-            self.db.cluster.transfer_leader(ls_id, self.svc.node_id)
+        participants), and wait until it is READY (replay caught up) —
+        role transfer alone is not enough to serve writes."""
+        from ..tx.txn import NotMaster
+
+        rep = self.svc.replicas[ls_id]
+        if rep.is_ready:
+            return
+        self.db.cluster.transfer_leader(ls_id, self.svc.node_id)
+        if not self.db.cluster.drive_until(lambda: rep.is_ready):
+            raise NotMaster(f"ls {ls_id} leadership did not settle")
+        self.db.location.invalidate(ls_id)
 
 
 class DbSession:
@@ -422,6 +441,8 @@ class DbSession:
             return ResultSet((), {})
         if isinstance(stmt, A.Show):
             return self._show(stmt)
+        if isinstance(stmt, A.LockTable):
+            return self._lock_table(stmt)
         if isinstance(stmt, A.Insert):
             return self._dml(lambda tx: self._insert(stmt, tx))
         if isinstance(stmt, A.Update):
@@ -429,6 +450,24 @@ class DbSession:
         if isinstance(stmt, A.Delete):
             return self._dml(lambda tx: self._delete(stmt, tx))
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # -------------------------------------------------------------- lock
+    def _lock_table(self, st: A.LockTable) -> ResultSet:
+        from ..tx.tablelock import DeadlockDetected, LockMode
+
+        ti = self.db.tables.get(st.name)
+        if ti is None:
+            raise SqlError(f"no such table {st.name}")
+        if self._tx is None:
+            raise SqlError("LOCK TABLE requires an open transaction")
+        mode = LockMode.EXCLUSIVE if st.exclusive else LockMode.SHARE
+        try:
+            self.db.lock_mgr.lock(self._tx.ctx.tx_id, ti.tablet_id, mode)
+        except DeadlockDetected:
+            # victim policy: the cycle-closing tx aborts (share/deadlock)
+            self._end_tx(commit=False)
+            raise
+        return ResultSet((), {})
 
     # -------------------------------------------------------------- show
     def _show(self, st: A.Show) -> ResultSet:
@@ -501,33 +540,38 @@ class DbSession:
         if tx is None or tx.ctx is None:
             return
         touched = tx.touched_tables
+        committed_ok = False
         try:
             if commit:
                 if touched:
                     self.db.cluster.commit_sync(tx.svc, tx.ctx)
                 else:
                     tx.svc.commit(tx.ctx)  # empty tx: finishes immediately
+                committed_ok = True
             else:
                 tx.svc.abort(tx.ctx)
         finally:
+            # locks hold through the commit decision, then release
+            self.db.lock_mgr.release_all(tx.ctx.tx_id)
             by_tablet = {}
             for name in touched:
                 ti = self.db.tables.get(name)
                 if ti is not None:
                     by_tablet[ti.tablet_id] = ti
-                    if commit:
+                    if committed_ok:
                         ti.data_version += 1
                     ti.cached_data_version = -1
-            if commit:
-                # the appends are durable now: later commits need not
-                # re-log them
+            if committed_ok:
+                # the appends are durable now (committed_ok, NOT the commit
+                # intent: a failed commit logged nothing): later commits
+                # need not re-log them
                 for tab_id, col, code, _s in tx.ctx.dict_appends:
                     ti = by_tablet.get(tab_id)
                     if ti is not None:
                         ti.logged_dict_len[col] = max(
                             ti.logged_dict_len.get(col, 0), code + 1
                         )
-            if commit and touched:
+            if committed_ok and touched:
                 # post-commit freeze/compaction check (the tenant freezer's
                 # write-path trigger; cheap when under the memstore limit)
                 self.db.run_maintenance()
@@ -556,6 +600,11 @@ class DbSession:
         A WriteConflict during staging still aborts the whole tx — that is
         transaction, not statement, semantics (first-committer-wins)."""
         if muts:
+            from ..tx.tablelock import LockMode
+
+            # implicit intention lock: DML conflicts with explicit
+            # SHARE/EXCLUSIVE table locks held by other txs (tablelock)
+            self.db.lock_mgr.lock(tx.ctx.tx_id, ti.tablet_id, LockMode.ROW_X)
             tx.ensure_leader(ti.ls_id)
             for key, op, vals in muts:
                 tx.svc.write(tx.ctx, ti.ls_id, ti.tablet_id, key, op, vals)

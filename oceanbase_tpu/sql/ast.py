@@ -254,6 +254,14 @@ class Show(Node):
 
 
 @dataclass(frozen=True)
+class LockTable(Node):
+    """LOCK TABLE name IN SHARE|EXCLUSIVE MODE (tx-scoped, tablelock)."""
+
+    name: str
+    exclusive: bool
+
+
+@dataclass(frozen=True)
 class Begin(Node):
     pass
 

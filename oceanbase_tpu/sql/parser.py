@@ -36,6 +36,7 @@ KEYWORDS = {
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
     "commit", "rollback", "alter", "system", "show", "parameters", "tables",
+    "lock", "mode", "share", "exclusive",
 }
 
 
@@ -136,6 +137,7 @@ class Parser:
             "rollback": lambda: (self.next(), A.Rollback())[1],
             "alter": self._alter,
             "show": self._show,
+            "lock": self._lock,
         }
         h = handlers.get(t.value) if t.kind == "kw" else None
         if h is None:
@@ -168,6 +170,17 @@ class Parser:
         if end == start:
             raise SyntaxError(f"missing parameter value at {t.pos}")
         return A.AlterSystemSet(name, self.sql[start:end].strip())
+
+    def _lock(self) -> A.LockTable:
+        self.expect("lock")
+        self.expect("table")
+        name = self.next().value
+        self.expect("in")
+        t = self.next().value
+        if t not in ("share", "exclusive"):
+            raise SyntaxError(f"bad lock mode {t!r}")
+        self.expect("mode")
+        return A.LockTable(name, exclusive=(t == "exclusive"))
 
     def _show(self) -> A.Show:
         self.expect("show")

@@ -117,6 +117,9 @@ def restore_database(root: str, n_nodes: int = 3, n_ls: int = 2,
         ti = db.tables[tmeta["name"]]
         for c, values in tmeta["dicts"].items():
             ti.dicts[c] = Dictionary(values)
+            # codes inside the backup snapshot are already durable: the
+            # first post-restore commit must not re-log the whole dict
+            ti.logged_dict_len[c] = len(values)
         with open(os.path.join(root, f"{tmeta['name']}.sst"), "rb") as f:
             blob = f.read()
         for rep in db.cluster.ls_groups[ti.ls_id].values():
@@ -153,6 +156,9 @@ def restore_database(root: str, n_nodes: int = 3, n_ls: int = 2,
                     raise IOError(
                         f"dictionary divergence at code {code} of {col}"
                     )
+            hit[0].logged_dict_len[col] = max(
+                hit[0].logged_dict_len.get(col, 0), len(d)
+            )
         for ch in merge_streams(changes):
             if ch.commit_version <= backup_scn:
                 continue  # already inside the backup snapshot

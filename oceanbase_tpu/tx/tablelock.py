@@ -1,0 +1,122 @@
+"""Table locks + deadlock detection.
+
+Reference surface: storage/tablelock (table/partition lock objects taken
+inside transactions, released at tx end) and share/deadlock — the LCL
+(lock-chain-length) distributed deadlock detection that finds wait cycles
+and kills one participant.
+
+Rebuild semantics: S/X locks on arbitrary lock ids (table tablet ids), one
+outstanding wait per tx. `lock()` either grants, or registers the wait
+edge and raises WouldBlock so the caller retries after the holder ends —
+the deterministic analog of queueing on the lock-wait manager. Before
+raising WouldBlock the manager walks the wait-for graph; a cycle aborts
+the REQUESTER with DeadlockDetected (the youngest-tx victim policy: the
+cycle closer is by construction the newest edge)."""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+
+
+class LockMode(enum.IntEnum):
+    ROW_X = 0  # intention-exclusive: taken implicitly by DML
+    SHARE = 1  # LOCK TABLE ... IN SHARE MODE (blocks writes)
+    EXCLUSIVE = 2  # LOCK TABLE ... IN EXCLUSIVE MODE (blocks everything)
+
+
+# requested-vs-held compatibility (symmetric): IX+IX coexist (row conflicts
+# are the memtable's job); S+S coexist; X conflicts with all
+_COMPAT = {
+    (LockMode.ROW_X, LockMode.ROW_X): True,
+    (LockMode.SHARE, LockMode.SHARE): True,
+}
+
+
+class WouldBlock(Exception):
+    """Lock held in a conflicting mode; retry after the holder finishes."""
+
+
+class DeadlockDetected(Exception):
+    """Granting this wait would close a wait-for cycle; abort the tx."""
+
+
+@dataclass
+class LockManager:
+    # lock_id -> {tx_id: mode} (granted)
+    _granted: dict[object, dict[int, LockMode]] = field(default_factory=dict)
+    # tx_id -> (lock_id, mode) one outstanding wait
+    _waiting: dict[int, tuple[object, LockMode]] = field(default_factory=dict)
+    _lock: threading.RLock = field(default_factory=threading.RLock)
+    deadlocks: int = 0
+
+    @staticmethod
+    def _compatible(a: LockMode, b: LockMode) -> bool:
+        return _COMPAT.get((a, b), False)
+
+    def _conflicting_holders(self, tx_id: int, lock_id, mode) -> set[int]:
+        return {
+            t for t, m in self._granted.get(lock_id, {}).items()
+            if t != tx_id and not self._compatible(mode, m)
+        }
+
+    def _wait_edges(self, tx_id: int) -> set[int]:
+        """Who tx_id waits for (via its registered wait)."""
+        w = self._waiting.get(tx_id)
+        if w is None:
+            return set()
+        return self._conflicting_holders(tx_id, w[0], w[1])
+
+    def _would_deadlock(self, start_tx: int) -> bool:
+        """DFS over the wait-for graph from start_tx back to itself."""
+        seen = set()
+        stack = list(self._wait_edges(start_tx))
+        while stack:
+            t = stack.pop()
+            if t == start_tx:
+                return True
+            if t in seen:
+                continue
+            seen.add(t)
+            stack.extend(self._wait_edges(t))
+        return False
+
+    # -------------------------------------------------------------- API
+    def lock(self, tx_id: int, lock_id, mode: LockMode) -> None:
+        """Grant, or raise WouldBlock/DeadlockDetected."""
+        with self._lock:
+            holders = self._granted.setdefault(lock_id, {})
+            held = holders.get(tx_id)
+            if held is not None and held >= mode:
+                return  # already held at sufficient strength
+            conflicts = self._conflicting_holders(tx_id, lock_id, mode)
+            if not conflicts:
+                holders[tx_id] = mode
+                self._waiting.pop(tx_id, None)
+                return
+            self._waiting[tx_id] = (lock_id, mode)
+            if self._would_deadlock(tx_id):
+                self.deadlocks += 1
+                self._waiting.pop(tx_id, None)
+                raise DeadlockDetected(
+                    f"tx {tx_id} waiting on {lock_id} closes a cycle"
+                )
+            raise WouldBlock(
+                f"lock {lock_id} held by {sorted(conflicts)}"
+            )
+
+    def release_all(self, tx_id: int) -> None:
+        with self._lock:
+            self._waiting.pop(tx_id, None)
+            for lock_id in [
+                k for k, hs in self._granted.items() if tx_id in hs
+            ]:
+                hs = self._granted[lock_id]
+                del hs[tx_id]
+                if not hs:
+                    del self._granted[lock_id]
+
+    def holders(self, lock_id) -> dict[int, LockMode]:
+        with self._lock:
+            return dict(self._granted.get(lock_id, {}))

@@ -1,0 +1,219 @@
+"""HA & failure handling: failure detector + leader demotion, keepalive
+peer-death detection, orphaned-state GC, table locks + deadlock detection.
+
+Reference: logservice/leader_coordinator (ObFailureDetector), obrpc
+keepalive, share/detect (ObDetectManager), storage/tablelock +
+share/deadlock (LCL).
+"""
+
+import pytest
+
+from oceanbase_tpu.ha import (
+    DetectManager,
+    FailureDetector,
+    LeaderCoordinator,
+    NetKeepAlive,
+)
+from oceanbase_tpu.log.transport import LocalBus
+from oceanbase_tpu.tx.cluster import LocalCluster
+from oceanbase_tpu.tx.tablelock import (
+    DeadlockDetected,
+    LockManager,
+    LockMode,
+    WouldBlock,
+)
+
+
+# ---- failure detector + leader coordinator --------------------------------
+
+
+def test_sick_leader_demotes_to_healthy_replica():
+    cluster = LocalCluster(n_nodes=3)
+    cluster.create_ls(1)
+    cluster.finalize()
+    lead0 = cluster.leader_node(1)
+
+    health = {n: True for n in range(3)}
+    detectors = {}
+    for n in range(3):
+        d = FailureDetector()
+        d.register("clog_disk", lambda n=n: health[n])
+        detectors[n] = d
+    coord = LeaderCoordinator(cluster.ls_groups, detectors)
+
+    health[lead0] = False  # leader's clog disk "hangs"
+    assert not detectors[lead0].healthy
+    assert coord.tick() == 1
+    ok = cluster.drive_until(
+        lambda: cluster.ls_groups[1][lead0].palf.role.name != "LEADER"
+        and any(r.is_ready for r in cluster.ls_groups[1].values())
+    )
+    assert ok
+    new_lead = cluster.leader_node(1)
+    assert new_lead != lead0 and detectors[new_lead].healthy
+    # healthy cluster: no further transfers
+    assert coord.tick() == 0
+
+
+def test_coordinator_stays_put_when_no_healthy_target():
+    cluster = LocalCluster(n_nodes=3)
+    cluster.create_ls(1)
+    cluster.finalize()
+    detectors = {n: FailureDetector() for n in range(3)}
+    for n, d in detectors.items():
+        d.register("x", lambda: False)  # everyone sick
+    coord = LeaderCoordinator(cluster.ls_groups, detectors)
+    assert coord.tick() == 0  # nowhere to go: keep serving
+
+
+# ---- keepalive + detect manager -------------------------------------------
+
+
+def _pump(bus, kas, t=3.0, dt=0.1):
+    steps = int(t / dt)
+    for _ in range(steps):
+        for ka in kas.values():
+            ka.tick()
+        bus.advance(dt)
+
+
+def test_keepalive_detects_death_and_revival():
+    bus = LocalBus()
+    kas = {n: NetKeepAlive(bus, n, peers=[0, 1, 2]) for n in range(3)}
+    _pump(bus, kas)
+    assert kas[0].dead_peers() == set()
+    from oceanbase_tpu.ha.detect import KA_BASE
+
+    bus.kill(KA_BASE + 2)
+    _pump(bus, kas)
+    assert kas[0].dead_peers() == {2}
+    assert kas[1].dead_peers() == {2}
+    bus.revive(KA_BASE + 2)
+    _pump(bus, kas)
+    assert kas[0].dead_peers() == set()
+
+
+def test_detect_manager_gc_on_peer_death():
+    bus = LocalBus()
+    kas = {n: NetKeepAlive(bus, n, peers=[0, 1]) for n in range(2)}
+    _pump(bus, kas)
+    dm = DetectManager(kas[0])
+    freed = []
+    dm.register(1, ("px_task", 7), lambda: freed.append("px_task_7"))
+    dm.register(1, ("dtl_ch", 3), lambda: freed.append("dtl_ch_3"))
+    assert dm.tick() == 0  # peer alive: nothing to GC
+    from oceanbase_tpu.ha.detect import KA_BASE
+
+    bus.kill(KA_BASE + 1)
+    _pump(bus, kas)
+    assert dm.tick() == 2
+    assert sorted(freed) == ["dtl_ch_3", "px_task_7"]
+    assert dm.tick() == 0  # idempotent
+
+
+# ---- table locks + deadlock ------------------------------------------------
+
+
+def test_lock_modes_and_release():
+    lm = LockManager()
+    lm.lock(1, "t", LockMode.SHARE)
+    lm.lock(2, "t", LockMode.SHARE)  # S+S compatible
+    with pytest.raises(WouldBlock):
+        lm.lock(3, "t", LockMode.EXCLUSIVE)
+    lm.release_all(1)
+    lm.release_all(2)
+    lm.lock(3, "t", LockMode.EXCLUSIVE)
+    with pytest.raises(WouldBlock):
+        lm.lock(1, "t", LockMode.SHARE)
+    assert lm.holders("t") == {3: LockMode.EXCLUSIVE}
+
+
+def test_deadlock_cycle_aborts_requester():
+    lm = LockManager()
+    lm.lock(1, "a", LockMode.EXCLUSIVE)
+    lm.lock(2, "b", LockMode.EXCLUSIVE)
+    with pytest.raises(WouldBlock):
+        lm.lock(1, "b", LockMode.EXCLUSIVE)  # 1 waits on 2
+    with pytest.raises(DeadlockDetected):
+        lm.lock(2, "a", LockMode.EXCLUSIVE)  # closes the cycle
+    assert lm.deadlocks == 1
+    # victim's wait cleared: tx1 proceeds after tx2 aborts
+    lm.release_all(2)
+    lm.lock(1, "b", LockMode.EXCLUSIVE)
+
+
+def test_exclusive_table_lock_blocks_dml():
+    """DML takes an implicit intention lock, so LOCK TABLE X excludes it."""
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=3, n_ls=1)
+    s1, s2 = db.session(), db.session()
+    s1.sql("create table dl (k bigint primary key, v bigint not null)")
+    s1.sql("insert into dl values (1, 1)")
+    s1.sql("begin")
+    s1.sql("lock table dl in exclusive mode")
+    with pytest.raises(WouldBlock):
+        s2.sql("insert into dl values (2, 2)")  # autocommit write blocked
+    # the blocked autocommit statement rolled back cleanly
+    s1.sql("commit")
+    s2.sql("insert into dl values (2, 2)")  # lock released: proceeds
+    assert s2.sql("select count(*) as c from dl").rows() == [(2,)]
+    # SHARE lock also blocks writers but not other SHARE lockers
+    s1.sql("begin")
+    s1.sql("lock table dl in share mode")
+    with pytest.raises(WouldBlock):
+        s2.sql("delete from dl where k = 1")
+    s1.sql("rollback")
+
+
+def test_archive_crash_recovery_no_duplicates(tmp_path):
+    """Entries appended after the last progress write must not re-archive
+    on resume (tail-segment scan recovery)."""
+    import os
+
+    from oceanbase_tpu.log.archive import ArchiveReader, ArchiveWriter
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=3, n_ls=1)
+    s = db.session()
+    s.sql("create table ar (k bigint primary key)")
+    s.sql("insert into ar values (1)")
+    root = str(tmp_path / "arch")
+    node = db.cluster.leader_node(1)
+    palf = db.cluster.ls_groups[1][node].palf
+    w = ArchiveWriter(root, 1)
+    w.archive_from(palf)
+    # simulate the crash window: progress file rolled back one batch
+    with open(os.path.join(root, "ls_1", "progress"), "w") as f:
+        f.write("0")
+    w2 = ArchiveWriter(root, 1)  # recovery scans the tail segment
+    assert w2.next_lsn == w.next_lsn
+    assert w2.archive_from(palf) == 0
+    lsns = [e[0] for e in ArchiveReader(root, 1).entries()]
+    assert lsns == sorted(set(lsns)), "duplicate LSNs after recovery"
+
+
+def test_lock_table_sql_and_deadlock():
+    from oceanbase_tpu.server import Database
+    from oceanbase_tpu.server.database import SqlError
+
+    db = Database(n_nodes=3, n_ls=1)
+    s1, s2 = db.session(), db.session()
+    s1.sql("create table lt_a (k bigint primary key)")
+    s1.sql("create table lt_b (k bigint primary key)")
+    with pytest.raises(SqlError, match="open transaction"):
+        s1.sql("lock table lt_a in exclusive mode")
+    s1.sql("begin")
+    s2.sql("begin")
+    s1.sql("lock table lt_a in exclusive mode")
+    s2.sql("lock table lt_b in exclusive mode")
+    with pytest.raises(WouldBlock):
+        s1.sql("lock table lt_b in share mode")
+    with pytest.raises(DeadlockDetected):
+        s2.sql("lock table lt_a in share mode")  # cycle: s2 aborts
+    # s2's tx was rolled back -> its lock on lt_b is gone; s1 proceeds
+    s1.sql("lock table lt_b in share mode")
+    s1.sql("commit")
+    # all released after commit
+    ti = db.tables["lt_a"]
+    assert db.lock_mgr.holders(ti.tablet_id) == {}
