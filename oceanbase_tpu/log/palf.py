@@ -144,7 +144,11 @@ class PalfReplica:
     # -------------------------------------------------------- public API
     def submit_log(self, payload: bytes, scn: int | None = None) -> int | None:
         """Leader appends; returns lsn or None if not leader (caller retries
-        at the real leader — the analog of OB_NOT_MASTER)."""
+        at the real leader — the analog of OB_NOT_MASTER). Errsim:
+        EN_LOG_SUBMIT injects append failures."""
+        from ..share.errsim import errsim_point
+
+        errsim_point("EN_LOG_SUBMIT")
         if self.role is not Role.LEADER:
             return None
         lsn = len(self.log)

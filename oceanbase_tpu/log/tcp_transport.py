@@ -1,0 +1,153 @@
+"""TCP transport: real-time multi-process message bus for replication.
+
+Reference surface: the real deployment plane the LocalBus simulates —
+obrpc over pkt-nio sockets (deps/oblib/src/rpc). The reference tests true
+multi-node behavior by forking three observer processes as three zones
+(mittest/multi_replica/env/ob_multi_replica_test_base.cpp:472); the
+rebuild's TcpBus lets the SAME PalfReplica state machine run across real
+processes: it exposes the LocalBus surface palf uses (`now`, `send`,
+`register`) over length-prefixed pickled frames.
+
+Wire safety note: frames are pickled (trusted in-cluster links only, like
+the reference's internal RPC); a hardened codec swaps in at this one
+boundary.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+_FRAME = struct.Struct("<II")  # dst node id, payload length
+
+
+class TcpBus:
+    """One process's endpoint. `route` maps every node id to the
+    (host, port) of the process hosting it; ids listed in `local_nodes`
+    are served by this process."""
+
+    def __init__(self, listen_port: int, route: dict[int, tuple[str, int]],
+                 local_nodes: set[int] | None = None):
+        self.listen_port = listen_port
+        self.route = route
+        self.local_nodes = set(local_nodes or ())
+        self._handlers: dict[int, object] = {}
+        self._conns: dict[tuple[str, int], socket.socket] = {}
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._listener: socket.socket | None = None
+
+    @property
+    def now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def register(self, node_id: int, handler) -> None:
+        self._handlers[node_id] = handler
+        self.local_nodes.add(node_id)
+
+    # ---------------------------------------------------------- sending
+    def send(self, src: int, dst: int, msg) -> None:
+        if dst in self.local_nodes:
+            h = self._handlers.get(dst)
+            if h is not None:
+                h(src, msg)
+            return
+        addr = self.route.get(dst)
+        if addr is None:
+            return
+        payload = pickle.dumps((src, msg), protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _FRAME.pack(dst, len(payload)) + payload
+        try:
+            with self._lock:
+                conn = self._conns.get(addr)
+                if conn is None:
+                    conn = socket.create_connection(addr, timeout=1.0)
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._conns[addr] = conn
+                conn.sendall(frame)
+        except OSError:
+            # network semantics: drops are normal; consensus retries
+            with self._lock:
+                c = self._conns.pop(addr, None)
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    # --------------------------------------------------------- receiving
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", self.listen_port))
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                t = threading.Thread(
+                    target=self._reader, args=(conn,), daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _reader(self, conn: socket.socket) -> None:
+        conn.settimeout(0.5)
+        buf = b""
+        while not self._stop.is_set():
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while len(buf) >= _FRAME.size:
+                dst, plen = _FRAME.unpack_from(buf)
+                if len(buf) < _FRAME.size + plen:
+                    break
+                payload = buf[_FRAME.size : _FRAME.size + plen]
+                buf = buf[_FRAME.size + plen :]
+                try:
+                    src, msg = pickle.loads(payload)
+                except Exception:  # noqa: BLE001 - corrupt frame: drop
+                    continue
+                h = self._handlers.get(dst)
+                if h is not None:
+                    h(src, msg)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()

@@ -153,6 +153,10 @@ class Tablet:
 
     def dump_mini(self) -> SSTable | None:
         """Dump the oldest frozen memtable into a mini delta sstable."""
+        from ..share.errsim import debug_sync, errsim_point
+
+        errsim_point("EN_MINI_MERGE")
+        debug_sync("BEFORE_MINI_DUMP")
         with self._maint_lock:
             with self._meta_lock:
                 if not self.frozen:

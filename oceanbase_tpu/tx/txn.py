@@ -136,6 +136,10 @@ class TransService:
     def commit(self, ctx: TxContext) -> None:
         """Start commit; terminal state arrives via apply callbacks
         (poll ctx.is_done under a drive loop, or block in live runtimes)."""
+        from ..share.errsim import debug_sync, errsim_point
+
+        errsim_point("EN_TX_COMMIT")
+        debug_sync("BEFORE_COMMIT")
         if ctx.state is not TxState.ACTIVE:
             raise RuntimeError(f"tx {ctx.tx_id} is {ctx.state.value}")
         parts = [ls for ls, ms in ctx.mutations.items() if ms]

@@ -1,0 +1,227 @@
+"""ERRSIM fault injection, debug sync, and the forked 3-zone cluster.
+
+Reference: ERRSIM tracepoints (ob_tracepoint_def.h) + ObDebugSync
+(share/ob_debug_sync.h); the multi-process replica harness that forks
+three observers as three zones (mittest/multi_replica, fork at
+env/ob_multi_replica_test_base.cpp:472) and the palf-only bench cluster
+(mittest/palf_cluster).
+"""
+
+import multiprocessing as mp
+import socket
+import time
+
+import pytest
+
+from oceanbase_tpu.share.errsim import (
+    DEBUG_SYNC,
+    ERRSIM,
+    InjectedError,
+    debug_sync,
+    errsim_point,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    yield
+    ERRSIM.clear()
+    DEBUG_SYNC.deactivate()
+
+
+# ---- errsim ----------------------------------------------------------------
+
+
+def test_errsim_arm_fire_count_and_clear():
+    ERRSIM.arm("EN_TEST_POINT", count=2)
+    with pytest.raises(InjectedError):
+        errsim_point("EN_TEST_POINT")
+    with pytest.raises(InjectedError):
+        errsim_point("EN_TEST_POINT")
+    errsim_point("EN_TEST_POINT")  # count exhausted: no-op
+    assert ERRSIM.fired("EN_TEST_POINT") == 2
+    ERRSIM.arm("EN_TEST_POINT", error=ValueError("custom"))
+    with pytest.raises(ValueError, match="custom"):
+        errsim_point("EN_TEST_POINT")
+    ERRSIM.clear("EN_TEST_POINT")
+    errsim_point("EN_TEST_POINT")
+
+
+def test_errsim_mini_merge_failure_hits_dag_warning_history():
+    """An injected mini-merge error must surface in the dag warning
+    history and leave the tablet intact for the retry."""
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=3, n_ls=1)
+    db.config.set("memstore_limit", 20_000)
+    db.config.set("freeze_trigger_ratio", 0.2)
+    s = db.session()
+    s.sql("create table et (k bigint primary key, v bigint not null)")
+    ERRSIM.arm("EN_MINI_MERGE", count=-1)
+    for b in range(4):
+        s.sql("insert into et values " + ",".join(
+            f"({b * 60 + i}, 1)" for i in range(60)))
+    assert any(
+        w.dag_type == "MINI_MERGE" for w in db.dag_scheduler.warnings
+    ), "injected failure did not reach the warning history"
+    ERRSIM.clear("EN_MINI_MERGE")
+    db.run_maintenance()  # retry succeeds now
+    assert s.sql("select count(*) as c from et").rows() == [(240,)]
+
+
+def test_errsim_commit_failure_rolls_back_cleanly():
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=3, n_ls=1)
+    s = db.session()
+    s.sql("create table ec (k bigint primary key)")
+    ERRSIM.arm("EN_TX_COMMIT", count=1)
+    with pytest.raises(InjectedError):
+        s.sql("insert into ec values (1)")
+    assert s.sql("select count(*) as c from ec").rows() == [(0,)]
+    s.sql("insert into ec values (2)")  # next statement unaffected
+    assert s.sql("select count(*) as c from ec").rows() == [(1,)]
+
+
+def test_debug_sync_interleaves_mid_operation():
+    """Park an action at BEFORE_COMMIT: a concurrent reader runs INSIDE
+    s1's commit window and must still see the pre-commit snapshot —
+    deterministically probing the visibility boundary."""
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=3, n_ls=1)
+    s1, s2 = db.session(), db.session()
+    s1.sql("create table ds (k bigint primary key, v bigint not null)")
+    s1.sql("insert into ds values (1, 0)")
+
+    observed = []
+
+    def observe():
+        DEBUG_SYNC.deactivate("BEFORE_COMMIT")
+        observed.append(
+            s2.sql("select v from ds where k = 1").rows()[0][0]
+        )
+
+    s1.sql("begin")
+    s1.sql("update ds set v = 1 where k = 1")
+    DEBUG_SYNC.activate("BEFORE_COMMIT", observe)
+    s1.sql("commit")
+    assert observed == [0], "mid-commit read leaked uncommitted state"
+    assert s2.sql("select v from ds where k = 1").rows() == [(1,)]
+
+
+# ---- forked 3-zone palf cluster -------------------------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _zone_main(zone, ports, conn):
+    """One forked zone: a PalfReplica over TcpBus + a control loop."""
+    from oceanbase_tpu.log.palf import PalfReplica, Role
+    from oceanbase_tpu.log.tcp_transport import TcpBus
+
+    route = {n: ("127.0.0.1", ports[n]) for n in range(3)}
+    bus = TcpBus(ports[zone], route, local_nodes={zone})
+    rep = PalfReplica(node_id=zone, peers=[0, 1, 2], bus=bus)
+    bus.start()
+    try:
+        while True:
+            if conn.poll(0.005):
+                cmd, arg = conn.recv()
+                if cmd == "role":
+                    conn.send((rep.role.name, rep.term))
+                elif cmd == "submit":
+                    conn.send(rep.submit_log(arg))
+                elif cmd == "committed":
+                    # skip leadership no-op entries (empty payloads)
+                    conn.send([
+                        e.payload for e in rep.log[: rep.commit_lsn + 1]
+                        if e.payload
+                    ])
+                elif cmd == "stop":
+                    conn.send("ok")
+                    return
+            rep.tick()
+    finally:
+        bus.stop()
+
+
+def test_three_process_palf_cluster():
+    """Fork three real processes as three zones: elect, replicate, fail
+    over, replicate again (the tier-4 harness)."""
+    ctx = mp.get_context("fork")
+    ports = _free_ports(3)
+    pipes, procs = [], []
+    for z in range(3):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_zone_main, args=(z, ports, child), daemon=True)
+        p.start()
+        pipes.append(parent)
+        procs.append(p)
+
+    def ask(z, cmd, arg=None, timeout=5.0):
+        pipes[z].send((cmd, arg))
+        if pipes[z].poll(timeout):
+            return pipes[z].recv()
+        raise TimeoutError(f"zone {z} no reply to {cmd}")
+
+    def wait_leader(exclude=(), timeout=20.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for z in range(3):
+                if z in exclude or not procs[z].is_alive():
+                    continue
+                role, _term = ask(z, "role")
+                if role == "LEADER":
+                    return z
+            time.sleep(0.05)
+        raise TimeoutError("no leader elected")
+
+    try:
+        lead = wait_leader()
+        # replicate entries through the leader
+        for i in range(5):
+            lsn = ask(lead, "submit", f"entry-{i}".encode())
+            assert lsn is not None
+        deadline = time.time() + 10
+        follower = next(z for z in range(3) if z != lead)
+        while time.time() < deadline:
+            got = ask(follower, "committed")
+            if len(got) >= 5:
+                break
+            time.sleep(0.05)
+        assert [p for p in got[:5]] == [f"entry-{i}".encode() for i in range(5)]
+
+        # kill the leader PROCESS: the survivors elect a new one
+        procs[lead].terminate()
+        procs[lead].join(timeout=5)
+        lead2 = wait_leader(exclude=(lead,))
+        assert lead2 != lead
+        assert ask(lead2, "submit", b"after-failover") is not None
+        deadline = time.time() + 10
+        other = next(z for z in range(3) if z not in (lead, lead2))
+        while time.time() < deadline:
+            got = ask(other, "committed")
+            if b"after-failover" in got:
+                break
+            time.sleep(0.05)
+        assert b"after-failover" in got
+    finally:
+        for z in range(3):
+            if procs[z].is_alive():
+                try:
+                    ask(z, "stop", timeout=2.0)
+                except Exception:
+                    pass
+                procs[z].terminate()
+            procs[z].join(timeout=3)
