@@ -37,7 +37,11 @@ class TcpBus:
         self._handlers: dict[int, object] = {}
         self._conns: dict[tuple[str, int], socket.socket] = {}
         self._t0 = time.monotonic()
+        # _lock guards only the _conns map; per-destination locks serialize
+        # connect/sendall, so one dead peer's 1s connect timeout cannot
+        # stall sends (palf heartbeats, votes) to healthy peers
         self._lock = threading.Lock()
+        self._dst_locks: dict[tuple[str, int], threading.Lock] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
@@ -62,13 +66,17 @@ class TcpBus:
             return
         payload = pickle.dumps((src, msg), protocol=pickle.HIGHEST_PROTOCOL)
         frame = _FRAME.pack(dst, len(payload)) + payload
+        with self._lock:
+            dlock = self._dst_locks.setdefault(addr, threading.Lock())
         try:
-            with self._lock:
-                conn = self._conns.get(addr)
+            with dlock:
+                with self._lock:
+                    conn = self._conns.get(addr)
                 if conn is None:
                     conn = socket.create_connection(addr, timeout=1.0)
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    self._conns[addr] = conn
+                    with self._lock:
+                        self._conns[addr] = conn
                 conn.sendall(frame)
         except OSError:
             # network semantics: drops are normal; consensus retries

@@ -44,8 +44,11 @@ class DeadlockDetected(Exception):
 
 @dataclass
 class LockManager:
-    # lock_id -> {tx_id: mode} (granted)
-    _granted: dict[object, dict[int, LockMode]] = field(default_factory=dict)
+    # lock_id -> {tx_id: set of granted base modes}. A tx may hold several
+    # base modes at once (SHARE + ROW_X == the SIX combination); keeping the
+    # set — instead of one "max" enum — means upgrades are checked against
+    # other holders per base mode, never granted by enum comparison.
+    _granted: dict[object, dict[int, set[LockMode]]] = field(default_factory=dict)
     # tx_id -> (lock_id, mode) one outstanding wait
     _waiting: dict[int, tuple[object, LockMode]] = field(default_factory=dict)
     _lock: threading.RLock = field(default_factory=threading.RLock)
@@ -57,8 +60,8 @@ class LockManager:
 
     def _conflicting_holders(self, tx_id: int, lock_id, mode) -> set[int]:
         return {
-            t for t, m in self._granted.get(lock_id, {}).items()
-            if t != tx_id and not self._compatible(mode, m)
+            t for t, ms in self._granted.get(lock_id, {}).items()
+            if t != tx_id and any(not self._compatible(mode, m) for m in ms)
         }
 
     def _wait_edges(self, tx_id: int) -> set[int]:
@@ -87,12 +90,12 @@ class LockManager:
         """Grant, or raise WouldBlock/DeadlockDetected."""
         with self._lock:
             holders = self._granted.setdefault(lock_id, {})
-            held = holders.get(tx_id)
-            if held is not None and held >= mode:
-                return  # already held at sufficient strength
+            held = holders.get(tx_id, set())
+            if mode in held or LockMode.EXCLUSIVE in held:
+                return  # this exact strength (or a superset) already granted
             conflicts = self._conflicting_holders(tx_id, lock_id, mode)
             if not conflicts:
-                holders[tx_id] = mode
+                holders.setdefault(tx_id, set()).add(mode)
                 self._waiting.pop(tx_id, None)
                 return
             self._waiting[tx_id] = (lock_id, mode)
@@ -118,5 +121,8 @@ class LockManager:
                     del self._granted[lock_id]
 
     def holders(self, lock_id) -> dict[int, LockMode]:
+        """Strongest base mode per holder (display/assert surface)."""
         with self._lock:
-            return dict(self._granted.get(lock_id, {}))
+            return {
+                t: max(ms) for t, ms in self._granted.get(lock_id, {}).items()
+            }
