@@ -10,6 +10,7 @@ from .palf import (
     AppendAck,
     AppendReq,
     LogEntry,
+    LogView,
     PalfReplica,
     Role,
     VoteReq,
@@ -17,11 +18,14 @@ from .palf import (
     leader_of,
     run_until,
 )
+from .store import LogStore
 from .transport import LocalBus
 
 __all__ = [
     "LocalBus",
     "LogEntry",
+    "LogView",
+    "LogStore",
     "PalfReplica",
     "Role",
     "AppendReq",

@@ -44,12 +44,18 @@ class ArchiveWriter:
         last = os.path.join(self.dir, segs[-1])
         with open(last, "rb") as f:
             buf = f.read()
-        pos, max_lsn = 0, -1
-        while pos + _ENTRY.size <= len(buf):
-            lsn, _t, _s, plen, _c = _ENTRY.unpack_from(buf, pos)
-            pos += _ENTRY.size + plen
-            if pos <= len(buf):
-                max_lsn = max(max_lsn, lsn)
+        # same record layout as the palf LogStore: reuse ITS crash-boundary
+        # scanner rather than a drifting copy of the loop
+        from .store import scan_records
+
+        recs, good = scan_records(buf)
+        max_lsn = max((r[0] for r in recs), default=-1)
+        if good < len(buf):
+            # torn final record (crash mid-append): truncate to the last
+            # whole-entry boundary so resumed appends don't bury partial
+            # bytes inside the segment (which would corrupt every later read)
+            with open(last, "r+b") as f:
+                f.truncate(good)
         self.next_lsn = max(self.next_lsn, max_lsn + 1)
 
     def _segment_path(self, lsn: int) -> str:

@@ -44,6 +44,64 @@ class LogEntry:
     payload: bytes
 
 
+class LogView:
+    """Dense-LSN log whose prefix may have been recycled to a checkpoint.
+
+    Presents list-like access indexed by ABSOLUTE lsn (the code's dense-LSN
+    invariant: log[lsn].lsn == lsn) while physically holding only entries
+    >= base. `base_prev_term` is the term of entry base-1 (needed for
+    log-matching AppendReqs that start exactly at base)."""
+
+    __slots__ = ("base", "entries", "base_prev_term")
+
+    def __init__(self, base: int = 0, entries: list[LogEntry] | None = None,
+                 base_prev_term: int = 0):
+        self.base = base
+        self.entries: list[LogEntry] = entries if entries is not None else []
+        self.base_prev_term = base_prev_term
+
+    def __len__(self) -> int:
+        return self.base + len(self.entries)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(len(self))
+            if step != 1:
+                raise ValueError("LogView slices are contiguous")
+            lo = max(start - self.base, 0)
+            hi = max(stop - self.base, 0)
+            return self.entries[lo:hi]
+        if i < 0:
+            i += len(self)
+        if i < self.base:
+            raise IndexError(f"lsn {i} recycled (base {self.base})")
+        return self.entries[i - self.base]
+
+    def __delitem__(self, i) -> None:
+        # only suffix deletion is meaningful for a log
+        if not isinstance(i, slice) or i.stop is not None or i.step is not None:
+            raise ValueError("only `del log[lsn:]` is supported")
+        start = i.start if i.start >= 0 else len(self) + i.start
+        if start < self.base:
+            raise IndexError(f"cannot truncate below base {self.base}")
+        del self.entries[start - self.base :]
+
+    def append(self, e: LogEntry) -> None:
+        self.entries.append(e)
+
+    def term_at(self, lsn: int) -> int | None:
+        """Term of entry at lsn; None if below base (recycled — committed
+        by construction) or beyond the end."""
+        if lsn < self.base:
+            return None
+        if lsn >= len(self):
+            return None
+        return self.entries[lsn - self.base].term
+
+
 # ---- messages -----------------------------------------------------------
 @dataclass(frozen=True)
 class AppendReq:
@@ -101,10 +159,15 @@ class PalfReplica:
     peers: list[int]  # all member ids including self
     bus: LocalBus
     on_commit: Callable[[LogEntry], None] | None = None
+    # durable log engine (log/store.LogStore); None = volatile (pure unit
+    # tests). With a store, every append/truncate is mirrored to disk and
+    # synced BEFORE the replica acks or counts itself in a quorum, and
+    # (term, voted_for) are persisted BEFORE any message acting on them.
+    store: Any | None = None
     role: Role = Role.FOLLOWER
     term: int = 0
     voted_for: int | None = None
-    log: list[LogEntry] = field(default_factory=list)
+    log: LogView = field(default_factory=LogView)
     commit_lsn: int = -1
     applied_lsn: int = -1
     leader_id: int | None = None
@@ -119,9 +182,50 @@ class PalfReplica:
     _last_ack: dict[int, float] = field(default_factory=dict)
 
     def __post_init__(self):
+        if self.store is not None:
+            entries, base, term, voted_for = self.store.load()
+            if entries or term:
+                self.log = LogView(
+                    base, entries, self.store.base_prev_term
+                )
+                self.term = term
+                self.voted_for = voted_for
+                if entries:
+                    self._scn = entries[-1].scn
         self.bus.register(self.node_id, self._on_message)
         self.next_election_at = (
             self.bus.now + LEASE_TIMEOUT + self._jitter()
+        )
+
+    # ------------------------------------------------------- durability
+    def _persist_meta(self) -> None:
+        if self.store is not None:
+            self.store.save_meta(self.term, self.voted_for)
+
+    def _persist_append(self, entries) -> None:
+        if self.store is not None:
+            self.store.append(entries)
+
+    def _persist_sync(self) -> None:
+        """Group-commit: durable point before ack/self-count."""
+        if self.store is not None:
+            self.store.sync()
+
+    def recycle(self, upto_lsn: int) -> None:
+        """Advance the disk recycle point (everything below upto_lsn is
+        covered by a durable checkpoint). In-memory entries are trimmed
+        too — a follower that has fallen below this point needs a
+        snapshot-based rebuild, not log catch-up."""
+        upto = min(upto_lsn, self.commit_lsn + 1)
+        if upto <= self.log.base:
+            return
+        if self.store is not None:
+            # disk recycling is segment-aligned and records its own base
+            # info (the durable base differs from the in-memory one)
+            self.store.recycle(upto)
+        keep_term = self.log[upto - 1].term
+        self.log = LogView(
+            upto, self.log.entries[upto - self.log.base :], keep_term
         )
 
     # ------------------------------------------------------------ utils
@@ -133,8 +237,12 @@ class PalfReplica:
         return len(self.peers) // 2 + 1
 
     def _last(self) -> tuple[int, int]:
-        if not self.log:
+        if len(self.log) == 0:
             return -1, 0
+        if not self.log.entries:
+            # fully-recycled log: the last entry's identity survives as the
+            # recorded base info (elections must keep working post-recycle)
+            return self.log.base - 1, self.log.base_prev_term
         e = self.log[-1]
         return e.lsn, e.term
 
@@ -153,7 +261,10 @@ class PalfReplica:
             return None
         lsn = len(self.log)
         self._scn = max(self._scn + 1, scn or 0)
-        self.log.append(LogEntry(lsn, self.term, self._scn, payload))
+        e = LogEntry(lsn, self.term, self._scn, payload)
+        self.log.append(e)
+        self._persist_append((e,))
+        self._persist_sync()  # durable before counting self in the quorum
         self._advance_commit()  # single-replica groups commit immediately
         return lsn
 
@@ -183,6 +294,7 @@ class PalfReplica:
         self.role = Role.CANDIDATE
         self.term += 1
         self.voted_for = self.node_id
+        self._persist_meta()  # durable before soliciting votes
         self._votes = {self.node_id}
         self.leader_id = None
         last_lsn, last_term = self._last()
@@ -219,7 +331,10 @@ class PalfReplica:
         # unblock commitment of everything inherited from old leaders.
         self._scn += 1
         self._term_start_lsn = len(self.log)
-        self.log.append(LogEntry(len(self.log), self.term, self._scn, b""))
+        e = LogEntry(len(self.log), self.term, self._scn, b"")
+        self.log.append(e)
+        self._persist_append((e,))
+        self._persist_sync()
         self._advance_commit()  # single-replica groups commit immediately
         self.next_heartbeat_at = self.bus.now  # heartbeat immediately
         self.tick()
@@ -241,6 +356,7 @@ class PalfReplica:
         if term > self.term:
             self.term = term
             self.voted_for = None
+            self._persist_meta()  # durable before acting in the new term
         if leader is not None:
             self.leader_id = leader
         self.next_election_at = self.bus.now + LEASE_TIMEOUT + self._jitter()
@@ -253,7 +369,8 @@ class PalfReplica:
 
     def _advance_commit(self) -> None:
         # highest lsn replicated on a majority AND from the current term
-        for lsn in range(len(self.log) - 1, self.commit_lsn, -1):
+        floor = max(self.commit_lsn, self.log.base - 1)
+        for lsn in range(len(self.log) - 1, floor, -1):
             if self.log[lsn].term != self.term:
                 break
             acked = 1 + sum(1 for m in self._match_lsn.values() if m >= lsn)
@@ -289,13 +406,16 @@ class PalfReplica:
         # valid leader for this term: refresh lease
         self._step_down(m.term, m.leader_id)
         self.lease_until = self.bus.now + LEASE_TIMEOUT
-        # log matching
-        if m.prev_lsn >= 0:
+        # log matching; prev below base = recycled = committed = matched
+        if m.prev_lsn >= self.log.base:
             if m.prev_lsn >= len(self.log) or self.log[m.prev_lsn].term != m.prev_term:
                 self.bus.send(self.node_id, src, AppendAck(self.term, -1, False))
                 return
         # append, truncating any conflicting suffix
+        appended = []
         for e in m.entries:
+            if e.lsn < self.log.base:
+                continue  # below our checkpointed prefix: already committed
             if e.lsn < len(self.log):
                 if self.log[e.lsn].term != e.term:
                     if e.lsn <= self.commit_lsn:
@@ -303,10 +423,18 @@ class PalfReplica:
                             f"node {self.node_id}: conflicting entry at committed lsn {e.lsn}"
                         )
                     del self.log[e.lsn :]
+                    if self.store is not None:
+                        self.store.truncate_from(e.lsn)
+                    appended = [a for a in appended if a.lsn < e.lsn]
                     self.log.append(e)
+                    appended.append(e)
                 # else: duplicate, keep
             else:
                 self.log.append(e)
+                appended.append(e)
+        if appended:
+            self._persist_append(appended)
+        self._persist_sync()  # durable BEFORE the ack joins a commit quorum
         new_commit = min(m.commit_lsn, len(self.log) - 1)
         if new_commit > self.commit_lsn:
             self.commit_lsn = new_commit
@@ -334,9 +462,16 @@ class PalfReplica:
             self._send_append_to(src)
 
     def _send_append_to(self, p: int) -> None:
-        nxt = self._next_lsn.get(p, len(self.log))
+        # a follower below our recycled base needs a snapshot rebuild, not
+        # log catch-up — clamp to base (ha/rebuild drives the snapshot)
+        nxt = max(self._next_lsn.get(p, len(self.log)), self.log.base)
         prev_lsn = nxt - 1
-        prev_term = self.log[prev_lsn].term if 0 <= prev_lsn < len(self.log) else 0
+        if prev_lsn < 0:
+            prev_term = 0
+        elif prev_lsn < self.log.base:
+            prev_term = self.log.base_prev_term
+        else:
+            prev_term = self.log[prev_lsn].term
         entries = tuple(self.log[nxt : nxt + MAX_INFLIGHT])
         self.bus.send(
             self.node_id, p,
@@ -357,6 +492,7 @@ class PalfReplica:
             if up_to_date:
                 granted = True
                 self.voted_for = m.candidate_id
+                self._persist_meta()  # the vote must survive restart
                 self.next_election_at = self.bus.now + LEASE_TIMEOUT + self._jitter()
         self.bus.send(self.node_id, src, VoteResp(self.term, granted))
 

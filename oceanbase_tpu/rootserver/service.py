@@ -15,7 +15,6 @@ rebuild's RootService owns:
 
 from __future__ import annotations
 
-import itertools
 import threading
 
 from ..share.schema_service import SchemaError, SchemaService
@@ -26,16 +25,30 @@ class RootService:
     def __init__(self, cluster: LocalCluster, schema: SchemaService):
         self.cluster = cluster
         self.schema = schema
-        self._tablet_ids = itertools.count(200001)
+        self.next_tablet_id = 200001  # plain int: restorable across restarts
         self._lock = threading.RLock()
+
+    def _alloc_tablet_id(self) -> int:
+        with self._lock:
+            v = self.next_tablet_id
+            self.next_tablet_id += 1
+            return v
 
     # ---------------------------------------------------------- bootstrap
     @staticmethod
-    def bootstrap(n_nodes: int, n_ls: int) -> tuple[LocalCluster, "RootService"]:
-        cluster = LocalCluster(n_nodes=n_nodes)
+    def bootstrap(n_nodes: int, n_ls: int, data_dir: str | None = None,
+                  fsync: bool = True,
+                  finalize: bool = True) -> tuple[LocalCluster, "RootService"]:
+        """Build the cluster. finalize=False defers TransService creation +
+        initial election: a restarting node must recreate tablets and load
+        storage checkpoints BEFORE commit/replay can run (the reference's
+        staged ObServer::init ordering — storage before log service start,
+        ob_server.cpp:232/923)."""
+        cluster = LocalCluster(n_nodes=n_nodes, data_dir=data_dir, fsync=fsync)
         for ls in range(1, n_ls + 1):
             cluster.create_ls(ls)
-        cluster.finalize()
+        if finalize:
+            cluster.finalize()
         return cluster, RootService(cluster, SchemaService())
 
     # ---------------------------------------------------------- placement
@@ -58,7 +71,7 @@ class RootService:
         publish the schema version. Returns the TableInfo."""
         with self._lock:
             ls_id = self.choose_ls()
-            tablet_id = next(self._tablet_ids)
+            tablet_id = self._alloc_tablet_id()
             ti = info_factory(ls_id, tablet_id)
 
             def mutate(tables: dict):

@@ -23,6 +23,7 @@ code-order == string-order invariant holds on device.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 from dataclasses import dataclass, field
@@ -98,6 +99,56 @@ class TableInfo:
         return sd, remap
 
 
+class TxCatalog(dict):
+    """Catalog mapping with statement-scoped transaction overlays.
+
+    The shared dict holds COMMITTED snapshot Tables that every session
+    reads. A session with an open tx needs private views (BEGIN-time
+    snapshot plus its own staged rows); installing those into the shared
+    dict would let a concurrent session read uncommitted rows between that
+    tx's refresh and its own (advisor finding r1). Private views therefore
+    live on the _OpenTx and are ACTIVATED only for the duration of one of
+    that tx's statements via `tx_scope` — a thread-local pointer, so a
+    different session's statement on the same OR another thread never
+    resolves through them."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._tls = threading.local()
+
+    @contextlib.contextmanager
+    def tx_scope(self, views: dict | None):
+        prev = getattr(self._tls, "ov", None)
+        self._tls.ov = views
+        try:
+            yield
+        finally:
+            self._tls.ov = prev
+
+    def _overlay(self) -> dict | None:
+        return getattr(self._tls, "ov", None)
+
+    def is_private(self, name: str) -> bool:
+        ov = self._overlay()
+        return ov is not None and name in ov
+
+    def __getitem__(self, name):
+        ov = self._overlay()
+        if ov is not None and name in ov:
+            return ov[name]
+        return super().__getitem__(name)
+
+    def get(self, name, default=None):
+        ov = self._overlay()
+        if ov is not None and name in ov:
+            return ov[name]
+        return super().get(name, default)
+
+    def __contains__(self, name) -> bool:
+        ov = self._overlay()
+        return (ov is not None and name in ov) or super().__contains__(name)
+
+
 class Database:
     """An in-process replicated database: schema + cluster + analytic engine.
 
@@ -106,9 +157,32 @@ class Database:
     """
 
     def __init__(self, n_nodes: int = 3, n_ls: int = 2,
-                 extra_catalog: dict[str, Table] | None = None):
-        self.cluster, self.rootservice = RootService.bootstrap(n_nodes, n_ls)
+                 extra_catalog: dict[str, Table] | None = None,
+                 data_dir: str | None = None, fsync: bool = True):
+        # durable mode: palf logs + storage checkpoints + schema meta live
+        # under data_dir; a Database pointed at an existing dir restarts
+        # from disk (ckpt replay + palf replay — ob_server.cpp:923 analog)
+        self.data_dir = data_dir
+        self._fsync = fsync
+        self._unique_keys: dict[str, tuple[str, ...]] = {}
+        # tablet_id -> TableInfo, rebuilt lazily after DDL (apply-path hot)
+        self._ti_by_tablet: dict[int, TableInfo] | None = None
+        node_meta = self._load_node_meta() if data_dir is not None else None
+        if node_meta is not None:
+            n_nodes, n_ls = node_meta["n_nodes"], node_meta["n_ls"]
+        self.cluster, self.rootservice = RootService.bootstrap(
+            n_nodes, n_ls, data_dir=data_dir, fsync=fsync, finalize=False
+        )
         self.schema_service = self.rootservice.schema
+        if node_meta is not None:
+            self._restore_from_disk(node_meta)
+        # every applied record re-applies logged dictionary appends and
+        # advances GTS past restored commit versions (idempotent in normal
+        # operation; essential during boot-time replay)
+        for group in self.cluster.ls_groups.values():
+            for rep in group.values():
+                rep.on_record = self._on_applied_record
+        self.cluster.finalize()
         self.config = Config()
         self.location = LocationService(
             self.cluster.leader_node,
@@ -117,7 +191,7 @@ class Database:
         )
         # analytic catalog: table name -> snapshot Table (plus any read-only
         # preloaded tables, e.g. benchmark data)
-        self.catalog: dict[str, Table] = dict(extra_catalog or {})
+        self.catalog: dict[str, Table] = TxCatalog(extra_catalog or {})
         self.plan_cache = PlanCache(capacity=self.config["plan_cache_capacity"])
         self.config.on_change(
             "plan_cache_capacity",
@@ -154,6 +228,14 @@ class Database:
         self.config.on_change(
             "block_cache_size",
             lambda _n, _o, v: self.block_cache.set_capacity(v))
+        # restored tablets (and their sstables) come off disk without a
+        # cache: reattach
+        for t in self._all_tablets():
+            t.cache = self.block_cache
+            for ss in t.deltas:
+                ss.cache = self.block_cache
+            if t.base is not None:
+                t.base.cache = self.block_cache
         self.dag_scheduler = TenantDagScheduler()
         self.maintenance = MaintenanceService(
             self.dag_scheduler,
@@ -166,7 +248,6 @@ class Database:
 
         self.lock_mgr = LockManager()
 
-        self._unique_keys: dict[str, tuple[str, ...]] = {}
         self.engine = Session(
             self.catalog,
             unique_keys=self._unique_keys,
@@ -196,6 +277,153 @@ class Database:
         out = self.maintenance.tick()
         self.dag_scheduler.run_until_idle()
         return out
+
+    # -------------------------------------------------- node durability
+    def _meta_path(self) -> str:
+        import os
+
+        return os.path.join(self.data_dir, "node_meta.pkl")
+
+    def _ckpt_path(self, node: int, ls_id: int) -> str:
+        import os
+
+        return os.path.join(self.data_dir, f"n{node}", f"ls_{ls_id}", "ckpt.pkl")
+
+    def _load_node_meta(self) -> dict | None:
+        import os
+        import pickle
+
+        path = self._meta_path()
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def _save_node_meta(self) -> None:
+        """Persist schema + TableInfo state (the slog meta-redo analog,
+        collapsed to an atomic whole-snapshot at DDL/checkpoint time).
+        MUST be written after LS checkpoints within checkpoint(): the meta's
+        dictionaries have to cover every code referenced by checkpointed
+        tablet rows (later codes are recovered from logged dict_appends)."""
+        import pickle
+
+        if self.data_dir is None:
+            return
+        meta = {
+            "n_nodes": self.cluster.n_nodes,
+            "n_ls": len(self.cluster.ls_groups),
+            "tables": dict(self.tables),
+            "next_tablet_id": self.rootservice.next_tablet_id,
+        }
+        from ..share.fsutil import atomic_write
+
+        atomic_write(
+            self._meta_path(),
+            pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL),
+            fsync=self._fsync,
+        )
+
+    def _restore_from_disk(self, meta: dict) -> None:
+        """Boot-time recovery, BEFORE the first election: install LS storage
+        checkpoints, reinstall the schema, recreate tablets that postdate
+        the last checkpoint. Replay of entries (applied_lsn, commit] then
+        happens through the normal apply path once leaders elect."""
+        from ..storage.ckpt import read_ls_checkpoint, restore_ls_replica
+
+        for ls_id, group in self.cluster.ls_groups.items():
+            for node, rep in group.items():
+                st = read_ls_checkpoint(self._ckpt_path(node, ls_id))
+                if st is not None:
+                    restore_ls_replica(rep, st)
+                    # GTS must clear every restored commit version even if
+                    # no log records remain to replay (fully-applied ckpt)
+                    self.cluster.gts.advance_to(st.get("max_version", 0))
+                elif rep.palf.log.base > 0:
+                    raise RuntimeError(
+                        f"ls {ls_id} node {node}: log recycled to "
+                        f"{rep.palf.log.base} but no readable checkpoint; "
+                        "replica needs a snapshot rebuild"
+                    )
+        tables = meta["tables"]
+
+        def mutate(t: dict) -> None:
+            t.update(tables)
+
+        self.schema_service.apply_ddl(mutate)
+        for ti in tables.values():
+            ti.cached_data_version = -1
+            for rep in self.cluster.ls_groups[ti.ls_id].values():
+                if ti.tablet_id not in rep.tablets:
+                    rep.create_tablet(ti.tablet_id, ti.schema, ti.key_cols)
+            self._unique_keys[ti.name] = tuple(ti.key_cols)
+        self.rootservice.next_tablet_id = meta["next_tablet_id"]
+        self._ti_by_tablet = None
+
+    def _on_applied_record(self, rec) -> None:
+        """Observer of every applied tx record. Normal operation: keeps GTS
+        ahead of replicated commit versions. Boot replay: re-applies logged
+        dictionary appends (codes past the checkpointed dictionaries) —
+        idempotent because codes are dense and append-ordered."""
+        if rec.commit_version:
+            self.cluster.gts.advance_to(rec.commit_version)
+        if not rec.dict_appends:
+            return
+        by_tab = self._ti_by_tablet
+        if by_tab is None:
+            by_tab = self._ti_by_tablet = {
+                ti.tablet_id: ti for ti in self.tables.values()
+            }
+        for tab_id, col, code, s in rec.dict_appends:
+            ti = by_tab.get(tab_id)
+            if ti is None:
+                continue
+            d = ti.dicts.get(col)
+            if d is None:
+                continue
+            if code == len(d):
+                d.encode_one(s)
+            ti.logged_dict_len[col] = max(
+                ti.logged_dict_len.get(col, 0), code + 1
+            )
+
+    def checkpoint(self, recycle: bool = True) -> bool:
+        """slog-ckpt analog: snapshot every replica's storage state, then
+        persist schema meta; optionally recycle palf logs below each
+        checkpoint. Returns False if any replica skipped (uncommitted
+        leader-staged rows) — its log is kept whole and boot replays it."""
+        if self.data_dir is None:
+            return False
+        ok_all = True
+        from ..storage.ckpt import write_ls_checkpoint
+
+        done: list[tuple] = []
+        for ls_id, group in self.cluster.ls_groups.items():
+            for node, rep in group.items():
+                covered = write_ls_checkpoint(
+                    self._ckpt_path(node, ls_id), rep, fsync=self._fsync
+                )
+                if covered is not None:
+                    done.append((rep, covered))
+                else:
+                    ok_all = False
+        # meta BEFORE recycling: the checkpointed rows' dictionary codes
+        # must be durable in meta (or still recoverable from log records)
+        # at every instant — recycling first would open a crash window
+        # where neither survives
+        self._save_node_meta()
+        if recycle:
+            for rep, covered in done:
+                # recycle only what the WRITTEN snapshot covers — the live
+                # applied_lsn may have advanced past it since the pickle
+                rep.palf.recycle(covered + 1)
+        return ok_all
+
+    def close(self) -> None:
+        """Flush and release durable resources (log stores)."""
+        for group in self.cluster.ls_groups.values():
+            for rep in group.values():
+                if rep.palf.store is not None:
+                    rep.palf.store.close()
 
     # ------------------------------------------------------------ schema
     def _key_extra(self, table_names: tuple[str, ...]) -> tuple:
@@ -262,9 +490,11 @@ class Database:
             for rep in self.cluster.ls_groups[ti.ls_id].values():
                 rep.tablets[ti.tablet_id].cache = self.block_cache
             self._unique_keys[stmt.name] = tuple(pk)
+            self._ti_by_tablet = None
             self.catalog[stmt.name] = Table(stmt.name, schema, {
                 f.name: np.zeros(0, f.dtype.storage_np) for f in schema.fields
             })
+            self._save_node_meta()
 
     def drop_table(self, stmt: A.DropTable) -> None:
         with self._ddl_lock:
@@ -276,7 +506,9 @@ class Database:
                 raise SqlError(f"no such table {stmt.name}") from None
             self.catalog.pop(stmt.name, None)
             self._unique_keys.pop(stmt.name, None)
+            self._ti_by_tablet = None
             self.engine.executor.invalidate_table(stmt.name)
+            self._save_node_meta()
 
     # ---------------------------------------------------------- snapshots
     def _leader_replica(self, ti: TableInfo):
@@ -321,11 +553,15 @@ class Database:
                 if len(data[col]):
                     data[col] = remap[data[col]]
                 dicts[col] = sd
-            self.catalog[name] = Table(name, ti.schema, data, dicts)
-            self.engine.executor.invalidate_table(name)
+            t = Table(name, ti.schema, data, dicts)
             if in_tx:
-                ti.cached_data_version = -1  # force rebuild after tx ends
+                # tx-private view (BEGIN snapshot + own staged rows): lives
+                # on the tx, activated per-statement via catalog.tx_scope —
+                # never the shared committed entry other sessions read
+                tx.views[name] = t
             else:
+                self.catalog[name] = t
+                self.engine.executor.invalidate_table(name)
                 ti.cached_data_version = ti.data_version
 
     # ------------------------------------------------------------ session
@@ -348,6 +584,9 @@ class _OpenTx:
         self.svc = db.cluster.services[home]
         self.ctx = self.svc.begin()
         self.touched_tables: set[str] = set()
+        # tx-private catalog views (BEGIN snapshot + own staged rows),
+        # activated per-statement through TxCatalog.tx_scope
+        self.views: dict[str, Table] = {}
 
     def ensure_leader(self, ls_id: int) -> None:
         """Co-locate the LS leader with this tx's coordinating node (the
@@ -499,10 +738,13 @@ class DbSession:
         names = _tables_in_ast(ast)
         any_vt = self.db.refresh_virtual(names)
         self.db.refresh_catalog(names, tx=self._tx)
+        in_tx = self._tx is not None and self._tx.ctx is not None
+        views = self._tx.views if in_tx else None
         try:
-            rs = self.db.engine.run_ast(
-                ast, norm_key, use_cache=False if any_vt else None
-            )
+            with self.db.catalog.tx_scope(views):
+                rs = self.db.engine.run_ast(
+                    ast, norm_key, use_cache=False if any_vt else None
+                )
             # surfaces in the audit record; for DML the qualification
             # scan's plan reuse IS the statement's plan-cache behavior
             self._stmt_cache_hit = rs.plan_cache_hit
@@ -543,10 +785,22 @@ class DbSession:
         committed_ok = False
         try:
             if commit:
-                if touched:
-                    self.db.cluster.commit_sync(tx.svc, tx.ctx)
-                else:
-                    tx.svc.commit(tx.ctx)  # empty tx: finishes immediately
+                try:
+                    if touched:
+                        self.db.cluster.commit_sync(tx.svc, tx.ctx)
+                    else:
+                        tx.svc.commit(tx.ctx)  # empty tx: finishes immediately
+                except Exception:
+                    # commit failed before a decision was logged: abort so the
+                    # staged rows don't stay undecided forever (which would
+                    # block later writers and pin frozen memtables). A tx in
+                    # COMMITTING has its decision in flight and must converge
+                    # on its own; abort() refuses that case.
+                    from ..tx.txn import TxState
+
+                    if not tx.ctx.is_done and tx.ctx.state is not TxState.COMMITTING:
+                        tx.svc.abort(tx.ctx)
+                    raise
                 committed_ok = True
             else:
                 tx.svc.abort(tx.ctx)

@@ -59,6 +59,17 @@ class Memtable:
     def _node_cost(self) -> int:
         return 48 + 16 * len(self.schema)
 
+    # Checkpoint serialization (storage/slog_ckpt analog): locks are
+    # runtime-only state, recreated on load.
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.RLock()
+
     # ---------------------------------------------------------- writes
     def stage(self, tx_id: int, read_snapshot: int, key: tuple, op: int,
               values: tuple | None) -> None:

@@ -228,6 +228,20 @@ class SSTable:
         self._col_dtype[VERSION_COL] = np.dtype(np.int64)
         self._col_dtype[OP_COL] = np.dtype(np.int8)
 
+    # Checkpoint serialization (storage/slog_ckpt analog): persist the raw
+    # blob only — memoryviews/np views/cache are rebuilt by __init__; the
+    # block cache is runtime-only and reattached by the owner (fresh uid
+    # keys mean no stale cache hits).
+    def __getstate__(self):
+        return {
+            "buf": bytes(self.buf),
+            "schema": self.schema,
+            "key_cols": self.key_cols,
+        }
+
+    def __setstate__(self, d):
+        self.__init__(d["buf"], d["schema"], d["key_cols"], cache=None)
+
     @staticmethod
     def open_file(path: str, schema: Schema, key_cols: list[str]) -> "SSTable":
         import mmap

@@ -59,6 +59,20 @@ class Tablet:
         if self.active is None:
             self.active = Memtable(self.schema, self.key_cols)
 
+    # Checkpoint serialization (storage/slog_ckpt analog): locks and the
+    # block cache are runtime-only, recreated/reattached on load.
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_meta_lock", None)
+        d.pop("_maint_lock", None)
+        d["cache"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._meta_lock = threading.RLock()
+        self._maint_lock = threading.RLock()
+
     # ------------------------------------------------------------ write
     def stage(self, tx_id: int, read_snapshot: int, key: tuple, op: int,
               values: tuple | None) -> "Memtable":

@@ -27,6 +27,9 @@ class LocalCluster:
     gts: GtsService = None  # type: ignore[assignment]
     ls_groups: dict[int, dict[int, LSReplica]] = field(default_factory=dict)
     services: dict[int, TransService] = field(default_factory=dict)
+    # durable mode: palf logs live under {data_dir}/n{node}/ls_{ls}
+    data_dir: str | None = None
+    fsync: bool = True
     _next_ls_base: int = 0
 
     def __post_init__(self):
@@ -41,6 +44,7 @@ class LocalCluster:
         group = make_ls_group(
             ls_id, list(range(self.n_nodes)), self.bus,
             palf_id_base=self._next_ls_base,
+            data_dir=self.data_dir, fsync=self.fsync,
         )
         self._next_ls_base += 1000
         self.ls_groups[ls_id] = group

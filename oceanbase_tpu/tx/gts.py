@@ -32,9 +32,12 @@ class GtsService:
             return self._last
 
     def current(self) -> int:
-        """A read snapshot: >= every previously issued ts, without burning
-        the sequence forward more than necessary."""
-        return self.next_ts()
+        """A read snapshot: >= every previously issued ts. Does NOT burn a
+        sequence slot (ObTsMgr serves reads from its local cache the same
+        way, ob_ts_mgr.h:358): the last issued ts already dominates every
+        committed commit version, which is all a snapshot needs."""
+        with self._lock:
+            return self._last
 
     def advance_to(self, ts: int) -> None:
         """Fast-forward past restored/replayed history so new timestamps

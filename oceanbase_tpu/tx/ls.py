@@ -46,6 +46,10 @@ class LSReplica:
     # follower-side redo retained from PREPARE until COMMIT/ABORT
     _pending_redo: dict[int, tuple[Mutation, ...]] = field(default_factory=dict)
     on_tx_applied: Callable[[int, RecordType, int], None] | None = None
+    # observer of every applied record (the multi-data-source consumer
+    # analog): the server layer uses it to re-apply logged dictionary
+    # appends and advance GTS during boot-time replay
+    on_record: Callable[[TxRecord], None] | None = None
 
     def __post_init__(self):
         self.palf.on_commit = self._apply
@@ -87,6 +91,8 @@ class LSReplica:
         if not entry.payload:
             return  # leadership no-op entry
         rec = TxRecord.from_bytes(entry.payload)
+        if self.on_record is not None:
+            self.on_record(rec)
         staged = rec.tx_id in self._locally_staged
         if rec.rtype is RecordType.REDO_COMMIT:
             if staged:
@@ -141,14 +147,28 @@ def make_ls_group(
     node_ids: list[int],
     bus: LocalBus,
     palf_id_base: int = 0,
+    data_dir: str | None = None,
+    fsync: bool = True,
 ) -> dict[int, LSReplica]:
     """Create one LS's replicas across nodes sharing a bus.
 
     Bus addresses must be unique per (ls, node): address = base + node_id.
+    With data_dir, each replica gets a durable LogStore at
+    `{data_dir}/n{node}/ls_{ls}` and reloads any existing log + election
+    meta from it (restart recovery).
     """
     addrs = [palf_id_base + n for n in node_ids]
     out = {}
     for n in node_ids:
-        palf = PalfReplica(palf_id_base + n, addrs, bus)
+        store = None
+        if data_dir is not None:
+            import os
+
+            from ..log.store import LogStore
+
+            store = LogStore(
+                os.path.join(data_dir, f"n{n}", f"ls_{ls_id}"), fsync=fsync
+            )
+        palf = PalfReplica(palf_id_base + n, addrs, bus, store=store)
         out[n] = LSReplica(ls_id, n, palf)
     return out

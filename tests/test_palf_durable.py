@@ -1,0 +1,286 @@
+"""Durable palf: disk log store, persisted election meta, restart recovery.
+
+Mirrors the reference's palf durability surface: LogStorage block files +
+LogIOWorker ordered appends (logservice/palf/log_engine.h, log_io_worker.h),
+persisted proposal/vote meta, and boot-time replay (ob_server.cpp:923).
+"""
+
+import os
+
+import pytest
+
+from oceanbase_tpu.log import LocalBus, LogEntry, LogStore, PalfReplica, Role
+from oceanbase_tpu.log.palf import LogView, leader_of, run_until
+from oceanbase_tpu.log.store import SEGMENT_ENTRIES
+
+
+# ---- LogStore unit behavior -------------------------------------------------
+
+
+def _mk_entries(n, term=1, start=0):
+    return [LogEntry(start + i, term, start + i + 1, f"e{start + i}".encode())
+            for i in range(n)]
+
+
+def test_store_append_sync_load_roundtrip(tmp_path):
+    st = LogStore(str(tmp_path / "ls1"), fsync=False)
+    ents = _mk_entries(10)
+    st.append(ents)
+    st.sync()
+    st.close()
+
+    st2 = LogStore(str(tmp_path / "ls1"), fsync=False)
+    loaded, base, term, voted = st2.load()
+    assert loaded == ents
+    assert base == 0 and term == 0 and voted is None
+
+
+def test_store_meta_roundtrip(tmp_path):
+    st = LogStore(str(tmp_path / "m"), fsync=False)
+    st.save_meta(7, 2)
+    st2 = LogStore(str(tmp_path / "m"), fsync=False)
+    _, _, term, voted = st2.load()
+    assert (term, voted) == (7, 2)
+    st.save_meta(9, None)
+    st3 = LogStore(str(tmp_path / "m"), fsync=False)
+    _, _, term, voted = st3.load()
+    assert (term, voted) == (9, None)
+
+
+def test_store_torn_tail_truncated_on_load(tmp_path):
+    st = LogStore(str(tmp_path / "t"), fsync=False)
+    ents = _mk_entries(5)
+    st.append(ents)
+    st.sync()
+    st.close()
+    seg = tmp_path / "t" / "seg_00000000.plog"
+    # simulate a crash mid-append: chop the last record in half
+    data = seg.read_bytes()
+    seg.write_bytes(data[: len(data) - 3])
+
+    st2 = LogStore(str(tmp_path / "t"), fsync=False)
+    loaded, base, _, _ = st2.load()
+    assert loaded == ents[:4]
+    # resumed appends don't bury partial bytes
+    st2.append([ents[4]])
+    st2.sync()
+    st2.close()
+    st3 = LogStore(str(tmp_path / "t"), fsync=False)
+    loaded, _, _, _ = st3.load()
+    assert loaded == ents
+
+
+def test_store_truncate_from(tmp_path):
+    st = LogStore(str(tmp_path / "tr"), fsync=False)
+    st.append(_mk_entries(10))
+    st.sync()
+    st.truncate_from(4)
+    st.append([LogEntry(4, 2, 100, b"new4")])
+    st.sync()
+    st.close()
+    loaded, _, _, _ = LogStore(str(tmp_path / "tr"), fsync=False).load()
+    assert [e.lsn for e in loaded] == list(range(5))
+    assert loaded[4].payload == b"new4"
+    assert loaded[3].payload == b"e3"
+
+
+def test_store_segment_rotation_and_recycle(tmp_path):
+    st = LogStore(str(tmp_path / "seg"), fsync=False)
+    n = SEGMENT_ENTRIES * 2 + 10
+    ents = [LogEntry(i, 1, i + 1, b"x") for i in range(n)]
+    st.append(ents)
+    st.sync()
+    assert len(st._segments()) == 3
+    st.set_base_info(SEGMENT_ENTRIES * 2 - 1, 1)
+    removed = st.recycle(SEGMENT_ENTRIES * 2)
+    assert removed == 2
+    st.close()
+    st2 = LogStore(str(tmp_path / "seg"), fsync=False)
+    loaded, base, _, _ = st2.load()
+    assert base == SEGMENT_ENTRIES * 2
+    assert loaded[0].lsn == SEGMENT_ENTRIES * 2
+    assert st2.base_prev_term == 1
+
+
+# ---- LogView ---------------------------------------------------------------
+
+
+def test_logview_base_offset_indexing():
+    ents = [LogEntry(5 + i, 1, i, b"p") for i in range(5)]
+    v = LogView(5, ents, base_prev_term=3)
+    assert len(v) == 10
+    assert v[5].lsn == 5 and v[-1].lsn == 9
+    assert [e.lsn for e in v[6:8]] == [6, 7]
+    assert [e.lsn for e in v[0:7]] == [5, 6]  # recycled prefix elided
+    assert v.term_at(4) is None and v.term_at(5) == 1
+    with pytest.raises(IndexError):
+        v[4]
+    del v[8:]
+    assert len(v) == 8
+
+
+# ---- replica restart recovery ----------------------------------------------
+
+
+def _cluster(tmp_path, n=3, fsync=False):
+    bus = LocalBus()
+    reps = []
+    for i in range(n):
+        st = LogStore(str(tmp_path / f"n{i}"), fsync=fsync)
+        reps.append(PalfReplica(node_id=i, peers=list(range(n)), bus=bus, store=st))
+    return bus, reps
+
+
+def test_replica_restart_recovers_log_and_term(tmp_path):
+    bus, reps = _cluster(tmp_path)
+    assert run_until(bus, reps, lambda: leader_of(reps) is not None)
+    lead = leader_of(reps)
+    for i in range(20):
+        assert lead.submit_log(f"p{i}".encode()) is not None
+    assert run_until(
+        bus, reps,
+        lambda: lead.commit_lsn >= 20
+        and all(r.commit_lsn == lead.commit_lsn for r in reps),
+    )
+
+    # "crash" follower 's' (drop the object), then restart from its store
+    s = next(r for r in reps if r is not lead)
+    sid = s.node_id
+    pre_log_len = len(s.log)
+    pre_term = s.term
+    bus.kill(sid)
+    reps.remove(s)
+    del s
+
+    bus.revive(sid)
+    st = LogStore(str(tmp_path / f"n{sid}"), fsync=False)
+    s2 = PalfReplica(node_id=sid, peers=[0, 1, 2], bus=bus, store=st)
+    assert len(s2.log) == pre_log_len
+    assert s2.term == pre_term
+    reps.append(s2)
+
+    # it rejoins and receives new entries
+    lead = leader_of(reps)
+    lead.submit_log(b"after-restart")
+    assert run_until(bus, reps, lambda: s2.commit_lsn == lead.commit_lsn)
+    assert s2.log[len(s2.log) - 1].payload == b"after-restart"
+
+
+def test_full_cluster_restart_preserves_committed_log(tmp_path):
+    bus, reps = _cluster(tmp_path)
+    assert run_until(bus, reps, lambda: leader_of(reps) is not None)
+    lead = leader_of(reps)
+    payloads = [f"entry-{i}".encode() for i in range(15)]
+    for p in payloads:
+        lead.submit_log(p)
+    assert run_until(
+        bus, reps,
+        lambda: lead.commit_lsn >= 15
+        and all(r.commit_lsn == lead.commit_lsn for r in reps),
+    )
+    committed = [e.payload for e in lead.log[: lead.commit_lsn + 1] if e.payload]
+    del bus, reps, lead
+
+    # cold restart: brand-new bus, replicas built purely from disk
+    bus2 = LocalBus()
+    reps2 = []
+    for i in range(3):
+        st = LogStore(str(tmp_path / f"n{i}"), fsync=False)
+        reps2.append(PalfReplica(node_id=i, peers=[0, 1, 2], bus=bus2, store=st))
+    assert run_until(bus2, reps2, lambda: leader_of(reps2) is not None)
+    lead2 = leader_of(reps2)
+    # the new leader's no-op commit re-commits the whole inherited prefix
+    assert run_until(bus2, reps2, lambda: lead2.commit_lsn >= len(committed) - 1)
+    assert [e.payload for e in lead2.log[: lead2.commit_lsn + 1] if e.payload] == committed
+
+
+def test_vote_survives_restart_no_double_vote(tmp_path):
+    """A replica that granted a vote must come back remembering it."""
+    bus = LocalBus()
+    st = LogStore(str(tmp_path / "voter"), fsync=False)
+    voter = PalfReplica(node_id=0, peers=[0, 1, 2], bus=bus, store=st)
+    from oceanbase_tpu.log.palf import VoteReq
+
+    voter._on_vote_req(1, VoteReq(term=5, candidate_id=1, last_lsn=-1, last_term=0))
+    assert voter.voted_for == 1 and voter.term == 5
+
+    st2 = LogStore(str(tmp_path / "voter"), fsync=False)
+    bus2 = LocalBus()
+    voter2 = PalfReplica(node_id=0, peers=[0, 1, 2], bus=bus2, store=st2)
+    assert voter2.term == 5
+    assert voter2.voted_for == 1
+    # same-term vote request from a DIFFERENT candidate is refused
+    got = []
+    bus2.register(2, lambda src, m: got.append(m))
+    voter2._on_vote_req(2, VoteReq(term=5, candidate_id=2, last_lsn=-1, last_term=0))
+    bus2.advance(0.01)
+    assert got and got[-1].granted is False
+
+
+def test_follower_truncation_mirrored_to_disk(tmp_path):
+    """Conflicting-suffix reconciliation must reach the store: a follower
+    that crashed after divergence reloads the reconciled log."""
+    from oceanbase_tpu.log.palf import AppendReq
+
+    bus = LocalBus()
+    st = LogStore(str(tmp_path / "f"), fsync=False)
+    f = PalfReplica(node_id=0, peers=[0, 1, 2], bus=bus, store=st)
+    # term-1 leader streams 3 uncommitted entries
+    e1 = [LogEntry(i, 1, i + 1, f"old{i}".encode()) for i in range(3)]
+    f._on_append(1, AppendReq(1, 1, -1, 0, tuple(e1), -1))
+    assert len(f.log) == 3
+    # term-2 leader rewrites the suffix from lsn 1
+    e2 = [LogEntry(1, 2, 10, b"new1"), LogEntry(2, 2, 11, b"new2")]
+    f._on_append(2, AppendReq(2, 2, 0, 1, tuple(e2), -1))
+    assert f.log[1].payload == b"new1"
+
+    st2 = LogStore(str(tmp_path / "f"), fsync=False)
+    loaded, _, _, _ = st2.load()
+    assert [e.payload for e in loaded] == [b"old0", b"new1", b"new2"]
+
+
+def test_recycle_then_restart_and_catchup(tmp_path):
+    """Recycled prefix: restart from a base > 0 and keep participating."""
+    bus, reps = _cluster(tmp_path)
+    assert run_until(bus, reps, lambda: leader_of(reps) is not None)
+    lead = leader_of(reps)
+    for i in range(50):
+        lead.submit_log(f"r{i}".encode())
+    assert run_until(
+        bus, reps,
+        lambda: lead.commit_lsn >= 50
+        and all(r.commit_lsn == lead.commit_lsn for r in reps),
+    )
+    for r in reps:
+        r.recycle(40)
+        assert r.log.base == 40
+
+    # everyone keeps working with the recycled prefix
+    pre = lead.commit_lsn
+    lead.submit_log(b"post-recycle")
+    assert run_until(
+        bus, reps,
+        lambda: lead.commit_lsn > pre
+        and all(r.commit_lsn == lead.commit_lsn for r in reps),
+    )
+
+    # note: disk recycling removes whole segments only; at this scale the
+    # tail segment still holds everything, so a restart reloads base=0 —
+    # the in-memory clamp above is what recycling guarantees. Segment-level
+    # disk recycling is covered in test_store_segment_rotation_and_recycle.
+    sid = reps[0].node_id
+    bus.kill(sid)
+    old = reps.pop(0)
+    del old
+    bus.revive(sid)
+    st = LogStore(str(tmp_path / f"n{sid}"), fsync=False)
+    r2 = PalfReplica(node_id=sid, peers=[0, 1, 2], bus=bus, store=st)
+    reps.append(r2)
+    lead = leader_of(reps)
+    if lead is not None:
+        lead.submit_log(b"after")
+    assert run_until(
+        bus, reps,
+        lambda: leader_of(reps) is not None
+        and all(r.commit_lsn == leader_of(reps).commit_lsn for r in reps),
+    )

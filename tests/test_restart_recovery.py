@@ -1,0 +1,217 @@
+"""Node restart recovery: boot = storage checkpoint + palf log replay.
+
+The RPO=0 capability at the SQL level (VERDICT r1 item 1): kill a Database
+holding committed data, rebuild it from its data_dir, and every committed
+row (including VARCHAR dictionary state) is served again. Mirrors
+ObServer::start's slog-ckpt replay + palf replay (ob_server.cpp:923).
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+
+
+def _mkdb(tmp_path, **kw):
+    return Database(n_nodes=3, n_ls=2, data_dir=str(tmp_path / "node"),
+                    fsync=False, **kw)
+
+
+def test_restart_replays_log_without_checkpoint(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table t (k bigint primary key, v bigint not null, "
+          "name varchar(16) not null)")
+    s.sql("insert into t values (1, 10, 'ann'), (2, 20, 'bob'), (3, 30, 'cy')")
+    s.sql("update t set v = 25 where k = 2")
+    s.sql("delete from t where k = 3")
+    db.close()
+    del db
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    rs = s2.sql("select k, v, name from t order by k")
+    assert rs.rows() == [(1, 10, "ann"), (2, 25, "bob")]
+    # the restarted cluster accepts new commits (GTS moved past history)
+    s2.sql("insert into t values (4, 40, 'dee')")
+    assert s2.sql("select count(*) as c from t").rows() == [(3,)]
+    db2.close()
+
+
+def test_restart_from_checkpoint_plus_log_tail(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table acc (k bigint primary key, owner varchar(16) not null)")
+    s.sql("insert into acc values (1, 'alice'), (2, 'bob')")
+    assert db.checkpoint()
+    # post-checkpoint activity: new rows AND new dictionary codes must come
+    # back from log replay on top of the checkpoint
+    s.sql("insert into acc values (3, 'carol')")
+    s.sql("update acc set owner = 'zed' where k = 1")
+    db.close()
+    del db
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    assert s2.sql("select k, owner from acc order by k").rows() == [
+        (1, "zed"), (2, "bob"), (3, "carol")
+    ]
+    db2.close()
+
+
+def test_checkpoint_recycles_palf_log(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table r (k bigint primary key)")
+    for i in range(30):
+        s.sql(f"insert into r values ({i})")
+    assert db.checkpoint()
+    bases = [
+        rep.palf.log.base
+        for g in db.cluster.ls_groups.values() for rep in g.values()
+    ]
+    assert any(b > 0 for b in bases), "no replica advanced its recycle point"
+    # cluster still fully operational after recycling
+    s.sql("insert into r values (100)")
+    assert s.sql("select count(*) as c from r").rows() == [(31,)]
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    assert db2.session().sql("select count(*) as c from r").rows() == [(31,)]
+    db2.close()
+
+
+def test_ddl_after_checkpoint_survives_restart(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table a (k bigint primary key, v bigint not null)")
+    s.sql("insert into a values (1, 1)")
+    assert db.checkpoint()
+    s.sql("create table b (k bigint primary key, s varchar(8) not null)")
+    s.sql("insert into b values (7, 'x')")
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    assert s2.sql("select v from a where k = 1").rows() == [(1,)]
+    assert s2.sql("select s from b where k = 7").rows() == [("x",)]
+    # tablet id allocation resumes past restored tables
+    s2.sql("create table c (k bigint primary key)")
+    tis = db2.tables
+    assert tis["c"].tablet_id > max(tis["a"].tablet_id, tis["b"].tablet_id)
+    db2.close()
+
+
+def test_restart_preserves_snapshot_isolation_versions(tmp_path):
+    """Commit versions restored from the log keep MVCC ordering: a new
+    statement's snapshot covers all pre-crash commits."""
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table m (k bigint primary key, v bigint not null)")
+    for i in range(5):
+        s.sql(f"update m set v = {i} where k = 0") if i else s.sql(
+            "insert into m values (0, 0)")
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    assert s2.sql("select v from m").rows() == [(4,)]
+    s2.sql("update m set v = 99 where k = 0")
+    assert s2.sql("select v from m").rows() == [(99,)]
+    db2.close()
+
+
+def test_double_restart(tmp_path):
+    """Restart of a restarted node (checkpoint written by the second life)."""
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table d (k bigint primary key, w varchar(8) not null)")
+    s.sql("insert into d values (1, 'one')")
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    s2.sql("insert into d values (2, 'two')")
+    assert db2.checkpoint()
+    s2.sql("insert into d values (3, 'three')")
+    db2.close()
+
+    db3 = _mkdb(tmp_path)
+    assert db3.session().sql("select k, w from d order by k").rows() == [
+        (1, "one"), (2, "two"), (3, "three")
+    ]
+    db3.close()
+
+
+def test_checkpoint_after_freeze_with_sstables(tmp_path):
+    """Checkpointing a tablet whose data reached SSTables (post-freeze) must
+    work and restore: sstable blobs serialize, caches reattach."""
+    db = _mkdb(tmp_path)
+    db.config.set("memstore_limit", 20_000)
+    db.config.set("freeze_trigger_ratio", 0.2)
+    s = db.session()
+    s.sql("create table big (k bigint primary key, v bigint not null)")
+    for b in range(4):
+        s.sql("insert into big values " + ",".join(
+            f"({b * 60 + i}, {b})" for i in range(60)))
+    db.run_maintenance()
+    has_sstables = any(
+        t.deltas or t.base is not None for t in db._all_tablets()
+    )
+    assert has_sstables, "test setup: no sstables materialized"
+    assert db.checkpoint()
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    assert s2.sql("select count(*) as c from big").rows() == [(240,)]
+    assert s2.sql("select sum(v) as s from big where k < 120").rows() == [(60,)]
+    # restored sstables participate in the cache again
+    for t in db2._all_tablets():
+        for ss in t.deltas:
+            assert ss.cache is db2.block_cache
+    db2.close()
+
+
+def test_failover_works_after_checkpoint_recycle(tmp_path):
+    """Elections must survive a fully-recycled in-memory log (the post-
+    checkpoint state): kill the leader node, a new one takes over."""
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table f (k bigint primary key)")
+    s.sql("insert into f values (1), (2)")
+    assert db.checkpoint()
+    ls_id = min(db.cluster.ls_groups)
+    old = db.cluster.leader_node(ls_id)
+    db.cluster.kill_node(old)
+    new = db.cluster.leader_node(ls_id)  # raises if no leader elected
+    assert new != old
+    db.cluster.bus.revive(
+        db.cluster.ls_groups[ls_id][old].palf.node_id
+    )
+    db.close()
+
+
+def test_fully_applied_checkpoint_restart_sees_data(tmp_path):
+    """Reopen after a checkpoint that covers EVERY record (no log left to
+    replay): the GTS high-water must come from the checkpoint itself, or
+    restored rows are invisible at snapshot 0 (r2 review repro)."""
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table q (k bigint primary key, v bigint not null)")
+    s.sql("insert into q values (1, 11), (2, 22)")
+    # drive every replica to full application so boot has nothing to replay
+    db.cluster.settle(2.0)
+    for g in db.cluster.ls_groups.values():
+        for rep in g.values():
+            assert rep.palf.applied_lsn == rep.palf.commit_lsn
+    assert db.checkpoint()
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    s2 = db2.session()
+    assert s2.sql("select k, v from q order by k").rows() == [(1, 11), (2, 22)]
+    # new commits land ABOVE restored versions (not shadowed by history)
+    assert s2.sql("update q set v = 99 where k = 1").affected == 1
+    assert s2.sql("select v from q where k = 1").rows() == [(99,)]
+    db2.close()
