@@ -121,10 +121,14 @@ def _dict_domain(batch: ColumnBatch, e: E.Expr) -> int | None:
 
 
 class Executor:
-    def __init__(self, catalog, unique_keys=None, default_rows_estimate=1 << 16):
+    def __init__(self, catalog, unique_keys=None, default_rows_estimate=1 << 16,
+                 stats=None):
         self.catalog = catalog
         self.unique_keys = unique_keys or {}
         self.default_rows_estimate = default_rows_estimate
+        # share/stats.StatsManager: NDV/histogram-backed cardinalities for
+        # static capacities (None = heuristic constants)
+        self.stats = stats
         self._batch_cache: dict[tuple[str, tuple], ColumnBatch] = {}
 
     # ---- input preparation -------------------------------------------
@@ -184,21 +188,29 @@ class Executor:
             del self._batch_cache[key]
 
     def table_batch(self, name: str, cols: tuple[str, ...]) -> ColumnBatch:
+        is_private = getattr(self.catalog, "is_private", None)
+        if is_private is not None and is_private(name):
+            # tx-private view: never enters (or reads) the shared device
+            # cache, so other sessions can't see uncommitted rows
+            return self._build_batch(name, cols)
         key = (name, cols)
         if key not in self._batch_cache:
-            t = self.catalog[name]
-            sub_schema = Schema(
-                tuple(f for f in t.schema.fields if f.name in cols)
-            )
-            from ..core.column import make_batch
-
-            self._batch_cache[key] = make_batch(
-                {c: t.data[c] for c in sub_schema.names()},
-                sub_schema,
-                {c: d for c, d in t.dicts.items() if c in cols},
-                valid={c: v for c, v in t.valid.items() if c in cols},
-            )
+            self._batch_cache[key] = self._build_batch(name, cols)
         return self._batch_cache[key]
+
+    def _build_batch(self, name: str, cols: tuple[str, ...]) -> ColumnBatch:
+        t = self.catalog[name]
+        sub_schema = Schema(
+            tuple(f for f in t.schema.fields if f.name in cols)
+        )
+        from ..core.column import make_batch
+
+        return make_batch(
+            {c: t.data[c] for c in sub_schema.names()},
+            sub_schema,
+            {c: d for c, d in t.dicts.items() if c in cols},
+            valid={c: v for c, v in t.valid.items() if c in cols},
+        )
 
     # ---- physical parameter seeding ----------------------------------
     def _est_rows(self, op) -> float:
@@ -206,11 +218,16 @@ class Executor:
         layer's distribution-method choice)."""
         est_rows = self._est_rows
         if isinstance(op, Scan):
-            base = self.catalog[op.table].nrows or 1
+            t = self.catalog[op.table]
+            base = t.nrows or 1
             if op.pushed_filter is not None:
-                base *= 0.25 ** min(
-                    len(self._conjuncts(op.pushed_filter)), 3
-                )
+                ts = self.stats.table_stats(op.table) if self.stats else None
+                if ts is not None and ts.nrows > 0:
+                    base *= ts.selectivity(op.pushed_filter, t)
+                else:
+                    base *= 0.25 ** min(
+                        len(self._conjuncts(op.pushed_filter)), 3
+                    )
             return max(base, 1.0)
         if isinstance(op, Filter):
             return max(est_rows(op.child) * 0.5, 1.0)
@@ -225,9 +242,20 @@ class Executor:
                 return l if self._is_scalar_relation(op.right) else l * r
             if self._join_build_unique(op):
                 return l
+            # M:N equi-join: |L||R| / max(ndv(Lkeys), ndv(Rkeys)) — the
+            # textbook containment estimate (ob_opt_selectivity analog)
+            lndv = self._keys_ndv(op.left, op.left_keys)
+            rndv = self._keys_ndv(op.right, op.right_keys)
+            if lndv is not None and rndv is not None:
+                denom = max(min(lndv, l), min(rndv, r), 1.0)
+                return max((l * r) / denom, 1.0)
             return max(l, r) * 2
         if isinstance(op, Aggregate):
-            return min(est_rows(op.child), float(self.default_rows_estimate))
+            child = est_rows(op.child)
+            nd = self._group_ndv(op)
+            if nd is not None:
+                return max(min(child, nd), 1.0)
+            return min(child, float(self.default_rows_estimate))
         if isinstance(op, (Project, Sort, Distinct)):
             return est_rows(op.child)
         if isinstance(op, Limit):
@@ -241,8 +269,15 @@ class Executor:
 
         for nid, op in nodes.items():
             if isinstance(op, Aggregate):
+                # hash-table capacity: group-count estimate when NDV stats
+                # resolve (margin absorbs sampling error), else child rows
+                nd = self._group_ndv(op)
+                target = (
+                    min(est_rows(op.child), nd * 1.5 + 64)
+                    if nd is not None else est_rows(op.child)
+                )
                 params.groupby_size[nid] = next_pow2(
-                    int(2 * min(est_rows(op.child), 1 << 21)) + 16
+                    int(2 * min(target, 1 << 21)) + 16
                 )
             if isinstance(op, Distinct):
                 params.groupby_size[nid] = next_pow2(
@@ -270,6 +305,47 @@ class Executor:
         from ..sql.planner import split_conjuncts
 
         return split_conjuncts(e)
+
+    def _keys_ndv(self, side: LogicalOp, keys) -> float | None:
+        """Product of base-column NDVs for join keys resolvable to scans of
+        `side` (None when any key isn't a plain column or stats are off)."""
+        if self.stats is None:
+            return None
+        amap = {s.alias: s.table for s in self._collect_scans(side)}
+        prod = 1.0
+        for k in keys:
+            if not isinstance(k, E.ColRef) or "." not in k.name:
+                return None
+            a, c = k.name.split(".", 1)
+            tname = amap.get(a)
+            if tname is None:
+                return None
+            ts = self.stats.table_stats(tname)
+            nd = ts.ndv_of(c) if ts is not None else None
+            if nd is None or nd <= 0:
+                return None
+            prod *= nd
+        return prod
+
+    def _group_ndv(self, op: Aggregate) -> float | None:
+        """Product of group-key NDVs (grouping cardinality upper bound)."""
+        if self.stats is None or not op.group_keys:
+            return None
+        prod = 1.0
+        amap = {s.alias: s.table for s in self._collect_scans(op.child)}
+        for _name, e in op.group_keys:
+            if not isinstance(e, E.ColRef) or "." not in e.name:
+                return None
+            a, c = e.name.split(".", 1)
+            tname = amap.get(a)
+            if tname is None:
+                return None
+            ts = self.stats.table_stats(tname)
+            nd = ts.ndv_of(c) if ts is not None else None
+            if nd is None or nd <= 0:
+                return None
+            prod *= nd
+        return prod
 
     @staticmethod
     def _is_scalar_relation(node: LogicalOp) -> bool:

@@ -53,8 +53,13 @@ class Session:
                  plan_cache: PlanCache | None = None, key_extra_fn=None,
                  cache_enabled_fn=None, plan_monitor=None):
         self.catalog = catalog
-        self.planner = Planner(catalog)
-        self.executor = Executor(catalog, unique_keys=unique_keys)
+        from ..share.stats import StatsManager
+
+        self.stats = StatsManager(catalog)
+        self.planner = Planner(catalog, stats=self.stats)
+        self.executor = Executor(
+            catalog, unique_keys=unique_keys, stats=self.stats
+        )
         # shareable across sessions (the reference's cache is per-tenant,
         # not per-session: ob_plan_cache.h:227)
         self.plan_cache = plan_cache if plan_cache is not None else PlanCache()

@@ -144,15 +144,21 @@ def _contains_subquery(node: A.Node) -> bool:
 class Planner:
     def __init__(self, catalog, stats=None):
         self.catalog = catalog  # name -> Table
-        self.stats = stats or {}
+        # share/stats.StatsManager (None = heuristic-only estimates)
+        self.stats = stats
         self.ctes: dict[str, A.Select] = {}
 
-    # -- cardinality guesses ------------------------------------------
+    # -- cardinality estimates (stats-backed with heuristic fallback) --
     def _scan_rows(self, scan: Scan) -> float:
-        base = self.catalog[scan.table].nrows or 1
+        t = self.catalog[scan.table]
+        base = t.nrows or 1
         if scan.pushed_filter is not None:
-            n_conj = len(split_conjuncts(scan.pushed_filter))
-            base = base * (0.25 ** min(n_conj, 3))
+            ts = self.stats.table_stats(scan.table) if self.stats else None
+            if ts is not None and ts.nrows > 0:
+                base = base * ts.selectivity(scan.pushed_filter, t)
+            else:
+                n_conj = len(split_conjuncts(scan.pushed_filter))
+                base = base * (0.25 ** min(n_conj, 3))
         return max(base, 1.0)
 
     def _rel_rows(self, rel: Relation) -> float:
