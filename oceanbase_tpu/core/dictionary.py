@@ -80,6 +80,29 @@ class Dictionary:
             np.int32
         )
 
+    @staticmethod
+    def merge(
+        dl: "Dictionary | None", dr: "Dictionary | None"
+    ) -> tuple["Dictionary | None", np.ndarray | None, np.ndarray | None]:
+        """Common dictionary for combining two dict-encoded columns (set
+        operations, cross-table comparisons). Returns (merged, remap_left,
+        remap_right); a None remap means codes pass through unchanged."""
+        if dr is None or dl is dr:
+            return dl, None, None
+        if dl is None:
+            return dr, None, None
+        if dl._values == dr._values:
+            return dl, None, None
+        merged_vals = sorted(set(dl._values) | set(dr._values))
+        merged = Dictionary(merged_vals, sorted_=True)
+        lmap = np.fromiter(
+            (merged._index[v] for v in dl._values), np.int32, len(dl._values)
+        )
+        rmap = np.fromiter(
+            (merged._index[v] for v in dr._values), np.int32, len(dr._values)
+        )
+        return merged, lmap, rmap
+
     def finalize_sorted(self, codes: np.ndarray) -> tuple["Dictionary", np.ndarray]:
         """Return an order-preserving dictionary and remapped codes.
 

@@ -1,0 +1,372 @@
+"""Out-of-core execution: stream chunks of a too-big table through the
+jitted plan, merge partial aggregates.
+
+Reference surface: the spill machinery of the vectorized engine — hash
+partitioning infrastructure (sql/engine/basic/ob_hp_infras_vec_op.h),
+sort/hash-join/hash-agg spill to tmp files (src/storage/tmp_file), and the
+SQL memory manager that decides when operators go out-of-core
+(ob_tenant_sql_memory_manager.h:580).
+
+TPU redesign: instead of spilling operator state to disk mid-run, the
+engine keeps the DEVICE program dense and static — the biggest input table
+streams through it in fixed-capacity row chunks (the host arrays are the
+"spill tier"), and the plan is algebraically split at its lowest blocking
+operator above the streamed scan:
+
+    original:  above_plan( Aggregate_A( stream_path(scan_T, residents...) ) )
+    streamed:  for each chunk c of T:   partial_c = Aggregate_A(... chunk ...)
+    merged:    above_plan( MergeAggregate( concat(partial_c) ) )
+
+sum/count/min/max partials merge exactly (count merges by sum); avg was
+already decomposed into sum/count by the resolver. Joins on the stream path
+keep the streamed side as the probe (left) input, so every chunk probes the
+same resident build sides — the ObHJPartition analog with the roles fixed
+by planning instead of runtime respill.
+
+The chunk capacity is constant across chunks (the last chunk is padded), so
+XLA compiles the chunk program exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace as dc_replace
+
+import numpy as np
+
+from ..core.dtypes import DataType, Field, Schema, TypeKind
+from ..core.table import Table
+from ..expr import ir as E
+from ..sql.logical import (
+    Aggregate,
+    Distinct,
+    Filter,
+    JoinOp,
+    Limit,
+    LogicalOp,
+    Project,
+    Scan,
+    SetOp,
+    Sort,
+    Window,
+    output_schema,
+)
+from .executor import Executor, _children
+
+DEFAULT_DEVICE_BUDGET = int(
+    os.environ.get("OB_TPU_DEVICE_BUDGET", str(6 << 30))
+)
+DEFAULT_CHUNK_ROWS = int(os.environ.get("OB_TPU_CHUNK_ROWS", str(1 << 23)))
+
+_MERGE_FN = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+
+class NotStreamable(Exception):
+    """The plan cannot be split for chunked execution (caller falls back to
+    whole-table upload and may simply run out of device memory — the same
+    contract as an unspillable operator in the reference)."""
+
+
+def scan_bytes(catalog, scan: Scan, needed_cols) -> int:
+    t = catalog[scan.table]
+    cols = needed_cols.get(scan.alias) or set(
+        [t.schema.fields[0].name]
+    )
+    per_row = 0
+    for c in cols:
+        if c in t.schema:
+            per_row += t.schema[c].storage_np.itemsize
+    return (t.nrows or 0) * max(per_row, 1)
+
+
+def plan_input_bytes(executor: Executor, plan: LogicalOp) -> int:
+    needed = executor._needed_columns(plan)
+    return sum(
+        scan_bytes(executor.catalog, s, needed)
+        for s in executor._collect_scans(plan)
+    )
+
+
+def _find_stream_split(executor: Executor, plan: LogicalOp, budget: int):
+    """Choose the streamed scan and the accumulation Aggregate.
+
+    Returns (stream_scan, agg_node) where agg_node is the lowest Aggregate
+    whose subtree contains stream_scan, every node on the path between them
+    is streamable (Filter/Project/Join-with-stream-on-probe-side), and the
+    plan's OTHER inputs fit the budget. Raises NotStreamable otherwise.
+    """
+    needed = executor._needed_columns(plan)
+    scans = executor._collect_scans(plan)
+    if not scans:
+        raise NotStreamable("no scans")
+    sizes = [(scan_bytes(executor.catalog, s, needed), s) for s in scans]
+    sizes.sort(key=lambda p: -p[0])
+    big, stream = sizes[0]
+    rest = sum(b for b, _ in sizes[1:])
+    if rest > budget:
+        raise NotStreamable("multiple over-budget inputs")
+    if sum(1 for s in scans if s.table == stream.table) > 1:
+        raise NotStreamable("streamed table scanned more than once")
+
+    # path from root to the streamed scan
+    path: list[LogicalOp] = []
+
+    def find(op) -> bool:
+        path.append(op)
+        if op is stream:
+            return True
+        for c in _children(op):
+            if find(c):
+                return True
+        path.pop()
+        return False
+
+    assert find(plan)
+    # lowest Aggregate on the path (nearest the scan)
+    agg = None
+    agg_pos = -1
+    for i, node in enumerate(path):
+        if isinstance(node, Aggregate):
+            agg = node
+            agg_pos = i
+    if agg is None:
+        raise NotStreamable("no aggregate above the streamed scan")
+    for name, fn, _arg, distinct in agg.aggs:
+        if distinct or fn not in _MERGE_FN:
+            raise NotStreamable(f"aggregate {fn} not mergeable")
+    # nodes strictly between the Aggregate and the scan must stream rows
+    for parent, child in zip(path[agg_pos:], path[agg_pos + 1 :]):
+        if isinstance(parent, Aggregate):
+            continue
+        if isinstance(parent, (Filter, Project)):
+            continue
+        if isinstance(parent, JoinOp):
+            if child is not parent.left:
+                raise NotStreamable("streamed table on a join build side")
+            continue
+        if isinstance(parent, Scan):
+            continue
+        raise NotStreamable(f"{type(parent).__name__} blocks streaming")
+    return stream, agg
+
+
+def _replace_node(plan: LogicalOp, target: LogicalOp, replacement: LogicalOp):
+    if plan is target:
+        return replacement
+    kids = _children(plan)
+    if not kids:
+        return plan
+    if isinstance(plan, (JoinOp, SetOp)):
+        return dc_replace(
+            plan,
+            left=_replace_node(plan.left, target, replacement),
+            right=_replace_node(plan.right, target, replacement),
+        )
+    return dc_replace(
+        plan, child=_replace_node(plan.child, target, replacement)
+    )
+
+
+def _merge_plan(agg: Aggregate, alias: str = "$m") -> tuple[Scan, Aggregate]:
+    """Build Scan($partials) + merge Aggregate reproducing `agg`'s output.
+
+    $partials carries an extra `$live` int8 column: the relation is padded
+    to a stable power-of-two capacity so the merge program's input shapes —
+    and therefore its XLA executable — are reused across runs; pad rows are
+    filtered by the pushed `$live = 1` predicate."""
+    out_s = output_schema(agg)
+    fields = [Field(f"{alias}.{f.name}", f.dtype) for f in out_s.fields]
+    fields.append(Field(f"{alias}.$live", DataType.int8()))
+    scan = Scan(
+        "$partials", alias, Schema(tuple(fields)),
+        pushed_filter=E.Compare("=", E.ColRef(f"{alias}.$live"), E.lit(1)),
+    )
+    group_keys = tuple(
+        (name, E.ColRef(f"{alias}.{name}")) for name, _e in agg.group_keys
+    )
+    aggs = tuple(
+        (name, _MERGE_FN[fn], E.ColRef(f"{alias}.{name}"), False)
+        for name, fn, _arg, _d in agg.aggs
+    )
+    return scan, Aggregate(scan, group_keys, aggs)
+
+
+class _OverlayCatalog:
+    """Base catalog plus extra tables (the $partials relation)."""
+
+    def __init__(self, base, extra: dict):
+        self.base = base
+        self.extra = extra
+
+    def __getitem__(self, name):
+        if name in self.extra:
+            return self.extra[name]
+        return self.base[name]
+
+    def __contains__(self, name):
+        return name in self.extra or name in self.base
+
+    def is_private(self, name):
+        if name in self.extra:
+            return False
+        f = getattr(self.base, "is_private", None)
+        return f(name) if f is not None else False
+
+
+class _ChunkSourceExecutor(Executor):
+    """Executor whose streamed table reads one fixed-capacity chunk."""
+
+    chunking_enabled = False
+
+    def __init__(self, catalog, stream_table: str, chunk_rows: int, **kw):
+        super().__init__(catalog, **kw)
+        self.stream_table = stream_table
+        self.chunk_rows = chunk_rows
+        self._chunk: tuple[int, int] | None = None
+
+    def set_chunk(self, start: int, end: int):
+        self._chunk = (start, end)
+        # drop only the streamed table's cached device batch
+        self.invalidate_table(self.stream_table)
+
+    def _build_batch(self, name, cols):
+        if name != self.stream_table or self._chunk is None:
+            return super()._build_batch(name, cols)
+        from ..core.column import make_batch
+
+        s, e = self._chunk
+        t = self.catalog[name]
+        sub_schema = Schema(
+            tuple(f for f in t.schema.fields if f.name in cols)
+        )
+        return make_batch(
+            {c: t.data[c][s:e] for c in sub_schema.names()},
+            sub_schema,
+            {c: d for c, d in t.dicts.items() if c in cols},
+            capacity=self.chunk_rows,
+            valid={c: v[s:e] for c, v in t.valid.items() if c in cols},
+        )
+
+    def _est_rows(self, op):
+        # the streamed scan sees chunk_rows per execution, not table rows
+        if isinstance(op, Scan) and op.table == self.stream_table:
+            est = float(self.chunk_rows)
+            if op.pushed_filter is not None:
+                t = self.catalog[op.table]
+                ts = self.stats.table_stats(op.table) if self.stats else None
+                if ts is not None and ts.nrows > 0:
+                    est *= ts.selectivity(op.pushed_filter, t)
+                else:
+                    est *= 0.25 ** min(
+                        len(self._conjuncts(op.pushed_filter)), 3
+                    )
+            return max(est, 1.0)
+        return super()._est_rows(op)
+
+
+class ChunkedPreparedPlan:
+    """Drop-in replacement for PreparedPlan when inputs exceed the device
+    budget: runs the chunk program per chunk, then the merge plan."""
+
+    def __init__(self, executor: Executor, plan: LogicalOp,
+                 stream: Scan, agg: Aggregate,
+                 chunk_rows: int):
+        self.executor = executor
+        self.plan = plan
+        self.stream = stream
+        self.agg = agg
+        self.chunk_rows = chunk_rows
+        self.retries = 0
+
+        scan, merge_agg = _merge_plan(agg)
+        self.above_plan = _replace_node(plan, agg, merge_agg)
+        self.partial_schema = output_schema(agg)
+
+        self.chunk_exec = _ChunkSourceExecutor(
+            executor.catalog, stream.table, chunk_rows,
+            unique_keys=executor.unique_keys, stats=executor.stats,
+        )
+        self.chunk_prepared = self.chunk_exec.prepare(agg)
+
+        # persistent merge executor: $partials is swapped per run at a
+        # grow-only power-of-two capacity so the merge XLA executable is
+        # compiled once and reused (review r2: no re-jit per execution)
+        self._overlay_extra: dict = {}
+        self.merge_exec = Executor(
+            _OverlayCatalog(executor.catalog, self._overlay_extra),
+            unique_keys=executor.unique_keys, stats=None,
+        )
+        self.merge_exec.chunking_enabled = False
+        self._partial_cap = 1024
+        self._merge_prepared = None
+        self._merge_cap = 0
+
+    def run(self, max_retries: int = 3, qparams: tuple = ()):
+        import jax
+        import jax.numpy as jnp
+
+        t = self.executor.catalog[self.stream.table]
+        n = t.nrows or 0
+        partial_batches = []
+        s = 0
+        while s < n or (s == 0 and n == 0):
+            e = min(s + self.chunk_rows, n)
+            self.chunk_exec.set_chunk(s, e)
+            out = self.chunk_prepared.run(max_retries, qparams=qparams)
+            partial_batches.append(out)
+            s = e
+            if n == 0:
+                break
+        self.retries = self.chunk_prepared.retries
+
+        # assemble $partials on host (each partial is small: one row per
+        # group per chunk)
+        cols: dict[str, list] = {f.name: [] for f in self.partial_schema.fields}
+        valids: dict[str, list] = {}
+        dicts = {}
+        for b in partial_batches:
+            sel = np.asarray(b.sel)
+            for f in self.partial_schema.fields:
+                cols[f.name].append(np.asarray(b.cols[f.name])[sel])
+                v = b.valid.get(f.name)
+                if v is not None:
+                    valids.setdefault(f.name, []).append(np.asarray(v)[sel])
+                elif f.name in valids:
+                    valids[f.name].append(np.ones(int(sel.sum()), np.bool_))
+            dicts.update(b.dicts)
+
+        data = {k: np.concatenate(v) for k, v in cols.items()}
+        vdata = {k: np.concatenate(v) for k, v in valids.items()}
+        n_part = len(next(iter(data.values()))) if data else 0
+        while self._partial_cap < n_part:
+            self._partial_cap *= 2
+        pad = self._partial_cap - n_part
+        if pad:
+            data = {
+                k: np.concatenate([v, np.zeros(pad, dtype=v.dtype)])
+                for k, v in data.items()
+            }
+            vdata = {
+                k: np.concatenate([v, np.zeros(pad, dtype=np.bool_)])
+                for k, v in vdata.items()
+            }
+        data["$live"] = np.concatenate(
+            [np.ones(n_part, np.int8), np.zeros(pad, np.int8)]
+        )
+        # partial sum columns may be NULL (empty chunk): mark nullable
+        part_fields = [
+            Field(f.name, f.dtype.with_nullable(f.dtype.nullable or f.name in vdata))
+            for f in self.partial_schema.fields
+        ]
+        part_fields.append(Field("$live", DataType.int8()))
+        partials = Table(
+            "$partials", Schema(tuple(part_fields)), data,
+            {k: d for k, d in dicts.items() if k in data},
+            valid=vdata,
+        )
+        self._overlay_extra["$partials"] = partials
+        self.merge_exec.invalidate_table("$partials")
+        if self._merge_prepared is None or self._merge_cap != self._partial_cap:
+            self._merge_prepared = self.merge_exec.prepare(self.above_plan)
+            self._merge_cap = self._partial_cap
+        return self._merge_prepared.run(max_retries, qparams=qparams)

@@ -60,8 +60,12 @@ from ..sql.logical import (
     LogicalOp,
     Project,
     Scan,
+    SetOp,
     Sort,
+    Window,
     output_schema,
+    setop_schema,
+    window_out_type,
 )
 
 DIRECT_GROUPBY_MAX_DOMAIN = 1 << 12
@@ -99,9 +103,9 @@ def _number_nodes(plan: LogicalOp) -> dict[int, LogicalOp]:
 
 
 def _children(op: LogicalOp):
-    if isinstance(op, (Filter, Project, Sort, Limit, Distinct, Aggregate)):
+    if isinstance(op, (Filter, Project, Sort, Limit, Distinct, Aggregate, Window)):
         return [op.child]
-    if isinstance(op, JoinOp):
+    if isinstance(op, (JoinOp, SetOp)):
         return [op.left, op.right]
     return []
 
@@ -121,14 +125,25 @@ def _dict_domain(batch: ColumnBatch, e: E.Expr) -> int | None:
 
 
 class Executor:
+    # subclasses that manage their own placement (PX) disable chunking
+    chunking_enabled = True
+
     def __init__(self, catalog, unique_keys=None, default_rows_estimate=1 << 16,
-                 stats=None):
+                 stats=None, device_budget=None, chunk_rows=None):
         self.catalog = catalog
         self.unique_keys = unique_keys or {}
         self.default_rows_estimate = default_rows_estimate
         # share/stats.StatsManager: NDV/histogram-backed cardinalities for
         # static capacities (None = heuristic constants)
         self.stats = stats
+        # out-of-core: inputs beyond this many bytes stream through the
+        # plan in chunks (engine/chunked.py); None = library default
+        from .chunked import DEFAULT_CHUNK_ROWS, DEFAULT_DEVICE_BUDGET
+
+        self.device_budget = (
+            device_budget if device_budget is not None else DEFAULT_DEVICE_BUDGET
+        )
+        self.chunk_rows = chunk_rows or DEFAULT_CHUNK_ROWS
         self._batch_cache: dict[tuple[str, tuple], ColumnBatch] = {}
 
     # ---- input preparation -------------------------------------------
@@ -176,6 +191,14 @@ class Executor:
             if isinstance(op, Sort):
                 for e, _ in op.keys:
                     note(e)
+            if isinstance(op, Window):
+                for _name, _fn, a, pk, ok in op.funcs:
+                    if a is not None:
+                        note(a)
+                    for p in pk:
+                        note(p)
+                    for oe, _d in ok:
+                        note(oe)
             for c in _children(op):
                 rec(c)
 
@@ -256,10 +279,17 @@ class Executor:
             if nd is not None:
                 return max(min(child, nd), 1.0)
             return min(child, float(self.default_rows_estimate))
-        if isinstance(op, (Project, Sort, Distinct)):
+        if isinstance(op, (Project, Sort, Distinct, Window)):
             return est_rows(op.child)
         if isinstance(op, Limit):
             return float(op.n + op.offset)
+        if isinstance(op, SetOp):
+            l, r = est_rows(op.left), est_rows(op.right)
+            if op.kind == "union":
+                return l + r
+            if op.kind == "intersect":
+                return min(l, r)
+            return l  # except
         return float(self.default_rows_estimate)
 
     def seed_params(self, plan: LogicalOp) -> PhysicalParams:
@@ -282,6 +312,14 @@ class Executor:
             if isinstance(op, Distinct):
                 params.groupby_size[nid] = next_pow2(
                     int(2 * min(est_rows(op.child), 1 << 21)) + 16
+                )
+            if isinstance(op, SetOp) and not (op.kind == "union" and op.all):
+                # dedup table over the left side (+ right for UNION)
+                base = est_rows(op.left)
+                if op.kind == "union":
+                    base += est_rows(op.right)
+                params.groupby_size[nid] = next_pow2(
+                    int(2 * min(base, 1 << 21)) + 16
                 )
             if isinstance(op, JoinOp):
                 needs_cap = (
@@ -501,31 +539,7 @@ class Executor:
 
         if isinstance(op, Distinct):
             child, ovf = emit(op.child, inputs)
-            keys = [child.cols[n] for n in child.schema.names()]
-            ts = params.groupby_size[nid]
-            row_slot, slot_used, slot_row = assign_group_slots(
-                keys, child.sel, ts
-            )
-            pend = jnp.sum(
-                child.sel & (row_slot < 0), dtype=jnp.int64
-            )
-            n = keys[0].shape[0]
-            rep = jnp.clip(slot_row, 0, n - 1)
-            cols = {
-                name: jnp.where(slot_used, child.cols[name][rep], 0)
-                for name in child.schema.names()
-            }
-            out = ColumnBatch(
-                cols=cols,
-                valid={},
-                sel=slot_used,
-                nrows=jnp.sum(slot_used, dtype=jnp.int64),
-                schema=child.schema,
-                dicts=child.dicts,
-            )
-            ovf = dict(ovf)
-            ovf[nid] = pend
-            return out, ovf
+            return self._dedup_batch(child, params.groupby_size[nid], nid, ovf)
 
         if isinstance(op, Sort):
             child, ovf = emit(op.child, inputs)
@@ -556,6 +570,12 @@ class Executor:
                 & (pos < op.offset + op.n)
             )
             return child.with_sel(keep), ovf
+
+        if isinstance(op, SetOp):
+            return self._emit_setop(op, nid, inputs, emit, params)
+
+        if isinstance(op, Window):
+            return self._emit_window(op, nid, inputs, emit, params)
 
         raise NotImplementedError(type(op))
 
@@ -760,6 +780,276 @@ class Executor:
         ovf[nid] = jnp.maximum(total - cap, 0)
         return out, ovf
 
+    # ---- set-operation emission ----------------------------------------
+    @staticmethod
+    def _cast_col(c, from_t: DataType, to_t: DataType):
+        """Physically convert one column to the promoted set-op type."""
+        if from_t.kind == to_t.kind and not to_t.is_decimal:
+            return c.astype(to_t.storage_np) if c.dtype != to_t.storage_np else c
+        if from_t.is_decimal and to_t.is_decimal:
+            shift = 10 ** (to_t.scale - from_t.scale)
+            return (c.astype(to_t.storage_np) * shift) if shift != 1 else c.astype(to_t.storage_np)
+        if to_t.kind is TypeKind.FLOAT64:
+            if from_t.is_decimal:
+                return c.astype(jnp.float64) / from_t.decimal_factor
+            return c.astype(jnp.float64)
+        if to_t.is_integer:
+            return c.astype(to_t.storage_np)
+        raise NotImplementedError(f"set-op cast {from_t} -> {to_t}")
+
+    @staticmethod
+    def _setop_key_cols(cols, valids, schema: Schema):
+        """Dedup/compare key columns with SQL set-op NULL semantics (NULLs
+        compare equal): NULL payloads normalize to 0 and the validity bit
+        joins the key."""
+        keys = []
+        for f in schema.fields:
+            c = cols[f.name]
+            v = valids.get(f.name)
+            if v is not None:
+                keys.append(jnp.where(v, c, jnp.zeros((), c.dtype)))
+                keys.append(v)
+            else:
+                keys.append(c)
+        return keys
+
+    def _emit_setop(self, op: SetOp, nid, inputs, emit, params):
+        from ..core.dictionary import Dictionary
+
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ovf = {**lovf, **rovf}
+        out_schema = setop_schema(left.schema, right.schema)
+        if op.all and op.kind != "union":
+            raise NotImplementedError(f"{op.kind.upper()} ALL")
+
+        lcols, rcols, lvalid, rvalid, dicts = {}, {}, {}, {}, {}
+        for i, f in enumerate(out_schema.fields):
+            ln = left.schema.fields[i].name
+            rn = right.schema.fields[i].name
+            lt = left.schema.fields[i].dtype
+            rt = right.schema.fields[i].dtype
+            lc, rc = left.cols[ln], right.cols[rn]
+            if f.dtype.kind is TypeKind.VARCHAR:
+                md, lmap, rmap = Dictionary.merge(
+                    left.dicts.get(ln), right.dicts.get(rn)
+                )
+                if md is not None:
+                    dicts[f.name] = md
+                if lmap is not None:
+                    lc = jnp.asarray(lmap)[jnp.clip(lc, 0, len(lmap) - 1)]
+                if rmap is not None:
+                    rc = jnp.asarray(rmap)[jnp.clip(rc, 0, len(rmap) - 1)]
+            else:
+                lc = self._cast_col(lc, lt, f.dtype)
+                rc = self._cast_col(rc, rt, f.dtype)
+            lcols[f.name], rcols[f.name] = lc, rc
+            if f.dtype.nullable:
+                lv = left.valid.get(ln)
+                rv = right.valid.get(rn)
+                lvalid[f.name] = (
+                    lv if lv is not None else jnp.ones(left.capacity, jnp.bool_)
+                )
+                rvalid[f.name] = (
+                    rv if rv is not None else jnp.ones(right.capacity, jnp.bool_)
+                )
+
+        if op.kind == "union":
+            cols = {n: jnp.concatenate([lcols[n], rcols[n]]) for n in lcols}
+            valid = {n: jnp.concatenate([lvalid[n], rvalid[n]]) for n in lvalid}
+            sel = jnp.concatenate([left.sel, right.sel])
+            out = ColumnBatch(
+                cols=cols, valid=valid, sel=sel,
+                nrows=jnp.sum(sel, dtype=jnp.int64),
+                schema=out_schema, dicts=dicts,
+            )
+            if op.all:
+                return out, ovf
+            return self._dedup_batch(out, params.groupby_size[nid], nid, ovf)
+
+        # INTERSECT / EXCEPT (distinct semantics): dedup the left side, then
+        # an existence probe against the right side decides each group
+        ts = params.groupby_size[nid]
+        lkeys = self._setop_key_cols(lcols, lvalid, out_schema)
+        row_slot, slot_used, slot_row = assign_group_slots(lkeys, left.sel, ts)
+        pend = jnp.sum(left.sel & (row_slot < 0), dtype=jnp.int64)
+        rep = jnp.clip(slot_row, 0, left.capacity - 1)
+
+        rkeys = self._setop_key_cols(rcols, rvalid, out_schema)
+        # build table sized by right capacity: always large enough, so the
+        # build needs no overflow accounting
+        bts = next_pow2(max(2 * right.capacity, 16))
+        slot_key, bslot_row = build_hash_table(rkeys, right.sel, bts)
+        probe_keys = [k[rep] for k in lkeys]
+        match = hash_join_probe(slot_key, bslot_row, rkeys, probe_keys, slot_used)
+        has = match >= 0
+        sel = slot_used & (has if op.kind == "intersect" else ~has)
+
+        cols = {n: jnp.where(sel, c[rep], 0) for n, c in lcols.items()}
+        valid = {n: v[rep] & sel for n, v in lvalid.items()}
+        out = ColumnBatch(
+            cols=cols, valid=valid, sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=out_schema, dicts=dicts,
+        )
+        ovf = dict(ovf)
+        ovf[nid] = pend
+        return out, ovf
+
+    def _dedup_batch(self, b: ColumnBatch, ts: int, nid: int, ovf):
+        """Distinct over all columns with NULLs-compare-equal key semantics
+        (shared by UNION and the Distinct operator's nullable path)."""
+        keys = self._setop_key_cols(b.cols, b.valid, b.schema)
+        row_slot, slot_used, slot_row = assign_group_slots(keys, b.sel, ts)
+        pend = jnp.sum(b.sel & (row_slot < 0), dtype=jnp.int64)
+        rep = jnp.clip(slot_row, 0, b.capacity - 1)
+        cols = {n: jnp.where(slot_used, c[rep], 0) for n, c in b.cols.items()}
+        valid = {n: v[rep] & slot_used for n, v in b.valid.items()}
+        out = ColumnBatch(
+            cols=cols, valid=valid, sel=slot_used,
+            nrows=jnp.sum(slot_used, dtype=jnp.int64),
+            schema=b.schema, dicts=b.dicts,
+        )
+        ovf = dict(ovf)
+        ovf[nid] = pend
+        return out, ovf
+
+    # ---- window emission ------------------------------------------------
+    def _emit_window(self, op: Window, nid, inputs, emit, params):
+        from ..ops.window import (
+            agg_identity,
+            boundaries,
+            peer_ends,
+            segment_starts,
+            segmented_cumsum,
+            segmented_scan_minmax,
+        )
+
+        child, ovf = emit(op.child, inputs)
+        n = child.capacity
+        out_cols = dict(child.cols)
+        out_valid = dict(child.valid)
+        out_dicts = dict(child.dicts)
+        fields = list(child.schema.fields)
+
+        by_spec: dict[tuple, list] = {}
+        for name, fn, arg, pk, ok in op.funcs:
+            by_spec.setdefault((pk, ok), []).append((name, fn, arg))
+
+        idx = jnp.arange(n, dtype=jnp.int64)
+        for (pk, ok), funcs in by_spec.items():
+            pkv = [evaluate(e, child)[0] for e in pk]
+            okv, odesc = [], []
+            for e, d in ok:
+                v, _ = evaluate(e, child)
+                okv.append(v)
+                odesc.append(d)
+            order = sort_indices(
+                pkv + okv, [False] * len(pkv) + odesc, child.sel
+            )
+            ssel = child.sel[order]
+            spk = [v[order] for v in pkv]
+            sok = [v[order] for v in okv]
+            if pk:
+                new_seg = boundaries(spk)
+            else:
+                new_seg = jnp.zeros(n, jnp.bool_).at[0].set(True)
+            seg_start = segment_starts(new_seg)
+            if ok:
+                new_peer = new_seg | boundaries(sok)
+                peer_start = segment_starts(new_peer)
+                pend_idx = peer_ends(new_peer)
+            else:
+                new_peer = peer_start = pend_idx = None
+            seg_id = jnp.cumsum(new_seg.astype(jnp.int64)) - 1
+
+            for name, fn, arg in funcs:
+                res_valid_sorted = None
+                if fn == "row_number":
+                    res_sorted = idx - seg_start + 1
+                elif fn == "rank":
+                    res_sorted = peer_start - seg_start + 1
+                elif fn == "dense_rank":
+                    dcum = jnp.cumsum(new_peer.astype(jnp.int64))
+                    res_sorted = dcum - dcum[seg_start] + 1
+                else:
+                    # aggregate over the frame (whole partition without
+                    # ORDER BY; running-with-peers with it)
+                    if arg is None:
+                        av_s, avv_s = None, None
+                    else:
+                        av, avv = evaluate(arg, child)
+                        av_s = av[order]
+                        avv_s = avv[order] if avv is not None else None
+                    vmask = ssel if avv_s is None else (ssel & avv_s)
+                    cnt_v = vmask.astype(jnp.int64)
+                    if ok:
+                        frame_cnt = segmented_cumsum(cnt_v, seg_start)[pend_idx]
+                    else:
+                        frame_cnt = (
+                            jnp.zeros(n, jnp.int64).at[seg_id].add(cnt_v)[seg_id]
+                        )
+                    if fn == "count":
+                        res_sorted = frame_cnt
+                    elif fn == "sum":
+                        acc = (
+                            jnp.int64
+                            if jnp.issubdtype(av_s.dtype, jnp.integer)
+                            else av_s.dtype
+                        )
+                        mv = jnp.where(vmask, av_s.astype(acc), 0)
+                        if ok:
+                            res_sorted = segmented_cumsum(mv, seg_start)[pend_idx]
+                        else:
+                            res_sorted = jnp.zeros(n, acc).at[seg_id].add(mv)[seg_id]
+                        res_valid_sorted = frame_cnt > 0
+                    elif fn in ("min", "max"):
+                        is_min = fn == "min"
+                        ident = agg_identity(av_s.dtype, is_min)
+                        mv = jnp.where(vmask, av_s, ident)
+                        if ok:
+                            res_sorted = segmented_scan_minmax(
+                                mv, new_seg, is_min
+                            )[pend_idx]
+                        else:
+                            tbl = jnp.full(n, ident, av_s.dtype)
+                            tbl = (
+                                tbl.at[seg_id].min(mv)
+                                if is_min
+                                else tbl.at[seg_id].max(mv)
+                            )
+                            res_sorted = tbl[seg_id]
+                        res_valid_sorted = frame_cnt > 0
+                    else:
+                        raise NotImplementedError(f"window function {fn}")
+
+                dt = window_out_type(fn, arg, child.schema)
+                res = (
+                    jnp.zeros(n, res_sorted.dtype)
+                    .at[order]
+                    .set(res_sorted)
+                    .astype(dt.storage_np)
+                )
+                out_cols[name] = res
+                if res_valid_sorted is not None:
+                    out_valid[name] = (
+                        jnp.zeros(n, jnp.bool_).at[order].set(res_valid_sorted)
+                    )
+                    dt = dt.with_nullable(True)
+                fields.append(Field(name, dt))
+                if (
+                    fn in ("min", "max")
+                    and isinstance(arg, E.ColRef)
+                    and arg.name in child.dicts
+                ):
+                    out_dicts[name] = child.dicts[arg.name]
+
+        out = ColumnBatch(
+            cols=out_cols, valid=out_valid, sel=child.sel, nrows=child.nrows,
+            schema=Schema(tuple(fields)), dicts=out_dicts,
+        )
+        return out, ovf
+
     # ---- aggregate emission --------------------------------------------
     def _emit_aggregate(self, op: Aggregate, nid, inputs, emit, params):
         child, ovf = emit(op.child, inputs)
@@ -864,9 +1154,27 @@ class Executor:
         return out, ovf
 
     # ---- execution ------------------------------------------------------
-    def prepare(self, plan: LogicalOp) -> "PreparedPlan":
+    def prepare(self, plan: LogicalOp):
         """Compile once; the returned PreparedPlan caches the XLA executable
-        (the expensive artifact — this is what the plan cache stores)."""
+        (the expensive artifact — this is what the plan cache stores).
+        Inputs beyond the device budget return a ChunkedPreparedPlan that
+        streams the biggest table through the program (engine/chunked.py)."""
+        if self.chunking_enabled:
+            from .chunked import (
+                ChunkedPreparedPlan,
+                NotStreamable,
+                _find_stream_split,
+                plan_input_bytes,
+            )
+
+            if plan_input_bytes(self, plan) > self.device_budget:
+                try:
+                    stream, agg = _find_stream_split(self, plan, self.device_budget)
+                    return ChunkedPreparedPlan(
+                        self, plan, stream, agg, self.chunk_rows
+                    )
+                except NotStreamable:
+                    pass  # whole-table upload; may exhaust device memory
         params = self.seed_params(plan)
         jitted, input_spec, overflow_nodes = self.compile(plan, params)
         return PreparedPlan(self, plan, params, jitted, input_spec, overflow_nodes)

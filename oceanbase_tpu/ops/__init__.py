@@ -1,4 +1,12 @@
-from .hashing import hash_combine, mix64, next_pow2, pack_keys
+from .hashing import (
+    fold32,
+    hash32_combine,
+    hash_combine,
+    mix32,
+    mix64,
+    next_pow2,
+    pack_keys,
+)
 from .hashagg import (
     assign_group_slots,
     groupby_direct,
@@ -16,6 +24,9 @@ from .join import (
 from .sort import apply_order, sort_indices, topn_indices
 
 __all__ = [
+    "fold32",
+    "hash32_combine",
+    "mix32",
     "hash_combine",
     "mix64",
     "next_pow2",

@@ -47,16 +47,18 @@ def assign_group_slots(
     Returns (row_slot [N] int32, slot_used [T] bool, slot_of_first_row [T]
     int32 — for materializing key columns per group via gather).
     Dead rows get slot -1.
+
+    The table stores a 32-bit hash TAG per slot (TPUs emulate 64-bit int
+    multiplies, so both the mix and the per-probe tag compare run 32-bit);
+    "same key" additionally compares every real key column against the
+    slot's first claimant, so tag collisions only cost an extra probe.
     """
+    from .hashing import hash32_combine, inherit_vma
+
     n = key_cols[0].shape[0]
     ts = table_size
-    h = (hash_combine(key_cols) & jnp.uint64(ts - 1)).astype(jnp.int32)
-    # single combined comparison key: collision-free only per-slot chain; we
-    # must compare true keys, so keep the packed 64-bit mixed key AND resolve
-    # rare mixed-key collisions by comparing all key columns via first-row
-    # representative. To stay exact, compare the full hash (64-bit) plus all
-    # key columns against the slot's first claimant.
-    keys64 = hash_combine(key_cols).astype(jnp.int64)  # 64-bit id per row
+    tags = hash32_combine(key_cols).astype(jnp.int32)
+    h = (tags.astype(jnp.uint32) & jnp.uint32(ts - 1)).astype(jnp.int32)
 
     rows = jnp.arange(n, dtype=jnp.int32)
 
@@ -65,50 +67,47 @@ def assign_group_slots(
         return jnp.logical_and(jnp.any(pending), probe < ts)
 
     def body(state):
-        slot_key, slot_row, row_slot, pending, probe, probe_of = state
+        slot_tag, slot_row, row_slot, pending, probe, probe_of = state
         pos = ((h + probe_of) & (ts - 1)).astype(jnp.int32)
-        used = slot_key != _I64_MIN
-        at_used = used[pos]
-        at_key = slot_key[pos]
-        # exact key equality vs the slot's first claimant (64-bit hash alone
+        at_used = slot_row[pos] >= 0
+        at_tag = slot_tag[pos]
+        # exact key equality vs the slot's first claimant (the tag alone
         # could merge distinct keys; the reference compares real keys too)
         at_row = jnp.clip(slot_row[pos], 0, n - 1)
         exact = jnp.ones(n, dtype=jnp.bool_)
         for c in key_cols:
             exact = exact & (c[at_row] == c)
-        same = pending & at_used & (at_key == keys64) & exact
+        same = pending & at_used & (at_tag == tags) & exact
         # claim arbitration: lowest row id wins each empty slot
         claim = jnp.full(ts, _I32_MAX, dtype=jnp.int32)
         claim = claim.at[jnp.where(pending & ~at_used, pos, ts)].min(
             rows, mode="drop"
         )
         winner = pending & ~at_used & (claim[pos] == rows)
-        # winners write their key + row id
+        # winners write their tag + row id
         wpos = jnp.where(winner, pos, ts)
-        slot_key = slot_key.at[wpos].set(keys64, mode="drop")
+        slot_tag = slot_tag.at[wpos].set(tags, mode="drop")
         slot_row = slot_row.at[wpos].set(rows, mode="drop")
         matched = winner | same
         row_slot = jnp.where(matched, pos, row_slot)
         pending = pending & ~matched
         # advance probe only for rows that saw a different-key occupied slot
-        advance = pending & at_used & ~((at_key == keys64) & exact)
+        advance = pending & at_used & ~((at_tag == tags) & exact)
         probe_of = probe_of + advance.astype(jnp.int32)
-        return slot_key, slot_row, row_slot, pending, probe + 1, probe_of
-
-    from .hashing import inherit_vma
+        return slot_tag, slot_row, row_slot, pending, probe + 1, probe_of
 
     init = (
-        inherit_vma(jnp.full(ts, _I64_MIN, dtype=jnp.int64), keys64),  # slot_key
-        inherit_vma(jnp.full(ts, -1, dtype=jnp.int32), keys64),  # slot_row
-        inherit_vma(jnp.full(n, -1, dtype=jnp.int32), keys64),  # row_slot
+        inherit_vma(jnp.zeros(ts, dtype=jnp.int32), tags),  # slot_tag
+        inherit_vma(jnp.full(ts, -1, dtype=jnp.int32), tags),  # slot_row
+        inherit_vma(jnp.full(n, -1, dtype=jnp.int32), tags),  # row_slot
         mask,  # pending
-        inherit_vma(jnp.zeros((), dtype=jnp.int32), keys64),  # round counter
-        inherit_vma(jnp.zeros(n, dtype=jnp.int32), keys64),  # per-row probe
+        inherit_vma(jnp.zeros((), dtype=jnp.int32), tags),  # round counter
+        inherit_vma(jnp.zeros(n, dtype=jnp.int32), tags),  # per-row probe
     )
-    slot_key, slot_row, row_slot, pending, _, _ = jax.lax.while_loop(
+    slot_tag, slot_row, row_slot, pending, _, _ = jax.lax.while_loop(
         cond, body, init
     )
-    slot_used = slot_key != _I64_MIN
+    slot_used = slot_row >= 0
     return row_slot, slot_used, slot_row
 
 

@@ -23,6 +23,11 @@ _C1 = jnp.uint64(0xBF58476D1CE4E5B9)
 _C2 = jnp.uint64(0x94D049BB133111EB)
 _GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
 
+# murmur3 fmix32 constants
+_M1 = jnp.uint32(0x85EBCA6B)
+_M2 = jnp.uint32(0xC2B2AE35)
+_GOLDEN32 = jnp.uint32(0x9E3779B9)
+
 
 def mix64(x: jnp.ndarray) -> jnp.ndarray:
     """splitmix64 finalizer: avalanches a 64-bit value. uint64 in/out."""
@@ -37,6 +42,42 @@ def hash_combine(columns: list[jnp.ndarray]) -> jnp.ndarray:
     h = jnp.zeros_like(columns[0], shape=columns[0].shape, dtype=jnp.uint64)
     for c in columns:
         h = mix64(h ^ (c.astype(jnp.uint64) + _GOLDEN))
+    return h
+
+
+def mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 fmix32 finalizer. uint32 in/out.
+
+    TPUs have no native 64-bit integer ALU (XLA emulates int64 multiplies
+    with 32-bit pairs), so the hot hash paths — table build/probe, exchange
+    slice-calc, bloom filters — run on 32-bit mixes. Key EQUALITY always
+    re-checks the real key columns, so tag collisions cost a probe step,
+    never correctness (same contract as the reference's murmur-based hash
+    tables, ob_hp_infras_vec_op.h)."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * _M1
+    x = (x ^ (x >> 13)) * _M2
+    return x ^ (x >> 16)
+
+
+def fold32(c: jnp.ndarray) -> jnp.ndarray:
+    """Fold a key column to 32 bits, width-stable: an int32 column and an
+    int64 column holding the same values fold identically (join sides may
+    store the same key at different widths, and co-partitioning/bloom
+    filters need both sides to agree). For narrow ints this is u ^ (u>>31)
+    — exactly the xor-fold of the sign-extended 64-bit value."""
+    if c.dtype.itemsize <= 4:
+        i = c.astype(jnp.int32)
+        return (i ^ (i >> 31)).astype(jnp.uint32)
+    u = c.astype(jnp.uint64)
+    return (u ^ (u >> 32)).astype(jnp.uint32)
+
+
+def hash32_combine(columns: list[jnp.ndarray]) -> jnp.ndarray:
+    """Combine N key columns into one avalanche-mixed uint32 hash."""
+    h = jnp.zeros_like(columns[0], shape=columns[0].shape, dtype=jnp.uint32)
+    for c in columns:
+        h = mix32(h ^ (fold32(c) + _GOLDEN32))
     return h
 
 

@@ -49,31 +49,36 @@ def build_hash_table(
     """Insert build rows into an open-addressing table.
 
     Unique keys assumed (duplicates: one winner per key survives — callers
-    needing M:N semantics use expand_join). Returns (slot_key64 [T],
-    slot_row [T] int32).
+    needing M:N semantics use expand_join). Returns (slot_tag [T] int32
+    32-bit hash tags, slot_row [T] int32; empty slots have slot_row < 0).
     """
+    from .hashing import hash32_combine
+
     row_slot, slot_used, slot_row = assign_group_slots(key_cols, mask, table_size)
-    keys64 = hash_combine(key_cols).astype(jnp.int64)
+    tags = hash32_combine(key_cols).astype(jnp.int32)
     n = key_cols[0].shape[0]
-    slot_key = jnp.where(
-        slot_used, keys64[jnp.clip(slot_row, 0, n - 1)], _I64_MIN
-    )
-    return slot_key, slot_row
+    slot_tag = jnp.where(slot_used, tags[jnp.clip(slot_row, 0, n - 1)], 0)
+    return slot_tag, slot_row
 
 
 def hash_join_probe(
-    slot_key: jnp.ndarray,
+    slot_tag: jnp.ndarray,
     slot_row: jnp.ndarray,
     build_key_cols: list[jnp.ndarray],
     probe_key_cols: list[jnp.ndarray],
     probe_mask: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Probe the table; returns match_row [N] int32 (build row idx or -1)."""
-    ts = slot_key.shape[0]
+    """Probe the table; returns match_row [N] int32 (build row idx or -1).
+
+    A hit requires tag equality AND exact equality of every key column, so
+    32-bit tag collisions cost an extra probe step, never a wrong match."""
+    from .hashing import hash32_combine, inherit_vma
+
+    ts = slot_tag.shape[0]
     nb = build_key_cols[0].shape[0]
     n = probe_key_cols[0].shape[0]
-    keys64 = hash_combine(probe_key_cols).astype(jnp.int64)
-    h = (hash_combine(probe_key_cols) & jnp.uint64(ts - 1)).astype(jnp.int32)
+    tags = hash32_combine(probe_key_cols).astype(jnp.int32)
+    h = (tags.astype(jnp.uint32) & jnp.uint32(ts - 1)).astype(jnp.int32)
 
     def cond(state):
         pending, probe, _ = state
@@ -82,23 +87,21 @@ def hash_join_probe(
     def body(state):
         pending, probe, match_row = state
         pos = ((h + probe) & (ts - 1)).astype(jnp.int32)
-        at_key = slot_key[pos]
-        at_row = jnp.clip(slot_row[pos], 0, nb - 1)
-        empty = at_key == _I64_MIN
+        at_row_raw = slot_row[pos]
+        empty = at_row_raw < 0
+        at_row = jnp.clip(at_row_raw, 0, nb - 1)
         exact = jnp.ones(n, dtype=jnp.bool_)
         for bc, pc in zip(build_key_cols, probe_key_cols):
             exact = exact & (bc[at_row] == pc)
-        hit = pending & ~empty & (at_key == keys64) & exact
-        match_row = jnp.where(hit, slot_row[pos], match_row)
+        hit = pending & ~empty & (slot_tag[pos] == tags) & exact
+        match_row = jnp.where(hit, at_row_raw, match_row)
         pending = pending & ~hit & ~empty
         return pending, probe + 1, match_row
 
-    from .hashing import inherit_vma
-
     init = (
         probe_mask,
-        inherit_vma(jnp.zeros((), jnp.int32), keys64),
-        inherit_vma(jnp.full(n, -1, jnp.int32), keys64),
+        inherit_vma(jnp.zeros((), jnp.int32), tags),
+        inherit_vma(jnp.full(n, -1, jnp.int32), tags),
     )
     _, _, match_row = jax.lax.while_loop(cond, body, init)
     return match_row

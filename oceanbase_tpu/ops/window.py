@@ -1,0 +1,105 @@
+"""Window-function kernels: segmented scans over sorted partitions.
+
+Reference surface: the vectorized window operator
+(src/sql/engine/window_function, ObWindowFunctionVecOp) which materializes
+partitions and evaluates ranking/aggregate functions per frame. The TPU
+redesign sorts the whole batch once by (partition keys, order keys) —
+masked-out rows to the tail — and then every window function is a
+branch-free segmented scan over the sorted array:
+
+  row_number  position - segment start + 1
+  rank        peer-group start - segment start + 1
+  dense_rank  segmented count of peer-group starts
+  sum/count   running: segmented cumsum read at the END of the peer group
+              (the SQL default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW
+              includes peers); whole-partition when there is no ORDER BY
+  min/max     segmented associative scan (flag, value) pairs
+
+Results scatter back to the original row positions, so the operator is
+order-preserving like the reference's. Static shapes throughout; dead rows
+ride along masked and cannot influence any frame because all value
+accumulations are masked to the aggregate's identity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def boundaries(sorted_keys: list[jnp.ndarray]) -> jnp.ndarray:
+    """True where any key column differs from the previous row (or row 0)."""
+    n = sorted_keys[0].shape[0] if sorted_keys else 0
+    if not sorted_keys:
+        return jnp.zeros(0, jnp.bool_)
+    new = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    for k in sorted_keys:
+        d = jnp.concatenate([jnp.ones(1, jnp.bool_), k[1:] != k[:-1]])
+        new = new | d
+    return new
+
+
+def segment_starts(new_seg: jnp.ndarray) -> jnp.ndarray:
+    """Index of the segment's first row, per row (int64)."""
+    idx = jnp.arange(new_seg.shape[0], dtype=jnp.int64)
+    return lax.cummax(jnp.where(new_seg, idx, 0))
+
+
+def peer_ends(new_peer: jnp.ndarray) -> jnp.ndarray:
+    """Index of the peer group's last row, per row (int64)."""
+    n = new_peer.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    arr = jnp.where(new_peer, idx, n)
+    # min over j >= i of boundary positions, then shift to "strictly after"
+    suffix_min = lax.cummin(arr[::-1])[::-1]
+    after = jnp.concatenate([suffix_min[1:], jnp.full(1, n, dtype=jnp.int64)])
+    return after - 1
+
+
+def segmented_cumsum(values: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive running sum within each segment. `values` must already be
+    masked (dead/NULL rows contribute the identity 0)."""
+    c = jnp.cumsum(values)
+    return c - c[seg_start] + values[seg_start]
+
+
+def segmented_scan_minmax(
+    values: jnp.ndarray, new_seg: jnp.ndarray, is_min: bool
+) -> jnp.ndarray:
+    """Inclusive segmented running min/max; masked rows must carry the
+    identity (+inf/-inf or int extremes) in `values`."""
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        v = jnp.where(fb, vb, jnp.minimum(va, vb) if is_min else jnp.maximum(va, vb))
+        return fa | fb, v
+
+    _, out = lax.associative_scan(comb, (new_seg, values))
+    return out
+
+
+def agg_identity(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return info.max if is_min else info.min
+    return jnp.inf if is_min else -jnp.inf
+
+
+def partition_totals(
+    values: jnp.ndarray, seg_id: jnp.ndarray, n_segs: int, op: str
+):
+    """Whole-partition aggregate per row (no ORDER BY): scatter-reduce by
+    segment id, gather back."""
+    if op == "sum":
+        tot = jnp.zeros(n_segs, dtype=values.dtype).at[seg_id].add(values)
+    elif op == "min":
+        tot = jnp.full(n_segs, agg_identity(values.dtype, True), values.dtype)
+        tot = tot.at[seg_id].min(values)
+    elif op == "max":
+        tot = jnp.full(n_segs, agg_identity(values.dtype, False), values.dtype)
+        tot = tot.at[seg_id].max(values)
+    else:
+        raise NotImplementedError(op)
+    return tot[seg_id]

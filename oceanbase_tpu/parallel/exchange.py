@@ -33,14 +33,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.hashing import hash_combine
+from ..ops.hashing import hash32_combine
 from .mesh import SHARD_AXIS
 
 
 def dest_by_hash(key_cols: list[jnp.ndarray], n_shards: int) -> jnp.ndarray:
-    """HASH distribution: shard id per row from mixed key hash."""
-    h = hash_combine(key_cols)
-    return (h % jnp.uint64(n_shards)).astype(jnp.int32)
+    """HASH distribution: shard id per row from mixed key hash (32-bit mix;
+    TPUs emulate 64-bit integer multiplies)."""
+    h = hash32_combine(key_cols)
+    return (h % jnp.uint32(n_shards)).astype(jnp.int32)
 
 
 def dest_by_range(

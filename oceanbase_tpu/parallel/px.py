@@ -57,8 +57,17 @@ from ..engine.executor import (
     _number_nodes,
 )
 from ..expr.compile import evaluate
-from ..ops.hashing import hash_combine, next_pow2
-from ..sql.logical import Aggregate, Distinct, JoinOp, Limit, Scan, Sort
+from ..ops.hashing import hash32_combine, next_pow2
+from ..sql.logical import (
+    Aggregate,
+    Distinct,
+    JoinOp,
+    Limit,
+    Scan,
+    SetOp,
+    Sort,
+    Window,
+)
 from .exchange import broadcast_rows, dest_by_hash, repartition
 from .mesh import SHARD_AXIS
 
@@ -74,7 +83,7 @@ def _exch_id(nid: int, slot: int) -> int:
     return _EXCH_BASE + nid * 4 + slot
 
 
-_AGG_CHILD, _JOIN_LEFT, _JOIN_RIGHT = 0, 1, 2
+_AGG_CHILD, _JOIN_LEFT, _JOIN_RIGHT, _SORT_CHILD = 0, 1, 2, 3
 
 
 class PxAdmission:
@@ -107,6 +116,10 @@ class PxAdmission:
 
 class PxExecutor(Executor):
     """Compiles logical plans into shard_map SPMD programs over a mesh."""
+
+    # chunked (out-of-core) streaming composes with PX via PxChunked (TODO);
+    # the single-chip chunker must not capture a shard_map executor
+    chunking_enabled = False
 
     def __init__(self, catalog, mesh: Mesh, unique_keys=None,
                  default_rows_estimate=1 << 16,
@@ -176,7 +189,40 @@ class PxExecutor(Executor):
             if isinstance(op, Aggregate) and op.group_keys:
                 params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
                     est(op.child))
+            if isinstance(op, Sort) and self._sortable_by_range(op):
+                params.exchange_cap[_exch_id(nid, _SORT_CHILD)] = lane_cap(
+                    est(op.child))
+            if isinstance(op, Window) and self._window_common_pk(op):
+                params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
+                    est(op.child))
         return params
+
+    @staticmethod
+    def _sortable_by_range(op: Sort) -> bool:
+        """RANGE exchange needs an integer-typed leading sort key (ints,
+        dates, dict codes, scaled decimals — everything the engine stores
+        as integers)."""
+        from ..expr.compile import infer_type
+        from ..sql.logical import output_schema
+
+        try:
+            dt = infer_type(op.keys[0][0], output_schema(op.child))
+        except Exception:
+            return False
+        return np.issubdtype(dt.storage_np, np.integer)
+
+    @staticmethod
+    def _window_common_pk(op: Window):
+        """The shared partition-key tuple of all window specs, or None.
+        With a common non-empty PARTITION BY, hash repartitioning on it is
+        semantics-preserving (each partition lands whole on one shard) —
+        the reference's range-dist parallel window (datahub winbuf) analog."""
+        pks = {pk for _n, _f, _a, pk, _ok in op.funcs}
+        if len(pks) == 1:
+            pk = next(iter(pks))
+            if pk:
+                return pk
+        return None
 
     # -------------------------------------------------------- exchanges
     def _gather_batch(self, b: ColumnBatch) -> ColumnBatch:
@@ -193,10 +239,8 @@ class PxExecutor(Executor):
             dicts=b.dicts,
         )
 
-    def _exchange_hash(self, b: ColumnBatch, key_exprs, cap: int):
-        """HASH distribution: co-partition rows by key hash (all_to_all)."""
-        keys = [evaluate(e, b)[0] for e in key_exprs]
-        dest = dest_by_hash(keys, self.nsh)
+    def _exchange_dest(self, b: ColumnBatch, dest, cap: int):
+        """Redistribute rows of a batch to per-row dest shards (all_to_all)."""
         payload = {f"c:{n}": a for n, a in b.cols.items()}
         payload.update({f"v:{n}": a for n, a in b.valid.items()})
         out, mask, ovf = repartition(payload, b.sel, dest, self.nsh, cap)
@@ -209,6 +253,11 @@ class PxExecutor(Executor):
             dicts=b.dicts,
         )
         return nb, ovf
+
+    def _exchange_hash(self, b: ColumnBatch, key_exprs, cap: int):
+        """HASH distribution: co-partition rows by key hash (all_to_all)."""
+        keys = [evaluate(e, b)[0] for e in key_exprs]
+        return self._exchange_dest(b, dest_by_hash(keys, self.nsh), cap)
 
     def _concat_batches(self, a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
         """Row-concatenate two same-schema batches (static capacities add)."""
@@ -235,9 +284,9 @@ class PxExecutor(Executor):
         all_gather, normal rows of both sides all_to_all by key hash."""
         hb = 4096
         pk = [evaluate(e, probe)[0] for e in probe_keys]
-        ph = (hash_combine(pk) % jnp.uint64(hb)).astype(jnp.int32)
+        ph = (hash32_combine(pk) % jnp.uint32(hb)).astype(jnp.int32)
         bk = [evaluate(e, build)[0] for e in build_keys]
-        bh = (hash_combine(bk) % jnp.uint64(hb)).astype(jnp.int32)
+        bh = (hash32_combine(bk) % jnp.uint32(hb)).astype(jnp.int32)
 
         def hot_buckets(h, sel):
             cnt = jnp.zeros(hb, dtype=jnp.int64).at[
@@ -274,13 +323,13 @@ class PxExecutor(Executor):
         shards, drop probe rows that cannot match BEFORE the exchange."""
         m = min(self.bloom_max_bits, next_pow2(max(int(4 * est_build), 1024)))
         bk = [evaluate(e, build)[0] for e in build_keys]
-        h = (hash_combine(bk) % jnp.uint64(m)).astype(jnp.int32)
+        h = (hash32_combine(bk) % jnp.uint32(m)).astype(jnp.int32)
         bits = jnp.zeros(m, dtype=jnp.int32).at[
             jnp.where(build.sel, h, m)
         ].set(1, mode="drop")
         bits = lax.psum(bits, SHARD_AXIS) > 0
         pk = [evaluate(e, probe)[0] for e in probe_keys]
-        ph = (hash_combine(pk) % jnp.uint64(m)).astype(jnp.int32)
+        ph = (hash32_combine(pk) % jnp.uint32(m)).astype(jnp.int32)
         return probe.with_sel(probe.sel & bits[ph])
 
     # ------------------------------------------------------- emission
@@ -298,10 +347,16 @@ class PxExecutor(Executor):
         if isinstance(op, Aggregate):
             return self._emit_agg_px(op, nid, inputs, emit, params, id_of)
 
-        if isinstance(op, (Sort, Limit, Distinct)):
-            # order/offset/dedup need the global row set: gather first
-            # (distinct could also hash-repartition; gathered inputs at
-            # these plan positions are small)
+        if isinstance(op, Sort):
+            return self._emit_sort_px(op, nid, inputs, emit, params, id_of)
+
+        if isinstance(op, Window):
+            return self._emit_window_px(op, nid, inputs, emit, params, id_of)
+
+        if isinstance(op, (Limit, Distinct)):
+            # offset/dedup need the global row set: gather first (distinct
+            # could also hash-repartition; gathered inputs at these plan
+            # positions are small)
             child, covf = emit(op.child, inputs)
             if self._dist[id(op.child)] == SHARDED:
                 child = self._gather_batch(child)
@@ -311,10 +366,102 @@ class PxExecutor(Executor):
             self._dist[id(op)] = REPLICATED
             return out, ovf
 
+        if isinstance(op, SetOp):
+            left, lovf = emit(op.left, inputs)
+            right, rovf = emit(op.right, inputs)
+            if self._dist[id(op.left)] == SHARDED:
+                left = self._gather_batch(left)
+            if self._dist[id(op.right)] == SHARDED:
+                right = self._gather_batch(right)
+            emit2 = _override(
+                _override(emit, op.left, (left, lovf)),
+                op.right, (right, rovf))
+            out, ovf = super()._emit_node(op, inputs, emit2, params, id_of)
+            self._dist[id(op)] = REPLICATED
+            return out, ovf
+
         # Filter / Project: local, distribution-preserving
         out, ovf = super()._emit_node(op, inputs, emit, params, id_of)
         child = getattr(op, "child", None)
         self._dist[id(op)] = self._dist[id(child)] if child is not None else SHARDED
+        return out, ovf
+
+    # ---- sort / window --------------------------------------------------
+    def _emit_sort_px(self, op: Sort, nid, inputs, emit, params, id_of):
+        """Large SHARDED sorts exchange by RANGE on the leading key (the
+        reference's ObPQDistributeMethod::RANGE, ob_sql_define.h:390):
+        every shard gets one contiguous key range, sorts locally, and the
+        shard-order concatenation at gather time IS the global order —
+        nothing ever holds the whole relation. Small or already-replicated
+        inputs keep the gather-then-sort path."""
+        from .exchange import dest_by_range, sample_range_bounds
+
+        child, covf = emit(op.child, inputs)
+        cd = self._dist[id(op.child)]
+        exch = _exch_id(nid, _SORT_CHILD)
+        use_range = (
+            cd == SHARDED
+            and exch in params.exchange_cap
+            and self._est_rows(op.child) > self.broadcast_threshold
+        )
+        if not use_range:
+            if cd == SHARDED:
+                child = self._gather_batch(child)
+            out, ovf = super()._emit_node(
+                op, inputs, _override(emit, op.child, (child, covf)),
+                params, id_of)
+            self._dist[id(op)] = REPLICATED
+            return out, ovf
+
+        key_expr, desc0 = op.keys[0]
+        kv = evaluate(key_expr, child)[0]
+        bounds = sample_range_bounds(kv, child.sel, self.nsh)
+        dest = dest_by_range(kv.astype(jnp.int64), bounds)
+        if desc0:
+            # shard 0 must hold the HIGHEST range so the gathered
+            # concatenation reads in descending order
+            dest = (self.nsh - 1) - dest
+        child2, xovf = self._exchange_dest(
+            child, dest, params.exchange_cap[exch])
+        out, ovf = super()._emit_node(
+            op, inputs, _override(emit, op.child, (child2, covf)),
+            params, id_of)
+        ovf = dict(ovf)
+        ovf[exch] = xovf
+        # rows stay sharded; each shard holds one globally-contiguous,
+        # locally-sorted range (ties colocate: equal keys share a dest)
+        self._dist[id(op)] = SHARDED
+        return out, ovf
+
+    def _emit_window_px(self, op: Window, nid, inputs, emit, params, id_of):
+        """Windows with a common PARTITION BY hash-repartition on it — each
+        partition lands whole on one shard, so per-shard evaluation is
+        exact and O(rows/shard). Mixed/empty partition keys gather."""
+        child, covf = emit(op.child, inputs)
+        cd = self._dist[id(op.child)]
+        exch = _exch_id(nid, _AGG_CHILD)
+        pk = self._window_common_pk(op)
+        if (
+            cd == SHARDED
+            and pk is not None
+            and exch in params.exchange_cap
+            and self._est_rows(op.child) > self.broadcast_threshold
+        ):
+            child2, xovf = self._exchange_hash(
+                child, list(pk), params.exchange_cap[exch])
+            out, ovf = super()._emit_node(
+                op, inputs, _override(emit, op.child, (child2, covf)),
+                params, id_of)
+            ovf = dict(ovf)
+            ovf[exch] = xovf
+            self._dist[id(op)] = SHARDED
+            return out, ovf
+        if cd == SHARDED:
+            child = self._gather_batch(child)
+        out, ovf = super()._emit_node(
+            op, inputs, _override(emit, op.child, (child, covf)),
+            params, id_of)
+        self._dist[id(op)] = REPLICATED
         return out, ovf
 
     # ---- joins ----------------------------------------------------------

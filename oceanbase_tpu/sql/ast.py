@@ -116,6 +116,21 @@ class FuncCall(Node):
 
 
 @dataclass(frozen=True)
+class WindowCall(Node):
+    """func(args) OVER (PARTITION BY ... ORDER BY ...).
+
+    Reference surface: the window-function resolver/operator
+    (src/sql/resolver/expr win_func items, src/sql/engine/window_function).
+    Frames: the SQL-default frame only (RANGE UNBOUNDED PRECEDING..CURRENT
+    ROW with ORDER BY; the whole partition without)."""
+
+    name: str  # row_number | rank | dense_rank | sum | count | min | max | avg
+    args: tuple[Node, ...]
+    partition_by: tuple[Node, ...] = ()
+    order_by: tuple["OrderItem", ...] = ()
+
+
+@dataclass(frozen=True)
 class ExtractOp(Node):
     field_: str  # year | month | day
     expr: Node
@@ -187,6 +202,25 @@ class Select(Node):
     offset: int | None = None
     distinct: bool = False
     ctes: tuple[tuple[str, "Select"], ...] = ()  # WITH name AS (...)
+
+
+@dataclass(frozen=True)
+class SetSelect(Node):
+    """Set operation between two query expressions.
+
+    Reference surface: the set-operator resolvers/operators
+    (src/sql/resolver/set, src/sql/engine/set — hash union/intersect/
+    except). ORDER BY / LIMIT apply to the combined result; output column
+    names come from the left side."""
+
+    kind: str  # union | intersect | except
+    all: bool
+    left: "Select | SetSelect"
+    right: "Select | SetSelect"
+    order_by: tuple[OrderItem, ...] = ()
+    limit: int | None = None
+    offset: int | None = None
+    ctes: tuple[tuple[str, "Select"], ...] = ()
 
 
 # ---- statements (DDL / DML / tx control) ----------------------------------

@@ -86,6 +86,37 @@ class Distinct(LogicalOp):
     child: LogicalOp
 
 
+@dataclass
+class SetOp(LogicalOp):
+    """UNION / INTERSECT / EXCEPT. Columns align by position; output field
+    names come from the left side. Reference: src/sql/engine/set (hash
+    union/intersect/except operators)."""
+
+    kind: str  # union | intersect | except
+    all: bool
+    left: LogicalOp
+    right: LogicalOp
+
+
+@dataclass
+class Window(LogicalOp):
+    """Window functions over the child relation. Output = child columns +
+    one column per window function; row set and order are unchanged.
+    funcs: (name, fn, arg expr | None, partition key exprs,
+    ((order expr, descending), ...)). Reference:
+    src/sql/engine/window_function (ObWindowFunctionVecOp)."""
+
+    child: LogicalOp
+    funcs: tuple[
+        tuple[
+            str, str, "E.Expr | None",
+            tuple["E.Expr", ...],
+            tuple[tuple["E.Expr", bool], ...],
+        ],
+        ...,
+    ]
+
+
 def output_schema(op: LogicalOp) -> Schema:
     """Schema of an operator's output (qualified names)."""
     if isinstance(op, Scan):
@@ -130,7 +161,60 @@ def output_schema(op: LogicalOp) -> Schema:
         return Schema(tuple(fields))
     if isinstance(op, (Sort, Limit, Distinct)):
         return output_schema(op.child)
+    if isinstance(op, SetOp):
+        return setop_schema(output_schema(op.left), output_schema(op.right))
+    if isinstance(op, Window):
+        child_s = output_schema(op.child)
+        fields = list(child_s.fields)
+        for name, fn, arg, _pk, _ok in op.funcs:
+            fields.append(Field(name, window_out_type(fn, arg, child_s)))
+        return Schema(tuple(fields))
     raise AssertionError(type(op))
+
+
+def window_out_type(fn: str, arg, child_s: Schema) -> DataType:
+    """Result type of one window function (mirrors aggregate typing)."""
+    from ..expr.compile import infer_type
+
+    if fn in ("row_number", "rank", "dense_rank", "count"):
+        return DataType.int64()
+    if fn == "avg":
+        return DataType.float64()
+    t = infer_type(arg, child_s)
+    if fn == "sum" and t.is_decimal:
+        t = DataType.decimal(18, t.scale)
+    elif fn == "sum" and t.is_integer:
+        t = DataType.int64()
+    # frames can be empty only for sum/min/max of all-NULL inputs; keep
+    # nullability from the argument
+    return t
+
+
+def setop_schema(ls: Schema, rs: Schema) -> Schema:
+    """Positionally-aligned common schema of a set operation (names from the
+    left side, types promoted per column)."""
+    if len(ls.fields) != len(rs.fields):
+        raise ResolveError(
+            f"set operation arity mismatch: {len(ls.fields)} vs {len(rs.fields)}"
+        )
+    fields = []
+    for lf, rf in zip(ls.fields, rs.fields):
+        fields.append(Field(lf.name, promote_types(lf.dtype, rf.dtype)))
+    return Schema(tuple(fields))
+
+
+def promote_types(l: DataType, r: DataType) -> DataType:
+    """Common type of two set-operation branch columns."""
+    from ..core.dtypes import common_numeric_type
+
+    nullable = l.nullable or r.nullable
+    if l.kind == r.kind:
+        if l.is_decimal and (l.scale, l.precision) != (r.scale, r.precision):
+            return DataType.decimal(18, max(l.scale, r.scale), nullable=nullable)
+        return l.with_nullable(nullable)
+    if l.is_numeric and r.is_numeric:
+        return common_numeric_type(l, r).with_nullable(nullable)
+    raise ResolveError(f"set operation type mismatch: {l} vs {r}")
 
 
 # ---- resolver -------------------------------------------------------------
@@ -157,6 +241,9 @@ class Resolver:
         self.scopes: list[tuple[str, Schema]] = []  # (alias, schema)
         self.agg_exprs: list[tuple[str, str, E.Expr | None, bool]] = []
         self.correlated: list[E.Expr] = []
+        # window-function sink: (name, fn, arg, partition keys, order keys);
+        # filled when WindowCall nodes resolve (planner builds the Window op)
+        self.win_exprs: list[tuple] = []
 
     # -- name resolution -------------------------------------------------
     def add_table(self, name: str, alias: str) -> Scan:
@@ -280,6 +367,8 @@ class Resolver:
                     length if length is not None else E.lit(-1),
                 ),
             )
+        if isinstance(node, A.WindowCall):
+            return self._window_call(node, allow_agg)
         if isinstance(node, A.FuncCall):
             if node.name in _AGG_FUNCS:
                 if not allow_agg:
@@ -328,6 +417,48 @@ class Resolver:
             return E.BinaryOp("/", E.ColRef(s), E.ColRef(c))
         name = self._add_agg(fn, arg, node.distinct)
         return E.ColRef(name)
+
+    _WINDOW_FUNCS = {
+        "row_number", "rank", "dense_rank", "sum", "count", "min", "max", "avg",
+    }
+
+    def _window_call(self, node: "A.WindowCall", allow_agg: bool) -> E.Expr:
+        """Resolve fn(args) OVER (...) to a ColRef on a window output column;
+        the spec is recorded in win_exprs for the planner's Window node.
+        avg decomposes into sum/count window functions (like _agg_call)."""
+        fn = node.name
+        if fn not in self._WINDOW_FUNCS:
+            raise ResolveError(f"unknown window function {fn}")
+        if fn in ("row_number", "rank", "dense_rank"):
+            if node.args:
+                raise ResolveError(f"{fn}() takes no arguments")
+            arg = None
+        elif fn == "count" and (not node.args or isinstance(node.args[0], A.Star)):
+            arg = None
+        else:
+            if len(node.args) != 1:
+                raise ResolveError(f"window {fn} takes one argument")
+            arg = self.expr(node.args[0], allow_agg)
+        if fn in ("rank", "dense_rank") and not node.order_by:
+            raise ResolveError(f"{fn}() requires ORDER BY in its window")
+        pk = tuple(self.expr(p, allow_agg) for p in node.partition_by)
+        ok = tuple(
+            (self.expr(oi.expr, allow_agg), oi.descending)
+            for oi in node.order_by
+        )
+        if fn == "avg":
+            s = self._add_window("sum", arg, pk, ok)
+            c = self._add_window("count", arg, pk, ok)
+            return E.BinaryOp("/", E.ColRef(s), E.ColRef(c))
+        return E.ColRef(self._add_window(fn, arg, pk, ok))
+
+    def _add_window(self, fn, arg, pk, ok) -> str:
+        for name, f2, a2, p2, o2 in self.win_exprs:
+            if (f2, a2, p2, o2) == (fn, arg, pk, ok):
+                return name
+        name = f"$win{next(_counter)}"
+        self.win_exprs.append((name, fn, arg, pk, ok))
+        return name
 
     def _add_agg(self, fn: str, arg: E.Expr | None, distinct: bool) -> str:
         # dedupe identical aggregates

@@ -32,6 +32,7 @@ KEYWORDS = {
     "extract", "substring", "for", "distinct", "join", "inner", "left",
     "right", "full", "cross", "outer", "on", "date", "interval", "year",
     "month", "day", "asc", "desc", "union", "all", "any", "some", "with",
+    "intersect", "except", "over", "partition",
     # statements
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
@@ -292,7 +293,7 @@ class Parser:
         where = self.expr() if self.accept("where") else None
         return A.Delete(name, where)
 
-    def parse(self) -> A.Select:
+    def parse(self) -> "A.Select | A.SetSelect":
         ctes = []
         if self.accept("with"):
             while True:
@@ -303,19 +304,120 @@ class Parser:
                 self.expect(")")
                 if not self.accept(","):
                     break
-        s = self.select()
+        s = self.query_expr()
         if ctes:
-            s = A.Select(
-                items=s.items, from_=s.from_, where=s.where,
-                group_by=s.group_by, having=s.having, order_by=s.order_by,
-                limit=s.limit, offset=s.offset, distinct=s.distinct,
-                ctes=tuple(ctes),
-            )
+            if isinstance(s, A.SetSelect):
+                s = A.SetSelect(
+                    kind=s.kind, all=s.all, left=s.left, right=s.right,
+                    order_by=s.order_by, limit=s.limit, offset=s.offset,
+                    ctes=tuple(ctes),
+                )
+            else:
+                s = A.Select(
+                    items=s.items, from_=s.from_, where=s.where,
+                    group_by=s.group_by, having=s.having, order_by=s.order_by,
+                    limit=s.limit, offset=s.offset, distinct=s.distinct,
+                    ctes=tuple(ctes),
+                )
         self.accept(";")
         if self.peek().kind != "eof":
             t = self.peek()
             raise SyntaxError(f"trailing tokens at {t.pos}: {t.value!r}")
         return s
+
+    # -- set operations (UNION / INTERSECT / EXCEPT) --------------------
+    def query_expr(self) -> "A.Select | A.SetSelect":
+        left, lparen = self.query_term()
+        while self.peek().kind == "kw" and self.peek().value in ("union", "except"):
+            kind = self.next().value
+            all_ = self.accept("all")
+            self.accept("distinct")
+            right, rparen = self.query_term()
+            left = self._make_setop(kind, all_, left, lparen, right, rparen)
+            lparen = False
+        # trailing ORDER BY / LIMIT after a parenthesized last branch still
+        # sits in the token stream; it scopes to the whole set result
+        if isinstance(left, A.SetSelect):
+            order_by = list(left.order_by)
+            limit, offset = left.limit, left.offset
+            changed = False
+            if self.peek().kind == "kw" and self.peek().value == "order":
+                if order_by:
+                    raise SyntaxError("duplicate ORDER BY on set operation")
+                self.next()
+                self.expect("by")
+                order_by = [self.order_item()]
+                while self.accept(","):
+                    order_by.append(self.order_item())
+                changed = True
+            if self.peek().kind == "kw" and self.peek().value == "limit":
+                if limit is not None:
+                    raise SyntaxError("duplicate LIMIT on set operation")
+                self.next()
+                limit = int(self.next().value)
+                if self.accept("offset"):
+                    offset = int(self.next().value)
+                changed = True
+            if changed:
+                left = A.SetSelect(
+                    left.kind, left.all, left.left, left.right,
+                    tuple(order_by), limit, offset, left.ctes,
+                )
+        return left
+
+    def query_term(self):
+        left, lparen = self.query_primary()
+        while self.peek().kind == "kw" and self.peek().value == "intersect":
+            self.next()
+            all_ = self.accept("all")
+            self.accept("distinct")
+            right, rparen = self.query_primary()
+            left = self._make_setop("intersect", all_, left, lparen, right, rparen)
+            lparen = False
+        return left, lparen
+
+    def query_primary(self):
+        if self.peek().value == "(" and self.peek().kind == "op":
+            self.next()
+            q = self.query_expr()
+            self.expect(")")
+            return q, True
+        return self.select(), False
+
+    @staticmethod
+    def _make_setop(kind, all_, left, lparen, right, rparen):
+        """Combine two branches. A trailing ORDER BY / LIMIT greedily parsed
+        into an UNPARENTHESIZED right branch scopes to the whole set result
+        (SQL scoping) and hoists onto the SetSelect node — including from a
+        nested SetSelect built by a tighter-binding INTERSECT. Parenthesized
+        branches keep their clauses (branch-local top-N is legitimate)."""
+        order_by, limit, offset = (), None, None
+        if (
+            not lparen
+            and isinstance(left, A.Select)
+            and (left.order_by or left.limit is not None)
+        ):
+            raise SyntaxError(
+                "ORDER BY/LIMIT on a set-operation branch needs parentheses"
+            )
+        if not rparen and isinstance(right, A.Select) and (
+            right.order_by or right.limit is not None
+        ):
+            order_by, limit, offset = right.order_by, right.limit, right.offset
+            right = A.Select(
+                items=right.items, from_=right.from_, where=right.where,
+                group_by=right.group_by, having=right.having,
+                distinct=right.distinct, ctes=right.ctes,
+            )
+        elif not rparen and isinstance(right, A.SetSelect) and (
+            right.order_by or right.limit is not None
+        ):
+            order_by, limit, offset = right.order_by, right.limit, right.offset
+            right = A.SetSelect(
+                right.kind, right.all, right.left, right.right,
+                (), None, None, right.ctes,
+            )
+        return A.SetSelect(kind, all_, left, right, order_by, limit, offset)
 
     def select(self) -> A.Select:
         self.expect("select")
@@ -594,6 +696,27 @@ class Parser:
                             args.append(self.expr())
                     args = tuple(args)
                 self.expect(")")
+                if self.peek().value == "over" and self.peek().kind == "kw":
+                    self.next()
+                    self.expect("(")
+                    partition_by = []
+                    if self.accept("partition"):
+                        self.expect("by")
+                        partition_by = [self.expr()]
+                        while self.accept(","):
+                            partition_by.append(self.expr())
+                    order_by = []
+                    if self.accept("order"):
+                        self.expect("by")
+                        order_by = [self.order_item()]
+                        while self.accept(","):
+                            order_by.append(self.order_item())
+                    self.expect(")")
+                    if distinct:
+                        raise SyntaxError("DISTINCT window aggregates unsupported")
+                    return A.WindowCall(
+                        t.value, args, tuple(partition_by), tuple(order_by)
+                    )
                 return A.FuncCall(t.value, args, distinct)
             parts = [t.value]
             while self.peek().value == "." and self.peek().kind == "op":

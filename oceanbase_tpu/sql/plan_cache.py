@@ -40,7 +40,9 @@ from .logical import (
     LogicalOp,
     Project,
     Scan,
+    SetOp,
     Sort,
+    Window,
 )
 
 # literal kinds whose values may become runtime parameters
@@ -166,6 +168,25 @@ class _Paramizer:
             return dc_replace(op, child=self.plan(op.child))
         if isinstance(op, Distinct):
             return dc_replace(op, child=self.plan(op.child))
+        if isinstance(op, SetOp):
+            # kind/all are structural (they shape the physical program)
+            self.baked.append(("setop", op.kind, op.all))
+            return dc_replace(
+                op, left=self.plan(op.left), right=self.plan(op.right)
+            )
+        if isinstance(op, Window):
+            return dc_replace(
+                op,
+                child=self.plan(op.child),
+                funcs=tuple(
+                    (
+                        n, fn, self.expr(a),
+                        tuple(self.expr(p) for p in pk),
+                        tuple((self.expr(o), d) for o, d in ok),
+                    )
+                    for n, fn, a, pk, ok in op.funcs
+                ),
+            )
         raise NotImplementedError(type(op))
 
 

@@ -36,7 +36,9 @@ from .logical import (
     ResolveError,
     Resolver,
     Scan,
+    SetOp,
     Sort,
+    Window,
     output_schema,
 )
 
@@ -183,11 +185,53 @@ class Planner:
         return est(rel.plan)
 
     # ================================================================ API
-    def plan(self, sel: A.Select, outer: Resolver | None = None) -> PlannedQuery:
+    def plan(self, sel: "A.Select | A.SetSelect", outer: Resolver | None = None) -> PlannedQuery:
         for name, csel in getattr(sel, "ctes", ()):
             self.ctes[name] = csel
+        if isinstance(sel, A.SetSelect):
+            return self._plan_setop(sel, outer)
         plan, r, out_items, visible = self._plan_block(sel, outer)
         return PlannedQuery(plan, visible)
+
+    def _plan_setop(self, node: A.SetSelect, outer: Resolver | None) -> PlannedQuery:
+        lq = self.plan(node.left, outer)
+        rq = self.plan(node.right, outer)
+        if len(lq.output_names) != len(rq.output_names):
+            raise ResolveError(
+                f"set operation arity mismatch: {len(lq.output_names)} vs "
+                f"{len(rq.output_names)}"
+            )
+        # align the right side positionally onto the left side's names
+        rplan = Project(
+            rq.plan,
+            tuple(
+                (ln, E.ColRef(rn))
+                for ln, rn in zip(lq.output_names, rq.output_names)
+            ),
+        )
+        plan: LogicalOp = SetOp(node.kind, node.all, lq.plan, rplan)
+        names = lq.output_names
+        order_keys = []
+        for oi in node.order_by:
+            if (
+                isinstance(oi.expr, A.Name)
+                and len(oi.expr.parts) == 1
+                and oi.expr.parts[0] in names
+            ):
+                order_keys.append((E.ColRef(oi.expr.parts[0]), oi.descending))
+            elif isinstance(oi.expr, A.NumberLit):
+                order_keys.append(
+                    (E.ColRef(names[int(oi.expr.value) - 1]), oi.descending)
+                )
+            else:
+                raise ResolveError(
+                    "set-operation ORDER BY must use output names or ordinals"
+                )
+        if order_keys:
+            plan = Sort(plan, tuple(order_keys))
+        if node.limit is not None:
+            plan = Limit(plan, node.limit, node.offset or 0)
+        return PlannedQuery(plan, names)
 
     # ======================================================== block core
     def _plan_block(self, sel: A.Select, outer: Resolver | None):
@@ -308,6 +352,7 @@ class Planner:
 
         # ---- GROUP BY / aggregates ------------------------------------
         alias_map: dict[str, E.Expr] = {}
+        agg_out_sub: dict[E.Expr, E.Expr] = {}
         group_nodes = list(sel.group_by)
         has_agg_in_select = _select_has_agg(sel)
         agg_order_keys: list[tuple[E.Expr, bool]] | None = None
@@ -393,6 +438,17 @@ class Planner:
                     matched = [n for n, e in out_items if e == oe]
                     oe = E.ColRef(matched[0]) if matched else oe
                 order_keys.append((oe, oi.descending))
+
+        # ---- window functions (after grouping/HAVING, before projection)
+        if r.win_exprs:
+            specs = []
+            for name, fn, arg, pk, ok in r.win_exprs:
+                if agg_out_sub:
+                    arg = _substitute(arg, agg_out_sub) if arg is not None else None
+                    pk = tuple(_substitute(p, agg_out_sub) for p in pk)
+                    ok = tuple((_substitute(o, agg_out_sub), d) for o, d in ok)
+                specs.append((name, fn, arg, pk, ok))
+            plan = Window(plan, tuple(specs))
 
         visible = tuple(n for n, _ in out_items)
         fixed_order = []

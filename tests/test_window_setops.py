@@ -1,0 +1,308 @@
+"""Window functions and set operators vs a sqlite oracle.
+
+sqlite supports window functions and UNION/INTERSECT/EXCEPT natively, so the
+oracle needs no transliteration beyond the date folding test_tpch_full uses.
+Also covers the PX (shard_map) paths for both operator families.
+"""
+
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.engine import Session
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import UNIQUE_KEYS
+from tests.test_tpch_full import to_sqlite
+
+
+@pytest.fixture(scope="module")
+def db():
+    tables = datagen.generate(sf=0.003)
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    conn = sqlite3.connect(":memory:")
+    for name, t in tables.items():
+        cols = t.schema.names()
+        decoded = {}
+        for c in cols:
+            dt = t.schema[c]
+            if dt.kind.value == "varchar":
+                decoded[c] = t.dicts[c].decode(t.data[c])
+            elif dt.is_decimal:
+                decoded[c] = (t.data[c] / dt.decimal_factor).tolist()
+            elif dt.kind.value == "date":
+                base = np.datetime64("1970-01-01", "D")
+                decoded[c] = [str(base + int(v)) for v in t.data[c]]
+            else:
+                decoded[c] = t.data[c].tolist()
+        conn.execute(f"create table {name} ({', '.join(cols)})")
+        rows = list(zip(*[decoded[c] for c in cols]))
+        ph = ",".join("?" * len(cols))
+        conn.executemany(f"insert into {name} values ({ph})", rows)
+    conn.commit()
+    return tables, sess, conn
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, (float, np.floating)):
+        if math.isnan(v):
+            return None
+        return round(float(v), 2)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return str(v)
+
+
+def check(db, sql, sqlite_sql=None):
+    tables, sess, conn = db
+    rs = sess.sql(sql)
+    want = [
+        tuple(_norm(v) for v in row)
+        for row in conn.execute(to_sqlite(sqlite_sql or sql)).fetchall()
+    ]
+    got = [
+        tuple(_norm(rs.columns[n][i]) for n in rs.names)
+        for i in range(rs.nrows)
+    ]
+    assert len(got) == len(want), (len(got), len(want), got[:3], want[:3])
+    for g, w in zip(sorted(got, key=repr), sorted(want, key=repr)):
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float) or isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=1e-4, abs=1e-2), (g, w)
+            else:
+                assert gv == wv, (g, w)
+
+
+# ---------------------------------------------------------------- set ops
+
+def test_union_all(db):
+    check(db, """
+        select c_nationkey as k from customer where c_acctbal < 0
+        union all
+        select s_nationkey from supplier where s_acctbal < 0
+    """)
+
+
+def test_union_distinct(db):
+    check(db, """
+        select c_nationkey as k from customer
+        union
+        select s_nationkey from supplier
+    """)
+
+
+def test_union_strings_distinct_dicts(db):
+    # different dictionaries on each side force a dictionary merge
+    check(db, """
+        select c_mktsegment as v from customer where c_custkey <= 50
+        union
+        select o_orderpriority from orders where o_orderkey <= 400
+    """)
+
+
+def test_intersect(db):
+    check(db, """
+        select c_nationkey as k from customer where c_acctbal > 5000
+        intersect
+        select s_nationkey from supplier
+    """)
+
+
+def test_except(db):
+    check(db, """
+        select c_nationkey as k from customer
+        except
+        select s_nationkey from supplier where s_acctbal > 0
+    """)
+
+
+def test_setop_order_limit(db):
+    tables, sess, conn = db
+    sql = """
+        select c_nationkey as k from customer
+        union
+        select s_nationkey from supplier
+        order by k desc
+        limit 5
+    """
+    rs = sess.sql(sql)
+    want = [r[0] for r in conn.execute(sql).fetchall()]
+    assert [int(v) for v in rs.columns["k"]] == want
+
+
+def test_setop_type_promotion(db):
+    # int32 nationkey vs int64 custkey promote to int64
+    check(db, """
+        select c_nationkey as k from customer where c_custkey < 5
+        union
+        select c_custkey from customer where c_custkey < 30
+    """)
+
+
+def test_setop_with_aggregates(db):
+    check(db, """
+        select c_nationkey as k, count(*) as n from customer group by c_nationkey
+        except
+        select s_nationkey, count(*) from supplier group by s_nationkey
+    """)
+
+
+# ---------------------------------------------------------------- windows
+
+def test_row_number(db):
+    check(db, """
+        select o_orderkey, row_number() over (
+            partition by o_custkey order by o_orderdate, o_orderkey) as rn
+        from orders where o_orderkey <= 2000
+    """)
+
+
+def test_rank_dense_rank(db):
+    check(db, """
+        select c_custkey,
+               rank() over (partition by c_nationkey order by c_acctbal desc) as r,
+               dense_rank() over (partition by c_nationkey order by c_acctbal desc) as dr
+        from customer where c_custkey <= 300
+    """)
+
+
+def test_sum_over_partition(db):
+    check(db, """
+        select o_orderkey, o_custkey,
+               sum(o_totalprice) over (partition by o_custkey) as tot,
+               count(*) over (partition by o_custkey) as cnt
+        from orders where o_orderkey <= 2000
+    """)
+
+
+def test_running_sum(db):
+    check(db, """
+        select o_orderkey,
+               sum(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey) as run
+        from orders where o_orderkey <= 2000
+    """)
+
+
+def test_running_sum_with_peers(db):
+    # ties on the order key: the default RANGE frame includes peer rows
+    check(db, """
+        select o_orderkey,
+               sum(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate) as run,
+               count(*) over (
+                   partition by o_custkey order by o_orderdate) as cnt
+        from orders where o_orderkey <= 2000
+    """)
+
+
+def test_min_max_running(db):
+    check(db, """
+        select o_orderkey,
+               min(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey) as mn,
+               max(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey) as mx
+        from orders where o_orderkey <= 2000
+    """)
+
+
+def test_avg_window(db):
+    check(db, """
+        select c_custkey,
+               avg(c_acctbal) over (partition by c_nationkey) as a
+        from customer where c_custkey <= 300
+    """)
+
+
+def test_window_no_partition(db):
+    check(db, """
+        select o_orderkey,
+               row_number() over (order by o_totalprice desc, o_orderkey) as rn
+        from orders where o_orderkey <= 1000
+    """)
+
+
+def test_window_over_aggregate(db):
+    check(db, """
+        select c_nationkey, count(*) as n,
+               rank() over (order by count(*) desc, c_nationkey) as r
+        from customer group by c_nationkey
+    """)
+
+
+def test_window_then_orderby_alias(db):
+    tables, sess, conn = db
+    sql = """
+        select o_orderkey,
+               row_number() over (partition by o_custkey
+                                  order by o_orderdate, o_orderkey) as rn
+        from orders where o_orderkey <= 1000
+        order by rn, o_orderkey
+        limit 20
+    """
+    rs = sess.sql(sql)
+    want = conn.execute(sql).fetchall()
+    got = list(zip(rs.columns["o_orderkey"], rs.columns["rn"]))
+    assert [(int(a), int(b)) for a, b in got] == [
+        (int(a), int(b)) for a, b in want
+    ]
+
+
+# ---------------------------------------------------------------- PX paths
+
+@pytest.fixture(scope="module")
+def px_mesh():
+    from oceanbase_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(4)
+
+
+def _px_rows(tables, sql, mesh):
+    from oceanbase_tpu.core.column import batch_rows_normalized
+    from oceanbase_tpu.parallel.px import PxExecutor
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    planner = Planner(tables)
+    pq = planner.plan(parse(sql))
+    px = PxExecutor(tables, mesh, unique_keys=UNIQUE_KEYS)
+    out = px.execute(pq.plan)
+    return batch_rows_normalized(out, pq.output_names)
+
+
+def _chip_rows(tables, sql):
+    from oceanbase_tpu.core.column import batch_rows_normalized
+    from oceanbase_tpu.engine.executor import Executor
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    planner = Planner(tables)
+    pq = planner.plan(parse(sql))
+    ex = Executor(tables, unique_keys=UNIQUE_KEYS)
+    out = ex.execute(pq.plan)
+    return batch_rows_normalized(out, pq.output_names)
+
+
+def test_px_window_matches_single_chip(db, px_mesh):
+    tables, _sess, _conn = db
+    sql = """
+        select o_custkey,
+               sum(o_totalprice) over (partition by o_custkey) as tot,
+               row_number() over (partition by o_custkey order by o_orderkey) as rn
+        from orders where o_orderkey <= 2000
+    """
+    assert _px_rows(tables, sql, px_mesh) == _chip_rows(tables, sql)
+
+
+def test_px_setop_matches_single_chip(db, px_mesh):
+    tables, _sess, _conn = db
+    sql = """
+        select c_nationkey as k from customer
+        union
+        select s_nationkey from supplier
+    """
+    assert _px_rows(tables, sql, px_mesh) == _chip_rows(tables, sql)
