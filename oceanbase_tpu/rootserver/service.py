@@ -88,6 +88,14 @@ class RootService:
                 raise
             return ti
 
+    def create_index_tablet(self, ls_id: int, schema, key_cols) -> int:
+        """Allocate and create an index tablet co-located with its base
+        table's LS (same log stream => index maintenance stays 1PC)."""
+        with self._lock:
+            tablet_id = self._alloc_tablet_id()
+        self.cluster.create_tablet(ls_id, tablet_id, schema, key_cols)
+        return tablet_id
+
     def drop_table(self, name: str) -> object:
         with self._lock:
             dropped = {}

@@ -49,6 +49,27 @@ class SqlError(Exception):
 
 
 @dataclass
+class IndexInfo:
+    """One secondary index: an index tablet co-located on the base table's
+    log stream, keyed by (index cols..., pk cols...) — pk suffix makes
+    non-unique entries unique; a UNIQUE index keys on the index cols alone
+    so duplicate values collide in the memtable (first-committer-wins).
+    Reference surface: index schemas + direct-insert build
+    (src/storage/ddl) and DAS index lookup iterators (src/sql/das/iter)."""
+
+    name: str
+    table: str
+    cols: tuple[str, ...]
+    tablet_id: int
+    schema: Schema  # index cols + pk cols (deduped, in that order)
+    key_cols: list[str]
+    unique: bool = False
+    status: str = "building"  # building -> ready
+    build_version: int = 0
+    reads: int = 0  # statements served through this index (diag surface)
+
+
+@dataclass
 class TableInfo:
     """Schema-service record of one user table (one tablet shard for now)."""
 
@@ -57,6 +78,7 @@ class TableInfo:
     key_cols: list[str]
     ls_id: int
     tablet_id: int
+    indexes: dict[str, IndexInfo] = field(default_factory=dict)
     # append-order dictionaries: code assignment is insertion order, so
     # logged/stored codes stay valid as strings arrive (the sorted view is
     # derived at read time)
@@ -192,6 +214,16 @@ class Database:
         # analytic catalog: table name -> snapshot Table (plus any read-only
         # preloaded tables, e.g. benchmark data)
         self.catalog: dict[str, Table] = TxCatalog(extra_catalog or {})
+        # placeholder entries for restored tables (create_table provides
+        # one on the DDL path): the resolver requires every table in the
+        # shared catalog even when the first statement reads it through a
+        # statement-scoped view (index route) or a tx overlay
+        for ti in self.tables.values():
+            if ti.name not in self.catalog:
+                self.catalog[ti.name] = Table(ti.name, ti.schema, {
+                    f.name: np.zeros(0, f.dtype.storage_np)
+                    for f in ti.schema.fields
+                })
         self.plan_cache = PlanCache(capacity=self.config["plan_cache_capacity"])
         self.config.on_change(
             "plan_cache_capacity",
@@ -247,6 +279,12 @@ class Database:
         from ..tx.tablelock import LockManager
 
         self.lock_mgr = LockManager()
+
+        # indexes built since the last checkpoint lost their (unlogged)
+        # backfill sstables in a crash: re-backfill now that leaders exist
+        for ti, idx in getattr(self, "_index_rebuild_pending", []):
+            self._backfill_index(ti, idx)
+        self._index_rebuild_pending = []
 
         self.engine = Session(
             self.catalog,
@@ -352,12 +390,27 @@ class Database:
         self.schema_service.apply_ddl(mutate)
         for ti in tables.values():
             ti.cached_data_version = -1
+            if not hasattr(ti, "indexes"):  # pre-index node_meta snapshots
+                ti.indexes = {}
             for rep in self.cluster.ls_groups[ti.ls_id].values():
                 if ti.tablet_id not in rep.tablets:
                     rep.create_tablet(ti.tablet_id, ti.schema, ti.key_cols)
+                for idx in ti.indexes.values():
+                    if idx.tablet_id not in rep.tablets:
+                        rep.create_tablet(idx.tablet_id, idx.schema, idx.key_cols)
             self._unique_keys[ti.name] = tuple(ti.key_cols)
         self.rootservice.next_tablet_id = meta["next_tablet_id"]
         self._ti_by_tablet = None
+        # index entries live in sstables installed outside the log (the
+        # direct-load analog); a checkpoint covers them, a crash since the
+        # last checkpoint may not — re-backfill is idempotent (same-content
+        # rows at a newer version) and restores completeness
+        self._index_rebuild_pending = [
+            (ti, idx)
+            for ti in tables.values()
+            for idx in ti.indexes.values()
+            if idx.status == "ready"
+        ]
 
     def _on_applied_record(self, rec) -> None:
         """Observer of every applied tx record. Normal operation: keeps GTS
@@ -499,15 +552,148 @@ class Database:
     def drop_table(self, stmt: A.DropTable) -> None:
         with self._ddl_lock:
             try:
-                self.rootservice.drop_table(stmt.name)
+                ti = self.rootservice.drop_table(stmt.name)
             except SchemaError:
                 if stmt.if_exists:
                     return
                 raise SqlError(f"no such table {stmt.name}") from None
+            for idx in getattr(ti, "indexes", {}).values():
+                for rep in self.cluster.ls_groups[ti.ls_id].values():
+                    rep.tablets.pop(idx.tablet_id, None)
             self.catalog.pop(stmt.name, None)
             self._unique_keys.pop(stmt.name, None)
             self._ti_by_tablet = None
             self.engine.executor.invalidate_table(stmt.name)
+            self._save_node_meta()
+
+    # ----------------------------------------------------------- indexes
+    def create_index(self, st: A.CreateIndex) -> None:
+        """Online-ish index build (src/storage/ddl direct-insert analog):
+
+        1. register the index under a momentary SHARE table lock — from
+           that instant every DML statement maintains it, and the SHARE
+           grant guarantees no tx holding ROW_X (staged base writes that
+           would miss maintenance) spans the registration;
+        2. backfill from a base-table snapshot taken after registration via
+           the direct-load path (an sstable at the snapshot version on all
+           replicas) — concurrent post-registration DML lands at HIGHER
+           commit versions, so MVCC ordering resolves every interleaving;
+        3. flip to ready."""
+        import time as _time
+
+        from ..tx.tablelock import LockMode, WouldBlock
+
+        with self._ddl_lock:
+            ti = self.tables.get(st.table)
+            if ti is None:
+                raise SqlError(f"no such table {st.table}")
+            if st.name in ti.indexes:
+                if st.if_not_exists:
+                    return
+                raise SqlError(f"index {st.name} already exists on {st.table}")
+            for c in st.columns:
+                if c not in ti.schema:
+                    raise SqlError(f"unknown column {c}")
+            icols = list(st.columns)
+            kcols = icols + [k for k in ti.key_cols if k not in icols]
+            ischema = Schema(tuple(Field(c, ti.schema[c]) for c in kcols))
+            ikey = icols if st.unique else kcols
+
+            lock_tx = -next(self._session_ids)  # DDL-private lock owner
+            deadline = _time.monotonic() + 10.0
+            while True:
+                try:
+                    self.lock_mgr.lock(lock_tx, ti.tablet_id, LockMode.SHARE)
+                    break
+                except WouldBlock:
+                    if _time.monotonic() > deadline:
+                        raise SqlError(
+                            f"create index on {st.table}: writers did not drain"
+                        ) from None
+                    _time.sleep(0.005)
+            try:
+                tablet_id = self.rootservice.create_index_tablet(
+                    ti.ls_id, ischema, ikey
+                )
+                idx = IndexInfo(
+                    st.name, st.table, tuple(icols), tablet_id, ischema,
+                    ikey, unique=st.unique,
+                )
+
+                def mutate(tables: dict) -> None:
+                    tables[st.table].indexes[st.name] = idx
+
+                ti.schema_version = self.schema_service.apply_ddl(mutate)
+            finally:
+                self.lock_mgr.release_all(lock_tx)
+            for rep in self.cluster.ls_groups[ti.ls_id].values():
+                rep.tablets[tablet_id].cache = self.block_cache
+            try:
+                self._backfill_index(ti, idx)
+            except Exception:
+                def unmutate(tables: dict) -> None:
+                    tables[st.table].indexes.pop(st.name, None)
+
+                self.schema_service.apply_ddl(unmutate)
+                for rep in self.cluster.ls_groups[ti.ls_id].values():
+                    rep.tablets.pop(tablet_id, None)
+                raise
+            self._save_node_meta()
+
+    def _backfill_index(self, ti: TableInfo, idx: IndexInfo) -> None:
+        """Fill the index tablet from a base snapshot (direct-load style:
+        one sorted sstable installed on every replica at the snapshot
+        version). Idempotent — re-running adds same-content rows at a newer
+        version, which is how crash recovery re-completes an index."""
+        from ..storage.sstable import SSTable, write_sstable
+
+        s0 = self.cluster.gts.next_ts()
+        rep = self._leader_replica(ti)
+        data = rep.tablets[ti.tablet_id].scan(s0, columns=list(idx.schema.names()))
+        n = len(data[idx.schema.names()[0]]) if idx.schema.names() else 0
+        if n:
+            keys = [data[k].astype(np.int64) for k in idx.key_cols]
+            order = np.lexsort(tuple(reversed(keys)))
+            cols = {c: data[c][order] for c in idx.schema.names()}
+            if idx.unique:
+                k2d = np.stack([cols[k].astype(np.int64) for k in idx.key_cols], axis=1)
+                dup = (k2d[1:] == k2d[:-1]).all(axis=1)
+                if dup.any():
+                    raise SqlError(
+                        f"unique index {idx.name}: duplicate value "
+                        f"{tuple(k2d[1:][dup][0])}"
+                    )
+            blob = write_sstable(
+                idx.schema, idx.key_cols, cols,
+                versions=np.full(n, s0, np.int64),
+                ops=np.zeros(n, np.int8),
+                base_version=0, end_version=s0,
+            )
+            for r in self.cluster.ls_groups[ti.ls_id].values():
+                t = r.tablets[idx.tablet_id]
+                with t._meta_lock:
+                    t.deltas.append(
+                        SSTable(blob, idx.schema, idx.key_cols,
+                                cache=self.block_cache)
+                    )
+        idx.build_version = s0
+        idx.status = "ready"
+
+    def drop_index(self, st: A.DropIndex) -> None:
+        with self._ddl_lock:
+            ti = self.tables.get(st.table)
+            idx = ti.indexes.get(st.name) if ti is not None else None
+            if idx is None:
+                if st.if_exists:
+                    return
+                raise SqlError(f"no such index {st.name} on {st.table}")
+
+            def mutate(tables: dict) -> None:
+                tables[st.table].indexes.pop(st.name, None)
+
+            ti.schema_version = self.schema_service.apply_ddl(mutate)
+            for rep in self.cluster.ls_groups[ti.ls_id].values():
+                rep.tablets.pop(idx.tablet_id, None)
             self._save_node_meta()
 
     # ---------------------------------------------------------- snapshots
@@ -659,6 +845,12 @@ class DbSession:
         if isinstance(stmt, A.DropTable):
             self.db.drop_table(stmt)
             return ResultSet((), {})
+        if isinstance(stmt, A.CreateIndex):
+            self.db.create_index(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.DropIndex):
+            self.db.drop_index(stmt)
+            return ResultSet((), {})
         if isinstance(stmt, A.Begin):
             if self._tx is not None:
                 raise SqlError("transaction already open")
@@ -734,9 +926,139 @@ class DbSession:
         raise SqlError(f"unsupported SHOW {st.what}")
 
     # ------------------------------------------------------------ select
+    _INDEX_ROUTE_MAX_ROWS = 4096
+
+    def _index_route(self, ast: A.Select) -> dict[str, Table] | None:
+        """DAS index/PK lookup analog (src/sql/das/iter): a single-table
+        statement whose WHERE pins an index prefix (or the full primary
+        key) with equality literals reads the few matching rows through the
+        host index path instead of materializing the whole table to the
+        device. Returns a statement-scoped {table: pruned Table} view, or
+        None to fall back to the full-scan path. Autocommit reads only —
+        in-tx statements keep their BEGIN-snapshot materialization."""
+        if not isinstance(ast, A.Select) or len(ast.from_) != 1:
+            return None
+        tref = ast.from_[0]
+        if not isinstance(tref, A.TableRef) or ast.ctes:
+            return None
+        from ..sql.planner import _contains_subquery
+
+        if _contains_subquery(ast):
+            return None
+        ti = self.db.tables.get(tref.name)
+        if ti is None or ast.where is None:
+            return None
+        alias = tref.alias or tref.name
+        from ..sql.planner import split_ast_conjuncts
+
+        eqs: dict[str, object] = {}
+        for c in split_ast_conjuncts(ast.where):
+            if not (isinstance(c, A.BinOp) and c.op == "="):
+                continue
+            lhs, rhs = c.left, c.right
+            if not isinstance(lhs, A.Name):
+                lhs, rhs = rhs, lhs
+            if not isinstance(lhs, A.Name):
+                continue
+            parts = lhs.parts
+            if len(parts) == 2 and parts[0] != alias:
+                continue
+            col = parts[-1]
+            if col not in ti.schema:
+                continue
+            try:
+                v = _eval_const(rhs)
+            except SqlError:
+                continue
+            # encode without growing the dictionary: an unknown string
+            # matches nothing (code -1 < every stored code)
+            dt = ti.schema[col]
+            if dt.kind is TypeKind.VARCHAR:
+                d = ti.dicts.get(col)
+                eqs[col] = d.encode_one(str(v), add=False) if d else -1
+            else:
+                try:
+                    eqs[col] = _coerce(v, dt, None, col)
+                except SqlError:
+                    continue  # untypable literal: leave it to the engine
+
+        if not eqs:
+            return None
+        snap = self.db.cluster.gts.current()
+        rep = self.db._leader_replica(ti)
+        rows: list[tuple] | None = None
+        used_idx = None
+        if set(ti.key_cols) <= set(eqs):
+            pk = tuple(int(eqs[k]) for k in ti.key_cols)
+            hit = rep.tablets[ti.tablet_id].get(pk, snap)
+            rows = [hit[1]] if hit is not None else []
+        else:
+            best = None
+            for idx in ti.indexes.values():
+                if idx.status != "ready":
+                    continue
+                m = 0
+                for c in idx.cols:
+                    if c in eqs:
+                        m += 1
+                    else:
+                        break
+                if m and (best is None or m > best[1]):
+                    best = (idx, m)
+            if best is None:
+                return None
+            idx, m = best
+            ranges = {
+                c: (float(eqs[c]), float(eqs[c])) for c in idx.cols[:m]
+            }
+            idata = rep.tablets[idx.tablet_id].scan(snap, ranges=ranges)
+            # ranges only PRUNE (zone maps; memtable rows come back whole):
+            # apply the exact equality filter before fetching base rows
+            if len(idata[idx.key_cols[0]]):
+                m_ok = np.ones(len(idata[idx.key_cols[0]]), dtype=bool)
+                for c in idx.cols[:m]:
+                    m_ok &= idata[c] == eqs[c]
+                idata = {c: a[m_ok] for c, a in idata.items()}
+            pk_arrays = [idata[k] for k in ti.key_cols]
+            npk = len(pk_arrays[0]) if pk_arrays else 0
+            if npk > self._INDEX_ROUTE_MAX_ROWS:
+                return None  # not selective enough: full scan wins
+            rows = []
+            for i in range(npk):
+                pk = tuple(int(a[i]) for a in pk_arrays)
+                hit = rep.tablets[ti.tablet_id].get(pk, snap)
+                if hit is not None:
+                    rows.append(hit[1])
+            used_idx = idx
+        names = ti.schema.names()
+        data = {
+            c: np.array([r[j] for r in rows], dtype=ti.schema[c].storage_np)
+            for j, c in enumerate(names)
+        }
+        dicts = {}
+        for col in ti.dicts:
+            sd, remap = ti.sorted_dict(col)
+            if len(data[col]):
+                data[col] = remap[data[col]]
+            dicts[col] = sd
+        if used_idx is not None:
+            used_idx.reads += 1
+        return {tref.name: Table(tref.name, ti.schema, data, dicts)}
+
     def _select(self, ast: A.Select, norm_key: str) -> ResultSet:
         names = _tables_in_ast(ast)
         any_vt = self.db.refresh_virtual(names)
+        route = None
+        if self._tx is None and not any_vt and isinstance(ast, A.Select):
+            route = self._index_route(ast)
+        if route is not None:
+            self.db.refresh_catalog(
+                [n for n in names if n not in route], tx=None
+            )
+            with self.db.catalog.tx_scope(route):
+                rs = self.db.engine.run_ast(ast, norm_key)
+            self._stmt_cache_hit = rs.plan_cache_hit
+            return rs
         self.db.refresh_catalog(names, tx=self._tx)
         in_tx = self._tx is not None and self._tx.ctx is not None
         views = self._tx.views if in_tx else None
@@ -847,13 +1169,16 @@ class DbSession:
                 )
 
     def _stage_all(self, tx: _OpenTx, ti: TableInfo,
-                   muts: list[tuple[tuple, int, tuple | None]]) -> int:
+                   muts: list[tuple[tuple, int, tuple | None]],
+                   index_muts: list[tuple[int, tuple, int, tuple | None]] = (),
+                   ) -> int:
         """Stage a fully-validated mutation batch (statement atomicity: no
         row reaches the memtable until the whole statement has resolved, so
         a failed statement inside an explicit tx leaves no partial writes).
         A WriteConflict during staging still aborts the whole tx — that is
-        transaction, not statement, semantics (first-committer-wins)."""
-        if muts:
+        transaction, not statement, semantics (first-committer-wins).
+        Index mutations ride the same tx on the same log stream (1PC)."""
+        if muts or index_muts:
             from ..tx.tablelock import LockMode
 
             # implicit intention lock: DML conflicts with explicit
@@ -862,8 +1187,40 @@ class DbSession:
             tx.ensure_leader(ti.ls_id)
             for key, op, vals in muts:
                 tx.svc.write(tx.ctx, ti.ls_id, ti.tablet_id, key, op, vals)
+            for tab_id, key, op, vals in index_muts:
+                tx.svc.write(tx.ctx, ti.ls_id, tab_id, key, op, vals)
             tx.touched_tables.add(ti.name)
         return len(muts)
+
+    @staticmethod
+    def _index_entry(ti: TableInfo, idx: IndexInfo, vals: tuple):
+        """(index key, index row values) of a base row's index entry."""
+        vmap = {f.name: vals[i] for i, f in enumerate(ti.schema.fields)}
+        ivals = tuple(vmap[c] for c in idx.schema.names())
+        ikey = tuple(int(vmap[c]) for c in idx.key_cols)
+        return ikey, ivals
+
+    def _check_unique(self, tx: _OpenTx, ti: TableInfo, idx: IndexInfo,
+                      ikey: tuple, own_pk: tuple | None = None) -> None:
+        """Reject a committed conflicting entry for a UNIQUE index key.
+        Concurrent in-flight writers of the same key are handled by the
+        memtable's first-committer-wins staging conflict."""
+        rep = tx.svc.replicas[ti.ls_id]
+        hit = rep.tablets[idx.tablet_id].get(
+            ikey, tx.ctx.read_snapshot, tx_id=tx.ctx.tx_id
+        )
+        if hit is None:
+            return
+        if own_pk is not None:
+            names = idx.schema.names()
+            hit_pk = tuple(
+                int(hit[1][names.index(k)]) for k in ti.key_cols
+            )
+            if hit_pk == own_pk:
+                return
+        raise SqlError(
+            f"unique index {idx.name} violation on {ikey} in {ti.name}"
+        )
 
     def _insert(self, st: A.Insert, tx: _OpenTx) -> int:
         ti = self.db.tables.get(st.table)
@@ -903,8 +1260,21 @@ class DbSession:
                 raise SqlError(f"duplicate primary key {key} in {st.table}")
             seen.add(key)
             muts.append((key, OP_PUT, vals))
+        index_muts: list[tuple[int, tuple, int, tuple | None]] = []
+        for idx in ti.indexes.values():
+            seen_i: set[tuple] = set()
+            for key, _op, vals in muts:
+                ikey, ivals = self._index_entry(ti, idx, vals)
+                if idx.unique:
+                    if ikey in seen_i:
+                        raise SqlError(
+                            f"unique index {idx.name} violation on {ikey}"
+                        )
+                    seen_i.add(ikey)
+                    self._check_unique(tx, ti, idx, ikey)
+                index_muts.append((idx.tablet_id, ikey, OP_PUT, ivals))
         self._note_dict_appends(tx, ti)
-        return self._stage_all(tx, ti, muts)
+        return self._stage_all(tx, ti, muts, index_muts)
 
     def _qualify(self, st, ti: TableInfo, cols: list[str],
                  set_exprs: tuple[tuple[str, A.Node], ...] = ()) -> ResultSet:
@@ -944,35 +1314,59 @@ class DbSession:
         set_cols = {col: rs.columns[f"$set{i}"]
                     for i, (col, _) in enumerate(computed)}
         muts: list[tuple[tuple, int, tuple | None]] = []
+        index_muts: list[tuple[int, tuple, int, tuple | None]] = []
         for r in range(rs.nrows):
             vals = []
+            old_vals = []
             for f in ti.schema.fields:
+                ov = rs.columns[f.name][r]
+                old_vals.append(_coerce(ov, f.dtype, ti.dicts.get(f.name), f.name))
                 if f.name in const_sets:
                     v = const_sets[f.name]
                 else:
                     src = set_cols.get(f.name)
-                    v = src[r] if src is not None else rs.columns[f.name][r]
+                    v = src[r] if src is not None else ov
                 vals.append(_coerce(v, f.dtype, ti.dicts.get(f.name), f.name))
             vals = tuple(vals)
+            old_vals = tuple(old_vals)
             key = tuple(int(vals[ti.schema.index(k)]) for k in ti.key_cols)
             muts.append((key, OP_PUT, vals))
+            for idx in ti.indexes.values():
+                old_ik, _ = self._index_entry(ti, idx, old_vals)
+                new_ik, new_iv = self._index_entry(ti, idx, vals)
+                if old_ik == new_ik:
+                    continue  # entry content (key cols + pk) unchanged
+                if idx.unique:
+                    self._check_unique(tx, ti, idx, new_ik, own_pk=key)
+                index_muts.append((idx.tablet_id, old_ik, OP_DELETE, None))
+                index_muts.append((idx.tablet_id, new_ik, OP_PUT, new_iv))
         self._note_dict_appends(tx, ti)
-        return self._stage_all(tx, ti, muts)
+        return self._stage_all(tx, ti, muts, index_muts)
 
     def _delete(self, st: A.Delete, tx: _OpenTx) -> int:
         ti = self.db.tables.get(st.table)
         if ti is None:
             raise SqlError(f"no such table {st.table}")
-        rs = self._qualify(st, ti, list(ti.key_cols))
+        # the qualification scan must surface every indexed column so the
+        # old index entries can be tombstoned alongside the base rows
+        cols = list(dict.fromkeys(
+            list(ti.key_cols)
+            + [c for idx in ti.indexes.values() for c in idx.key_cols]
+        ))
+        rs = self._qualify(st, ti, cols)
         muts: list[tuple[tuple, int, tuple | None]] = []
+        index_muts: list[tuple[int, tuple, int, tuple | None]] = []
         for r in range(rs.nrows):
-            key = tuple(
-                int(_coerce(rs.columns[k][r], ti.schema[k],
-                            ti.dicts.get(k), k))
-                for k in ti.key_cols
-            )
+            row = {
+                c: _coerce(rs.columns[c][r], ti.schema[c], ti.dicts.get(c), c)
+                for c in cols
+            }
+            key = tuple(int(row[k]) for k in ti.key_cols)
             muts.append((key, OP_DELETE, None))
-        return self._stage_all(tx, ti, muts)
+            for idx in ti.indexes.values():
+                ikey = tuple(int(row[c]) for c in idx.key_cols)
+                index_muts.append((idx.tablet_id, ikey, OP_DELETE, None))
+        return self._stage_all(tx, ti, muts, index_muts)
 
 
 # ---- helpers ---------------------------------------------------------------

@@ -68,16 +68,54 @@ class TableApi:
 
     # --------------------------------------------------------------- ops
     def _tx_op(self, muts: list[tuple[tuple, int, tuple | None]]) -> None:
-        """One autocommit tx staging the given mutations (batch = atomic)."""
+        """One autocommit tx staging the given mutations (batch = atomic).
+        Secondary indexes are maintained in the same tx: puts are upserts,
+        so the OLD row is read first to tombstone superseded entries."""
         ti = self._ti
         tx = _OpenTx(self.db)
         from ..tx.tablelock import LockMode
+        from .database import DbSession
 
         try:
             self.db.lock_mgr.lock(tx.ctx.tx_id, ti.tablet_id, LockMode.ROW_X)
             tx.ensure_leader(ti.ls_id)
+            rep = tx.svc.replicas[ti.ls_id]
+            index_muts: list[tuple[int, tuple, int, tuple | None]] = []
+            if ti.indexes:
+                for key, op, vals in muts:
+                    old = rep.tablets[ti.tablet_id].get(
+                        key, tx.ctx.read_snapshot, tx_id=tx.ctx.tx_id
+                    )
+                    for idx in ti.indexes.values():
+                        old_ik = (
+                            DbSession._index_entry(ti, idx, old[1])[0]
+                            if old is not None else None
+                        )
+                        if op == OP_DELETE:
+                            if old_ik is not None:
+                                index_muts.append(
+                                    (idx.tablet_id, old_ik, OP_DELETE, None))
+                            continue
+                        new_ik, new_iv = DbSession._index_entry(ti, idx, vals)
+                        if old_ik == new_ik:
+                            continue
+                        if idx.unique:
+                            hit = rep.tablets[idx.tablet_id].get(
+                                new_ik, tx.ctx.read_snapshot,
+                                tx_id=tx.ctx.tx_id)
+                            if hit is not None:
+                                raise SqlError(
+                                    f"unique index {idx.name} violation on "
+                                    f"{new_ik}")
+                        if old_ik is not None:
+                            index_muts.append(
+                                (idx.tablet_id, old_ik, OP_DELETE, None))
+                        index_muts.append(
+                            (idx.tablet_id, new_ik, OP_PUT, new_iv))
             for key, op, vals in muts:
                 tx.svc.write(tx.ctx, ti.ls_id, ti.tablet_id, key, op, vals)
+            for tab_id, key, op, vals in index_muts:
+                tx.svc.write(tx.ctx, ti.ls_id, tab_id, key, op, vals)
             self.db.cluster.commit_sync(tx.svc, tx.ctx)
             ti.data_version += 1
         except Exception:

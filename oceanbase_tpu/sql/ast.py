@@ -251,6 +251,25 @@ class DropTable(Node):
 
 
 @dataclass(frozen=True)
+class CreateIndex(Node):
+    """CREATE [UNIQUE] INDEX name ON table (cols...). Reference surface:
+    the DDL resolver + direct-insert index build (src/storage/ddl)."""
+
+    name: str
+    table: str
+    columns: tuple[str, ...]
+    unique: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropIndex(Node):
+    name: str
+    table: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class Insert(Node):
     table: str
     columns: tuple[str, ...]  # empty -> full schema order

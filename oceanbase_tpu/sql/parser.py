@@ -37,7 +37,7 @@ KEYWORDS = {
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
     "commit", "rollback", "alter", "system", "show", "parameters", "tables",
-    "lock", "mode", "share", "exclusive",
+    "lock", "mode", "share", "exclusive", "unique", "index",
 }
 
 
@@ -196,8 +196,26 @@ class Parser:
             self.expect("transaction")
         return A.Begin()
 
-    def _create(self) -> A.CreateTable:
+    def _create(self) -> "A.CreateTable | A.CreateIndex":
         self.expect("create")
+        unique = self.accept("unique")
+        if self.accept("index"):
+            if_not_exists = False
+            if self.accept("if"):
+                self.expect("not")
+                self.expect("exists")
+                if_not_exists = True
+            name = self.next().value
+            self.expect("on")
+            table = self.next().value
+            self.expect("(")
+            cols = [self.next().value]
+            while self.accept(","):
+                cols.append(self.next().value)
+            self.expect(")")
+            return A.CreateIndex(name, table, tuple(cols), unique, if_not_exists)
+        if unique:
+            raise SyntaxError("UNIQUE outside CREATE UNIQUE INDEX")
         self.expect("table")
         if_not_exists = False
         if self.accept("if"):
@@ -236,8 +254,16 @@ class Parser:
         self.expect(")")
         return A.CreateTable(name, tuple(cols), pk, if_not_exists)
 
-    def _drop(self) -> A.DropTable:
+    def _drop(self) -> "A.DropTable | A.DropIndex":
         self.expect("drop")
+        if self.accept("index"):
+            if_exists = False
+            if self.accept("if"):
+                self.expect("exists")
+                if_exists = True
+            name = self.next().value
+            self.expect("on")
+            return A.DropIndex(name, self.next().value, if_exists)
         self.expect("table")
         if_exists = False
         if self.accept("if"):

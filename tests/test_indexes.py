@@ -1,0 +1,157 @@
+"""Secondary indexes: DDL, online build under concurrent writes, DML
+maintenance, unique enforcement, index-backed point reads, restart.
+
+Reference surface: src/storage/ddl (direct-insert index build) and
+src/sql/das/iter (index lookup iterators)."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+
+@pytest.fixture()
+def db():
+    d = Database(n_nodes=3, n_ls=2)
+    s = d.session()
+    s.sql("create table emp (id bigint primary key, dept int, "
+          "name varchar, sal decimal(10,2))")
+    for i in range(1, 41):
+        s.sql(f"insert into emp values ({i}, {i % 5}, 'n{i % 7}', {100 + i})")
+    return d
+
+
+def test_create_index_and_point_read(db):
+    s = db.session()
+    s.sql("create index i_dept on emp (dept)")
+    ti = db.tables["emp"]
+    idx = ti.indexes["i_dept"]
+    assert idx.status == "ready"
+    rs = s.sql("select id from emp where dept = 3 order by id")
+    assert list(rs.columns["id"]) == [i for i in range(1, 41) if i % 5 == 3]
+    assert idx.reads == 1  # the statement went through the index route
+
+
+def test_index_maintained_by_dml(db):
+    s = db.session()
+    s.sql("create index i_dept on emp (dept)")
+    idx = db.tables["emp"].indexes["i_dept"]
+    s.sql("insert into emp values (100, 3, 'x', 1.5)")
+    s.sql("update emp set dept = 4 where id = 3")  # was dept 3
+    s.sql("delete from emp where id = 8")          # was dept 3
+    rs = s.sql("select id from emp where dept = 3 order by id")
+    want = sorted(
+        [i for i in range(1, 41) if i % 5 == 3 and i not in (3, 8)] + [100]
+    )
+    assert list(rs.columns["id"]) == want
+    assert idx.reads >= 1
+    # the filter column itself: updated row must appear under its new value
+    rs = s.sql("select id from emp where dept = 4 order by id")
+    assert 3 in list(rs.columns["id"])
+
+
+def test_index_on_string_column(db):
+    s = db.session()
+    s.sql("create index i_name on emp (name)")
+    idx = db.tables["emp"].indexes["i_name"]
+    rs = s.sql("select id from emp where name = 'n2' order by id")
+    assert list(rs.columns["id"]) == [i for i in range(1, 41) if i % 7 == 2]
+    assert idx.reads == 1
+    # unknown string: no rows, no dictionary growth
+    n0 = len(db.tables["emp"].dicts["name"])
+    rs = s.sql("select id from emp where name = 'nope'")
+    assert rs.nrows == 0
+    assert len(db.tables["emp"].dicts["name"]) == n0
+
+
+def test_unique_index_enforced(db):
+    s = db.session()
+    s.sql("create table acct (id bigint primary key, email varchar)")
+    s.sql("insert into acct values (1, 'a'), (2, 'b')")
+    s.sql("create unique index u_email on acct (email)")
+    with pytest.raises(SqlError, match="unique index"):
+        s.sql("insert into acct values (3, 'a')")
+    s.sql("insert into acct values (3, 'c')")
+    with pytest.raises(SqlError, match="unique index"):
+        s.sql("update acct set email = 'b' where id = 3")
+    # updating to its own current value is fine
+    s.sql("update acct set email = 'c' where id = 3")
+
+
+def test_unique_index_build_rejects_duplicates(db):
+    s = db.session()
+    with pytest.raises(SqlError, match="duplicate"):
+        s.sql("create unique index u_dept on emp (dept)")
+    assert "u_dept" not in db.tables["emp"].indexes
+
+
+def test_build_under_concurrent_open_tx(db):
+    """An open tx writing the base table blocks index registration (SHARE
+    vs ROW_X) until it ends; after commit the index covers its rows."""
+    s1 = db.session()
+    s2 = db.session()
+    s1.sql("begin")
+    s1.sql("insert into emp values (200, 9, 'zz', 1)")
+    with pytest.raises(SqlError, match="writers did not drain"):
+        s2.sql("create index i_dept on emp (dept)")
+    s1.sql("commit")
+    s2.sql("create index i_dept on emp (dept)")
+    rs = s2.sql("select id from emp where dept = 9")
+    assert list(rs.columns["id"]) == [200]
+
+
+def test_composite_index_prefix(db):
+    s = db.session()
+    s.sql("create index i_dn on emp (dept, name)")
+    idx = db.tables["emp"].indexes["i_dn"]
+    rs = s.sql("select id from emp where dept = 1 and name = 'n3' order by id")
+    want = [i for i in range(1, 41) if i % 5 == 1 and i % 7 == 3]
+    assert list(rs.columns["id"]) == want
+    # prefix-only equality also routes
+    rs = s.sql("select count(*) as n from emp where dept = 1")
+    assert rs.columns["n"][0] == sum(1 for i in range(1, 41) if i % 5 == 1)
+    assert idx.reads == 2
+
+
+def test_pk_point_read_route(db):
+    s = db.session()
+    rs = s.sql("select name, sal from emp where id = 7")
+    assert rs.nrows == 1 and rs.columns["name"][0] == "n0"
+
+
+def test_drop_index(db):
+    s = db.session()
+    s.sql("create index i_dept on emp (dept)")
+    tablet_id = db.tables["emp"].indexes["i_dept"].tablet_id
+    s.sql("drop index i_dept on emp")
+    assert "i_dept" not in db.tables["emp"].indexes
+    for rep in db.cluster.ls_groups[db.tables["emp"].ls_id].values():
+        assert tablet_id not in rep.tablets
+    # full scan still works
+    rs = s.sql("select count(*) as n from emp where dept = 3")
+    assert rs.columns["n"][0] == 8
+
+
+def test_index_survives_restart(tmp_path):
+    d = Database(n_nodes=3, n_ls=1, data_dir=str(tmp_path), fsync=False)
+    s = d.session()
+    s.sql("create table t (id bigint primary key, v int)")
+    for i in range(1, 21):
+        s.sql(f"insert into t values ({i}, {i % 4})")
+    s.sql("create index i_v on t (v)")
+    s.sql("insert into t values (21, 3)")
+    d.close()
+    del d, s
+
+    d2 = Database(data_dir=str(tmp_path), fsync=False)
+    s2 = d2.session()
+    idx = d2.tables["t"].indexes["i_v"]
+    assert idx.status == "ready"
+    rs = s2.sql("select id from t where v = 3 order by id")
+    assert list(rs.columns["id"]) == [3, 7, 11, 15, 19, 21]
+    assert idx.reads == 1
+    # maintained after restart too
+    s2.sql("delete from t where id = 7")
+    rs = s2.sql("select id from t where v = 3 order by id")
+    assert list(rs.columns["id"]) == [3, 11, 15, 19, 21]
+    d2.close()
