@@ -156,7 +156,20 @@ def main():
         prepared = sess.executor.prepare(pz.plan)
         qp = bind(pz.values, pz.dtypes)
         prepared.run(qparams=qp)  # warm
-        tpu_t[qname], _ = _best(lambda p=prepared, q=qp: p.run(qparams=q), reps)
+        # device throughput, amortized: dispatch K executions (the device
+        # runs them back to back) and sync once at the end — a single
+        # dispatch+fetch would mostly measure host<->device round-trip
+        # latency, not the program (async dispatch returns immediately)
+        K = 8
+
+        def _run_k(p=prepared, q=qp):
+            out = None
+            for _ in range(K):
+                out = p.run_nocheck(qparams=q)
+            return int(out.nrows)
+
+        t, _ = _best(_run_k, reps)
+        tpu_t[qname] = t / K
 
     # ---- correctness cross-checks --------------------------------------
     ok = True

@@ -301,6 +301,9 @@ class ChunkedPreparedPlan:
         self._merge_prepared = None
         self._merge_cap = 0
 
+    def run_nocheck(self, qparams: tuple = ()):
+        return self.run(qparams=qparams)
+
     def run(self, max_retries: int = 3, qparams: tuple = ()):
         import jax
         import jax.numpy as jnp

@@ -41,13 +41,15 @@ from ..expr.compile import (
     evaluate,
     infer_type,
 )
-from ..ops.hashagg import assign_group_slots, _apply_agg
+from ..ops.hashagg import assign_group_slots, sort_groupby
 from ..ops.hashing import next_pow2, pack_keys
 from ..ops.join import (
     build_hash_table,
     expand_join,
     hash_join_probe,
     join_keys64,
+    merge_join_unique,
+    probe_run_any,
     sort_build_side,
 )
 from ..ops.sort import sort_indices
@@ -62,13 +64,45 @@ from ..sql.logical import (
     Scan,
     SetOp,
     Sort,
+    TopN,
     Window,
     output_schema,
     setop_schema,
     window_out_type,
 )
 
-DIRECT_GROUPBY_MAX_DOMAIN = 1 << 12
+# direct group-by = one fused masked reduction per (slot, aggregate): dirt
+# cheap on the VPU for small domains (measured ~2.4ms for 8 slots over 8M
+# rows) but linear in the domain, so the cap is small; larger domains ride
+# the sort-based path (a TPU scatter costs ~1.1s per 8M rows, so the old
+# scatter-direct design lost to sorting even at domain 8)
+DIRECT_GROUPBY_MAX_DOMAIN = 1 << 6
+
+# synthetic PhysicalParams id for the root result-compaction capacity
+ROOT_COMPACT = -1
+
+
+def compact_batch(b: ColumnBatch, cap2: int):
+    """Compact live rows to a smaller capacity, preserving their relative
+    order (stable sort by deadness). Returns (batch, overflow count).
+    Used at plan roots so device->host result transfer moves O(result)
+    bytes, not O(input capacity)."""
+    if b.capacity <= cap2:
+        return b, jnp.zeros((), jnp.int64)
+    idx = jnp.arange(b.capacity, dtype=jnp.int32)
+    _dead, sidx = jax.lax.sort((~b.sel, idx), num_keys=2)
+    take = sidx[:cap2]
+    nlive = jnp.sum(b.sel, dtype=jnp.int64)
+    sel = jnp.arange(cap2, dtype=jnp.int64) < nlive
+    out = ColumnBatch(
+        cols={n: c[take] for n, c in b.cols.items()},
+        valid={n: v[take] for n, v in b.valid.items()},
+        sel=sel,
+        nrows=jnp.minimum(nlive, cap2),
+        schema=b.schema,
+        dicts=b.dicts,
+    )
+    return out, jnp.maximum(nlive - cap2, 0)
 
 
 @dataclass
@@ -103,7 +137,8 @@ def _number_nodes(plan: LogicalOp) -> dict[int, LogicalOp]:
 
 
 def _children(op: LogicalOp):
-    if isinstance(op, (Filter, Project, Sort, Limit, Distinct, Aggregate, Window)):
+    if isinstance(op, (Filter, Project, Sort, Limit, Distinct, Aggregate,
+                       Window, TopN)):
         return [op.child]
     if isinstance(op, (JoinOp, SetOp)):
         return [op.left, op.right]
@@ -188,7 +223,7 @@ class Executor:
                 for _, _, a, _ in op.aggs:
                     if a is not None:
                         note(a)
-            if isinstance(op, Sort):
+            if isinstance(op, (Sort, TopN)):
                 for e, _ in op.keys:
                     note(e)
             if isinstance(op, Window):
@@ -281,7 +316,7 @@ class Executor:
             return min(child, float(self.default_rows_estimate))
         if isinstance(op, (Project, Sort, Distinct, Window)):
             return est_rows(op.child)
-        if isinstance(op, Limit):
+        if isinstance(op, (Limit, TopN)):
             return float(op.n + op.offset)
         if isinstance(op, SetOp):
             l, r = est_rows(op.left), est_rows(op.right)
@@ -297,33 +332,20 @@ class Executor:
         nodes = _number_nodes(plan)
         est_rows = self._est_rows
 
+        # root compaction capacity: results travel device->host compacted
+        # to the estimated output size (pulling a full input-capacity batch
+        # to the host costs seconds at SF>=1); overflow retries apply
+        params.join_cap[ROOT_COMPACT] = next_pow2(
+            int(2 * est_rows(plan)) + 1024
+        )
+        # group-by / distinct / set-op dedup are sort-based: output reuses
+        # the input capacity, so no table sizes (and no overflow retries)
+        # are seeded for them
         for nid, op in nodes.items():
-            if isinstance(op, Aggregate):
-                # hash-table capacity: group-count estimate when NDV stats
-                # resolve (margin absorbs sampling error), else child rows
-                nd = self._group_ndv(op)
-                target = (
-                    min(est_rows(op.child), nd * 1.5 + 64)
-                    if nd is not None else est_rows(op.child)
-                )
-                params.groupby_size[nid] = next_pow2(
-                    int(2 * min(target, 1 << 21)) + 16
-                )
-            if isinstance(op, Distinct):
-                params.groupby_size[nid] = next_pow2(
-                    int(2 * min(est_rows(op.child), 1 << 21)) + 16
-                )
-            if isinstance(op, SetOp) and not (op.kind == "union" and op.all):
-                # dedup table over the left side (+ right for UNION)
-                base = est_rows(op.left)
-                if op.kind == "union":
-                    base += est_rows(op.right)
-                params.groupby_size[nid] = next_pow2(
-                    int(2 * min(base, 1 << 21)) + 16
-                )
             if isinstance(op, JoinOp):
                 needs_cap = (
-                    (op.kind in ("inner", "cross") and not self._join_build_unique(op))
+                    (op.kind in ("inner", "cross")
+                     and not self._merge_joinable(op))
                     or (op.kind in ("semi", "anti") and op.residual is not None)
                     or op.kind == "left"
                 )
@@ -337,6 +359,31 @@ class Executor:
                         cap = int(est_rows(op)) * 2 + 1024
                     params.join_cap[nid] = -(-cap // 1024) * 1024
         return params
+
+    def _merge_joinable(self, op: JoinOp) -> bool:
+        """True when the join rides the combined-sort unique-build merge
+        path (no pair expansion, no capacity): unique build side and one
+        integer-typed key per side (dates, dict codes, ints, decimals —
+        everything the engine stores as integers). Multi-column or
+        non-integer keys go through expand_join, whose exact pair
+        verification is collision-safe for hashed keys."""
+        if not self._join_build_unique(op):
+            return False
+        if not op.left_keys:  # scalar-subquery cross: constant int key
+            return True
+        if len(op.left_keys) != 1:
+            return False
+        from ..expr.compile import infer_type
+
+        try:
+            lt = infer_type(op.left_keys[0], output_schema(op.left))
+            rt = infer_type(op.right_keys[0], output_schema(op.right))
+        except Exception:
+            return False
+        return (
+            np.issubdtype(lt.storage_np, np.integer)
+            and np.issubdtype(rt.storage_np, np.integer)
+        )
 
     @staticmethod
     def _conjuncts(e):
@@ -465,6 +512,9 @@ class Executor:
                 out, ovf = emit(plan, inputs)
             finally:
                 expr_compile.set_params(prev)
+            out, oc = compact_batch(out, params.join_cap[ROOT_COMPACT])
+            ovf = dict(ovf)
+            ovf[ROOT_COMPACT] = oc
             ovf_vec = [
                 ovf.get(nid, jnp.zeros((), jnp.int64)) for nid in overflow_nodes
             ]
@@ -539,7 +589,7 @@ class Executor:
 
         if isinstance(op, Distinct):
             child, ovf = emit(op.child, inputs)
-            return self._dedup_batch(child, params.groupby_size[nid], nid, ovf)
+            return self._dedup_batch(child, ovf)
 
         if isinstance(op, Sort):
             child, ovf = emit(op.child, inputs)
@@ -571,6 +621,13 @@ class Executor:
             )
             return child.with_sel(keep), ovf
 
+        if isinstance(op, TopN):
+            child, ovf = emit(op.child, inputs)
+            return (
+                self._topn_batch(child, op.keys, op.n, op.offset),
+                ovf,
+            )
+
         if isinstance(op, SetOp):
             return self._emit_setop(op, nid, inputs, emit, params)
 
@@ -578,6 +635,32 @@ class Executor:
             return self._emit_window(op, nid, inputs, emit, params)
 
         raise NotImplementedError(type(op))
+
+    def _topn_batch(self, child: ColumnBatch, keys, n: int, offset: int,
+                    apply_offset: bool = True) -> ColumnBatch:
+        """Fused ORDER BY + LIMIT: sort for the order, materialize only the
+        top n+offset rows (tiny gathers instead of a full-capacity payload
+        permutation). The output keeps global order in its row order."""
+        key_vals, desc = [], []
+        for e, d in keys:
+            v, _ = evaluate(e, child)
+            key_vals.append(v)
+            desc.append(d)
+        order = sort_indices(key_vals, desc, child.sel)
+        k = n + offset
+        cap2 = min(child.capacity, max(8, -(-k // 8) * 8))
+        take = order[:cap2]
+        pos = jnp.arange(cap2, dtype=jnp.int64)
+        nlive = jnp.sum(child.sel, dtype=jnp.int64)
+        lo = offset if apply_offset else 0
+        sel = (pos >= lo) & (pos < jnp.minimum(k, nlive))
+        cols = {nm: c[take] for nm, c in child.cols.items()}
+        valid = {nm: v[take] for nm, v in child.valid.items()}
+        return ColumnBatch(
+            cols=cols, valid=valid, sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=child.schema, dicts=child.dicts,
+        )
 
     # ---- join emission -------------------------------------------------
     def _emit_join(self, op: JoinOp, nid, inputs, emit, params):
@@ -598,11 +681,8 @@ class Executor:
             rkeys = [jnp.zeros(right.capacity, dtype=jnp.int32)]
         merged_dicts = {**left.dicts, **right.dicts}
 
-        if self._join_build_unique(op):
-            nb = rkeys[0].shape[0] if rkeys else right.capacity
-            ts = next_pow2(max(2 * nb, 16))
-            slot_key, slot_row = build_hash_table(rkeys, right.sel, ts)
-            match = hash_join_probe(slot_key, slot_row, rkeys, lkeys, left.sel)
+        if self._merge_joinable(op):
+            match = merge_join_unique(rkeys[0], right.sel, lkeys[0], left.sel)
             sel = left.sel & (match >= 0)
             idx = jnp.clip(match, 0, None)
             cols = dict(left.cols)
@@ -623,7 +703,7 @@ class Executor:
         else:
             cap = params.join_cap[nid]
             skeys, order = sort_build_side(rkeys, right.sel)
-            pr, br, valid_rows, total = expand_join(
+            pr, br, valid_rows, total, _st, _of = expand_join(
                 skeys, order, right.nrows, lkeys, left.sel, cap
             )
             cols = {}
@@ -660,26 +740,40 @@ class Executor:
 
     def _emit_semi_anti(self, op: JoinOp, nid, inputs, emit, params):
         """Semi/anti join: output = left rows with (without) a matching right
-        row. No residual: a single hash-probe existence test (duplicate build
-        keys are fine — one witness per key suffices, and the probe
-        exact-verifies key columns). With residual: expand candidate pairs,
-        evaluate the residual per pair, scatter-or a has-match bit per left
-        row."""
+        row. No residual, single integer key: sorted-build + searchsorted
+        range counts (exact — true keys, no hashing, no table). No residual,
+        multi-column keys: the open-addressing existence probe (cold path).
+        With residual: expand candidate pairs, evaluate the residual per
+        pair, and reduce a has-match bit per left row scatter-free via the
+        pair-run cumsum (probe_run_any)."""
         left, lovf = emit(op.left, inputs)
         right, rovf = emit(op.right, inputs)
         ovf = {**lovf, **rovf}
         lkeys = [evaluate(e, left)[0] for e in op.left_keys]
         rkeys = [evaluate(e, right)[0] for e in op.right_keys]
         if op.residual is None:
-            nb = rkeys[0].shape[0]
-            ts = next_pow2(max(2 * nb, 16))
-            slot_key, slot_row = build_hash_table(rkeys, right.sel, ts)
-            match = hash_join_probe(slot_key, slot_row, rkeys, lkeys, left.sel)
-            has = match >= 0
+            if len(lkeys) == 1 and jnp.issubdtype(lkeys[0].dtype, jnp.integer) \
+                    and jnp.issubdtype(rkeys[0].dtype, jnp.integer):
+                skeys, _order = sort_build_side(rkeys, right.sel)
+                pk = jnp.where(
+                    left.sel, lkeys[0].astype(jnp.int64),
+                    jnp.iinfo(jnp.int64).max,
+                )
+                lo = jnp.searchsorted(skeys, pk, side="left", method="sort")
+                hi = jnp.searchsorted(skeys, pk, side="right", method="sort")
+                has = left.sel & (hi > lo)
+            else:
+                nb = rkeys[0].shape[0]
+                ts = next_pow2(max(2 * nb, 16))
+                slot_key, slot_row = build_hash_table(rkeys, right.sel, ts)
+                match = hash_join_probe(
+                    slot_key, slot_row, rkeys, lkeys, left.sel
+                )
+                has = match >= 0
         else:
             cap = params.join_cap[nid]
             skeys, order = sort_build_side(rkeys, right.sel)
-            pr, br, valid_rows, total = expand_join(
+            pr, br, valid_rows, total, starts, offs = expand_join(
                 skeys, order, right.nrows, lkeys, left.sel, cap
             )
             pair_sel = valid_rows
@@ -702,12 +796,7 @@ class Executor:
                 dicts={**left.dicts, **right.dicts},
             )
             pair_ok = compile_predicate(op.residual, pair_batch)
-            n = left.capacity
-            has = (
-                jnp.zeros(n, dtype=jnp.bool_)
-                .at[pr]
-                .max(pair_ok, mode="drop")
-            )
+            has = probe_run_any(pair_ok, starts, offs)
             ovf = dict(ovf)
             ovf[nid] = jnp.maximum(total - cap, 0)
         sel = left.sel & (has if op.kind == "semi" else ~has)
@@ -724,7 +813,7 @@ class Executor:
         rkeys = [evaluate(e, right)[0] for e in op.right_keys]
         cap = params.join_cap[nid]
         skeys, order = sort_build_side(rkeys, right.sel)
-        pr, br, valid_rows, total = expand_join(
+        pr, br, valid_rows, total, starts, offs = expand_join(
             skeys, order, right.nrows, lkeys, left.sel, cap
         )
         pair_sel = valid_rows
@@ -749,7 +838,7 @@ class Executor:
             )
             pair_sel = compile_predicate(op.residual, pair_batch)
         nl = left.capacity
-        has = jnp.zeros(nl, dtype=jnp.bool_).at[pr].max(pair_sel, mode="drop")
+        has = probe_run_any(pair_sel, starts, offs)
         # output = [cap matched-pair slots] ++ [nl unmatched-left slots]
         cols, valid = {}, {}
         for n, c in left.cols.items():
@@ -865,53 +954,69 @@ class Executor:
             )
             if op.all:
                 return out, ovf
-            return self._dedup_batch(out, params.groupby_size[nid], nid, ovf)
+            return self._dedup_batch(out, ovf)
 
-        # INTERSECT / EXCEPT (distinct semantics): dedup the left side, then
-        # an existence probe against the right side decides each group
-        ts = params.groupby_size[nid]
-        lkeys = self._setop_key_cols(lcols, lvalid, out_schema)
-        row_slot, slot_used, slot_row = assign_group_slots(lkeys, left.sel, ts)
-        pend = jnp.sum(left.sel & (row_slot < 0), dtype=jnp.int64)
-        rep = jnp.clip(slot_row, 0, left.capacity - 1)
-
+        # INTERSECT / EXCEPT (distinct semantics): sort-dedup the left
+        # side, then an existence probe against the right side decides each
+        # surviving row
+        lb = ColumnBatch(
+            cols=lcols, valid=lvalid, sel=left.sel,
+            nrows=left.nrows, schema=out_schema, dicts=dicts,
+        )
+        db, ovf = self._dedup_batch(lb, ovf)
+        lkeys = self._setop_key_cols(db.cols, db.valid, out_schema)
         rkeys = self._setop_key_cols(rcols, rvalid, out_schema)
         # build table sized by right capacity: always large enough, so the
         # build needs no overflow accounting
         bts = next_pow2(max(2 * right.capacity, 16))
         slot_key, bslot_row = build_hash_table(rkeys, right.sel, bts)
-        probe_keys = [k[rep] for k in lkeys]
-        match = hash_join_probe(slot_key, bslot_row, rkeys, probe_keys, slot_used)
+        match = hash_join_probe(slot_key, bslot_row, rkeys, lkeys, db.sel)
         has = match >= 0
-        sel = slot_used & (has if op.kind == "intersect" else ~has)
+        sel = db.sel & (has if op.kind == "intersect" else ~has)
+        return db.with_sel(sel), ovf
 
-        cols = {n: jnp.where(sel, c[rep], 0) for n, c in lcols.items()}
-        valid = {n: v[rep] & sel for n, v in lvalid.items()}
+    def _dedup_batch(self, b: ColumnBatch, ovf):
+        """Distinct over all columns with NULLs-compare-equal key semantics
+        (shared by UNION and the Distinct operator). Sort-based: one
+        multi-operand lexicographic sort, run boundaries mark the surviving
+        representative rows — no hash table, no scatter, no capacity."""
+        operands: list[jnp.ndarray] = []
+        spec: list[tuple[str, bool]] = []  # (field, nullable)
+        for f in b.schema.fields:
+            c = b.cols[f.name]
+            v = b.valid.get(f.name)
+            if v is not None:
+                operands.append(jnp.where(v, c, jnp.zeros((), c.dtype)))
+                operands.append(v)
+                spec.append((f.name, True))
+            else:
+                operands.append(c)
+                spec.append((f.name, False))
+        n = b.capacity
+        sorted_ = jax.lax.sort(
+            (~b.sel,) + tuple(operands), num_keys=1 + len(operands)
+        )
+        sdead = sorted_[0]
+        svals = sorted_[1:]
+        new = jnp.zeros(n, jnp.bool_).at[0].set(True)
+        for sv in (sdead,) + tuple(svals):
+            new = new | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]]
+            )
+        sel = new & ~sdead
+        cols, valid = {}, {}
+        i = 0
+        for name, nullable in spec:
+            cols[name] = svals[i]
+            i += 1
+            if nullable:
+                valid[name] = svals[i]
+                i += 1
         out = ColumnBatch(
             cols=cols, valid=valid, sel=sel,
             nrows=jnp.sum(sel, dtype=jnp.int64),
-            schema=out_schema, dicts=dicts,
-        )
-        ovf = dict(ovf)
-        ovf[nid] = pend
-        return out, ovf
-
-    def _dedup_batch(self, b: ColumnBatch, ts: int, nid: int, ovf):
-        """Distinct over all columns with NULLs-compare-equal key semantics
-        (shared by UNION and the Distinct operator's nullable path)."""
-        keys = self._setop_key_cols(b.cols, b.valid, b.schema)
-        row_slot, slot_used, slot_row = assign_group_slots(keys, b.sel, ts)
-        pend = jnp.sum(b.sel & (row_slot < 0), dtype=jnp.int64)
-        rep = jnp.clip(slot_row, 0, b.capacity - 1)
-        cols = {n: jnp.where(slot_used, c[rep], 0) for n, c in b.cols.items()}
-        valid = {n: v[rep] & slot_used for n, v in b.valid.items()}
-        out = ColumnBatch(
-            cols=cols, valid=valid, sel=slot_used,
-            nrows=jnp.sum(slot_used, dtype=jnp.int64),
             schema=b.schema, dicts=b.dicts,
         )
-        ovf = dict(ovf)
-        ovf[nid] = pend
         return out, ovf
 
     # ---- window emission ------------------------------------------------
@@ -960,8 +1065,13 @@ class Executor:
                 peer_start = segment_starts(new_peer)
                 pend_idx = peer_ends(new_peer)
             else:
-                new_peer = peer_start = pend_idx = None
-            seg_id = jnp.cumsum(new_seg.astype(jnp.int64)) - 1
+                # no ORDER BY: the frame is the whole partition — same code
+                # as the running case with the peer group = the segment
+                new_peer = peer_start = None
+                pend_idx = peer_ends(new_seg)
+            # inverse permutation for the writeback: a sort, not a scatter
+            # (a TPU scatter costs ~1.1s per 8M rows; argsort ~20ms)
+            inv = jnp.argsort(order)
 
             for name, fn, arg in funcs:
                 res_valid_sorted = None
@@ -983,12 +1093,7 @@ class Executor:
                         avv_s = avv[order] if avv is not None else None
                     vmask = ssel if avv_s is None else (ssel & avv_s)
                     cnt_v = vmask.astype(jnp.int64)
-                    if ok:
-                        frame_cnt = segmented_cumsum(cnt_v, seg_start)[pend_idx]
-                    else:
-                        frame_cnt = (
-                            jnp.zeros(n, jnp.int64).at[seg_id].add(cnt_v)[seg_id]
-                        )
+                    frame_cnt = segmented_cumsum(cnt_v, seg_start)[pend_idx]
                     if fn == "count":
                         res_sorted = frame_cnt
                     elif fn == "sum":
@@ -998,43 +1103,24 @@ class Executor:
                             else av_s.dtype
                         )
                         mv = jnp.where(vmask, av_s.astype(acc), 0)
-                        if ok:
-                            res_sorted = segmented_cumsum(mv, seg_start)[pend_idx]
-                        else:
-                            res_sorted = jnp.zeros(n, acc).at[seg_id].add(mv)[seg_id]
+                        res_sorted = segmented_cumsum(mv, seg_start)[pend_idx]
                         res_valid_sorted = frame_cnt > 0
                     elif fn in ("min", "max"):
                         is_min = fn == "min"
                         ident = agg_identity(av_s.dtype, is_min)
                         mv = jnp.where(vmask, av_s, ident)
-                        if ok:
-                            res_sorted = segmented_scan_minmax(
-                                mv, new_seg, is_min
-                            )[pend_idx]
-                        else:
-                            tbl = jnp.full(n, ident, av_s.dtype)
-                            tbl = (
-                                tbl.at[seg_id].min(mv)
-                                if is_min
-                                else tbl.at[seg_id].max(mv)
-                            )
-                            res_sorted = tbl[seg_id]
+                        res_sorted = segmented_scan_minmax(
+                            mv, new_seg, is_min
+                        )[pend_idx]
                         res_valid_sorted = frame_cnt > 0
                     else:
                         raise NotImplementedError(f"window function {fn}")
 
                 dt = window_out_type(fn, arg, child.schema)
-                res = (
-                    jnp.zeros(n, res_sorted.dtype)
-                    .at[order]
-                    .set(res_sorted)
-                    .astype(dt.storage_np)
-                )
+                res = res_sorted[inv].astype(dt.storage_np)
                 out_cols[name] = res
                 if res_valid_sorted is not None:
-                    out_valid[name] = (
-                        jnp.zeros(n, jnp.bool_).at[order].set(res_valid_sorted)
-                    )
+                    out_valid[name] = res_valid_sorted[inv]
                     dt = dt.with_nullable(True)
                 fields.append(Field(name, dt))
                 if (
@@ -1084,10 +1170,12 @@ class Executor:
             and all(d is not None for d in domains)
             and int(np.prod([d for d in domains])) <= DIRECT_GROUPBY_MAX_DOMAIN
         ):
+            # direct path: one fused masked reduction per (slot, aggregate)
             packed, domain = pack_keys(key_vals, domains)
-            live = jnp.zeros(domain, dtype=jnp.int64).at[
-                jnp.where(child.sel, packed, domain)
-            ].add(1, mode="drop")
+            slot_is = [packed == g for g in range(domain)]
+            live = jnp.stack([
+                jnp.sum(child.sel & g_, dtype=jnp.int64) for g_ in slot_is
+            ])
             slot_used = live > 0
             # unpack keys from slot index
             bits = [max(1, int(d - 1).bit_length()) for d in domains]
@@ -1103,26 +1191,18 @@ class Executor:
             for (name, _, _, _), aop, av, am in zip(
                 op.aggs, agg_ops, agg_vals, agg_masks
             ):
-                cols[name] = _apply_agg(aop, packed, am, av, domain)
+                cols[name] = _direct_slot_agg(aop, slot_is, am, av)
             sel = slot_used
         elif op.group_keys:
-            ts = params.groupby_size[nid]
-            row_slot, slot_used, slot_row = assign_group_slots(
-                key_vals, child.sel, ts
+            # sort-based group-by: no hash table, no scatter, no capacity
+            skeys, sel, agg_cols, order = sort_groupby(
+                key_vals, child.sel, agg_ops, agg_vals, agg_masks
             )
-            pend = jnp.sum(child.sel & (row_slot < 0), dtype=jnp.int64)
-            n = key_vals[0].shape[0]
-            rep = jnp.clip(slot_row, 0, n - 1)
             cols = {}
-            for (name, e), kv in zip(op.group_keys, key_vals):
-                cols[name] = jnp.where(slot_used, kv[rep], 0)
-            for (name, _, _, _), aop, av, am in zip(
-                op.aggs, agg_ops, agg_vals, agg_masks
-            ):
-                cols[name] = _apply_agg(aop, row_slot, am, av, ts)
-            sel = slot_used
-            ovf = dict(ovf)
-            ovf[nid] = pend
+            for (name, _e), kv in zip(op.group_keys, skeys):
+                cols[name] = kv
+            for (name, _, _, _), av in zip(op.aggs, agg_cols):
+                cols[name] = av
         else:
             # scalar aggregate: single-row output, per-agg masks; SQL
             # semantics: sum/min/max over ZERO rows is NULL (count is 0)
@@ -1196,6 +1276,16 @@ class PreparedPlan:
         self.overflow_nodes = overflow_nodes
         self.retries = 0  # lifetime overflow-recompile count (plan monitor)
 
+    def run_nocheck(self, qparams: tuple = ()):
+        """Dispatch one execution WITHOUT the overflow host sync — for
+        benchmarking/pipelining after a checked run validated capacities."""
+        inputs = {
+            alias: self.executor.table_batch(table, cols)
+            for alias, table, cols in self.input_spec
+        }
+        out, _ovf = self.jitted(inputs, qparams)
+        return out
+
     def run(self, max_retries: int = 3, qparams: tuple = ()):
         for attempt in range(max_retries + 1):
             inputs = {
@@ -1220,6 +1310,44 @@ class PreparedPlan:
                 self.executor.compile(self.plan, self.params)
             )
         raise AssertionError
+
+
+def _direct_slot_agg(op: str, slot_is, mask, values):
+    """One aggregate over a small packed-key domain as fused masked
+    reductions (the scatter-free direct group-by)."""
+    if op == "count":
+        return jnp.stack(
+            [jnp.sum(mask & g, dtype=jnp.int64) for g in slot_is]
+        )
+    if op == "sum":
+        acc = (
+            jnp.int64
+            if jnp.issubdtype(values.dtype, jnp.integer)
+            else values.dtype
+        )
+        return jnp.stack([
+            jnp.sum(jnp.where(mask & g, values, 0).astype(acc))
+            for g in slot_is
+        ])
+    if op == "min":
+        ident = (
+            jnp.iinfo(values.dtype).max
+            if jnp.issubdtype(values.dtype, jnp.integer)
+            else jnp.inf
+        )
+        return jnp.stack([
+            jnp.min(jnp.where(mask & g, values, ident)) for g in slot_is
+        ])
+    if op == "max":
+        ident = (
+            jnp.iinfo(values.dtype).min
+            if jnp.issubdtype(values.dtype, jnp.integer)
+            else -jnp.inf
+        )
+        return jnp.stack([
+            jnp.max(jnp.where(mask & g, values, ident)) for g in slot_is
+        ])
+    raise NotImplementedError(op)
 
 
 def _join_schema(ls: Schema, rs: Schema) -> Schema:

@@ -189,17 +189,132 @@ def groupby_direct(
 
     packed_keys in [0, domain). Returns (slot_used [domain], aggs [domain]).
     The group's key columns are recovered by unpacking the slot index.
+
+    Computed as `domain` MASKED REDUCTIONS per aggregate, not scatters:
+    on TPU a fused masked-sum sweep over 8M rows costs ~2.4ms for 8 slots
+    while one scatter-add costs ~1.1s. The reductions share the row scan
+    (XLA fuses them), so cost scales with domain * passes, which is why the
+    engine caps the direct path at a small domain.
     """
-    idx = jnp.where(mask, packed_keys, domain)
-    counts = jnp.zeros(domain, dtype=jnp.int64).at[idx].add(1, mode="drop")
+    aggs: list[jnp.ndarray] = []
+    slot_is = [packed_keys == g for g in range(domain)]
+    counts = jnp.stack(
+        [jnp.sum(mask & is_g, dtype=jnp.int64) for is_g in slot_is]
+    )
     slot_used = counts > 0
-    aggs = []
     for op, v in zip(agg_ops, agg_values):
         if op == "count":
             aggs.append(counts)
+            continue
+        if op == "sum":
+            acc = (
+                jnp.int64
+                if jnp.issubdtype(v.dtype, jnp.integer)
+                else v.dtype
+            )
+            aggs.append(jnp.stack([
+                jnp.sum(jnp.where(mask & is_g, v, 0).astype(acc))
+                for is_g in slot_is
+            ]))
+        elif op == "min":
+            ident = (
+                jnp.iinfo(v.dtype).max
+                if jnp.issubdtype(v.dtype, jnp.integer) else jnp.inf
+            )
+            aggs.append(jnp.stack([
+                jnp.min(jnp.where(mask & is_g, v, ident)) for is_g in slot_is
+            ]))
+        elif op == "max":
+            ident = (
+                jnp.iinfo(v.dtype).min
+                if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf
+            )
+            aggs.append(jnp.stack([
+                jnp.max(jnp.where(mask & is_g, v, ident)) for is_g in slot_is
+            ]))
         else:
-            aggs.append(_apply_agg(op, packed_keys, mask, v, domain))
+            raise NotImplementedError(op)
     return slot_used, aggs
+
+
+def sort_groupby(
+    key_cols: list[jnp.ndarray],
+    mask: jnp.ndarray,
+    agg_ops: list[str],
+    agg_values: list[jnp.ndarray | None],
+    agg_masks: list[jnp.ndarray | None] = None,
+):
+    """Sort-based group-by: the TPU default for unbounded key domains.
+
+    One multi-operand lexicographic sort (dead rows last), segment
+    boundaries by exact key comparison, then every aggregate is a
+    segmented cumsum / associative scan read at the segment end — no hash
+    table, no scatter, no capacity/overflow: the output reuses the input
+    capacity with one live row per group (at its segment start, in sorted
+    key order).
+
+    Returns (group_keys: list [N] arrays, sel [N] bool group-start mask,
+    aggs: list [N] arrays, order [N] int32 the sort permutation).
+    agg_masks[i] (optional) restricts which rows feed aggregate i (SQL
+    null-skipping); rows outside `mask` never contribute.
+    """
+    from .window import peer_ends, segmented_cumsum, segmented_scan_minmax
+
+    n = key_cols[0].shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    operands = (~mask,) + tuple(key_cols) + (idx,)
+    sorted_ = jax.lax.sort(operands, num_keys=1 + len(key_cols))
+    sdead = sorted_[0]
+    skeys = list(sorted_[1:-1])
+    order = sorted_[-1]
+    ssel = ~sdead
+
+    new_seg = jnp.zeros(n, jnp.bool_).at[0].set(True)
+    for k in skeys:
+        new_seg = new_seg | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), k[1:] != k[:-1]]
+        )
+    # dead rows sort last; the first dead row must not join the previous
+    # live segment
+    new_seg = new_seg | jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), sdead[1:] != sdead[:-1]]
+    )
+    pos = jnp.arange(n, dtype=jnp.int64)
+    seg_start = jax.lax.cummax(jnp.where(new_seg, pos, 0))
+    seg_end = peer_ends(new_seg)
+
+    aggs_out: list[jnp.ndarray] = []
+    for i, (op, v) in enumerate(zip(agg_ops, agg_values)):
+        am = agg_masks[i] if agg_masks is not None else None
+        vm = ssel if am is None else (ssel & am[order])
+        if op == "count":
+            cnt = segmented_cumsum(vm.astype(jnp.int64), seg_start)
+            aggs_out.append(cnt[seg_end])
+            continue
+        sv = v[order]
+        if op == "sum":
+            acc = (
+                jnp.int64
+                if jnp.issubdtype(sv.dtype, jnp.integer)
+                else sv.dtype
+            )
+            mv = jnp.where(vm, sv.astype(acc), 0)
+            aggs_out.append(segmented_cumsum(mv, seg_start)[seg_end])
+        elif op in ("min", "max"):
+            is_min = op == "min"
+            ident = (
+                (jnp.iinfo(sv.dtype).max if is_min else jnp.iinfo(sv.dtype).min)
+                if jnp.issubdtype(sv.dtype, jnp.integer)
+                else (jnp.inf if is_min else -jnp.inf)
+            )
+            mv = jnp.where(vm, sv, ident)
+            aggs_out.append(
+                segmented_scan_minmax(mv, new_seg, is_min)[seg_end]
+            )
+        else:
+            raise NotImplementedError(op)
+    sel = new_seg & ssel
+    return skeys, sel, aggs_out, order
 
 
 def scalar_aggregate(

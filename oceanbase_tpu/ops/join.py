@@ -2,23 +2,33 @@
 
 Reference surface: ObHashJoinVecOp (sql/engine/join/hash_join/
 ob_hash_join_vec_op.h:316 — build :402, probe :425), merge join, and
-nested-loop join. The TPU redesign avoids pointer-chasing buckets entirely:
+nested-loop join.
 
-- hash_join_probe (unique build keys — the PK-FK case that covers most
-  TPC-H/TPC-DS joins): build side inserts into an open-addressing table via
-  the same lockstep-probe scatter loop as group-by; probe rows then walk the
-  probe chain in lockstep gathers until they hit their key or an empty slot.
-  Output keeps the probe side's static capacity: each probe row gets the
-  matching build row index (or -1), and payload columns materialize by
-  gather. Inner/semi/anti/left-outer all fall out of the match mask.
+TPU redesign, driven by measured v5e costs (8M rows: sort ~20ms, cumsum
+~7ms, random gather ~60-120ms, scatter ~1.1s, open-addressing while-loops
+~30s): the hot joins are SORT-based and scatter-free.
+
+- merge_join_unique (unique single-int-key build — the PK-FK case that
+  covers most TPC-H/TPC-DS joins): one combined sort of (key, side, row)
+  over build++probe; within a key run the build row (if any) sorts first,
+  a segmented cummax pins it, and an inverse permutation (argsort of the
+  sort permutation — a sort, not a scatter) maps matches back to original
+  probe order. Output keeps the probe side's static capacity: each probe
+  row gets the matching build row index (or -1), and payload columns
+  materialize by gather.
 
 - expand_join (M:N general case): sort the build side by key once, binary
-  search each probe key's [lo, hi) duplicate range, prefix-sum the counts,
-  and scatter/gather-expand into a static output capacity. The engine
+  search each probe key's [lo, hi) duplicate range (searchsorted
+  method='sort' — the scan variant costs 20x on TPU), prefix-sum the
+  counts, and gather-expand into a static output capacity. The engine
   chooses capacity from optimizer cardinality estimates and re-executes
   with a larger capacity on overflow (detected via the returned total).
 
-Both paths are pure jittable functions with static shapes; XLA fuses the
+- build_hash_table / hash_join_probe (open-addressing lockstep loops) stay
+  for cold paths that need multi-column existence probes (set operations);
+  they are correct everywhere but orders of magnitude slower on TPU.
+
+All paths are pure jittable functions with static shapes; XLA fuses the
 surrounding filters/projections into the gathers.
 """
 
@@ -107,6 +117,48 @@ def hash_join_probe(
     return match_row
 
 
+def merge_join_unique(
+    build_key: jnp.ndarray,
+    build_mask: jnp.ndarray,
+    probe_key: jnp.ndarray,
+    probe_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Unique-build join on ONE integer key column via a combined sort.
+
+    Returns match_row [Np] int32 in ORIGINAL probe order (-1 = no match).
+    Exact (sorts true keys, no hashing). Duplicate build keys: one winner
+    per key (the one sorting first), same contract as build_hash_table.
+    """
+    _BIG = jnp.int64(1) << 62
+    bk = jnp.where(build_mask, build_key.astype(jnp.int64), _BIG)
+    pk = jnp.where(probe_mask, probe_key.astype(jnp.int64), _BIG - 1)
+    nb = bk.shape[0]
+    npr = pk.shape[0]
+    n = nb + npr
+    keys = jnp.concatenate([bk, pk])
+    side = jnp.concatenate(
+        [jnp.zeros(nb, jnp.int32), jnp.ones(npr, jnp.int32)]
+    )
+    idx = jnp.concatenate(
+        [jnp.arange(nb, dtype=jnp.int32), jnp.arange(npr, dtype=jnp.int32)]
+    )
+    sk, sside, sidx = jax.lax.sort((keys, side, idx), num_keys=2)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    new_run = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]]
+    )
+    run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
+    b_at_start = sside[run_start] == 0
+    cand = sidx[run_start]
+    match_sorted = jnp.where(
+        (sside == 1) & b_at_start & (sk < _BIG - 1), cand, -1
+    )
+    # inverse permutation restricted to probe entries — computed by a
+    # second sort (argsort), never a scatter
+    inv = jnp.argsort(sside.astype(jnp.int64) * n + sidx)
+    return match_sorted[inv[nb:]]
+
+
 def gather_payload(
     columns: dict[str, jnp.ndarray], match_row: jnp.ndarray
 ) -> dict[str, jnp.ndarray]:
@@ -129,26 +181,48 @@ def expand_join(
     dead rows sorted to the end (callers pass +inf-like sentinel);
     build_order: original build row index per sorted position;
     Returns (out_probe_row [C] int32, out_build_row [C] int32, out_valid [C]
-    bool, total matches [scalar int64]). If total > out_capacity the output
-    is truncated — the engine checks and re-runs with a larger capacity.
+    bool, total matches [scalar int64], pair_starts [N] int64, pair_offs [N]
+    int64). pair_starts/offs delimit each probe row's pair run in output-slot
+    space (for scatter-free per-probe reductions, see probe_run_any). If
+    total > out_capacity the output is truncated — the engine checks and
+    re-runs with a larger capacity.
     """
     keys64 = join_keys64(probe_key_cols)
-    lo = jnp.searchsorted(build_sorted_keys64, keys64, side="left")
-    hi = jnp.searchsorted(build_sorted_keys64, keys64, side="right")
+    # method='sort': the binary-search variant ('scan') lowers to a gather
+    # loop that costs ~20x on TPU
+    lo = jnp.searchsorted(
+        build_sorted_keys64, keys64, side="left", method="sort"
+    )
+    hi = jnp.searchsorted(
+        build_sorted_keys64, keys64, side="right", method="sort"
+    )
     cnt = jnp.where(probe_mask, (hi - lo).astype(jnp.int64), 0)
     offs = jnp.cumsum(cnt)  # inclusive prefix sum
     total = offs[-1] if cnt.shape[0] > 0 else jnp.zeros((), jnp.int64)
     starts = offs - cnt  # exclusive
     # for each output slot t: probe row p = first row with offs[p] > t
     t = jnp.arange(out_capacity, dtype=jnp.int64)
-    p = jnp.searchsorted(offs, t, side="right").astype(jnp.int32)
+    p = jnp.searchsorted(offs, t, side="right", method="sort").astype(jnp.int32)
     pc = jnp.clip(p, 0, cnt.shape[0] - 1)
     k = t - starts[pc]
     b_sorted_pos = (lo[pc].astype(jnp.int64) + k).astype(jnp.int32)
     out_valid = t < total
     nb = build_order.shape[0]
     out_build_row = build_order[jnp.clip(b_sorted_pos, 0, nb - 1)]
-    return pc, out_build_row, out_valid, total
+    return pc, out_build_row, out_valid, total, starts, offs
+
+
+def probe_run_any(pair_ok: jnp.ndarray, starts: jnp.ndarray, offs: jnp.ndarray):
+    """Per-probe-row OR over its pair run [starts, offs) in output-slot
+    space — the scatter-free replacement for `.at[probe].max(pair_ok)`
+    (cumsum + two monotone gathers instead of a ~1s TPU scatter)."""
+    c = jnp.cumsum(pair_ok.astype(jnp.int64))
+    cap = c.shape[0]
+
+    def upto(x):
+        return jnp.where(x > 0, c[jnp.clip(x - 1, 0, cap - 1)], 0)
+
+    return (upto(jnp.minimum(offs, cap)) - upto(jnp.minimum(starts, cap))) > 0
 
 
 def sort_build_side(key_cols: list[jnp.ndarray], mask: jnp.ndarray):

@@ -87,19 +87,3 @@ def agg_identity(dtype, is_min: bool):
     return jnp.inf if is_min else -jnp.inf
 
 
-def partition_totals(
-    values: jnp.ndarray, seg_id: jnp.ndarray, n_segs: int, op: str
-):
-    """Whole-partition aggregate per row (no ORDER BY): scatter-reduce by
-    segment id, gather back."""
-    if op == "sum":
-        tot = jnp.zeros(n_segs, dtype=values.dtype).at[seg_id].add(values)
-    elif op == "min":
-        tot = jnp.full(n_segs, agg_identity(values.dtype, True), values.dtype)
-        tot = tot.at[seg_id].min(values)
-    elif op == "max":
-        tot = jnp.full(n_segs, agg_identity(values.dtype, False), values.dtype)
-        tot = tot.at[seg_id].max(values)
-    else:
-        raise NotImplementedError(op)
-    return tot[seg_id]

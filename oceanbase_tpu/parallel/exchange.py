@@ -70,38 +70,29 @@ def repartition(
     Returns (new_cols, new_mask [n_shards*cap], overflow: scalar count of
     rows dropped because a (src,dst) lane exceeded cap). Call inside
     shard_map. cap is per source->dest lane.
+
+    Lane packing is SORT-based (sort rows by dest, lanes are contiguous
+    windows of the sorted order read back by gather) — a TPU scatter costs
+    ~1.1s per 8M rows, a sort ~20ms.
     """
+    n = mask.shape[0]
     dest = jnp.where(mask, dest, n_shards)  # dead rows -> dropped
-    # position of each row within its dest lane (stable, per-dest cumsum);
-    # n_shards is static and small so this unrolls into vector ops
-    send = {}
-    lane_pos = jnp.zeros_like(dest)
-    overflow = jnp.zeros((), jnp.int64)
-    onehots = []
-    for d in range(n_shards):
-        is_d = dest == d
-        pos_d = jnp.cumsum(is_d.astype(jnp.int32)) - 1
-        lane_pos = jnp.where(is_d, pos_d, lane_pos)
-        overflow = overflow + jnp.maximum(
-            jnp.sum(is_d, dtype=jnp.int64) - cap, 0
-        )
-        onehots.append(is_d)
-    in_lane = lane_pos < cap
-    flat_idx = jnp.where(
-        mask & (dest < n_shards) & in_lane,
-        dest * cap + lane_pos,
-        n_shards * cap,
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sd, sidx = lax.sort((dest, idx), num_keys=1)
+    counts = jnp.stack([
+        jnp.sum(sd == d, dtype=jnp.int64) for d in range(n_shards)
+    ])
+    offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int64), jnp.cumsum(counts)[:-1]]
     )
-    for name, c in cols.items():
-        buf = jnp.zeros((n_shards * cap,), dtype=c.dtype)
-        buf = buf.at[flat_idx].set(c, mode="drop")
-        send[name] = buf.reshape(n_shards, cap)
-    sent_mask = (
-        jnp.zeros((n_shards * cap,), dtype=jnp.bool_)
-        .at[flat_idx]
-        .set(True, mode="drop")
-        .reshape(n_shards, cap)
-    )
+    overflow = jnp.sum(jnp.maximum(counts - cap, 0))
+    s = jnp.arange(cap, dtype=jnp.int64)
+    pos = offs[:, None] + s[None, :]  # (n_shards, cap) sorted positions
+    sent_mask = s[None, :] < jnp.minimum(counts, cap)[:, None]
+    take = sidx[jnp.clip(pos, 0, n - 1).reshape(-1)]
+    send = {
+        name: c[take].reshape(n_shards, cap) for name, c in cols.items()
+    }
 
     recv = {}
     for name, buf in send.items():

@@ -66,6 +66,7 @@ from ..sql.logical import (
     Scan,
     SetOp,
     Sort,
+    TopN,
     Window,
 )
 from .exchange import broadcast_rows, dest_by_hash, repartition
@@ -350,6 +351,21 @@ class PxExecutor(Executor):
         if isinstance(op, Sort):
             return self._emit_sort_px(op, nid, inputs, emit, params, id_of)
 
+        if isinstance(op, TopN):
+            # two-phase top-n: per-shard top (n+offset) local rows, gather
+            # the small survivors, final top-n (the merge-sort-receive
+            # coordinator analog, ob_px_ms_receive_vec_op.h)
+            child, covf = emit(op.child, inputs)
+            if self._dist[id(op.child)] == SHARDED:
+                local = self._topn_batch(
+                    child, op.keys, op.n, op.offset, apply_offset=False)
+                gathered = self._gather_batch(local)
+                out = self._topn_batch(gathered, op.keys, op.n, op.offset)
+            else:
+                out = self._topn_batch(child, op.keys, op.n, op.offset)
+            self._dist[id(op)] = REPLICATED
+            return out, covf
+
         if isinstance(op, Window):
             return self._emit_window_px(op, nid, inputs, emit, params, id_of)
 
@@ -628,6 +644,13 @@ class PxExecutor(Executor):
                 out, ovf = emit(plan, inputs)
             finally:
                 expr_compile.set_params(prev)
+            # compact BEFORE the root gather: the collective then moves
+            # O(result) rows per shard instead of the full capacity
+            from ..engine.executor import ROOT_COMPACT, compact_batch
+
+            out, oc = compact_batch(out, params.join_cap[ROOT_COMPACT])
+            ovf = dict(ovf)
+            ovf[ROOT_COMPACT] = oc
             if self._dist[id(plan)] == SHARDED:
                 out = self._gather_batch(out)
             # overflow counters must leave the shard_map replicated; psum

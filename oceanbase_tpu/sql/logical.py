@@ -87,6 +87,18 @@ class Distinct(LogicalOp):
 
 
 @dataclass
+class TopN(LogicalOp):
+    """Fused ORDER BY + LIMIT (the reference's top-n sort with pushdown,
+    sql/engine/sort/ob_pd_topn_sort_filter.h). On TPU this collapses the
+    full-capacity payload permutation of a Sort into a k-row gather."""
+
+    child: LogicalOp
+    keys: tuple[tuple["E.Expr", bool], ...]  # (expr, descending)
+    n: int
+    offset: int = 0
+
+
+@dataclass
 class SetOp(LogicalOp):
     """UNION / INTERSECT / EXCEPT. Columns align by position; output field
     names come from the left side. Reference: src/sql/engine/set (hash
@@ -159,7 +171,7 @@ def output_schema(op: LogicalOp) -> Schema:
                     t = DataType.int64()
                 fields.append(Field(name, t))
         return Schema(tuple(fields))
-    if isinstance(op, (Sort, Limit, Distinct)):
+    if isinstance(op, (Sort, Limit, Distinct, TopN)):
         return output_schema(op.child)
     if isinstance(op, SetOp):
         return setop_schema(output_schema(op.left), output_schema(op.right))

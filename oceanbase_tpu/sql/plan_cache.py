@@ -42,6 +42,7 @@ from .logical import (
     Scan,
     SetOp,
     Sort,
+    TopN,
     Window,
 )
 
@@ -168,6 +169,14 @@ class _Paramizer:
             return dc_replace(op, child=self.plan(op.child))
         if isinstance(op, Distinct):
             return dc_replace(op, child=self.plan(op.child))
+        if isinstance(op, TopN):
+            # n/offset shape the static output capacity: structural
+            self.baked.append(("topn", op.n, op.offset))
+            return dc_replace(
+                op,
+                child=self.plan(op.child),
+                keys=tuple((self.expr(e), d) for e, d in op.keys),
+            )
         if isinstance(op, SetOp):
             # kind/all are structural (they shape the physical program)
             self.baked.append(("setop", op.kind, op.all))

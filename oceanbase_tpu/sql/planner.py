@@ -38,6 +38,7 @@ from .logical import (
     Scan,
     SetOp,
     Sort,
+    TopN,
     Window,
     output_schema,
 )
@@ -227,9 +228,11 @@ class Planner:
                 raise ResolveError(
                     "set-operation ORDER BY must use output names or ordinals"
                 )
-        if order_keys:
+        if order_keys and node.limit is not None:
+            plan = TopN(plan, tuple(order_keys), node.limit, node.offset or 0)
+        elif order_keys:
             plan = Sort(plan, tuple(order_keys))
-        if node.limit is not None:
+        elif node.limit is not None:
             plan = Limit(plan, node.limit, node.offset or 0)
         return PlannedQuery(plan, names)
 
@@ -469,9 +472,13 @@ class Planner:
         plan = Project(plan, tuple(out_items))
         if sel.distinct:
             plan = Distinct(plan)
-        if order_keys:
+        if order_keys and sel.limit is not None:
+            # ORDER BY + LIMIT fuse into top-n (ob_pd_topn_sort_filter
+            # analog): only the surviving rows ever materialize
+            plan = TopN(plan, tuple(order_keys), sel.limit, sel.offset or 0)
+        elif order_keys:
             plan = Sort(plan, tuple(order_keys))
-        if sel.limit is not None:
+        elif sel.limit is not None:
             plan = Limit(plan, sel.limit, sel.offset or 0)
 
         return plan, r, out_items, visible

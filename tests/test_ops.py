@@ -154,7 +154,7 @@ def test_expand_join_mn(rng):
         skeys, order = sort_build_side([bk], bm)
         return expand_join(skeys, order, bm.sum(), [pk], pm, cap)
 
-    op, ob, ov, total = run(
+    op, ob, ov, total, _starts, _offs = run(
         jnp.asarray(build_keys),
         jnp.asarray(build_mask),
         jnp.asarray(probe_keys),
