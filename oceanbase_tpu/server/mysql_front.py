@@ -1,0 +1,219 @@
+"""MySQL wire-protocol front door: stock clients connect and run SQL.
+
+Reference surface: the MySQL command layer — connection handshake and
+COM_QUERY dispatch (src/observer/mysql/obmp_query.cpp:53, obmp_connect),
+packet codecs (deps/oblib/src/rpc/obmysql). The rebuild speaks classic
+protocol v10 / CLIENT_PROTOCOL_41 with the text resultset encoding:
+
+  greeting -> login (any credentials accepted) -> OK
+  COM_QUERY    -> resultset (column defs, EOF, text rows, EOF)
+                  or OK (DML/DDL with affected-rows) or ERR
+  COM_PING     -> OK,  COM_INIT_DB -> OK,  COM_QUIT -> close
+
+Each connection binds one DbSession (transactions span statements on the
+same connection, like a real server thread). Values travel as text; NULL
+is the 0xFB marker — the lowest common denominator every client and
+driver understands.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from .database import Database, SqlError
+
+CLIENT_PROTOCOL_41 = 0x0200
+CLIENT_CONNECT_WITH_DB = 0x0008
+CLIENT_SECURE_CONNECTION = 0x8000
+
+MYSQL_TYPE_VAR_STRING = 253
+
+
+def _lenenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + n.to_bytes(2, "little")
+    if n < 1 << 24:
+        return b"\xfd" + n.to_bytes(3, "little")
+    return b"\xfe" + n.to_bytes(8, "little")
+
+
+def _lenenc_str(s: bytes) -> bytes:
+    return _lenenc_int(len(s)) + s
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    def read_packet(self) -> bytes:
+        head = self._read_n(4)
+        length = int.from_bytes(head[:3], "little")
+        self.seq = (head[3] + 1) & 0xFF
+        return self._read_n(length)
+
+    def _read_n(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def send_packet(self, payload: bytes) -> None:
+        head = len(payload).to_bytes(3, "little") + bytes([self.seq])
+        self.seq = (self.seq + 1) & 0xFF
+        self.sock.sendall(head + payload)
+
+    def reset_seq(self) -> None:
+        self.seq = 0
+
+
+def _ok_packet(affected: int = 0, info: bytes = b"") -> bytes:
+    return (
+        b"\x00" + _lenenc_int(affected) + _lenenc_int(0)
+        + (0x0002).to_bytes(2, "little")  # SERVER_STATUS_AUTOCOMMIT
+        + (0).to_bytes(2, "little") + info
+    )
+
+
+def _eof_packet() -> bytes:
+    return b"\xfe" + (0).to_bytes(2, "little") + (0x0002).to_bytes(2, "little")
+
+
+def _err_packet(code: int, msg: str) -> bytes:
+    return (
+        b"\xff" + code.to_bytes(2, "little") + b"#HY000"
+        + msg.encode()[:400]
+    )
+
+
+def _coldef(name: str) -> bytes:
+    return (
+        _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
+        + _lenenc_str(b"") + _lenenc_str(name.encode())
+        + _lenenc_str(name.encode())
+        + b"\x0c" + (33).to_bytes(2, "little")  # utf8
+        + (255).to_bytes(4, "little")
+        + bytes([MYSQL_TYPE_VAR_STRING])
+        + (0).to_bytes(2, "little") + b"\x00" + b"\x00\x00"
+    )
+
+
+def _cell(v) -> bytes:
+    if v is None:
+        return b"\xfb"
+    if isinstance(v, float) and v != v:  # NaN surfaces SQL NULL
+        return b"\xfb"
+    if isinstance(v, (np.floating, float)):
+        return _lenenc_str(repr(float(v)).encode())
+    if isinstance(v, (np.integer, int)):
+        return _lenenc_str(str(int(v)).encode())
+    return _lenenc_str(str(v).encode())
+
+
+class MySqlFrontend:
+    """TCP listener translating MySQL protocol to DbSessions."""
+
+    def __init__(self, db: Database, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._serve(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "MySqlFrontend":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    # ---------------------------------------------------------- protocol
+    def _serve(self, sock: socket.socket) -> None:
+        conn = _Conn(sock)
+        sess = self.db.session()
+        try:
+            self._greet(conn)
+            conn.read_packet()  # login request: all credentials accepted
+            conn.send_packet(_ok_packet())
+            while True:
+                conn.reset_seq()
+                pkt = conn.read_packet()
+                if not pkt:
+                    return
+                cmd = pkt[0]
+                if cmd == 0x01:  # COM_QUIT
+                    return
+                if cmd in (0x0E, 0x02):  # COM_PING / COM_INIT_DB
+                    conn.send_packet(_ok_packet())
+                    continue
+                if cmd == 0x03:  # COM_QUERY
+                    self._query(conn, sess, pkt[1:].decode())
+                    continue
+                conn.send_packet(_err_packet(1047, "unsupported command"))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _greet(self, conn: _Conn) -> None:
+        caps = (
+            CLIENT_PROTOCOL_41 | CLIENT_CONNECT_WITH_DB
+            | CLIENT_SECURE_CONNECTION
+        )
+        salt = b"0123456789abcdefghij"
+        payload = (
+            b"\x0a" + b"5.7.0-oceanbase-tpu\x00"
+            + (1).to_bytes(4, "little")
+            + salt[:8] + b"\x00"
+            + (caps & 0xFFFF).to_bytes(2, "little")
+            + bytes([33])  # charset utf8
+            + (0x0002).to_bytes(2, "little")
+            + ((caps >> 16) & 0xFFFF).to_bytes(2, "little")
+            + bytes([len(salt) + 1])
+            + b"\x00" * 10
+            + salt[8:] + b"\x00"
+            + b"mysql_native_password\x00"
+        )
+        conn.send_packet(payload)
+
+    def _query(self, conn: _Conn, sess, sql: str) -> None:
+        try:
+            rs = sess.sql(sql)
+        except Exception as e:  # SqlError, parse errors, resolver errors
+            conn.send_packet(_err_packet(1064, f"{type(e).__name__}: {e}"))
+            return
+        if not rs.names:
+            conn.send_packet(_ok_packet(affected=rs.affected))
+            return
+        conn.send_packet(_lenenc_int(len(rs.names)))
+        for n in rs.names:
+            conn.send_packet(_coldef(n))
+        conn.send_packet(_eof_packet())
+        cols = [rs.columns[n] for n in rs.names]
+        for i in range(rs.nrows):
+            conn.send_packet(b"".join(_cell(c[i]) for c in cols))
+        conn.send_packet(_eof_packet())
