@@ -171,40 +171,77 @@ class TxCatalog(dict):
         return (ov is not None and name in ov) or super().__contains__(name)
 
 
+@dataclass
+class TenantUnit:
+    """Resource unit of one tenant (the OMT unit-config analog: worker
+    pool size, memory quota, PX quota — observer/omt ObTenant +
+    ob_unit_config). None limits = unbounded (the sys tenant default)."""
+
+    max_workers: int | None = None  # concurrent statements
+    queue_timeout_s: float = 5.0  # wait for a worker slot
+    memory_limit: int | None = None  # bytes of resident catalog snapshots
+    px_target: int | None = None  # cluster-parallelism quota
+
+
 class Database:
     """An in-process replicated database: schema + cluster + analytic engine.
 
-    One Database ~ one tenant of the reference: a catalog, a plan cache, a
-    set of log streams with tablets, and sessions issuing any SQL.
-    """
+    One Database ~ one TENANT of the reference: a catalog, a plan cache,
+    schemas, diagnostics, resource unit — plus, in standalone mode, the
+    cluster itself. Pass `cluster`/`rootservice` to share one cluster
+    among several tenants (observer/omt: tenants are resource-isolated
+    units over shared nodes; see server/tenant.TenantManager)."""
 
     def __init__(self, n_nodes: int = 3, n_ls: int = 2,
                  extra_catalog: dict[str, Table] | None = None,
-                 data_dir: str | None = None, fsync: bool = True):
+                 data_dir: str | None = None, fsync: bool = True,
+                 cluster=None, rootservice=None, tenant_name: str = "sys",
+                 unit: TenantUnit | None = None):
         # durable mode: palf logs + storage checkpoints + schema meta live
         # under data_dir; a Database pointed at an existing dir restarts
         # from disk (ckpt replay + palf replay — ob_server.cpp:923 analog)
         self.data_dir = data_dir
         self._fsync = fsync
+        self.tenant_name = tenant_name
+        self.unit = unit or TenantUnit()
+        self._shared_cluster = cluster is not None
         self._unique_keys: dict[str, tuple[str, ...]] = {}
         # tablet_id -> TableInfo, rebuilt lazily after DDL (apply-path hot)
         self._ti_by_tablet: dict[int, TableInfo] | None = None
-        node_meta = self._load_node_meta() if data_dir is not None else None
-        if node_meta is not None:
-            n_nodes, n_ls = node_meta["n_nodes"], node_meta["n_ls"]
-        self.cluster, self.rootservice = RootService.bootstrap(
-            n_nodes, n_ls, data_dir=data_dir, fsync=fsync, finalize=False
+        if self._shared_cluster:
+            if data_dir is not None:
+                raise ValueError(
+                    "durable mode is per-cluster; pass data_dir to the "
+                    "TenantManager, not a shared-cluster tenant"
+                )
+            self.cluster, self.rootservice = cluster, rootservice
+            self.schema_service = self.rootservice.schema
+            # record observation is multiplexed across tenants (each
+            # ignores tablets it does not own)
+            self.cluster.record_observers.append(self._on_applied_record)
+        else:
+            node_meta = self._load_node_meta() if data_dir is not None else None
+            if node_meta is not None:
+                n_nodes, n_ls = node_meta["n_nodes"], node_meta["n_ls"]
+            self.cluster, self.rootservice = RootService.bootstrap(
+                n_nodes, n_ls, data_dir=data_dir, fsync=fsync, finalize=False
+            )
+            self.schema_service = self.rootservice.schema
+            if node_meta is not None:
+                self._restore_from_disk(node_meta)
+            # every applied record re-applies logged dictionary appends and
+            # advances GTS past restored commit versions (idempotent in
+            # normal operation; essential during boot-time replay)
+            for group in self.cluster.ls_groups.values():
+                for rep in group.values():
+                    rep.on_record = self._on_applied_record
+            self.cluster.finalize()
+        # worker pool quota (ObTenant worker queues): bounds concurrent
+        # statements of this tenant
+        self._worker_sem = (
+            threading.BoundedSemaphore(self.unit.max_workers)
+            if self.unit.max_workers else None
         )
-        self.schema_service = self.rootservice.schema
-        if node_meta is not None:
-            self._restore_from_disk(node_meta)
-        # every applied record re-applies logged dictionary appends and
-        # advances GTS past restored commit versions (idempotent in normal
-        # operation; essential during boot-time replay)
-        for group in self.cluster.ls_groups.values():
-            for rep in group.values():
-                rep.on_record = self._on_applied_record
-        self.cluster.finalize()
         self.config = Config()
         self.location = LocationService(
             self.cluster.leader_node,
@@ -301,12 +338,25 @@ class Database:
         """Current-version schema view (name -> TableInfo)."""
         return self.schema_service.guard().tables
 
+    def _own_tablet_ids(self) -> set[int]:
+        ids = set()
+        for ti in self.tables.values():
+            ids.add(ti.tablet_id)
+            for idx in getattr(ti, "indexes", {}).values():
+                ids.add(idx.tablet_id)
+        return ids
+
     def _all_tablets(self):
-        """Every replica's tablets (each replica maintains its own LSM)."""
+        """This tenant's tablets on every replica (each replica maintains
+        its own LSM). In standalone mode that is every tablet; on a shared
+        cluster, only the tenant's own (maintenance/freeze isolation)."""
+        own = self._own_tablet_ids() if self._shared_cluster else None
         out = []
         for group in self.cluster.ls_groups.values():
             for rep in group.values():
-                out.extend(rep.tablets.values())
+                for tid, t in rep.tablets.items():
+                    if own is None or tid in own:
+                        out.append(t)
         return out
 
     def run_maintenance(self) -> dict:
@@ -749,6 +799,48 @@ class Database:
                 self.catalog[name] = t
                 self.engine.executor.invalidate_table(name)
                 ti.cached_data_version = ti.data_version
+                self._enforce_memory(keep=name)
+
+    def _resident_bytes(self) -> int:
+        """Approximate bytes of DML-backed catalog snapshots (the tenant's
+        resident analytic memory — the unit's accounting surface)."""
+        total = 0
+        for name, ti in self.tables.items():
+            t = self.catalog.get(name)
+            if t is None:
+                continue
+            for a in t.data.values():
+                total += getattr(a, "nbytes", 0)
+        return total
+
+    def _enforce_memory(self, keep: str) -> None:
+        """Tenant memory unit: evict other tables' snapshots (they re-
+        materialize on next use) until under the quota; raise if the kept
+        table alone exceeds it (the unit is simply too small)."""
+        limit = self.unit.memory_limit
+        if limit is None:
+            return
+        if self._resident_bytes() <= limit:
+            return
+        for name, ti in self.tables.items():
+            if name == keep:
+                continue
+            t = self.catalog.get(name)
+            if t is None or not t.data or ti.cached_data_version < 0:
+                continue
+            self.catalog[name] = Table(name, ti.schema, {
+                f.name: np.zeros(0, f.dtype.storage_np)
+                for f in ti.schema.fields
+            })
+            ti.cached_data_version = -1
+            self.engine.executor.invalidate_table(name)
+            if self._resident_bytes() <= limit:
+                return
+        if self._resident_bytes() > limit:
+            raise SqlError(
+                f"tenant {self.tenant_name}: memory unit exceeded "
+                f"({self._resident_bytes()} > {limit} bytes)"
+            )
 
     # ------------------------------------------------------------ session
     def session(self) -> "DbSession":
@@ -811,6 +903,26 @@ class DbSession:
         err, rs = "", None
         self._last_stmt_type = ""  # "": did not parse
         self._stmt_cache_hit = False  # set by any inner _select
+        # tenant worker quota (ObThWorker queue analog): bound concurrent
+        # statements; waiting beyond the queue timeout fails the statement
+        sem = db._worker_sem
+        if sem is not None:
+            if not sem.acquire(timeout=db.unit.queue_timeout_s):
+                raise SqlError(
+                    f"tenant {db.tenant_name}: worker queue timeout "
+                    f"({db.unit.max_workers} workers busy)"
+                )
+        try:
+            return self._sql_inner(text, t0)
+        finally:
+            if sem is not None:
+                sem.release()
+
+    def _sql_inner(self, text: str, t0) -> ResultSet:
+        import time as _time
+
+        db = self.db
+        err, rs = "", None
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
@@ -1403,7 +1515,16 @@ def _eval_const(node: A.Node):
         return -_eval_const(node.operand)
     if isinstance(node, A.BinOp):
         l, r = _eval_const(node.left), _eval_const(node.right)
-        return {"+": l + r, "-": l - r, "*": l * r, "/": l / r}[node.op]
+        if node.op == "+":
+            return l + r
+        if node.op == "-":
+            return l - r
+        if node.op == "*":
+            return l * r
+        if node.op == "/":
+            if r == 0:
+                raise SqlError("division by zero in VALUES expression")
+            return l / r
     raise SqlError(f"unsupported VALUES expression {node!r}")
 
 

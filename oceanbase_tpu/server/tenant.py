@@ -1,0 +1,97 @@
+"""Multitenancy: resource-isolated tenants over one shared cluster.
+
+Reference surface: observer/omt — tenants as resource-isolated units
+(ObTenant worker pools, ob_th_worker.cpp:313, multi-level queues, unit
+configs) with per-tenant config (ob_tenant_config_mgr.h) and the MTL
+per-tenant singleton registry.
+
+The rebuild's mapping: one shared LocalCluster (nodes, log streams, GTS,
+consensus) hosts N tenants; each tenant IS a Database in shared-cluster
+mode — its own schema service, catalog, plan cache, diagnostics, config,
+lock manager, and TenantUnit (worker quota, memory quota, PX quota).
+Tablet-id ranges are disjoint per tenant, so storage, locks and logged
+dictionary appends route cleanly; applied-record observation fans out to
+every tenant, each ignoring tablets it does not own (the multi-data-
+source consumer registry analog). MTL: `Tenant.mtl` is the per-tenant
+singleton registry."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..rootserver import RootService
+from ..share.schema_service import SchemaService
+from .database import Database, SqlError, TenantUnit
+
+# disjoint tablet-id ranges per tenant (schema isolation needs no shared
+# id space, but storage/locks key on tablet ids cluster-wide)
+_TENANT_ID_SPAN = 10_000_000
+
+
+@dataclass
+class Tenant:
+    tenant_id: int
+    name: str
+    db: Database
+    # MTL analog: per-tenant singleton registry (diag, caches, services)
+    mtl: dict[str, object] = field(default_factory=dict)
+
+    def session(self):
+        return self.db.session()
+
+
+class TenantManager:
+    """Creates and owns tenants over one shared cluster (OMT analog)."""
+
+    def __init__(self, n_nodes: int = 3, n_ls: int = 2):
+        self.cluster, sys_rs = RootService.bootstrap(
+            n_nodes, n_ls, finalize=False
+        )
+        # one dispatcher fans applied records to every tenant's observer
+        for group in self.cluster.ls_groups.values():
+            for rep in group.values():
+                rep.on_record = self._dispatch_record
+        self.cluster.finalize()
+        self._next_tenant_id = 1
+        self.tenants: dict[str, Tenant] = {}
+
+    def _dispatch_record(self, rec) -> None:
+        for f in self.cluster.record_observers:
+            f(rec)
+
+    def create_tenant(self, name: str, unit: TenantUnit | None = None) -> Tenant:
+        if name in self.tenants:
+            raise SqlError(f"tenant {name} already exists")
+        tid = self._next_tenant_id
+        self._next_tenant_id += 1
+        rs = RootService(self.cluster, SchemaService())
+        # disjoint tablet-id range per tenant
+        rs.next_tablet_id = tid * _TENANT_ID_SPAN
+        db = Database(
+            cluster=self.cluster, rootservice=rs,
+            tenant_name=name, unit=unit,
+        )
+        t = Tenant(tid, name, db)
+        t.mtl.update(
+            audit=db.audit, plan_monitor=db.plan_monitor, ash=db.ash,
+            config=db.config, plan_cache=db.plan_cache,
+            lock_mgr=db.lock_mgr,
+        )
+        self.tenants[name] = t
+        return t
+
+    def drop_tenant(self, name: str) -> None:
+        t = self.tenants.pop(name, None)
+        if t is None:
+            raise SqlError(f"no such tenant {name}")
+        # drop the tenant's tablets from every replica and detach its
+        # record observer (the LS garbage-collection analog for units)
+        own = t.db._own_tablet_ids()
+        for group in self.cluster.ls_groups.values():
+            for rep in group.values():
+                for tid in own:
+                    rep.tablets.pop(tid, None)
+        try:
+            self.cluster.record_observers.remove(t.db._on_applied_record)
+        except ValueError:
+            pass

@@ -30,6 +30,10 @@ class LocalCluster:
     # durable mode: palf logs live under {data_dir}/n{node}/ls_{ls}
     data_dir: str | None = None
     fsync: bool = True
+    # multi-tenant record observation: when several tenants share this
+    # cluster, each registers here and a dispatcher fans records out
+    # (each observer ignores tablets it does not own)
+    record_observers: list = field(default_factory=list)
     _next_ls_base: int = 0
 
     def __post_init__(self):
