@@ -312,7 +312,10 @@ class ChunkedPreparedPlan:
         n = t.nrows or 0
         partial_batches = []
         s = 0
+        from ..share.interrupt import checkpoint
+
         while s < n or (s == 0 and n == 0):
+            checkpoint()  # a killed query stops between chunks
             e = min(s + self.chunk_rows, n)
             self.chunk_exec.set_chunk(s, e)
             out = self.chunk_prepared.run(max_retries, qparams=qparams)

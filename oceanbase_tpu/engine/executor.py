@@ -1287,7 +1287,10 @@ class PreparedPlan:
         return out
 
     def run(self, max_retries: int = 3, qparams: tuple = ()):
+        from ..share.interrupt import checkpoint
+
         for attempt in range(max_retries + 1):
+            checkpoint()  # between overflow retries (and before the first run)
             inputs = {
                 alias: self.executor.table_batch(table, cols)
                 for alias, table, cols in self.input_spec

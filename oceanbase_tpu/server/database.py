@@ -242,6 +242,16 @@ class Database:
             threading.BoundedSemaphore(self.unit.max_workers)
             if self.unit.max_workers else None
         )
+        # global query interrupt (share/interrupt analog): one manager per
+        # node, shared by every tenant on the cluster
+        from ..share.interrupt import attach_cluster_interrupts
+
+        if not hasattr(self.cluster, "_interrupt_mgrs"):
+            self.cluster._interrupt_mgrs = attach_cluster_interrupts(self.cluster)
+        self.interrupts = self.cluster._interrupt_mgrs
+        # session_id -> interrupt id of its running statement
+        self._active_stmts: dict[int, tuple] = {}
+        self._stmt_seq = itertools.count(1)
         self.config = Config()
         self.location = LocationService(
             self.cluster.leader_node,
@@ -842,6 +852,14 @@ class Database:
                 f"({self._resident_bytes()} > {limit} bytes)"
             )
 
+    def kill_query(self, session_id: int, reason: str = "killed by user") -> None:
+        """Interrupt a session's running statement cluster-wide (the
+        ObGlobalInterruptManager call analog; KILL QUERY <session>)."""
+        iid = self._active_stmts.get(session_id)
+        if iid is None:
+            raise SqlError(f"session {session_id} has no running statement")
+        self.interrupts[0].interrupt(iid, reason)
+
     # ------------------------------------------------------------ session
     def session(self) -> "DbSession":
         return DbSession(self)
@@ -912,9 +930,19 @@ class DbSession:
                     f"tenant {db.tenant_name}: worker queue timeout "
                     f"({db.unit.max_workers} workers busy)"
                 )
+        # per-statement interrupt registration (KILL QUERY target)
+        from ..share import interrupt as _I
+
+        iid = ("stmt", db.tenant_name, self.session_id, next(db._stmt_seq))
+        checker = db.interrupts[0].register(iid)
+        db._active_stmts[self.session_id] = iid
+        prev = _I.set_current(checker)
         try:
             return self._sql_inner(text, t0)
         finally:
+            _I.set_current(prev)
+            db._active_stmts.pop(self.session_id, None)
+            db.interrupts[0].unregister(iid)
             if sem is not None:
                 sem.release()
 
@@ -986,6 +1014,9 @@ class DbSession:
             return self._show(stmt)
         if isinstance(stmt, A.LockTable):
             return self._lock_table(stmt)
+        if isinstance(stmt, A.KillQuery):
+            self.db.kill_query(stmt.session_id)
+            return ResultSet((), {})
         if isinstance(stmt, A.Insert):
             return self._dml(lambda tx: self._insert(stmt, tx))
         if isinstance(stmt, A.Update):

@@ -315,6 +315,14 @@ class LockTable(Node):
 
 
 @dataclass(frozen=True)
+class KillQuery(Node):
+    """KILL [QUERY] <session_id>: interrupt the session's running
+    statement cluster-wide (share/interrupt analog)."""
+
+    session_id: int
+
+
+@dataclass(frozen=True)
 class Begin(Node):
     pass
 

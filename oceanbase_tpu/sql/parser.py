@@ -37,7 +37,7 @@ KEYWORDS = {
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
     "commit", "rollback", "alter", "system", "show", "parameters", "tables",
-    "lock", "mode", "share", "exclusive", "unique", "index",
+    "lock", "mode", "share", "exclusive", "unique", "index", "kill", "query",
 }
 
 
@@ -139,6 +139,7 @@ class Parser:
             "alter": self._alter,
             "show": self._show,
             "lock": self._lock,
+            "kill": self._kill,
         }
         h = handlers.get(t.value) if t.kind == "kw" else None
         if h is None:
@@ -171,6 +172,11 @@ class Parser:
         if end == start:
             raise SyntaxError(f"missing parameter value at {t.pos}")
         return A.AlterSystemSet(name, self.sql[start:end].strip())
+
+    def _kill(self) -> "A.KillQuery":
+        self.expect("kill")
+        self.accept("query")
+        return A.KillQuery(int(self.next().value))
 
     def _lock(self) -> A.LockTable:
         self.expect("lock")
