@@ -296,6 +296,8 @@ class Executor:
                 return max(l * 0.5, 1.0)
             if op.kind == "left":
                 return l * 2
+            if op.kind == "full":
+                return l + r
             if not op.left_keys:  # cross / scalar broadcast
                 return l if self._is_scalar_relation(op.right) else l * r
             if self._join_build_unique(op):
@@ -347,10 +349,10 @@ class Executor:
                     (op.kind in ("inner", "cross")
                      and not self._merge_joinable(op))
                     or (op.kind in ("semi", "anti") and op.residual is not None)
-                    or op.kind == "left"
+                    or op.kind in ("left", "full")
                 )
                 if needs_cap:
-                    if op.kind in ("semi", "anti", "left"):
+                    if op.kind in ("semi", "anti", "left", "full"):
                         # candidate-pair capacity, not output rows
                         cap = int(
                             max(est_rows(op.left), est_rows(op.right)) * 2
@@ -668,6 +670,8 @@ class Executor:
             return self._emit_semi_anti(op, nid, inputs, emit, params)
         if op.kind == "left":
             return self._emit_left(op, nid, inputs, emit, params)
+        if op.kind == "full":
+            return self._emit_full(op, nid, inputs, emit, params)
         left, lovf = emit(op.left, inputs)
         right, rovf = emit(op.right, inputs)
         ovf = {**lovf, **rovf}
@@ -1134,6 +1138,75 @@ class Executor:
             cols=out_cols, valid=out_valid, sel=child.sel, nrows=child.nrows,
             schema=Schema(tuple(fields)), dicts=out_dicts,
         )
+        return out, ovf
+
+    def _emit_full(self, op: JoinOp, nid, inputs, emit, params):
+        """Full outer join: matched pairs ++ unmatched-left tail (NULL
+        right) ++ unmatched-right tail (NULL left). Both sides' columns
+        gain validity masks. Cold path: the per-build-row matched bit uses
+        one scatter (pairs are ordered by probe row, not build row)."""
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ovf = {**lovf, **rovf}
+        lkeys = [evaluate(e, left)[0] for e in op.left_keys]
+        rkeys = [evaluate(e, right)[0] for e in op.right_keys]
+        cap = params.join_cap[nid]
+        skeys, order = sort_build_side(rkeys, right.sel)
+        pr, br, valid_rows, total, starts, offs = expand_join(
+            skeys, order, right.nrows, lkeys, left.sel, cap
+        )
+        pair_sel = valid_rows
+        if len(op.left_keys) > 1:
+            for le, re_ in zip(op.left_keys, op.right_keys):
+                lv, _ = evaluate(le, left)
+                rv, _ = evaluate(re_, right)
+                pair_sel = pair_sel & (lv[pr] == rv[br])
+        merged_dicts = {**left.dicts, **right.dicts}
+        if op.residual is not None:
+            pair_cols = {n: c[pr] for n, c in left.cols.items()}
+            pair_cols.update({n: c[br] for n, c in right.cols.items()})
+            pair_valid = {n: v[pr] for n, v in left.valid.items()}
+            pair_valid.update({n: v[br] for n, v in right.valid.items()})
+            pair_batch = ColumnBatch(
+                cols=pair_cols, valid=pair_valid, sel=pair_sel,
+                nrows=jnp.sum(pair_sel, dtype=jnp.int64),
+                schema=_join_schema(left.schema, right.schema),
+                dicts=merged_dicts,
+            )
+            pair_sel = compile_predicate(op.residual, pair_batch)
+        nl, nr = left.capacity, right.capacity
+        has_l = probe_run_any(pair_sel, starts, offs)
+        has_r = (
+            jnp.zeros(nr, dtype=jnp.bool_).at[br].max(pair_sel, mode="drop")
+        )
+        cols, valid = {}, {}
+        for n, c in left.cols.items():
+            cols[n] = jnp.concatenate(
+                [c[pr], c, jnp.zeros_like(c, shape=(nr,))]
+            )
+            lv = left.valid.get(n)
+            mv = lv[pr] if lv is not None else jnp.ones(cap, jnp.bool_)
+            tv = lv if lv is not None else jnp.ones(nl, jnp.bool_)
+            valid[n] = jnp.concatenate([mv, tv, jnp.zeros(nr, jnp.bool_)])
+        for n, c in right.cols.items():
+            cols[n] = jnp.concatenate(
+                [c[br], jnp.zeros_like(c, shape=(nl,)), c]
+            )
+            rv = right.valid.get(n)
+            mv = rv[br] if rv is not None else jnp.ones(cap, jnp.bool_)
+            tv = rv if rv is not None else jnp.ones(nr, jnp.bool_)
+            valid[n] = jnp.concatenate([mv, jnp.zeros(nl, jnp.bool_), tv])
+        sel = jnp.concatenate(
+            [pair_sel, left.sel & ~has_l, right.sel & ~has_r]
+        )
+        out_schema = output_schema(op)
+        out = ColumnBatch(
+            cols=cols, valid=valid, sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=out_schema, dicts=merged_dicts,
+        )
+        ovf = dict(ovf)
+        ovf[nid] = jnp.maximum(total - cap, 0)
         return out, ovf
 
     # ---- aggregate emission --------------------------------------------

@@ -488,7 +488,11 @@ class PxExecutor(Executor):
         ovf = {**lovf, **rovf}
 
         # choose distribution method (the optimizer's exchange allocation)
-        if rd == REPLICATED:
+        if op.kind == "full" and (ld == SHARDED or rd == SHARDED):
+            # a broadcast build would duplicate unmatched-right rows on
+            # every shard: FULL joins must co-partition both sides
+            method = "hash" if op.left_keys else "gather_both"
+        elif rd == REPLICATED:
             method = "local"  # build already everywhere; probe drives output
         elif not op.left_keys:
             method = "broadcast"  # cross join: replicate the build side
@@ -522,6 +526,12 @@ class PxExecutor(Executor):
         elif method == "broadcast":
             right = self._gather_batch(right)
             out_dist = ld
+        elif method == "gather_both":
+            if ld == SHARDED:
+                left = self._gather_batch(left)
+            if rd == SHARDED:
+                right = self._gather_batch(right)
+            out_dist = REPLICATED
         else:
             out_dist = ld
 

@@ -65,26 +65,35 @@ class RootService:
         return min(sorted(counts), key=lambda ls: counts[ls])
 
     # ---------------------------------------------------------------- DDL
-    def create_table(self, info_factory) -> object:
-        """Run a CREATE TABLE: pick placement, build the TableInfo via
-        `info_factory(ls_id, tablet_id)`, create tablets on all replicas,
-        publish the schema version. Returns the TableInfo."""
+    def create_table(self, info_factory, n_partitions: int = 1) -> object:
+        """Run a CREATE TABLE: pick placement for every partition (least-
+        loaded LS round-robin), build the TableInfo via
+        `info_factory(partitions)` with partitions = [(ls_id, tablet_id)],
+        create tablets on all replicas, publish the schema version."""
         with self._lock:
-            ls_id = self.choose_ls()
-            tablet_id = self._alloc_tablet_id()
-            ti = info_factory(ls_id, tablet_id)
+            partitions = []
+            counts = self.tablet_counts()
+            for _ in range(max(1, n_partitions)):
+                ls_id = min(sorted(counts), key=lambda ls: counts[ls])
+                counts[ls_id] += 1
+                partitions.append((ls_id, self._alloc_tablet_id()))
+            ti = info_factory(partitions)
 
             def mutate(tables: dict):
                 if ti.name in tables:
                     raise SchemaError(f"table {ti.name} already exists")
                 tables[ti.name] = ti
 
-            self.cluster.create_tablet(ls_id, tablet_id, ti.schema, ti.key_cols)
+            for ls_id, tablet_id in partitions:
+                self.cluster.create_tablet(
+                    ls_id, tablet_id, ti.schema, ti.key_cols
+                )
             try:
                 ti.schema_version = self.schema.apply_ddl(mutate)
             except SchemaError:
-                for rep in self.cluster.ls_groups[ls_id].values():
-                    rep.tablets.pop(tablet_id, None)
+                for ls_id, tablet_id in partitions:
+                    for rep in self.cluster.ls_groups[ls_id].values():
+                        rep.tablets.pop(tablet_id, None)
                 raise
             return ti
 
@@ -107,6 +116,9 @@ class RootService:
 
             self.schema.apply_ddl(mutate)
             ti = dropped["ti"]
-            for rep in self.cluster.ls_groups[ti.ls_id].values():
-                rep.tablets.pop(ti.tablet_id, None)
+            for ls_id, tablet_id in getattr(
+                ti, "partitions", [(ti.ls_id, ti.tablet_id)]
+            ):
+                for rep in self.cluster.ls_groups[ls_id].values():
+                    rep.tablets.pop(tablet_id, None)
             return ti

@@ -69,9 +69,24 @@ class IndexInfo:
     reads: int = 0  # statements served through this index (diag surface)
 
 
+def _part_of(value: int, n_parts: int) -> int:
+    """Hash-partition routing: stable over the 64-bit mix of the partition
+    column's storage value (dict codes are append-ordered and global per
+    table, so string partition columns route consistently too)."""
+    v = (int(value) * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    return (v >> 32) % n_parts
+
+
 @dataclass
 class TableInfo:
-    """Schema-service record of one user table (one tablet shard for now)."""
+    """Schema-service record of one user table.
+
+    `partitions` lists the table's (ls_id, tablet_id) shards — one entry
+    for an unpartitioned table; PARTITION BY HASH(part_col) PARTITIONS n
+    spreads n tablets across log streams (the reference's hash-partitioned
+    tables; a multi-partition statement stages on several LS leaders and
+    commits with 2PC — the parallel-DML shape). ls_id/tablet_id remain the
+    first partition (index tablets and the table lock anchor there)."""
 
     name: str
     schema: Schema
@@ -79,6 +94,8 @@ class TableInfo:
     ls_id: int
     tablet_id: int
     indexes: dict[str, IndexInfo] = field(default_factory=dict)
+    partitions: list[tuple[int, int]] | None = None
+    part_col: str | None = None
     # append-order dictionaries: code assignment is insertion order, so
     # logged/stored codes stay valid as strings arrive (the sorted view is
     # derived at read time)
@@ -97,6 +114,18 @@ class TableInfo:
     # created by aborted txs stay unlogged and are re-logged by the next
     # committer that references them)
     logged_dict_len: dict[str, int] = field(default_factory=dict)
+
+    def all_partitions(self) -> list[tuple[int, int]]:
+        return self.partitions or [(self.ls_id, self.tablet_id)]
+
+    def partition_for_key(self, key: tuple) -> tuple[int, int]:
+        """(ls_id, tablet_id) owning a primary-key tuple (the partition
+        column is enforced to be part of the primary key)."""
+        parts = self.all_partitions()
+        if len(parts) == 1 or self.part_col is None:
+            return parts[0]
+        v = key[self.key_cols.index(self.part_col)]
+        return parts[_part_of(int(v), len(parts))]
 
     @property
     def dict_sig(self) -> tuple:
@@ -351,7 +380,8 @@ class Database:
     def _own_tablet_ids(self) -> set[int]:
         ids = set()
         for ti in self.tables.values():
-            ids.add(ti.tablet_id)
+            for _ls, tab in ti.all_partitions():
+                ids.add(tab)
             for idx in getattr(ti, "indexes", {}).values():
                 ids.add(idx.tablet_id)
         return ids
@@ -452,9 +482,14 @@ class Database:
             ti.cached_data_version = -1
             if not hasattr(ti, "indexes"):  # pre-index node_meta snapshots
                 ti.indexes = {}
+            if not hasattr(ti, "partitions") or ti.partitions is None:
+                ti.partitions = [(ti.ls_id, ti.tablet_id)]
+                ti.part_col = getattr(ti, "part_col", None)
+            for pls, ptab in ti.all_partitions():
+                for rep in self.cluster.ls_groups[pls].values():
+                    if ptab not in rep.tablets:
+                        rep.create_tablet(ptab, ti.schema, ti.key_cols)
             for rep in self.cluster.ls_groups[ti.ls_id].values():
-                if ti.tablet_id not in rep.tablets:
-                    rep.create_tablet(ti.tablet_id, ti.schema, ti.key_cols)
                 for idx in ti.indexes.values():
                     if idx.tablet_id not in rep.tablets:
                         rep.create_tablet(idx.tablet_id, idx.schema, idx.key_cols)
@@ -588,20 +623,39 @@ class Database:
                 i = schema.index(k)
                 fields[i] = Field(k, fields[i].dtype.with_nullable(False))
             schema = Schema(tuple(fields))
+            if stmt.partition_by is not None:
+                if stmt.partition_by not in schema:
+                    raise SqlError(
+                        f"partition column {stmt.partition_by} not in table"
+                    )
+                if stmt.partition_by not in pk:
+                    # MySQL rule: the partition key must be part of every
+                    # unique key, or cross-partition duplicates could hide
+                    raise SqlError(
+                        "partition column must be part of the primary key"
+                    )
 
-            def factory(ls_id: int, tablet_id: int) -> TableInfo:
-                ti = TableInfo(stmt.name, schema, pk, ls_id, tablet_id)
+            def factory(partitions: list[tuple[int, int]]) -> TableInfo:
+                ls_id, tablet_id = partitions[0]
+                ti = TableInfo(
+                    stmt.name, schema, pk, ls_id, tablet_id,
+                    partitions=list(partitions),
+                    part_col=stmt.partition_by,
+                )
                 for f in schema.fields:
                     if f.dtype.kind is TypeKind.VARCHAR:
                         ti.dicts[f.name] = Dictionary()
                 return ti
 
             try:
-                ti = self.rootservice.create_table(factory)
+                ti = self.rootservice.create_table(
+                    factory, n_partitions=stmt.n_partitions
+                )
             except SchemaError as e:
                 raise SqlError(str(e)) from None
-            for rep in self.cluster.ls_groups[ti.ls_id].values():
-                rep.tablets[ti.tablet_id].cache = self.block_cache
+            for ls_id, tablet_id in ti.all_partitions():
+                for rep in self.cluster.ls_groups[ls_id].values():
+                    rep.tablets[tablet_id].cache = self.block_cache
             self._unique_keys[stmt.name] = tuple(pk)
             self._ti_by_tablet = None
             self.catalog[stmt.name] = Table(stmt.name, schema, {
@@ -708,8 +762,16 @@ class Database:
         from ..storage.sstable import SSTable, write_sstable
 
         s0 = self.cluster.gts.next_ts()
-        rep = self._leader_replica(ti)
-        data = rep.tablets[ti.tablet_id].scan(s0, columns=list(idx.schema.names()))
+        parts = []
+        for pls, ptab in ti.all_partitions():
+            rep = self._leader_replica_ls(pls)
+            parts.append(rep.tablets[ptab].scan(
+                s0, columns=list(idx.schema.names())
+            ))
+        data = (
+            parts[0] if len(parts) == 1
+            else {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
+        )
         n = len(data[idx.schema.names()[0]]) if idx.schema.names() else 0
         if n:
             keys = [data[k].astype(np.int64) for k in idx.key_cols]
@@ -757,16 +819,19 @@ class Database:
             self._save_node_meta()
 
     # ---------------------------------------------------------- snapshots
-    def _leader_replica(self, ti: TableInfo):
+    def _leader_replica_ls(self, ls_id: int):
         """Route through the location cache; one retry on a stale entry
         (the NOT_MASTER feedback loop of the reference's DAS routing)."""
-        node = self.location.leader(ti.ls_id)
-        rep = self.cluster.ls_groups[ti.ls_id][node]
+        node = self.location.leader(ls_id)
+        rep = self.cluster.ls_groups[ls_id][node]
         if not rep.is_ready:
-            self.location.invalidate(ti.ls_id)
-            node = self.location.leader(ti.ls_id)
-            rep = self.cluster.ls_groups[ti.ls_id][node]
+            self.location.invalidate(ls_id)
+            node = self.location.leader(ls_id)
+            rep = self.cluster.ls_groups[ls_id][node]
         return rep
+
+    def _leader_replica(self, ti: TableInfo):
+        return self._leader_replica_ls(ti.ls_id)
 
     def refresh_catalog(self, names, tx=None) -> None:
         """Bring catalog snapshot Tables of the given tables up to date.
@@ -782,17 +847,26 @@ class Database:
             in_tx = tx is not None and tx.ctx is not None
             if not in_tx and ti.cached_data_version == ti.data_version:
                 continue
-            if in_tx:
-                touched = name in tx.touched_tables
-                rep = (tx.svc.replicas[ti.ls_id] if touched
-                       else self._leader_replica(ti))
-                data = rep.tablets[ti.tablet_id].scan(
-                    tx.ctx.read_snapshot,
-                    tx_id=tx.ctx.tx_id if touched else 0,
-                )
+            touched = in_tx and name in tx.touched_tables
+            snap = (
+                tx.ctx.read_snapshot if in_tx else self.cluster.gts.current()
+            )
+            parts = []
+            for ls_id, tablet_id in ti.all_partitions():
+                if touched:
+                    rep = tx.svc.replicas[ls_id]
+                else:
+                    rep = self._leader_replica_ls(ls_id)
+                parts.append(rep.tablets[tablet_id].scan(
+                    snap, tx_id=tx.ctx.tx_id if touched else 0,
+                ))
+            if len(parts) == 1:
+                data = parts[0]
             else:
-                rep = self._leader_replica(ti)
-                data = rep.tablets[ti.tablet_id].scan(self.cluster.gts.current())
+                data = {
+                    c: np.concatenate([p[c] for p in parts])
+                    for c in parts[0]
+                }
             dicts = {}
             for col in ti.dicts:
                 sd, remap = ti.sorted_dict(col)
@@ -1133,7 +1207,8 @@ class DbSession:
         used_idx = None
         if set(ti.key_cols) <= set(eqs):
             pk = tuple(int(eqs[k]) for k in ti.key_cols)
-            hit = rep.tablets[ti.tablet_id].get(pk, snap)
+            pls, ptab = ti.partition_for_key(pk)
+            hit = self.db._leader_replica_ls(pls).tablets[ptab].get(pk, snap)
             rows = [hit[1]] if hit is not None else []
         else:
             best = None
@@ -1169,7 +1244,8 @@ class DbSession:
             rows = []
             for i in range(npk):
                 pk = tuple(int(a[i]) for a in pk_arrays)
-                hit = rep.tablets[ti.tablet_id].get(pk, snap)
+                pls, ptab = ti.partition_for_key(pk)
+                hit = self.db._leader_replica_ls(pls).tablets[ptab].get(pk, snap)
                 if hit is not None:
                     rows.append(hit[1])
             used_idx = idx
@@ -1320,16 +1396,24 @@ class DbSession:
         a failed statement inside an explicit tx leaves no partial writes).
         A WriteConflict during staging still aborts the whole tx — that is
         transaction, not statement, semantics (first-committer-wins).
-        Index mutations ride the same tx on the same log stream (1PC)."""
+
+        Rows route to their hash partition's tablet; a multi-partition
+        statement stages on several LS leaders in one tx and commits with
+        2PC — the parallel-DML shape (reference sql/engine/pdml). Index
+        mutations ride the same tx on the first partition's log stream."""
         if muts or index_muts:
             from ..tx.tablelock import LockMode
 
             # implicit intention lock: DML conflicts with explicit
             # SHARE/EXCLUSIVE table locks held by other txs (tablelock)
             self.db.lock_mgr.lock(tx.ctx.tx_id, ti.tablet_id, LockMode.ROW_X)
-            tx.ensure_leader(ti.ls_id)
-            for key, op, vals in muts:
-                tx.svc.write(tx.ctx, ti.ls_id, ti.tablet_id, key, op, vals)
+            needed_ls = {ls for ls, _t, _k, _o, _v in muts}
+            if index_muts:
+                needed_ls.add(ti.ls_id)
+            for ls in sorted(needed_ls):
+                tx.ensure_leader(ls)
+            for ls_id, tab_id, key, op, vals in muts:
+                tx.svc.write(tx.ctx, ls_id, tab_id, key, op, vals)
             for tab_id, key, op, vals in index_muts:
                 tx.svc.write(tx.ctx, ti.ls_id, tab_id, key, op, vals)
             tx.touched_tables.add(ti.name)
@@ -1385,9 +1469,7 @@ class DbSession:
             py_rows = [tuple(_eval_const(e) for e in row) for row in st.rows]
 
         order = [names.index(n) for n in ti.schema.names()]
-        tx.ensure_leader(ti.ls_id)
-        rep = tx.svc.replicas[ti.ls_id]
-        muts: list[tuple[tuple, int, tuple | None]] = []
+        staged: list[tuple[int, int, tuple, tuple]] = []
         seen: set[tuple] = set()
         for row in py_rows:
             if len(row) != len(names):
@@ -1397,16 +1479,28 @@ class DbSession:
                 for i, f in enumerate(ti.schema.fields)
             )
             key = tuple(int(vals[ti.schema.index(k)]) for k in ti.key_cols)
-            if key in seen or rep.tablets[ti.tablet_id].get(
+            if key in seen:
+                raise SqlError(f"duplicate primary key {key} in {st.table}")
+            seen.add(key)
+            ls_id, tab_id = ti.partition_for_key(key)
+            staged.append((ls_id, tab_id, key, vals))
+        needed_ls = sorted({ls for ls, _t, _k, _v in staged})
+        if ti.indexes:
+            needed_ls = sorted(set(needed_ls) | {ti.ls_id})
+        for ls in needed_ls:
+            tx.ensure_leader(ls)
+        muts: list[tuple[int, int, tuple, int, tuple | None]] = []
+        for ls_id, tab_id, key, vals in staged:
+            rep = tx.svc.replicas[ls_id]
+            if rep.tablets[tab_id].get(
                 key, tx.ctx.read_snapshot, tx_id=tx.ctx.tx_id
             ) is not None:
                 raise SqlError(f"duplicate primary key {key} in {st.table}")
-            seen.add(key)
-            muts.append((key, OP_PUT, vals))
+            muts.append((ls_id, tab_id, key, OP_PUT, vals))
         index_muts: list[tuple[int, tuple, int, tuple | None]] = []
         for idx in ti.indexes.values():
             seen_i: set[tuple] = set()
-            for key, _op, vals in muts:
+            for _ls, _t, key, _op, vals in muts:
                 ikey, ivals = self._index_entry(ti, idx, vals)
                 if idx.unique:
                     if ikey in seen_i:
@@ -1473,7 +1567,8 @@ class DbSession:
             vals = tuple(vals)
             old_vals = tuple(old_vals)
             key = tuple(int(vals[ti.schema.index(k)]) for k in ti.key_cols)
-            muts.append((key, OP_PUT, vals))
+            ls_id, tab_id = ti.partition_for_key(key)
+            muts.append((ls_id, tab_id, key, OP_PUT, vals))
             for idx in ti.indexes.values():
                 old_ik, _ = self._index_entry(ti, idx, old_vals)
                 new_ik, new_iv = self._index_entry(ti, idx, vals)
@@ -1505,7 +1600,8 @@ class DbSession:
                 for c in cols
             }
             key = tuple(int(row[k]) for k in ti.key_cols)
-            muts.append((key, OP_DELETE, None))
+            ls_id, tab_id = ti.partition_for_key(key)
+            muts.append((ls_id, tab_id, key, OP_DELETE, None))
             for idx in ti.indexes.values():
                 ikey = tuple(int(row[c]) for c in idx.key_cols)
                 index_muts.append((idx.tablet_id, ikey, OP_DELETE, None))

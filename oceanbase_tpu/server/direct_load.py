@@ -93,38 +93,59 @@ def direct_load(db, table_name: str, data: dict[str, object]) -> int:
             f"duplicate primary key in batch: {tuple(keys2d[1:][dup][0])}"
         )
 
-    # existing-key collision check through the tablet's read path
-    rep = db._leader_replica(ti)
-    tablet = rep.tablets[ti.tablet_id]
-    if tablet.nrows_estimate:
-        maybe = np.zeros(len(keys2d), dtype=bool)
-        for st in ([tablet.base] if tablet.base else []) + list(tablet.deltas):
-            maybe |= st.may_contain_keys(keys2d)
-        for mt in [tablet.active] + list(tablet.frozen):
-            if mt.nkeys:
-                for i in np.flatnonzero(~maybe):
-                    if mt.get(tuple(keys2d[i]), 2**62) is not None:
-                        maybe[i] = True
-        for i in np.flatnonzero(maybe):
-            if tablet.get(tuple(keys2d[i]), 2**62) is not None:
-                raise DirectLoadError(
-                    f"primary key {tuple(keys2d[i])} already exists"
-                )
-
-    version = db.cluster.gts.next_ts()
-    blob = write_sstable(
-        ti.schema, ti.key_cols, cols,
-        versions=np.full(n, version, np.int64),
-        ops=np.zeros(n, np.int8),
-        base_version=0, end_version=version,
+    # partition routing: each hash partition gets its own sorted sstable
+    # (the parallel direct-load shape — per-partition sort + install)
+    part_ids = np.array(
+        [0] * n if ti.part_col is None or len(ti.all_partitions()) == 1
+        else [
+            _part_route(keys2d[i], ti) for i in range(n)
+        ],
+        dtype=np.int64,
     )
-    # install on every replica (the data-movement replication analog)
-    for r in db.cluster.ls_groups[ti.ls_id].values():
-        t = r.tablets[ti.tablet_id]
-        with t._meta_lock:
-            t.deltas.append(
-                SSTable(blob, ti.schema, ti.key_cols, cache=db.block_cache)
-            )
+    version = db.cluster.gts.next_ts()
+    for p_idx, (pls, ptab) in enumerate(ti.all_partitions()):
+        m = part_ids == p_idx
+        if not m.any():
+            continue
+        pcols = {c: v[m] for c, v in cols.items()}
+        pk2d = keys2d[m]
+        # existing-key collision check through the tablet's read path
+        rep = db._leader_replica_ls(pls)
+        tablet = rep.tablets[ptab]
+        if tablet.nrows_estimate:
+            maybe = np.zeros(len(pk2d), dtype=bool)
+            for st in ([tablet.base] if tablet.base else []) + list(tablet.deltas):
+                maybe |= st.may_contain_keys(pk2d)
+            for mt in [tablet.active] + list(tablet.frozen):
+                if mt.nkeys:
+                    for i in np.flatnonzero(~maybe):
+                        if mt.get(tuple(pk2d[i]), 2**62) is not None:
+                            maybe[i] = True
+            for i in np.flatnonzero(maybe):
+                if tablet.get(tuple(pk2d[i]), 2**62) is not None:
+                    raise DirectLoadError(
+                        f"primary key {tuple(pk2d[i])} already exists"
+                    )
+        blob = write_sstable(
+            ti.schema, ti.key_cols, pcols,
+            versions=np.full(int(m.sum()), version, np.int64),
+            ops=np.zeros(int(m.sum()), np.int8),
+            base_version=0, end_version=version,
+        )
+        # install on every replica (the data-movement replication analog)
+        for r in db.cluster.ls_groups[pls].values():
+            t = r.tablets[ptab]
+            with t._meta_lock:
+                t.deltas.append(
+                    SSTable(blob, ti.schema, ti.key_cols, cache=db.block_cache)
+                )
     ti.data_version += 1
     ti.cached_data_version = -1
     return int(n)
+
+
+def _part_route(key_row: np.ndarray, ti) -> int:
+    from .database import _part_of
+
+    v = key_row[ti.key_cols.index(ti.part_col)]
+    return _part_of(int(v), len(ti.all_partitions()))

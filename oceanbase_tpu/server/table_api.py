@@ -78,14 +78,22 @@ class TableApi:
 
         try:
             self.db.lock_mgr.lock(tx.ctx.tx_id, ti.tablet_id, LockMode.ROW_X)
-            tx.ensure_leader(ti.ls_id)
-            rep = tx.svc.replicas[ti.ls_id]
+            routed = [
+                (*ti.partition_for_key(key), key, op, vals)
+                for key, op, vals in muts
+            ]
+            needed_ls = {ls for ls, _t, _k, _o, _v in routed}
+            if ti.indexes:
+                needed_ls.add(ti.ls_id)
+            for ls in sorted(needed_ls):
+                tx.ensure_leader(ls)
             index_muts: list[tuple[int, tuple, int, tuple | None]] = []
             if ti.indexes:
-                for key, op, vals in muts:
-                    old = rep.tablets[ti.tablet_id].get(
+                for ls_id, tab_id, key, op, vals in routed:
+                    old = tx.svc.replicas[ls_id].tablets[tab_id].get(
                         key, tx.ctx.read_snapshot, tx_id=tx.ctx.tx_id
                     )
+                    rep = tx.svc.replicas[ti.ls_id]
                     for idx in ti.indexes.values():
                         old_ik = (
                             DbSession._index_entry(ti, idx, old[1])[0]
@@ -112,8 +120,8 @@ class TableApi:
                                 (idx.tablet_id, old_ik, OP_DELETE, None))
                         index_muts.append(
                             (idx.tablet_id, new_ik, OP_PUT, new_iv))
-            for key, op, vals in muts:
-                tx.svc.write(tx.ctx, ti.ls_id, ti.tablet_id, key, op, vals)
+            for ls_id, tab_id, key, op, vals in routed:
+                tx.svc.write(tx.ctx, ls_id, tab_id, key, op, vals)
             for tab_id, key, op, vals in index_muts:
                 tx.svc.write(tx.ctx, ti.ls_id, tab_id, key, op, vals)
             self.db.cluster.commit_sync(tx.svc, tx.ctx)
@@ -141,10 +149,10 @@ class TableApi:
 
     def get(self, key) -> dict | None:
         ti = self._ti
-        rep = self.db._leader_replica(ti)
-        hit = rep.tablets[ti.tablet_id].get(
-            self._key_of(key), self.db.cluster.gts.current()
-        )
+        k = self._key_of(key)
+        ls_id, tab_id = ti.partition_for_key(k)
+        rep = self.db._leader_replica_ls(ls_id)
+        hit = rep.tablets[tab_id].get(k, self.db.cluster.gts.current())
         return None if hit is None else self._decode_row(hit[1])
 
     def scan(self, key_min=None, key_max=None, row_filter=None,
@@ -152,14 +160,19 @@ class TableApi:
         """Range scan on the FIRST key column with optional row filter
         (the HBase-filter analog, applied host-side post-snapshot)."""
         ti = self._ti
-        rep = self.db._leader_replica(ti)
         ranges = None
         if key_min is not None or key_max is not None:
             lo = -float("inf") if key_min is None else float(key_min)
             hi = float("inf") if key_max is None else float(key_max)
             ranges = {ti.key_cols[0]: (lo, hi)}
-        data = rep.tablets[ti.tablet_id].scan(
-            self.db.cluster.gts.current(), ranges=ranges
+        snap = self.db.cluster.gts.current()
+        parts = []
+        for pls, ptab in ti.all_partitions():
+            rep = self.db._leader_replica_ls(pls)
+            parts.append(rep.tablets[ptab].scan(snap, ranges=ranges))
+        data = (
+            parts[0] if len(parts) == 1
+            else {c: np.concatenate([p[c] for p in parts]) for c in parts[0]}
         )
         names = ti.schema.names()
         n = len(data[names[0]]) if names else 0

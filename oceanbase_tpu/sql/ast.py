@@ -242,6 +242,10 @@ class CreateTable(Node):
     columns: tuple[ColumnDef, ...]
     primary_key: tuple[str, ...]  # empty -> first column
     if_not_exists: bool = False
+    # PARTITION BY HASH(col) PARTITIONS n (reference: hash-partitioned
+    # tables; each partition is a tablet placed on a log stream)
+    partition_by: str | None = None
+    n_partitions: int = 1
 
 
 @dataclass(frozen=True)

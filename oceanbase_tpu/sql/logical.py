@@ -148,8 +148,12 @@ def output_schema(op: LogicalOp) -> Schema:
         ls, rs = output_schema(op.left), output_schema(op.right)
         if op.kind in ("semi", "anti"):
             return ls
-        fields = list(ls.fields)
-        nullable_right = op.kind == "left"
+        nullable_left = op.kind == "full"
+        nullable_right = op.kind in ("left", "full")
+        fields = [
+            Field(f.name, f.dtype.with_nullable(f.dtype.nullable or nullable_left))
+            for f in ls.fields
+        ]
         for f in rs.fields:
             fields.append(
                 Field(f.name, f.dtype.with_nullable(f.dtype.nullable or nullable_right))

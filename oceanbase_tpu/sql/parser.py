@@ -37,7 +37,7 @@ KEYWORDS = {
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
     "commit", "rollback", "alter", "system", "show", "parameters", "tables",
-    "lock", "mode", "share", "exclusive", "unique", "index", "kill", "query",
+    "lock", "mode", "share", "exclusive", "unique", "index", "kill", "query", "partitions",
 }
 
 
@@ -258,7 +258,22 @@ class Parser:
             if not self.accept(","):
                 break
         self.expect(")")
-        return A.CreateTable(name, tuple(cols), pk, if_not_exists)
+        part_col, n_parts = None, 1
+        if self.accept("partition"):
+            self.expect("by")
+            kind = self.next().value
+            if kind != "hash":
+                raise SyntaxError(f"unsupported partitioning {kind!r}")
+            self.expect("(")
+            part_col = self.next().value
+            self.expect(")")
+            self.expect("partitions")
+            n_parts = int(self.next().value)
+            if n_parts < 1:
+                raise SyntaxError("PARTITIONS must be >= 1")
+        return A.CreateTable(
+            name, tuple(cols), pk, if_not_exists, part_col, n_parts
+        )
 
     def _drop(self) -> "A.DropTable | A.DropIndex":
         self.expect("drop")

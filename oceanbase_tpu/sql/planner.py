@@ -263,11 +263,22 @@ class Planner:
                     if node.on is not None:
                         join_conds.extend(split_conjuncts(r.expr(node.on)))
                     return None
-                if node.kind == "left":
+                if node.kind in ("left", "full"):
                     add_relation_from(node.left)
                     ra = add_relation_from(node.right)
                     if ra is None:
-                        raise ResolveError("left join right side must be a relation")
+                        raise ResolveError(
+                            f"{node.kind} join right side must be a relation"
+                        )
+                    outer_join_specs.append((node.kind, ra, node.on))
+                    return None
+                if node.kind == "right":
+                    # A RIGHT JOIN B == B LEFT JOIN A (the reference's
+                    # resolver does the same side swap)
+                    la = add_relation_from(node.right)
+                    ra = add_relation_from(node.left)
+                    if ra is None:
+                        raise ResolveError("right join left side must be a relation")
                     outer_join_specs.append(("left", ra, node.on))
                     return None
                 raise ResolveError(f"{node.kind} join not yet supported")
@@ -302,22 +313,37 @@ class Planner:
         # classify: single-relation -> pushdown; equi-join; residual
         by_alias = {rel.alias: rel for rel in relations}
         outer_right = {ra for _, ra, _ in outer_join_specs}
+        # a FULL join null-extends BOTH sides, so no WHERE conjunct may be
+        # pushed below it — scans pre-filtered on the preserved side would
+        # resurrect their partners as spurious unmatched rows
+        has_full = any(kind == "full" for kind, _ra, _on in outer_join_specs)
         equi: list[tuple[E.ColRef, E.ColRef]] = []
         residual: list[E.Expr] = []
+        post_outer: list[E.Expr] = []
         for c in where_conjs:
             tabs = _tables_of(c)
             ej = _is_equi_join(c)
-            if ej is not None and not (
-                {ej[0].name.split(".")[0], ej[1].name.split(".")[0]} & outer_right
+            if (
+                ej is not None
+                and not has_full
+                and not (
+                    {ej[0].name.split(".")[0], ej[1].name.split(".")[0]}
+                    & outer_right
+                )
             ):
                 equi.append(ej)
             elif (
                 len(tabs) == 1
                 and next(iter(tabs)) in by_alias
                 and next(iter(tabs)) not in outer_right
+                and not has_full
             ):
                 rel = by_alias[next(iter(tabs))]
                 self._push_filter(rel, c)
+            elif (tabs & outer_right) or has_full:
+                # references a null-extended side (or any side under a
+                # FULL join): WHERE applies after the outer joins
+                post_outer.append(c)
             else:
                 residual.append(c)
 
@@ -336,8 +362,10 @@ class Planner:
                         l_, r_ = r_, l_
                     lkeys.append(l_)
                     rkeys.append(r_)
-                elif _tables_of(c) == {ra}:
+                elif _tables_of(c) == {ra} and kind == "left":
                     # right-side-only ON condition filters the build input
+                    # (LEFT join only: a FULL join must still emit right
+                    # rows that fail the ON condition as unmatched)
                     self._push_filter(rel, c)
                 else:
                     resid.append(c)
@@ -345,6 +373,8 @@ class Planner:
                 kind, plan, rel.plan, tuple(lkeys), tuple(rkeys),
                 E.and_(*resid) if resid else None,
             )
+        for c in post_outer:
+            plan = Filter(plan, c)
 
         # ---- semi/anti/scalar joins on top of the join tree ------------
         for spec in semi_specs:

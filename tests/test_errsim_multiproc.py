@@ -7,6 +7,12 @@ env/ob_multi_replica_test_base.cpp:472) and the palf-only bench cluster
 (mittest/palf_cluster).
 """
 
+import pytest as _pytest
+
+# multi-device mesh / forked-cluster tests: skipped on a single real chip
+pytestmark = _pytest.mark.multidevice
+
+
 import multiprocessing as mp
 import socket
 import time
@@ -14,6 +20,7 @@ import time
 import pytest
 
 from oceanbase_tpu.share.errsim import (
+
     DEBUG_SYNC,
     ERRSIM,
     InjectedError,

@@ -21,6 +21,11 @@ from oceanbase_tpu.parallel.exchange import (
 )
 from oceanbase_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
+import pytest as _pytest
+
+# multi-device mesh / forked-cluster tests: skipped on a single real chip
+pytestmark = _pytest.mark.multidevice
+
 NSH = 8
 
 

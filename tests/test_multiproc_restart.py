@@ -14,6 +14,11 @@ import time
 
 import pytest
 
+import pytest as _pytest
+
+# multi-device mesh / forked-cluster tests: skipped on a single real chip
+pytestmark = _pytest.mark.multidevice
+
 
 def _free_ports(n):
     socks, ports = [], []

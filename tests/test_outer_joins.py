@@ -1,0 +1,86 @@
+"""RIGHT and FULL OUTER joins vs the sqlite oracle (sqlite >= 3.39
+supports both natively)."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.engine import Session
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import UNIQUE_KEYS
+from tests.test_window_setops import _norm, check
+
+
+@pytest.fixture(scope="module")
+def db():
+    from tests.test_window_setops import db as _mk  # reuse the oracle loader
+
+    tables = datagen.generate(sf=0.003)
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    conn = sqlite3.connect(":memory:")
+    for name, t in tables.items():
+        cols = t.schema.names()
+        decoded = {}
+        for c in cols:
+            dt = t.schema[c]
+            if dt.kind.value == "varchar":
+                decoded[c] = t.dicts[c].decode(t.data[c])
+            elif dt.is_decimal:
+                decoded[c] = (t.data[c] / dt.decimal_factor).tolist()
+            elif dt.kind.value == "date":
+                base = np.datetime64("1970-01-01", "D")
+                decoded[c] = [str(base + int(v)) for v in t.data[c]]
+            else:
+                decoded[c] = t.data[c].tolist()
+        conn.execute(f"create table {name} ({', '.join(cols)})")
+        rows = list(zip(*[decoded[c] for c in cols]))
+        conn.executemany(
+            f"insert into {name} values ({','.join('?' * len(cols))})", rows
+        )
+    conn.commit()
+    if sqlite3.sqlite_version_info < (3, 39):
+        pytest.skip("sqlite too old for FULL/RIGHT JOIN oracle")
+    return tables, sess, conn
+
+
+def test_right_join(db):
+    # some customers have no orders (custkey % 3 == 0 spec rule)
+    check(db, """
+        select o_orderkey, c_custkey, c_acctbal
+        from orders o right join customer c on o_custkey = c_custkey
+        where c_custkey <= 120
+    """)
+
+
+def test_full_join(db):
+    check(db, """
+        select c_custkey, o_orderkey
+        from customer c full join orders o on c_custkey = o_custkey
+        where c_custkey <= 60 or c_custkey is null
+    """, sqlite_sql="""
+        select c_custkey, o_orderkey
+        from customer c full join orders o on c_custkey = o_custkey
+        where c_custkey <= 60 or c_custkey is null
+    """)
+
+
+def test_full_join_counts(db):
+    tables, sess, conn = db
+    sql = """
+        select count(*) as n
+        from customer c full join orders o on c_custkey = o_custkey
+    """
+    got = sess.sql(sql).columns["n"][0]
+    want = conn.execute(sql).fetchone()[0]
+    assert int(got) == int(want)
+
+
+def test_full_join_on_condition_not_pushed(db):
+    # right rows failing the ON condition must still appear (NULL left)
+    check(db, """
+        select c_custkey, o_orderkey
+        from customer c full join orders o
+          on c_custkey = o_custkey and o_orderkey < 1000
+        where c_custkey <= 30 or c_custkey is null
+    """)

@@ -1,5 +1,11 @@
 """SPMD exchange tests on the 8-device virtual CPU mesh."""
 
+import pytest as _pytest
+
+# multi-device mesh / forked-cluster tests: skipped on a single real chip
+pytestmark = _pytest.mark.multidevice
+
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -8,6 +14,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from oceanbase_tpu.parallel import (
+
     SHARD_AXIS,
     broadcast_rows,
     dest_by_hash,

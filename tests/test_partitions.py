@@ -1,0 +1,148 @@
+"""Hash-partitioned tables + multi-partition (parallel) DML.
+
+Reference surface: hash partitioning (a table = N tablets spread over log
+streams by the rootserver's balance placement) and PDML
+(sql/engine/pdml): one statement staging on several LS leaders inside one
+transaction, committed with 2PC."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+
+@pytest.fixture()
+def db():
+    return Database(n_nodes=3, n_ls=2)
+
+
+def _mk(db, n_parts=4):
+    s = db.session()
+    s.sql(
+        "create table p (id bigint primary key, v int) "
+        f"partition by hash(id) partitions {n_parts}"
+    )
+    return s
+
+
+def test_partitions_spread_over_log_streams(db):
+    _mk(db)
+    ti = db.tables["p"]
+    parts = ti.all_partitions()
+    assert len(parts) == 4
+    assert len({tab for _ls, tab in parts}) == 4
+    # placement spreads across both log streams
+    assert len({ls for ls, _tab in parts}) == 2
+
+
+def test_multi_partition_dml_and_read(db):
+    s = _mk(db)
+    vals = ", ".join(f"({i}, {i * 10})" for i in range(1, 101))
+    assert s.sql(f"insert into p values {vals}").affected == 100
+    # rows actually landed in more than one partition
+    ti = db.tables["p"]
+    per_part = []
+    for pls, ptab in ti.all_partitions():
+        rep = db._leader_replica_ls(pls)
+        per_part.append(len(rep.tablets[ptab].scan(
+            db.cluster.gts.current())["id"]))
+    assert sum(per_part) == 100
+    assert sum(1 for n in per_part if n > 0) >= 2
+    rs = s.sql("select sum(v) as t, count(*) as n from p")
+    assert rs.columns["t"][0] == sum(i * 10 for i in range(1, 101))
+    assert rs.columns["n"][0] == 100
+    # point read routes through the owning partition
+    rs = s.sql("select v from p where id = 42")
+    assert list(rs.columns["v"]) == [420]
+
+
+def test_partitioned_update_delete(db):
+    s = _mk(db)
+    vals = ", ".join(f"({i}, {i})" for i in range(1, 51))
+    s.sql(f"insert into p values {vals}")
+    assert s.sql("update p set v = v + 100 where id <= 25").affected == 25
+    assert s.sql("delete from p where id > 40").affected == 10
+    rs = s.sql("select sum(v) as t, count(*) as n from p")
+    want = sum(i + 100 for i in range(1, 26)) + sum(range(26, 41))
+    assert rs.columns["t"][0] == want and rs.columns["n"][0] == 40
+
+
+def test_cross_partition_tx_atomic(db):
+    """A tx touching several partitions (=> several LS) commits atomically
+    (2PC) or rolls back leaving nothing."""
+    s = _mk(db)
+    s.sql("begin")
+    s.sql("insert into p values (1, 1), (2, 2), (3, 3), (4, 4), (5, 5)")
+    s.sql("rollback")
+    assert s.sql("select count(*) as n from p").columns["n"][0] == 0
+    s.sql("begin")
+    s.sql("insert into p values (1, 1), (2, 2), (3, 3), (4, 4), (5, 5)")
+    s.sql("commit")
+    assert s.sql("select count(*) as n from p").columns["n"][0] == 5
+
+
+def test_duplicate_pk_across_statement(db):
+    s = _mk(db)
+    s.sql("insert into p values (7, 7)")
+    with pytest.raises(SqlError, match="duplicate primary key"):
+        s.sql("insert into p values (7, 8)")
+
+
+def test_partition_col_must_be_in_pk(db):
+    s = db.session()
+    with pytest.raises(SqlError, match="primary key"):
+        s.sql("create table bad (id bigint primary key, v int) "
+              "partition by hash(v) partitions 4")
+
+
+def test_partitioned_with_index(db):
+    s = _mk(db)
+    vals = ", ".join(f"({i}, {i % 7})" for i in range(1, 60))
+    s.sql(f"insert into p values {vals}")
+    s.sql("create index i_v on p (v)")
+    rs = s.sql("select id from p where v = 3 order by id")
+    want = [i for i in range(1, 60) if i % 7 == 3]
+    assert list(rs.columns["id"]) == want
+    assert db.tables["p"].indexes["i_v"].reads == 1
+    s.sql("delete from p where id = 3")
+    rs = s.sql("select id from p where v = 3 order by id")
+    assert list(rs.columns["id"]) == [i for i in want if i != 3]
+
+
+def test_partitioned_obkv_and_direct_load(db):
+    from oceanbase_tpu.server.direct_load import direct_load
+    from oceanbase_tpu.server.table_api import TableApi
+
+    s = _mk(db)
+    api = TableApi(db, "p")
+    api.batch_put([{"id": i, "v": i} for i in range(1, 21)])
+    assert api.get((13,)) == {"id": 13, "v": 13}
+    api.delete((13,))
+    assert api.get((13,)) is None
+    rows = api.scan(key_min=5, key_max=10)
+    assert sorted(r["id"] for r in rows) == [5, 6, 7, 8, 9, 10]
+    n = direct_load(db, "p", {
+        "id": np.arange(100, 131), "v": np.arange(100, 131),
+    })
+    assert n == 31
+    rs = s.sql("select count(*) as n from p where id >= 100")
+    assert rs.columns["n"][0] == 31
+
+
+def test_partitioned_restart(tmp_path):
+    d = Database(n_nodes=3, n_ls=2, data_dir=str(tmp_path), fsync=False)
+    s = d.session()
+    s.sql("create table p (id bigint primary key, v int) "
+          "partition by hash(id) partitions 4")
+    vals = ", ".join(f"({i}, {i})" for i in range(1, 31))
+    s.sql(f"insert into p values {vals}")
+    d.close()
+    del d, s
+    d2 = Database(data_dir=str(tmp_path), fsync=False)
+    s2 = d2.session()
+    rs = s2.sql("select sum(v) as t, count(*) as n from p")
+    assert rs.columns["t"][0] == sum(range(1, 31))
+    assert rs.columns["n"][0] == 30
+    s2.sql("insert into p values (99, 99)")
+    assert s2.sql("select count(*) as n from p").columns["n"][0] == 31
+    d2.close()

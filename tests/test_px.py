@@ -18,6 +18,11 @@ from oceanbase_tpu.parallel.px import PxAdmission, PxExecutor
 from oceanbase_tpu.sql.parser import parse
 from oceanbase_tpu.sql.planner import Planner
 
+import pytest as _pytest
+
+# multi-device mesh / forked-cluster tests: skipped on a single real chip
+pytestmark = _pytest.mark.multidevice
+
 
 @pytest.fixture(scope="module")
 def env():
