@@ -362,6 +362,65 @@ class Executor:
                     params.join_cap[nid] = -(-cap // 1024) * 1024
         return params
 
+    # host-side column-layout property cache: id(array) -> (a0, stride)|None
+    _affine_cache: dict[int, tuple[int, int] | None] = {}
+
+    def _affine_build_info(self, op: JoinOp) -> tuple[int, int] | None:
+        """(a0, stride) when the build side's single join-key column is an
+        AFFINE sequence in storage order (key[i] = a0 + stride*i) — true
+        for identifier columns of LSM tables laid out in key order with
+        regular keys (every TPC-H key column). Such joins skip sorting
+        entirely: the matching build row is (key - a0) / stride, verified
+        by one gather — a direct-address join (the TPU answer to the
+        reference's hash table; cf. dense dict decoders in
+        blocksstable/encoding). Filters/projections above the scan keep
+        the array layout (they only mask/rename), so the property holds
+        through them."""
+        if not op.left_keys or len(op.right_keys) != 1:
+            return None
+        e = op.right_keys[0]
+        node = op.right
+        name = e.name if isinstance(e, E.ColRef) else None
+        if name is None:
+            return None
+        while True:
+            if isinstance(node, Filter):
+                node = node.child
+            elif isinstance(node, Project):
+                nxt = dict(node.exprs).get(name)
+                if not isinstance(nxt, E.ColRef):
+                    return None
+                name = nxt.name
+                node = node.child
+            else:
+                break
+        if not isinstance(node, Scan) or "." not in name:
+            return None
+        alias, col = name.split(".", 1)
+        if alias != node.alias:
+            return None
+        try:
+            arr = self.catalog[node.table].data[col]
+        except (KeyError, AttributeError):
+            return None
+        if not isinstance(arr, np.ndarray) or arr.ndim != 1 or len(arr) < 2:
+            return None
+        key = id(arr)
+        hit = Executor._affine_cache.get(key)
+        if hit is not None or key in Executor._affine_cache:
+            return hit
+        if len(Executor._affine_cache) > 4096:
+            Executor._affine_cache.clear()
+        out = None
+        if np.issubdtype(arr.dtype, np.integer):
+            stride = int(arr[1]) - int(arr[0])
+            if stride > 0:
+                d = np.diff(arr)
+                if (d == stride).all():
+                    out = (int(arr[0]), stride)
+        Executor._affine_cache[key] = out
+        return out
+
     def _merge_joinable(self, op: JoinOp) -> bool:
         """True when the join rides the combined-sort unique-build merge
         path (no pair expansion, no capacity): unique build side and one
@@ -686,7 +745,15 @@ class Executor:
         merged_dicts = {**left.dicts, **right.dicts}
 
         if self._merge_joinable(op):
-            match = merge_join_unique(rkeys[0], right.sel, lkeys[0], left.sel)
+            aff = self._affine_build_info(op) if op.left_keys else None
+            if aff is not None:
+                match = _affine_probe(
+                    rkeys[0], right.sel, lkeys[0], left.sel, aff
+                )
+            else:
+                match = merge_join_unique(
+                    rkeys[0], right.sel, lkeys[0], left.sel
+                )
             sel = left.sel & (match >= 0)
             idx = jnp.clip(match, 0, None)
             cols = dict(left.cols)
@@ -758,6 +825,13 @@ class Executor:
         if op.residual is None:
             if len(lkeys) == 1 and jnp.issubdtype(lkeys[0].dtype, jnp.integer) \
                     and jnp.issubdtype(rkeys[0].dtype, jnp.integer):
+                aff = self._affine_build_info(op)
+                if aff is not None:
+                    has = _affine_probe(
+                        rkeys[0], right.sel, lkeys[0], left.sel, aff
+                    ) >= 0
+                    sel = left.sel & (has if op.kind == "semi" else ~has)
+                    return left.with_sel(sel), ovf
                 skeys, _order = sort_build_side(rkeys, right.sel)
                 pk = jnp.where(
                     left.sel, lkeys[0].astype(jnp.int64),
@@ -1386,6 +1460,23 @@ class PreparedPlan:
                 self.executor.compile(self.plan, self.params)
             )
         raise AssertionError
+
+
+def _affine_probe(build_key, build_sel, probe_key, probe_sel, aff):
+    """Direct-address unique join against an affine build key column:
+    match_row = (key - a0) / stride, one verify gather — no sorts."""
+    a0, stride = aff
+    nb = build_key.shape[0]
+    off = probe_key.astype(jnp.int64) - a0
+    cand = off // stride
+    in_range = (off >= 0) & (off % stride == 0) & (cand < nb)
+    candc = jnp.clip(cand, 0, nb - 1).astype(jnp.int32)
+    hit = (
+        probe_sel & in_range
+        & (build_key[candc] == probe_key)
+        & build_sel[candc]
+    )
+    return jnp.where(hit, candc, -1)
 
 
 def _direct_slot_agg(op: str, slot_is, mask, values):

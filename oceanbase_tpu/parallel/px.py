@@ -122,6 +122,12 @@ class PxExecutor(Executor):
     # the single-chip chunker must not capture a shard_map executor
     chunking_enabled = False
 
+    def _affine_build_info(self, op):
+        # inside shard_map every batch is a per-shard SLICE (and hash
+        # exchanges reorder rows), so the storage-layout affinity the
+        # direct-address join relies on does not hold: always sort-merge
+        return None
+
     def __init__(self, catalog, mesh: Mesh, unique_keys=None,
                  default_rows_estimate=1 << 16,
                  broadcast_threshold: int = 1 << 16,
