@@ -26,6 +26,7 @@ Physical choices made here (the optimizer's physical half):
 
 from __future__ import annotations
 
+import weakref
 from dataclasses import dataclass, field, replace
 
 import jax
@@ -143,6 +144,52 @@ def _children(op: LogicalOp):
     if isinstance(op, (JoinOp, SetOp)):
         return [op.left, op.right]
     return []
+
+
+def _row_key_operands(cols, valid, schema):
+    """Whole-row lexicographic sort operands with NULLs-compare-equal
+    semantics: nullable columns contribute (zeroed values, validity flag)
+    pairs. Returns (operands, spec) where spec records (name, nullable)
+    for _unpack_sorted. Shared by dedup and bag set-op kernels."""
+    operands: list[jnp.ndarray] = []
+    spec: list[tuple[str, bool]] = []
+    for f in schema.fields:
+        c = cols[f.name]
+        v = valid.get(f.name)
+        if v is not None:
+            operands.append(jnp.where(v, c, jnp.zeros((), c.dtype)))
+            operands.append(v)
+            spec.append((f.name, True))
+        else:
+            operands.append(c)
+            spec.append((f.name, False))
+    return operands, spec
+
+
+def _run_boundaries(sorted_operands):
+    """True at positions where any sorted operand differs from the previous
+    row — the first row of each equal-value run."""
+    n = sorted_operands[0].shape[0]
+    new = jnp.zeros(n, jnp.bool_)
+    for sv in sorted_operands:
+        new = new | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]]
+        )
+    return new
+
+
+def _unpack_sorted(svals, spec):
+    """Rebuild (cols, valid) dicts from sorted operands per the spec that
+    _row_key_operands produced."""
+    cols, valid = {}, {}
+    i = 0
+    for name, nullable in spec:
+        cols[name] = svals[i]
+        i += 1
+        if nullable:
+            valid[name] = svals[i]
+            i += 1
+    return cols, valid
 
 
 def _dict_domain(batch: ColumnBatch, e: E.Expr) -> int | None:
@@ -362,8 +409,14 @@ class Executor:
                     params.join_cap[nid] = -(-cap // 1024) * 1024
         return params
 
-    # host-side column-layout property cache: id(array) -> (a0, stride)|None
-    _affine_cache: dict[int, tuple[int, int] | None] = {}
+    # host-side column-layout property cache. Keyed by id(array) with a
+    # WEAK reference in the value: a bare id can be reused by a new array
+    # after the old one is GC'd (catalog refreshes replace DML tables'
+    # arrays), which would silently apply a stale (a0, stride) to an
+    # unrelated column and drop matching join rows. The weakref keeps the
+    # check honest (dead ref or different object -> recompute) without
+    # pinning superseded multi-MB columns until the 4096-entry clear.
+    _affine_cache: dict[int, tuple["weakref.ref", tuple[int, int] | None]] = {}
 
     def _affine_build_info(self, op: JoinOp) -> tuple[int, int] | None:
         """(a0, stride) when the build side's single join-key column is an
@@ -407,8 +460,8 @@ class Executor:
             return None
         key = id(arr)
         hit = Executor._affine_cache.get(key)
-        if hit is not None or key in Executor._affine_cache:
-            return hit
+        if hit is not None and hit[0]() is arr:
+            return hit[1]
         if len(Executor._affine_cache) > 4096:
             Executor._affine_cache.clear()
         out = None
@@ -418,7 +471,7 @@ class Executor:
                 d = np.diff(arr)
                 if (d == stride).all():
                     out = (int(arr[0]), stride)
-        Executor._affine_cache[key] = out
+        Executor._affine_cache[key] = (weakref.ref(arr), out)
         return out
 
     def _merge_joinable(self, op: JoinOp) -> bool:
@@ -833,13 +886,17 @@ class Executor:
                     sel = left.sel & (has if op.kind == "semi" else ~has)
                     return left.with_sel(sel), ovf
                 skeys, _order = sort_build_side(rkeys, right.sel)
-                pk = jnp.where(
-                    left.sel, lkeys[0].astype(jnp.int64),
-                    jnp.iinfo(jnp.int64).max,
-                )
+                pk = lkeys[0].astype(jnp.int64)
                 lo = jnp.searchsorted(skeys, pk, side="left", method="sort")
                 hi = jnp.searchsorted(skeys, pk, side="right", method="sort")
-                has = left.sel & (hi > lo)
+                # dead build rows sit at sorted positions >= right.nrows
+                # with int64-max placeholders; clamp so a live probe key
+                # of int64 max can't match them (dead probe rows are
+                # masked by left.sel below)
+                n_live = right.nrows.astype(lo.dtype)
+                has = left.sel & (
+                    jnp.minimum(hi, n_live) > jnp.minimum(lo, n_live)
+                )
             else:
                 nb = rkeys[0].shape[0]
                 ts = next_pow2(max(2 * nb, 16))
@@ -987,8 +1044,6 @@ class Executor:
         right, rovf = emit(op.right, inputs)
         ovf = {**lovf, **rovf}
         out_schema = setop_schema(left.schema, right.schema)
-        if op.all and op.kind != "union":
-            raise NotImplementedError(f"{op.kind.upper()} ALL")
 
         lcols, rcols, lvalid, rvalid, dicts = {}, {}, {}, {}, {}
         for i, f in enumerate(out_schema.fields):
@@ -1034,6 +1089,21 @@ class Executor:
                 return out, ovf
             return self._dedup_batch(out, ovf)
 
+        if op.all:
+            # INTERSECT ALL / EXCEPT ALL (bag semantics): one combined
+            # lexicographic sort of both sides with the side flag as the
+            # LAST key, so within each equal-value run all left copies
+            # precede the right copies. Per run with l left and r right
+            # copies, the k-th left copy (k = 0..l-1) survives iff
+            # k < r (INTERSECT ALL → min(l, r) copies) or k >= r
+            # (EXCEPT ALL → max(l - r, 0) copies) — the run-length
+            # counting form of ObHashSetVecOp's bag semantics
+            # (sql/engine/set), recast as sort + prefix sums for the TPU.
+            return self._emit_setop_all(
+                op.kind, lcols, rcols, lvalid, rvalid,
+                left, right, out_schema, dicts, ovf,
+            )
+
         # INTERSECT / EXCEPT (distinct semantics): sort-dedup the left
         # side, then an existence probe against the right side decides each
         # surviving row
@@ -1053,43 +1123,76 @@ class Executor:
         sel = db.sel & (has if op.kind == "intersect" else ~has)
         return db.with_sel(sel), ovf
 
+    def _emit_setop_all(self, kind, lcols, rcols, lvalid, rvalid,
+                        left, right, out_schema, dicts, ovf):
+        """INTERSECT ALL / EXCEPT ALL kernel (see caller comment)."""
+        nl, nr = left.capacity, right.capacity
+        n = nl + nr
+        cols = {
+            f.name: jnp.concatenate([lcols[f.name], rcols[f.name]])
+            for f in out_schema.fields
+        }
+        valid = {
+            name: jnp.concatenate([lvalid[name], rvalid[name]])
+            for name in lvalid
+        }
+        live = jnp.concatenate([left.sel, right.sel])
+        side = jnp.concatenate(
+            [jnp.zeros(nl, jnp.int32), jnp.ones(nr, jnp.int32)]
+        )
+        operands, spec = _row_key_operands(cols, valid, out_schema)
+        sorted_ = jax.lax.sort(
+            (~live,) + tuple(operands) + (side,),
+            num_keys=2 + len(operands),
+        )
+        sdead = sorted_[0]
+        svals = sorted_[1:-1]
+        sside = sorted_[-1]
+        pos = jnp.arange(n, dtype=jnp.int64)
+        # runs are delimited by value (and deadness) changes — NOT side
+        new_run = _run_boundaries((sdead,) + tuple(svals))
+        run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
+        # exclusive run end = start of the NEXT run (suffix-min of marked
+        # positions, shifted one left)
+        marked = jnp.where(new_run, pos, n)
+        suffix_min = jax.lax.cummin(marked[::-1])[::-1]
+        run_end = jnp.concatenate(
+            [suffix_min[1:], jnp.full(1, n, dtype=jnp.int64)]
+        )
+        is_left = sside == 0
+        cum_left = jnp.cumsum(is_left.astype(jnp.int64))
+
+        def left_before(x):
+            return jnp.where(x > 0, cum_left[jnp.clip(x - 1, 0, n - 1)], 0)
+
+        l_run = left_before(run_end) - left_before(run_start)
+        r_run = (run_end - run_start) - l_run
+        left_rank = pos - run_start
+        keep = left_rank < r_run if kind == "intersect" \
+            else left_rank >= r_run
+        sel = ~sdead & is_left & keep
+        out_cols, out_valid = _unpack_sorted(svals, spec)
+        out = ColumnBatch(
+            cols=out_cols, valid=out_valid, sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=out_schema, dicts=dicts,
+        )
+        return out, ovf
+
     def _dedup_batch(self, b: ColumnBatch, ovf):
         """Distinct over all columns with NULLs-compare-equal key semantics
         (shared by UNION and the Distinct operator). Sort-based: one
         multi-operand lexicographic sort, run boundaries mark the surviving
         representative rows — no hash table, no scatter, no capacity."""
-        operands: list[jnp.ndarray] = []
-        spec: list[tuple[str, bool]] = []  # (field, nullable)
-        for f in b.schema.fields:
-            c = b.cols[f.name]
-            v = b.valid.get(f.name)
-            if v is not None:
-                operands.append(jnp.where(v, c, jnp.zeros((), c.dtype)))
-                operands.append(v)
-                spec.append((f.name, True))
-            else:
-                operands.append(c)
-                spec.append((f.name, False))
-        n = b.capacity
+        operands, spec = _row_key_operands(b.cols, b.valid, b.schema)
         sorted_ = jax.lax.sort(
             (~b.sel,) + tuple(operands), num_keys=1 + len(operands)
         )
         sdead = sorted_[0]
         svals = sorted_[1:]
-        new = jnp.zeros(n, jnp.bool_).at[0].set(True)
-        for sv in (sdead,) + tuple(svals):
-            new = new | jnp.concatenate(
-                [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]]
-            )
+        new = _run_boundaries((sdead,) + tuple(svals))
         sel = new & ~sdead
-        cols, valid = {}, {}
-        i = 0
-        for name, nullable in spec:
-            cols[name] = svals[i]
-            i += 1
-            if nullable:
-                valid[name] = svals[i]
-                i += 1
+        cols, valid = _unpack_sorted(svals, spec)
         out = ColumnBatch(
             cols=cols, valid=valid, sel=sel,
             nrows=jnp.sum(sel, dtype=jnp.int64),

@@ -128,30 +128,37 @@ def merge_join_unique(
     Returns match_row [Np] int32 in ORIGINAL probe order (-1 = no match).
     Exact (sorts true keys, no hashing). Duplicate build keys: one winner
     per key (the one sorting first), same contract as build_hash_table.
+
+    Deadness rides as a separate LEADING sort operand rather than an
+    in-band sentinel value, so the full int64 key domain (including
+    2^62.. and int64 max) joins correctly.
     """
-    _BIG = jnp.int64(1) << 62
-    bk = jnp.where(build_mask, build_key.astype(jnp.int64), _BIG)
-    pk = jnp.where(probe_mask, probe_key.astype(jnp.int64), _BIG - 1)
-    nb = bk.shape[0]
-    npr = pk.shape[0]
+    nb = build_key.shape[0]
+    npr = probe_key.shape[0]
     n = nb + npr
-    keys = jnp.concatenate([bk, pk])
+    keys = jnp.concatenate(
+        [build_key.astype(jnp.int64), probe_key.astype(jnp.int64)]
+    )
+    dead = jnp.concatenate([~build_mask, ~probe_mask]).astype(jnp.int32)
     side = jnp.concatenate(
         [jnp.zeros(nb, jnp.int32), jnp.ones(npr, jnp.int32)]
     )
     idx = jnp.concatenate(
         [jnp.arange(nb, dtype=jnp.int32), jnp.arange(npr, dtype=jnp.int32)]
     )
-    sk, sside, sidx = jax.lax.sort((keys, side, idx), num_keys=2)
+    sdead, sk, sside, sidx = jax.lax.sort(
+        (dead, keys, side, idx), num_keys=3
+    )
     pos = jnp.arange(n, dtype=jnp.int32)
     new_run = jnp.concatenate(
-        [jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]]
+        [jnp.ones(1, jnp.bool_),
+         (sk[1:] != sk[:-1]) | (sdead[1:] != sdead[:-1])]
     )
     run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
     b_at_start = sside[run_start] == 0
     cand = sidx[run_start]
     match_sorted = jnp.where(
-        (sside == 1) & b_at_start & (sk < _BIG - 1), cand, -1
+        (sside == 1) & (sdead == 0) & b_at_start, cand, -1
     )
     # inverse permutation restricted to probe entries — computed by a
     # second sort (argsort), never a scatter
@@ -196,6 +203,12 @@ def expand_join(
     hi = jnp.searchsorted(
         build_sorted_keys64, keys64, side="right", method="sort"
     )
+    # dead build rows occupy sorted positions [build_nrows, nb) (they carry
+    # int64-max placeholders); clamping keeps a live int64-max probe key
+    # from matching them
+    n_live = build_nrows.astype(lo.dtype)
+    lo = jnp.minimum(lo, n_live)
+    hi = jnp.minimum(hi, n_live)
     cnt = jnp.where(probe_mask, (hi - lo).astype(jnp.int64), 0)
     offs = jnp.cumsum(cnt)  # inclusive prefix sum
     total = offs[-1] if cnt.shape[0] > 0 else jnp.zeros((), jnp.int64)
@@ -226,9 +239,18 @@ def probe_run_any(pair_ok: jnp.ndarray, starts: jnp.ndarray, offs: jnp.ndarray):
 
 
 def sort_build_side(key_cols: list[jnp.ndarray], mask: jnp.ndarray):
-    """Sort build rows by mixed 64-bit key for expand_join; dead rows last."""
+    """Sort build rows by mixed 64-bit key for expand_join; dead rows
+    strictly last (deadness is a separate leading sort operand, so live
+    rows whose key happens to equal int64 max still precede every dead
+    row; expand_join then clamps searchsorted ranges to the live count)."""
     keys64 = join_keys64(key_cols)
-    keys64 = jnp.where(mask, keys64, jnp.iinfo(jnp.int64).max)
     n = keys64.shape[0]
-    order = jnp.argsort(keys64)
-    return keys64[order], order.astype(jnp.int32)
+    dead = (~mask).astype(jnp.int32)
+    sdead, skeys, order = jax.lax.sort(
+        (dead, keys64, jnp.arange(n, dtype=jnp.int32)), num_keys=2
+    )
+    # dead tail carries int64 max so the array stays nondecreasing for
+    # the binary search (live rows can also hold int64 max — harmless,
+    # the clamp excludes the tail)
+    skeys = jnp.where(sdead == 0, skeys, jnp.iinfo(jnp.int64).max)
+    return skeys, order

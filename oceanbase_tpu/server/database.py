@@ -1550,8 +1550,20 @@ class DbSession:
         rs = self._qualify(st, ti, ti.schema.names(), tuple(computed))
         set_cols = {col: rs.columns[f"$set{i}"]
                     for i, (col, _) in enumerate(computed)}
+        if any(idx.unique for idx in ti.indexes.values()):
+            # _check_unique below reads the local replica of the index LS;
+            # become (or sync with) its leader first or a lagging follower
+            # can miss committed entries and admit a UNIQUE violation
+            # (mirrors _insert's ensure_leader-before-check ordering)
+            tx.ensure_leader(ti.ls_id)
         muts: list[tuple[tuple, int, tuple | None]] = []
         index_muts: list[tuple[int, tuple, int, tuple | None]] = []
+        # intra-statement duplicate guard (mirrors _insert's seen_i): two
+        # rows updated to the same unique key both pass the committed-state
+        # check, so the statement itself must catch the collision
+        seen_i: dict[str, set[tuple]] = {
+            idx.name: set() for idx in ti.indexes.values() if idx.unique
+        }
         for r in range(rs.nrows):
             vals = []
             old_vals = []
@@ -1572,6 +1584,14 @@ class DbSession:
             for idx in ti.indexes.values():
                 old_ik, _ = self._index_entry(ti, idx, old_vals)
                 new_ik, new_iv = self._index_entry(ti, idx, vals)
+                if idx.unique:
+                    # an unchanged entry still occupies its key within this
+                    # statement; record it so another row can't move onto it
+                    if new_ik in seen_i[idx.name]:
+                        raise SqlError(
+                            f"unique index {idx.name} violation on {new_ik}"
+                        )
+                    seen_i[idx.name].add(new_ik)
                 if old_ik == new_ik:
                     continue  # entry content (key cols + pk) unchanged
                 if idx.unique:

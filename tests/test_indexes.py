@@ -76,6 +76,10 @@ def test_unique_index_enforced(db):
         s.sql("update acct set email = 'b' where id = 3")
     # updating to its own current value is fine
     s.sql("update acct set email = 'c' where id = 3")
+    # one statement moving TWO rows onto the same fresh key must fail:
+    # neither key exists in committed state, the collision is intra-stmt
+    with pytest.raises(SqlError, match="unique index"):
+        s.sql("update acct set email = 'zz' where id >= 2")
 
 
 def test_unique_index_build_rejects_duplicates(db):

@@ -142,6 +142,67 @@ def test_setop_type_promotion(db):
     """)
 
 
+def _bag_check(db, kind, sql, left_sql, right_sql):
+    """Oracle for INTERSECT ALL / EXCEPT ALL (sqlite lacks them): bag
+    semantics computed from each side's rows with a Counter."""
+    from collections import Counter
+
+    tables, sess, conn = db
+    lrows = Counter(conn.execute(to_sqlite(left_sql)).fetchall())
+    rrows = Counter(conn.execute(to_sqlite(right_sql)).fetchall())
+    want = []
+    for row, ln in sorted(lrows.items(), key=repr):
+        rn = rrows.get(row, 0)
+        k = min(ln, rn) if kind == "intersect" else max(ln - rn, 0)
+        want += [tuple(_norm(v) for v in row)] * k
+    rs = sess.sql(sql)
+    got = [
+        tuple(_norm(rs.columns[n][i]) for n in rs.names)
+        for i in range(rs.nrows)
+    ]
+    assert sorted(got, key=repr) == sorted(want, key=repr)
+
+
+def test_intersect_all(db):
+    l = "select c_nationkey as k from customer where c_acctbal > 1000"
+    r = "select s_nationkey from supplier"
+    _bag_check(db, "intersect", f"{l} intersect all {r}", l, r)
+
+
+def test_except_all(db):
+    l = "select c_nationkey as k from customer where c_custkey <= 300"
+    r = "select s_nationkey from supplier"
+    _bag_check(db, "except", f"{l} except all {r}", l, r)
+
+
+def test_intersect_all_multicol_dups(db):
+    # two columns, duplicates on both sides
+    l = ("select c_nationkey as a, c_mktsegment as b from customer "
+         "where c_custkey <= 200")
+    r = ("select c_nationkey, c_mktsegment from customer "
+         "where c_custkey between 100 and 400")
+    _bag_check(db, "intersect", f"{l} intersect all {r}", l, r)
+
+
+def test_intersect_all_with_nulls(db):
+    # LEFT JOIN produces genuine NULLs in s_suppkey, exercising the
+    # validity-flag sort keys (NULLs compare equal) of the bag kernel
+    l = ("select c.c_nationkey as a, s.s_suppkey as b from customer c "
+         "left join supplier s on c.c_custkey = s.s_suppkey "
+         "where c.c_custkey <= 40")
+    r = ("select c.c_nationkey, s.s_suppkey from customer c "
+         "left join supplier s on c.c_custkey = s.s_suppkey "
+         "where c.c_custkey between 10 and 80")
+    _bag_check(db, "intersect", f"{l} intersect all {r}", l, r)
+    _bag_check(db, "except", f"{l} except all {r}", l, r)
+
+
+def test_except_all_keeps_surplus_duplicates(db):
+    l = "select o_orderpriority as p from orders where o_orderkey <= 600"
+    r = "select o_orderpriority from orders where o_orderkey <= 200"
+    _bag_check(db, "except", f"{l} except all {r}", l, r)
+
+
 def test_setop_with_aggregates(db):
     check(db, """
         select c_nationkey as k, count(*) as n from customer group by c_nationkey
