@@ -2,15 +2,26 @@
 """Headline benchmark: TPC-H on the TPU engine vs a CPU vectorized baseline.
 
 Per BASELINE.json the metric is TPC-H rows/sec/chip with the CPU vectorized
-engine as the measured baseline. Round 2 extends round 1's scan/aggregate
-pair (Q1/Q6) with JOIN-shaped queries (Q3, Q14) and runs at SF10 by default
-— data flows through the real SQL engine (parse -> plan -> stats-seeded
-capacities -> jitted XLA program, plan-cache warm), not hand-built kernels.
+engine as the measured baseline. Queries run through the real SQL engine
+(parse -> plan -> stats-seeded capacities -> jitted XLA program, plan-cache
+warm), not hand-built kernels.
 
-Prints exactly ONE JSON line:
+Budget-aware by design (round 2 lost every number to a driver timeout):
+- generated tables are cached to .bench_cache/tpch_sf{sf}.npz — datagen is
+  paid once per machine, not per run;
+- the XLA persistent compilation cache lives in .bench_cache/xla — repeat
+  runs skip the 20-40s per-query compiles;
+- queries run cheap-first (q6 -> q1 -> q14 -> q3) and a CUMULATIVE summary
+  line is printed after every query, so at any kill point the last stdout
+  line is a complete, parseable summary of everything measured so far;
+- BENCH_BUDGET_S (default 270) stops starting new queries when the
+  remaining budget is under the worst per-query cost observed so far.
+
+Every line (and so the LAST line) honors the one-line summary contract:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "detail": {...}}
 
-Env knobs: BENCH_SF (default 10), BENCH_REPS (default 5).
+Env knobs: BENCH_SF (default: largest of {10, 1} that fits the budget),
+BENCH_REPS (default 5), BENCH_BUDGET_S (default 270).
 """
 
 import json
@@ -19,15 +30,67 @@ import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.path.join(REPO, ".bench_cache")
+ORDER = ["q6", "q1", "q14", "q3"]  # cheap-first
+QID = {"q1": 1, "q6": 6, "q3": 3, "q14": 14}
+START = time.monotonic()
 
-def _best(f, reps):
-    """(best wall time, last result) over reps calls."""
-    ts, out = [], None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = f()
-        ts.append(time.perf_counter() - t0)
-    return min(ts), out
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def elapsed():
+    return time.monotonic() - START
+
+
+# ---------------------------------------------------------------------------
+# Cached TPC-H tables
+# ---------------------------------------------------------------------------
+
+def cache_path(sf: float) -> str:
+    return os.path.join(CACHE, f"tpch_sf{sf:g}.npz")
+
+
+def load_or_generate(sf: float):
+    """Tables from the on-disk cache, else generate + populate the cache."""
+    from oceanbase_tpu.core.dictionary import Dictionary
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.models.tpch import datagen
+    from oceanbase_tpu.models.tpch import schema as S
+
+    p = cache_path(sf)
+    if os.path.exists(p):
+        z = np.load(p, allow_pickle=False)
+        names = set(z.files)
+        tables = {}
+        for name, schema in S.TABLES.items():
+            data, dicts = {}, {}
+            for f in schema.fields:
+                data[f.name] = z[f"{name}|{f.name}"]
+                dk = f"{name}|{f.name}#dict"
+                if dk in names:
+                    dicts[f.name] = Dictionary(
+                        z[dk].tolist(), sorted_=True
+                    )
+            tables[name] = Table(name, schema, data, dicts)
+        return tables, "cache"
+    tables = datagen.generate(sf)
+    try:
+        os.makedirs(CACHE, exist_ok=True)
+        arrs = {}
+        for n, t in tables.items():
+            for c, a in t.data.items():
+                arrs[f"{n}|{c}"] = a
+            for c, d in t.dicts.items():
+                arrs[f"{n}|{c}#dict"] = np.array(d.values())
+        tmp = p + f".tmp{os.getpid()}.npz"
+        np.savez(tmp, **arrs)
+        os.replace(tmp, p)
+    except OSError:
+        pass  # cache is an optimization; never fail the bench on disk
+    return tables, "generated"
 
 
 # ---------------------------------------------------------------------------
@@ -84,27 +147,66 @@ def q14_cpu(part, li):
     return float(100.0 * rev[is_promo].sum() / max(rev.sum(), 1))
 
 
-Q_TEXTS = {
-    "q1": 1,
-    "q6": 6,
-    "q3": 3,
-    "q14": 14,
-}
+def _best(f, reps):
+    ts, out = [], None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def check_result(qname, rs, cpu_val):
+    """Per-query correctness cross-check vs the CPU baseline value."""
+    if qname == "q6":
+        got = float(rs.columns["revenue"][0])
+        return abs(got - cpu_val) <= 1e-6 * max(1.0, abs(cpu_val))
+    if qname == "q3":
+        got3 = [
+            (int(rs.columns["l_orderkey"][i]), float(rs.columns["revenue"][i]))
+            for i in range(rs.nrows)
+        ]
+        want3 = [(k, float(r)) for k, r, _d, _p in cpu_val]
+        return len(got3) == len(want3) and all(
+            gk == wk and abs(gr - wr) < 1e-2
+            for (gk, gr), (wk, wr) in zip(got3, want3)
+        )
+    if qname == "q14":
+        return abs(float(rs.columns["promo_revenue"][0]) - cpu_val) < 1e-3
+    return True  # q1: full-table check is in tests/test_tpch_full.py
 
 
 def main():
-    sf = float(os.environ.get("BENCH_SF", "10"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "270"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
-    cpu_reps = 2 if sf <= 1 else 1
 
     import jax
 
+    # persistent XLA compile cache: repeat runs skip 20-40s per query
+    try:
+        os.makedirs(os.path.join(CACHE, "xla"), exist_ok=True)
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(CACHE, "xla")
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+
+    sf_env = os.environ.get("BENCH_SF")
+    if sf_env:
+        sf = float(sf_env)
+    elif os.path.exists(cache_path(10)) or budget >= 180:
+        sf = 10.0
+    else:
+        sf = 1.0
+    cpu_reps = 2 if sf <= 1 else 1
+
     from oceanbase_tpu.engine import Session
-    from oceanbase_tpu.models.tpch import datagen
     from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
 
     t0 = time.perf_counter()
-    tables = datagen.generate(sf)
+    tables, source = load_or_generate(sf)
     gen_s = time.perf_counter() - t0
     li = tables["lineitem"]
     n = li.nrows
@@ -114,106 +216,103 @@ def main():
         "sf": sf,
         "rows": int(n),
         "datagen_s": round(gen_s, 1),
+        "tables_source": source,
+        "budget_s": budget,
     }
 
-    # ---- CPU vectorized baselines --------------------------------------
     from oceanbase_tpu.models.tpch.queries import q1_numpy_fast, q6_numpy
-
-    cpu_t, cpu_vals = {}, {}
-    cpu_t["q6"], cpu_vals["q6"] = _best(lambda: q6_numpy(li), cpu_reps)
-    cpu_t["q1"], _ = _best(lambda: q1_numpy_fast(li), cpu_reps)
-    cpu_t["q3"], cpu_vals["q3"] = _best(
-        lambda: q3_cpu(tables["customer"], tables["orders"], li), cpu_reps
-    )
-    cpu_t["q14"], cpu_vals["q14"] = _best(
-        lambda: q14_cpu(tables["part"], li), cpu_reps
+    from oceanbase_tpu.sql import parser as P
+    from oceanbase_tpu.sql.plan_cache import (
+        bind,
+        parameterize,
+        plan_fingerprint,
     )
 
-    # ---- TPU engine (SQL path: parse -> plan -> jitted XLA program) ----
-    # headline times the compiled plan's device execution (inputs resident
-    # in HBM, same rules as the CPU baseline which also reads RAM-resident
-    # arrays); end-to-end SQL latency (parse+plan+result fetch) is reported
-    # separately per query.
-    sess = Session(tables, unique_keys=UNIQUE_KEYS)
-    tpu_t = {}
-    e2e_t = {}
-    tpu_rs = {}
-    for qname, qid in Q_TEXTS.items():
-        text = QUERIES[qid]
-        try:
-            rs = sess.sql(text)  # compile + first run
-            tpu_rs[qname] = rs
-            e2e_t[qname], _ = _best(lambda t=text: sess.sql(t), max(2, reps // 2))
-        except Exception as e:  # pragma: no cover - report partial results
-            detail[f"{qname}_error"] = f"{type(e).__name__}: {e}"
-            continue
-        # device-path timing through the prepared plan (plan-cache artifact)
-        from oceanbase_tpu.sql import parser as P
-        from oceanbase_tpu.sql.plan_cache import bind, parameterize
-
-        pq = sess.planner.plan(P.parse(text))
-        pz = parameterize(pq.plan)
-        prepared = sess.executor.prepare(pz.plan)
-        qp = bind(pz.values, pz.dtypes)
-        prepared.run(qparams=qp)  # warm
-        # device throughput, amortized: dispatch K executions (the device
-        # runs them back to back) and sync once at the end — a single
-        # dispatch+fetch would mostly measure host<->device round-trip
-        # latency, not the program (async dispatch returns immediately)
-        K = 8
-
-        def _run_k(p=prepared, q=qp):
-            out = None
-            for _ in range(K):
-                out = p.run_nocheck(qparams=q)
-            return int(out.nrows)
-
-        t, _ = _best(_run_k, reps)
-        tpu_t[qname] = t / K
-
-    # ---- correctness cross-checks --------------------------------------
-    ok = True
-    if "q6" in tpu_rs:
-        got = float(tpu_rs["q6"].columns["revenue"][0])
-        ok &= abs(got - cpu_vals["q6"]) <= 1e-6 * max(1.0, abs(cpu_vals["q6"]))
-    if "q3" in tpu_rs:
-        rs = tpu_rs["q3"]
-        got3 = [
-            (int(rs.columns["l_orderkey"][i]), float(rs.columns["revenue"][i]))
-            for i in range(rs.nrows)
-        ]
-        want3 = [(k, float(r)) for k, r, _d, _p in cpu_vals["q3"]]
-        ok &= len(got3) == len(want3) and all(
-            gk == wk and abs(gr - wr) < 1e-2
-            for (gk, gr), (wk, wr) in zip(got3, want3)
-        )
-    if "q14" in tpu_rs:
-        got14 = float(tpu_rs["q14"].columns["promo_revenue"][0])
-        ok &= abs(got14 - cpu_vals["q14"]) < 1e-3
-    detail["correct"] = bool(ok)
-
-    for qname in Q_TEXTS:
-        if qname in tpu_t:
-            detail[f"{qname}_tpu_s"] = round(tpu_t[qname], 6)
-            detail[f"{qname}_cpu_s"] = round(cpu_t[qname], 6)
-            detail[f"{qname}_e2e_s"] = round(e2e_t[qname], 6)
-            detail[f"{qname}_speedup"] = round(cpu_t[qname] / tpu_t[qname], 3)
-
-    q6_rows_s = n / tpu_t["q6"] if "q6" in tpu_t else 0.0
-    vs = (q6_rows_s / (n / cpu_t["q6"])) if "q6" in tpu_t else 0.0
-    # geometric-mean speedup across all measured queries (joins included)
-    sps = [cpu_t[q] / tpu_t[q] for q in tpu_t]
-    if sps:
-        detail["geomean_speedup"] = round(float(np.exp(np.mean(np.log(sps)))), 3)
-
-    out = {
-        "metric": f"tpch_q6_sf{sf:g}_rows_per_sec_chip",
-        "value": round(q6_rows_s, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(vs, 3),
-        "detail": detail,
+    cpu_fns = {
+        "q6": lambda: q6_numpy(li),
+        "q1": lambda: q1_numpy_fast(li),
+        "q3": lambda: q3_cpu(tables["customer"], tables["orders"], li),
+        "q14": lambda: q14_cpu(tables["part"], li),
     }
-    print(json.dumps(out))
+
+    def summary(tpu_t, cpu_t):
+        """Cumulative summary of everything measured so far — printed
+        after every query so the last stdout line is always complete."""
+        sps = [cpu_t[q] / tpu_t[q] for q in tpu_t]
+        if sps:
+            detail["geomean_speedup"] = round(
+                float(np.exp(np.mean(np.log(sps)))), 3
+            )
+        detail["total_s"] = round(elapsed(), 1)
+        q6_rows_s = n / tpu_t["q6"] if "q6" in tpu_t else 0.0
+        vs = (q6_rows_s / (n / cpu_t["q6"])) if "q6" in tpu_t else 0.0
+        emit({
+            "metric": f"tpch_q6_sf{sf:g}_rows_per_sec_chip",
+            "value": round(q6_rows_s, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(vs, 3),
+            "detail": detail,
+        })
+
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    tpu_t, cpu_t = {}, {}
+    summary(tpu_t, cpu_t)  # tables line: a kill during q6 still parses
+    # reserve: the worst per-query wall cost seen so far (compile + CPU
+    # baseline dominate; with warm XLA/datagen caches this collapses)
+    worst_q = 45.0
+    for qname in ORDER:
+        if elapsed() > budget - worst_q:
+            detail[f"{qname}_skipped"] = "budget"
+            continue
+        q_start = elapsed()
+        text = QUERIES[QID[qname]]
+        try:
+            cpu_t[qname], cpu_val = _best(cpu_fns[qname], cpu_reps)
+            rs = sess.sql(text)  # compile + first run
+            ok = check_result(qname, rs, cpu_val)
+            e2e, _ = _best(lambda t=text: sess.sql(t), max(2, reps // 2))
+            # device-path timing through the SAME cached executable the
+            # session compiled (a separately prepared plan would re-trace
+            # and pay a second ~100s remote compile on the axon tunnel)
+            norm_key, _n = P.normalize_for_cache(text)
+            pq = sess.planner.plan(P.parse(text))
+            pz = parameterize(pq.plan)
+            key = (id(sess.executor.catalog), norm_key, pz.sig, pz.baked,
+                   plan_fingerprint(pz.plan), ())
+            entry = sess.plan_cache.get(key)
+            assert entry is not None, "plan cache miss on timed re-fetch"
+            prepared = entry.prepared
+            qp = bind(pz.values, entry.dtypes)
+            prepared.run(qparams=qp)  # warm
+            # amortized dispatch: K back-to-back executions, one sync —
+            # a single dispatch+fetch mostly measures host<->device
+            # round-trip latency, not the program
+            K = 8
+
+            def _run_k(p=prepared, q=qp):
+                out = None
+                for _ in range(K):
+                    out = p.run_nocheck(qparams=q)
+                return int(out.nrows)
+
+            t, _ = _best(_run_k, reps)
+            tpu_t[qname] = t / K
+            qd = {
+                "tpu_s": round(tpu_t[qname], 6),
+                "cpu_s": round(cpu_t[qname], 6),
+                "e2e_s": round(e2e, 6),
+                "speedup": round(cpu_t[qname] / tpu_t[qname], 3),
+                "rows_per_s": round(n / tpu_t[qname], 1),
+                "correct": bool(ok),
+            }
+            for k, v in qd.items():
+                detail[f"{qname}_{k}"] = v
+        except Exception as e:  # pragma: no cover — keep partial results
+            detail[f"{qname}_error"] = f"{type(e).__name__}: {e}"
+        worst_q = max(worst_q, (elapsed() - q_start) * 1.1)
+        summary(tpu_t, cpu_t)
+    # final line re-emits with any budget-skip markers included
+    summary(tpu_t, cpu_t)
 
 
 if __name__ == "__main__":

@@ -48,6 +48,7 @@ from ..sql.logical import (
     Scan,
     SetOp,
     Sort,
+    TopN,
     Window,
     output_schema,
 )
@@ -87,13 +88,28 @@ def plan_input_bytes(executor: Executor, plan: LogicalOp) -> int:
     )
 
 
-def _find_stream_split(executor: Executor, plan: LogicalOp, budget: int):
-    """Choose the streamed scan and the accumulation Aggregate.
+def _row_bytes(schema: Schema) -> int:
+    return max(sum(f.dtype.storage_np.itemsize for f in schema.fields), 1)
 
-    Returns (stream_scan, agg_node) where agg_node is the lowest Aggregate
-    whose subtree contains stream_scan, every node on the path between them
-    is streamable (Filter/Project/Join-with-stream-on-probe-side), and the
-    plan's OTHER inputs fit the budget. Raises NotStreamable otherwise.
+
+def _find_stream_split(executor: Executor, plan: LogicalOp, budget: int):
+    """Choose the streamed scan and the chunk-accumulation split node.
+
+    Returns (stream_scan, split_node, kind). `split_node` is the node run
+    per chunk; its per-chunk outputs (the "partials") concatenate into the
+    $partials relation which the merge plan consumes. Kinds, tried
+    most-reducing first along the root->scan path (every node between the
+    split and the scan must stream rows: Filter / Project /
+    Join-with-stream-on-probe-side):
+
+      agg         lowest Aggregate with mergeable aggs -> re-aggregate
+      topn        lowest TopN -> per-chunk top (n+offset), final top-n
+      distinct    lowest Distinct -> per-chunk dedup, final dedup
+      passthrough the maximal streamable prefix itself (filters, projects,
+                  probe joins): partials are the surviving rows; the rest
+                  of the plan (sort / window / distinct / set ops / any
+                  aggregate) runs unchanged on $partials. Guarded by the
+                  optimizer estimate of surviving rows fitting the budget.
     """
     needed = executor._needed_columns(plan)
     scans = executor._collect_scans(plan)
@@ -122,32 +138,80 @@ def _find_stream_split(executor: Executor, plan: LogicalOp, budget: int):
         return False
 
     assert find(plan)
-    # lowest Aggregate on the path (nearest the scan)
-    agg = None
-    agg_pos = -1
-    for i, node in enumerate(path):
-        if isinstance(node, Aggregate):
-            agg = node
-            agg_pos = i
-    if agg is None:
-        raise NotStreamable("no aggregate above the streamed scan")
-    for name, fn, _arg, distinct in agg.aggs:
-        if distinct or fn not in _MERGE_FN:
-            raise NotStreamable(f"aggregate {fn} not mergeable")
-    # nodes strictly between the Aggregate and the scan must stream rows
-    for parent, child in zip(path[agg_pos:], path[agg_pos + 1 :]):
-        if isinstance(parent, Aggregate):
-            continue
-        if isinstance(parent, (Filter, Project)):
-            continue
-        if isinstance(parent, JoinOp):
-            if child is not parent.left:
-                raise NotStreamable("streamed table on a join build side")
-            continue
-        if isinstance(parent, Scan):
-            continue
-        raise NotStreamable(f"{type(parent).__name__} blocks streaming")
-    return stream, agg
+
+    def path_streams(from_pos: int) -> bool:
+        """All nodes strictly below path[from_pos] down to the scan move
+        rows chunk-wise."""
+        for parent, child in zip(path[from_pos + 1:], path[from_pos + 2:]):
+            if isinstance(parent, (Filter, Project)):
+                continue
+            if isinstance(parent, JoinOp):
+                if child is not parent.left:
+                    return False
+                continue
+            if isinstance(parent, Scan):
+                continue
+            return False
+        return True
+
+    # lowest (nearest-scan) candidates per kind
+    def lowest(pred):
+        best = None
+        for i, node in enumerate(path):
+            if pred(node):
+                best = i
+        return best
+
+    i = lowest(lambda n: isinstance(n, Aggregate))
+    if i is not None and path_streams(i):
+        agg = path[i]
+        if all(
+            not d and fn in _MERGE_FN for _nm, fn, _a, d in agg.aggs
+        ):
+            return stream, agg, "agg"
+
+    i = lowest(lambda n: isinstance(n, TopN))
+    if i is not None and path_streams(i):
+        topn = path[i]
+        if all(isinstance(e, E.ColRef) for e, _d in topn.keys):
+            return stream, topn, "topn"
+
+    i = lowest(lambda n: isinstance(n, Distinct))
+    if i is not None and path_streams(i):
+        return stream, path[i], "distinct"
+
+    # passthrough: the TOPMOST node that itself streams and whose whole
+    # lower path streams (the maximal streamable prefix)
+    best = None
+    for i in range(len(path) - 1):
+        node = path[i]
+        ok_self = isinstance(node, (Filter, Project)) or (
+            isinstance(node, JoinOp) and path[i + 1] is node.left
+        )
+        if ok_self and path_streams(i):
+            best = i
+            break
+    if best is not None:
+        split = path[best]
+        est = executor._est_rows(split)
+        out_b = est * _row_bytes(output_schema(split))
+        if out_b <= budget:
+            return stream, split, "passthrough"
+        raise NotStreamable("passthrough partials exceed budget")
+    # last resort: stream the scan itself (its pushed filter reduces per
+    # chunk); everything above — window, sort, set ops — runs on $partials.
+    # Partial width counts only the columns the plan reads, matching the
+    # narrowed chunk program ChunkedPreparedPlan builds for this kind
+    est = executor._est_rows(stream)
+    t = executor.catalog[stream.table]
+    cols = needed.get(stream.alias) or {t.schema.fields[0].name}
+    per_row = max(sum(
+        f.dtype.storage_np.itemsize
+        for f in t.schema.fields if f.name in cols
+    ), 1)
+    if est * per_row <= budget:
+        return stream, stream, "scan"
+    raise NotStreamable("no streamable split above the streamed scan")
 
 
 def _replace_node(plan: LogicalOp, target: LogicalOp, replacement: LogicalOp):
@@ -167,28 +231,57 @@ def _replace_node(plan: LogicalOp, target: LogicalOp, replacement: LogicalOp):
     )
 
 
-def _merge_plan(agg: Aggregate, alias: str = "$m") -> tuple[Scan, Aggregate]:
-    """Build Scan($partials) + merge Aggregate reproducing `agg`'s output.
-
-    $partials carries an extra `$live` int8 column: the relation is padded
-    to a stable power-of-two capacity so the merge program's input shapes —
-    and therefore its XLA executable — are reused across runs; pad rows are
-    filtered by the pushed `$live = 1` predicate."""
-    out_s = output_schema(agg)
+def _partials_scan(out_s: Schema, alias: str = "$m") -> Scan:
+    """Scan($partials) with an extra `$live` int8 column: the relation is
+    padded to a stable power-of-two capacity so the merge program's input
+    shapes — and therefore its XLA executable — are reused across runs;
+    pad rows are filtered by the pushed `$live = 1` predicate."""
     fields = [Field(f"{alias}.{f.name}", f.dtype) for f in out_s.fields]
     fields.append(Field(f"{alias}.$live", DataType.int8()))
-    scan = Scan(
+    return Scan(
         "$partials", alias, Schema(tuple(fields)),
         pushed_filter=E.Compare("=", E.ColRef(f"{alias}.$live"), E.lit(1)),
     )
-    group_keys = tuple(
-        (name, E.ColRef(f"{alias}.{name}")) for name, _e in agg.group_keys
+
+
+def _merge_plan(split: LogicalOp, kind: str, alias: str = "$m"):
+    """(chunk_plan, merge_node): the program run per chunk and the node
+    that replaces `split` in the surrounding plan, reading $partials.
+
+    agg:         partial = Aggregate output rows; merge = re-aggregate
+                 (sum/count->sum, min->min, max->max)
+    topn:        partial = top (n+offset) rows per chunk; merge = the
+                 original TopN over the concatenated partials
+    distinct:    partial = per-chunk dedup; merge = final dedup
+    passthrough: partial = the surviving rows themselves; merge = a rename
+                 projection (the rest of the plan runs unchanged)
+    """
+    out_s = output_schema(split)
+    scan = _partials_scan(out_s, alias)
+    if kind == "agg":
+        group_keys = tuple(
+            (name, E.ColRef(f"{alias}.{name}"))
+            for name, _e in split.group_keys
+        )
+        aggs = tuple(
+            (name, _MERGE_FN[fn], E.ColRef(f"{alias}.{name}"), False)
+            for name, fn, _arg, _d in split.aggs
+        )
+        return split, scan, Aggregate(scan, group_keys, aggs)
+    # rename projection: "$m.x" -> "x" so the surrounding plan sees the
+    # split node's original output names
+    rename = Project(
+        scan,
+        tuple((f.name, E.ColRef(f"{alias}.{f.name}")) for f in out_s.fields),
     )
-    aggs = tuple(
-        (name, _MERGE_FN[fn], E.ColRef(f"{alias}.{name}"), False)
-        for name, fn, _arg, _d in agg.aggs
-    )
-    return scan, Aggregate(scan, group_keys, aggs)
+    if kind == "topn":
+        chunk = dc_replace(split, n=split.n + split.offset, offset=0)
+        return chunk, scan, dc_replace(split, child=rename)
+    if kind == "distinct":
+        return split, scan, Distinct(rename)
+    if kind == "passthrough":
+        return split, scan, rename
+    raise AssertionError(kind)
 
 
 class _OverlayCatalog:
@@ -228,6 +321,14 @@ class _ChunkSourceExecutor(Executor):
         self._chunk = (start, end)
         # drop only the streamed table's cached device batch
         self.invalidate_table(self.stream_table)
+
+    def table_batch(self, name, cols):
+        # the streamed table must NOT ride the per-column device cache
+        # (each chunk is a different host slice); every read rebuilds
+        # from the current chunk window
+        if name == self.stream_table and self._chunk is not None:
+            return self._build_batch(name, cols)
+        return super().table_batch(name, cols)
 
     def _build_batch(self, name, cols):
         if name != self.stream_table or self._chunk is None:
@@ -269,24 +370,52 @@ class ChunkedPreparedPlan:
     budget: runs the chunk program per chunk, then the merge plan."""
 
     def __init__(self, executor: Executor, plan: LogicalOp,
-                 stream: Scan, agg: Aggregate,
+                 stream: Scan, split: LogicalOp, kind: str,
                  chunk_rows: int):
         self.executor = executor
         self.plan = plan
         self.stream = stream
-        self.agg = agg
+        self.split = split
+        self.kind = kind
         self.chunk_rows = chunk_rows
         self.retries = 0
 
-        scan, merge_agg = _merge_plan(agg)
-        self.above_plan = _replace_node(plan, agg, merge_agg)
-        self.partial_schema = output_schema(agg)
+        if kind == "scan":
+            # chunk program = the scan narrowed to the raw columns the
+            # plan reads; the rename projection restores the scan's
+            # qualified output names for the surrounding plan
+            t = executor.catalog[stream.table]
+            needed = executor._needed_columns(plan).get(stream.alias) or {
+                t.schema.fields[0].name
+            }
+            chunk_plan = Project(
+                stream,
+                tuple(
+                    (c, E.ColRef(f"{stream.alias}.{c}"))
+                    for c in sorted(needed)
+                ),
+            )
+            out_s = output_schema(chunk_plan)
+            scan2 = _partials_scan(out_s)
+            merge_node = Project(
+                scan2,
+                tuple(
+                    (f"{stream.alias}.{f.name}", E.ColRef(f"$m.{f.name}"))
+                    for f in out_s.fields
+                ),
+            )
+            self.above_plan = _replace_node(plan, split, merge_node)
+            self.partial_schema = out_s
+        else:
+            chunk_plan, _scan, merge_node = _merge_plan(split, kind)
+            self.above_plan = _replace_node(plan, split, merge_node)
+            self.partial_schema = output_schema(split)
 
         self.chunk_exec = _ChunkSourceExecutor(
             executor.catalog, stream.table, chunk_rows,
             unique_keys=executor.unique_keys, stats=executor.stats,
         )
-        self.chunk_prepared = self.chunk_exec.prepare(agg)
+        self.chunk_prepared = self.chunk_exec.prepare(chunk_plan)
 
         # persistent merge executor: $partials is swapped per run at a
         # grow-only power-of-two capacity so the merge XLA executable is

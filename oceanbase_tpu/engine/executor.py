@@ -83,6 +83,23 @@ DIRECT_GROUPBY_MAX_DOMAIN = 1 << 6
 ROOT_COMPACT = -1
 
 
+def gather_payload(cols: dict, valid: dict, idx, sel=None):
+    """Gather a whole batch payload by one index array via the packed
+    row-gather (ops/gather.py). Use where len(idx) is comparable to the
+    table length — the packing pass scans the full table once, so tiny
+    index sets (top-n, root compaction) keep plain element gathers."""
+    from ..ops.gather import gather_rows
+
+    payload = {("c", n): c for n, c in cols.items()}
+    payload.update({("v", n): v for n, v in valid.items()})
+    if sel is not None:
+        payload[("s", "")] = sel
+    out = gather_rows(payload, idx)
+    cols2 = {n: out[("c", n)] for n in cols}
+    valid2 = {n: out[("v", n)] for n in valid}
+    return cols2, valid2, out.get(("s", ""))
+
+
 def compact_batch(b: ColumnBatch, cap2: int):
     """Compact live rows to a smaller capacity, preserving their relative
     order (stable sort by deadness). Returns (batch, overflow count).
@@ -274,9 +291,12 @@ class Executor:
                 for e, _ in op.keys:
                     note(e)
             if isinstance(op, Window):
-                for _name, _fn, a, pk, ok in op.funcs:
+                for _name, fn, a, pk, ok, extra in op.funcs:
                     if a is not None:
                         note(a)
+                    if fn in ("lag", "lead") and extra is not None \
+                            and extra[1] is not None:
+                        note(extra[1])
                     for p in pk:
                         note(p)
                     for oe, _d in ok:
@@ -298,10 +318,58 @@ class Executor:
             # tx-private view: never enters (or reads) the shared device
             # cache, so other sessions can't see uncommitted rows
             return self._build_batch(name, cols)
-        key = (name, cols)
-        if key not in self._batch_cache:
-            self._batch_cache[key] = self._build_batch(name, cols)
-        return self._batch_cache[key]
+        # the device cache is PER COLUMN, not per column-set: queries with
+        # overlapping needs share one H2D upload per column (uploads over
+        # the network-attached chip cost ~seconds/GB and dominated the
+        # bench when q1/q6/q3/q14 each re-shipped lineitem)
+        t = self.catalog[name]
+        sub_schema = Schema(
+            tuple(f for f in t.schema.fields if f.name in cols)
+        )
+        n = t.nrows
+        cap = max(1024, -(-max(n, 1) // 1024) * 1024)
+        dcols: dict[str, jnp.ndarray] = {}
+        dvalid: dict[str, jnp.ndarray] = {}
+        for f in sub_schema.fields:
+            key = (name, f.name)
+            hit = self._batch_cache.get(key)
+            if hit is None:
+                a = np.asarray(t.data[f.name], dtype=f.dtype.storage_np)
+                if cap > n:
+                    a = np.concatenate(
+                        [a, np.zeros(cap - n, dtype=a.dtype)])
+                dev = jnp.asarray(a)
+                vdev = None
+                if f.dtype.nullable:
+                    v = (
+                        np.asarray(t.valid[f.name], dtype=np.bool_)
+                        if f.name in t.valid
+                        else np.ones(n, dtype=np.bool_)
+                    )
+                    if cap > n:
+                        v = np.concatenate(
+                            [v, np.zeros(cap - n, dtype=np.bool_)])
+                    vdev = jnp.asarray(v)
+                hit = (dev, vdev)
+                self._batch_cache[key] = hit
+            dcols[f.name] = hit[0]
+            if hit[1] is not None:
+                dvalid[f.name] = hit[1]
+        skey = (name, "#sel")
+        sel = self._batch_cache.get(skey)
+        if sel is None:
+            s = np.zeros(cap, dtype=np.bool_)
+            s[:n] = True
+            sel = jnp.asarray(s)
+            self._batch_cache[skey] = sel
+        return ColumnBatch(
+            cols=dcols,
+            valid=dvalid,
+            sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=sub_schema,
+            dicts={c: d for c, d in t.dicts.items() if c in cols},
+        )
 
     def _build_batch(self, name: str, cols: tuple[str, ...]) -> ColumnBatch:
         t = self.catalog[name]
@@ -713,15 +781,11 @@ class Executor:
                 keys.append(v)
                 desc.append(d)
             order = sort_indices(keys, desc, child.sel)
-            cols = {n: c[order] for n, c in child.cols.items()}
-            valid = {n: v[order] for n, v in child.valid.items()}
+            cols, valid, ssel = gather_payload(
+                child.cols, child.valid, order, child.sel
+            )
             return (
-                replace(
-                    child,
-                    cols=cols,
-                    valid=valid,
-                    sel=child.sel[order],
-                ),
+                replace(child, cols=cols, valid=valid, sel=ssel),
                 ovf,
             )
 
@@ -799,22 +863,31 @@ class Executor:
 
         if self._merge_joinable(op):
             aff = self._affine_build_info(op) if op.left_keys else None
+            cols = dict(left.cols)
+            valid = dict(left.valid)
             if aff is not None:
-                match = _affine_probe(
-                    rkeys[0], right.sel, lkeys[0], left.sel, aff
+                # direct address + ONE packed gather carrying the verify
+                # key, build liveness, and every payload column together
+                candc, in_range = _affine_candidates(
+                    lkeys[0], aff, right.capacity)
+                rcols, rvalid, rsel = gather_payload(
+                    {**right.cols, "#bk": rkeys[0]},
+                    right.valid, candc, right.sel,
+                )
+                bk_at = rcols.pop("#bk")
+                sel = (
+                    left.sel & in_range & (bk_at == lkeys[0]) & rsel
                 )
             else:
                 match = merge_join_unique(
                     rkeys[0], right.sel, lkeys[0], left.sel
                 )
-            sel = left.sel & (match >= 0)
-            idx = jnp.clip(match, 0, None)
-            cols = dict(left.cols)
-            valid = dict(left.valid)
-            for n, c in right.cols.items():
-                cols[n] = c[idx]
-            for n, v in right.valid.items():
-                valid[n] = v[idx]
+                sel = left.sel & (match >= 0)
+                idx = jnp.clip(match, 0, None)
+                rcols, rvalid, _ = gather_payload(
+                    right.cols, right.valid, idx)
+            cols.update(rcols)
+            valid.update(rvalid)
             out_schema = _join_schema(left.schema, right.schema)
             out = ColumnBatch(
                 cols=cols,
@@ -830,16 +903,10 @@ class Executor:
             pr, br, valid_rows, total, _st, _of = expand_join(
                 skeys, order, right.nrows, lkeys, left.sel, cap
             )
-            cols = {}
-            valid = {}
-            for n, c in left.cols.items():
-                cols[n] = c[pr]
-            for n, v in left.valid.items():
-                valid[n] = v[pr]
-            for n, c in right.cols.items():
-                cols[n] = c[br]
-            for n, v in right.valid.items():
-                valid[n] = v[br]
+            cols, valid, _ = gather_payload(left.cols, left.valid, pr)
+            rcols, rvalid, _ = gather_payload(right.cols, right.valid, br)
+            cols.update(rcols)
+            valid.update(rvalid)
             sel = valid_rows
             # multi-column keys ride a hash: exact-verify the expansion
             if len(op.left_keys) > 1:
@@ -918,10 +985,11 @@ class Executor:
                     rv, _ = evaluate(re_, right)
                     pair_sel = pair_sel & (lv[pr] == rv[br])
             # pair batch: left cols gathered by pr, right cols by br
-            pair_cols = {n: c[pr] for n, c in left.cols.items()}
-            pair_cols.update({n: c[br] for n, c in right.cols.items()})
-            pair_valid = {n: v[pr] for n, v in left.valid.items()}
-            pair_valid.update({n: v[br] for n, v in right.valid.items()})
+            pair_cols, pair_valid, _ = gather_payload(
+                left.cols, left.valid, pr)
+            _rc, _rv, _ = gather_payload(right.cols, right.valid, br)
+            pair_cols.update(_rc)
+            pair_valid.update(_rv)
             pair_batch = ColumnBatch(
                 cols=pair_cols,
                 valid=pair_valid,
@@ -959,10 +1027,11 @@ class Executor:
                 pair_sel = pair_sel & (lv[pr] == rv[br])
         merged_dicts = {**left.dicts, **right.dicts}
         if op.residual is not None:
-            pair_cols = {n: c[pr] for n, c in left.cols.items()}
-            pair_cols.update({n: c[br] for n, c in right.cols.items()})
-            pair_valid = {n: v[pr] for n, v in left.valid.items()}
-            pair_valid.update({n: v[br] for n, v in right.valid.items()})
+            pair_cols, pair_valid, _ = gather_payload(
+                left.cols, left.valid, pr)
+            _rc, _rv, _ = gather_payload(right.cols, right.valid, br)
+            pair_cols.update(_rc)
+            pair_valid.update(_rv)
             pair_batch = ColumnBatch(
                 cols=pair_cols,
                 valid=pair_valid,
@@ -975,15 +1044,18 @@ class Executor:
         nl = left.capacity
         has = probe_run_any(pair_sel, starts, offs)
         # output = [cap matched-pair slots] ++ [nl unmatched-left slots]
+        lc_pr, lv_pr, _ = gather_payload(left.cols, left.valid, pr)
+        rc_br, rv_br, _ = gather_payload(right.cols, right.valid, br)
         cols, valid = {}, {}
         for n, c in left.cols.items():
-            cols[n] = jnp.concatenate([c[pr], c])
+            cols[n] = jnp.concatenate([lc_pr[n], c])
         for n, v in left.valid.items():
-            valid[n] = jnp.concatenate([v[pr], v])
+            valid[n] = jnp.concatenate([lv_pr[n], v])
         for n, c in right.cols.items():
-            cols[n] = jnp.concatenate([c[br], jnp.zeros_like(c, shape=(nl,))])
-            rv = right.valid.get(n)
-            matched_valid = rv[br] if rv is not None else jnp.ones(cap, jnp.bool_)
+            cols[n] = jnp.concatenate(
+                [rc_br[n], jnp.zeros_like(c, shape=(nl,))])
+            matched_valid = (
+                rv_br[n] if n in rv_br else jnp.ones(cap, jnp.bool_))
             valid[n] = jnp.concatenate([matched_valid, jnp.zeros(nl, jnp.bool_)])
         sel = jnp.concatenate([pair_sel, left.sel & ~has])
         rs_nullable = Schema(
@@ -1037,14 +1109,16 @@ class Executor:
                 keys.append(c)
         return keys
 
-    def _emit_setop(self, op: SetOp, nid, inputs, emit, params):
+    def _setop_promote(self, op: SetOp, left: ColumnBatch, right: ColumnBatch):
+        """Positionally align both sides onto the common promoted schema:
+        merged dictionaries, numeric casts, materialized validity. Returns
+        (lb, rb, out_schema, dicts) — promoted same-schema batches. Split
+        from the combine step so the PX layer can hash-exchange promoted
+        rows (raw dict codes from different dictionaries would NOT
+        co-partition equal strings)."""
         from ..core.dictionary import Dictionary
 
-        left, lovf = emit(op.left, inputs)
-        right, rovf = emit(op.right, inputs)
-        ovf = {**lovf, **rovf}
         out_schema = setop_schema(left.schema, right.schema)
-
         lcols, rcols, lvalid, rvalid, dicts = {}, {}, {}, {}, {}
         for i, f in enumerate(out_schema.fields):
             ln = left.schema.fields[i].name
@@ -1075,6 +1149,28 @@ class Executor:
                 rvalid[f.name] = (
                     rv if rv is not None else jnp.ones(right.capacity, jnp.bool_)
                 )
+        lb = ColumnBatch(
+            cols=lcols, valid=lvalid, sel=left.sel, nrows=left.nrows,
+            schema=out_schema, dicts=dicts,
+        )
+        rb = ColumnBatch(
+            cols=rcols, valid=rvalid, sel=right.sel, nrows=right.nrows,
+            schema=out_schema, dicts=dicts,
+        )
+        return lb, rb, out_schema, dicts
+
+    def _emit_setop(self, op: SetOp, nid, inputs, emit, params):
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ovf = {**lovf, **rovf}
+        lb, rb, out_schema, dicts = self._setop_promote(op, left, right)
+        return self._setop_combine(op, lb, rb, out_schema, dicts, ovf)
+
+    def _setop_combine(self, op: SetOp, left: ColumnBatch, right: ColumnBatch,
+                       out_schema, dicts, ovf):
+        """Combine two PROMOTED same-schema sides per the set-op kind."""
+        lcols, rcols = left.cols, right.cols
+        lvalid, rvalid = left.valid, right.valid
 
         if op.kind == "union":
             cols = {n: jnp.concatenate([lcols[n], rcols[n]]) for n in lcols}
@@ -1207,8 +1303,8 @@ class Executor:
             boundaries,
             peer_ends,
             segment_starts,
-            segmented_cumsum,
             segmented_scan_minmax,
+            suffix_scan_minmax,
         )
 
         child, ovf = emit(op.child, inputs)
@@ -1219,8 +1315,8 @@ class Executor:
         fields = list(child.schema.fields)
 
         by_spec: dict[tuple, list] = {}
-        for name, fn, arg, pk, ok in op.funcs:
-            by_spec.setdefault((pk, ok), []).append((name, fn, arg))
+        for name, fn, arg, pk, ok, extra in op.funcs:
+            by_spec.setdefault((pk, ok), []).append((name, fn, arg, extra))
 
         idx = jnp.arange(n, dtype=jnp.int64)
         for (pk, ok), funcs in by_spec.items():
@@ -1240,7 +1336,15 @@ class Executor:
                 new_seg = boundaries(spk)
             else:
                 new_seg = jnp.zeros(n, jnp.bool_).at[0].set(True)
+            # dead rows (capacity padding / filter-masked) sort to the
+            # tail; the live->dead transition must start its OWN segment
+            # or seg_end-based frames (ntile, lead defaults, UNBOUNDED
+            # FOLLOWING) would count dead slots into the last partition
+            new_seg = new_seg | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), ssel[1:] != ssel[:-1]]
+            )
             seg_start = segment_starts(new_seg)
+            seg_end = peer_ends(new_seg)
             if ok:
                 new_peer = new_seg | boundaries(sok)
                 peer_start = segment_starts(new_peer)
@@ -1249,12 +1353,96 @@ class Executor:
                 # no ORDER BY: the frame is the whole partition — same code
                 # as the running case with the peer group = the segment
                 new_peer = peer_start = None
-                pend_idx = peer_ends(new_seg)
+                pend_idx = seg_end
             # inverse permutation for the writeback: a sort, not a scatter
             # (a TPU scatter costs ~1.1s per 8M rows; argsort ~20ms)
             inv = jnp.argsort(order)
 
-            for name, fn, arg in funcs:
+            def frame_lo_hi(extra):
+                """Per-row inclusive frame bounds [lo, hi] in sorted space.
+                None = the SQL default frame (partition start .. last peer
+                with ORDER BY, whole partition without)."""
+                if extra is None:
+                    return seg_start, pend_idx
+                unit, lo_b, hi_b = extra
+                if unit == "rows":
+                    lo = seg_start if lo_b is None else jnp.maximum(
+                        seg_start, idx + lo_b)
+                    hi = seg_end if hi_b is None else jnp.minimum(
+                        seg_end, idx + hi_b)
+                    return lo, hi
+                # RANGE: value-based bounds on the single ASC-normalized
+                # order key; CURRENT ROW maps to the peer group edges
+                lo = hi = None
+                if lo_b is None:
+                    lo = seg_start
+                elif lo_b == 0:
+                    lo = peer_start
+                if hi_b is None:
+                    hi = seg_end
+                elif hi_b == 0:
+                    hi = pend_idx
+                if lo is not None and hi is not None:
+                    return lo, hi
+                # numeric offset: binary search over a packed composite
+                # (partition rank, key) that is globally nondecreasing —
+                # the TPU replacement for the reference's per-row frame
+                # cursor walk (ob_window_function_vec_op.cpp frames)
+                kk = sok[0].astype(jnp.int64)
+                kt = infer_type(ok[0][0], child.schema)
+                if kt.is_decimal:
+                    # RANGE offsets are in VALUE units; the key column
+                    # stores scaled integers
+                    lo_b = None if lo_b is None else lo_b * kt.decimal_factor
+                    hi_b = None if hi_b is None else hi_b * kt.decimal_factor
+                if odesc[0]:
+                    kk = -kk
+                live_k = jnp.where(ssel, kk, 0)
+                kmin = jnp.min(jnp.where(ssel, kk, jnp.iinfo(jnp.int64).max))
+                kmax = jnp.max(jnp.where(ssel, kk, jnp.iinfo(jnp.int64).min))
+                span = jnp.maximum(kmax - kmin + 1, 1)
+                seg_rank = jnp.cumsum(new_seg.astype(jnp.int64)) - 1
+                packed = jnp.where(
+                    ssel,
+                    seg_rank * span + (live_k - kmin),
+                    jnp.iinfo(jnp.int64).max,
+                )
+
+                def bound_at(off, side):
+                    # out-of-domain targets must yield EMPTY frames, not
+                    # clamp onto the edge rows: a frame-start above the
+                    # segment's keys resolves past its end (rel=span ->
+                    # next segment's base -> lo > hi), a frame-end below
+                    # resolves before its start (rel=-1 -> hi < lo)
+                    if side == "lo":
+                        rel = jnp.clip(live_k + off - kmin, 0, span)
+                        target = seg_rank * span + rel
+                        return jnp.searchsorted(
+                            packed, target, side="left", method="sort"
+                        ).astype(jnp.int64)
+                    rel = jnp.clip(live_k + off - kmin, -1, span - 1)
+                    target = seg_rank * span + rel
+                    return jnp.searchsorted(
+                        packed, target, side="right", method="sort"
+                    ).astype(jnp.int64) - 1
+
+                if lo is None:
+                    lo = bound_at(lo_b, "lo")
+                if hi is None:
+                    hi = bound_at(hi_b, "hi")
+                return lo, hi
+
+            def csum_range(masked_vals, lo, hi):
+                """Sum over [lo, hi] via one global inclusive cumsum
+                (frames never cross segment bounds by construction)."""
+                c = jnp.cumsum(masked_vals)
+                hi_v = c[jnp.clip(hi, 0, n - 1)]
+                lo_v = jnp.where(lo > 0, c[jnp.clip(lo - 1, 0, n - 1)], 0)
+                return jnp.where(hi >= lo, hi_v - lo_v, 0)
+
+            pending_cols: dict[str, jnp.ndarray] = {}
+            pending_valid: dict[str, jnp.ndarray] = {}
+            for name, fn, arg, extra in funcs:
                 res_valid_sorted = None
                 if fn == "row_number":
                     res_sorted = idx - seg_start + 1
@@ -1263,9 +1451,56 @@ class Executor:
                 elif fn == "dense_rank":
                     dcum = jnp.cumsum(new_peer.astype(jnp.int64))
                     res_sorted = dcum - dcum[seg_start] + 1
+                elif fn == "ntile":
+                    k = jnp.int64(extra)
+                    cnt = seg_end - seg_start + 1
+                    j = idx - seg_start
+                    q = cnt // k
+                    r = cnt % k
+                    cut = r * (q + 1)
+                    res_sorted = jnp.where(
+                        j < cut,
+                        j // (q + 1),
+                        r + (j - cut) // jnp.maximum(q, 1),
+                    ) + 1
+                elif fn in ("lag", "lead"):
+                    off, dflt = extra
+                    av, avv = evaluate(arg, child)
+                    av_s = av[order]
+                    srcvalid = ssel if avv is None else (ssel & avv[order])
+                    src = idx - off if fn == "lag" else idx + off
+                    inside = (
+                        src >= seg_start if fn == "lag" else src <= seg_end
+                    )
+                    srcc = jnp.clip(src, 0, n - 1)
+                    val = av_s[srcc]
+                    vvalid = srcvalid[srcc]
+                    if dflt is None:
+                        res_sorted = jnp.where(inside, val, 0)
+                        res_valid_sorted = inside & vvalid
+                    else:
+                        dv, dvv = evaluate(dflt, child)
+                        dv_s = jnp.broadcast_to(dv, (n,))[order]
+                        dvalid = (
+                            jnp.ones(n, jnp.bool_)
+                            if dvv is None else dvv[order]
+                        )
+                        res_sorted = jnp.where(
+                            inside, val, dv_s.astype(val.dtype))
+                        res_valid_sorted = jnp.where(
+                            inside, vvalid, dvalid)
+                elif fn in ("first_value", "last_value"):
+                    av, avv = evaluate(arg, child)
+                    av_s = av[order]
+                    srcvalid = ssel if avv is None else (ssel & avv[order])
+                    lo, hi = frame_lo_hi(extra)
+                    at = lo if fn == "first_value" else hi
+                    atc = jnp.clip(at, 0, n - 1)
+                    res_sorted = av_s[atc]
+                    res_valid_sorted = (hi >= lo) & srcvalid[atc]
                 else:
-                    # aggregate over the frame (whole partition without
-                    # ORDER BY; running-with-peers with it)
+                    # frame aggregate: count / sum via prefix-sum range
+                    # reads; min/max via one-end-bounded segmented scans
                     if arg is None:
                         av_s, avv_s = None, None
                     else:
@@ -1273,8 +1508,8 @@ class Executor:
                         av_s = av[order]
                         avv_s = avv[order] if avv is not None else None
                     vmask = ssel if avv_s is None else (ssel & avv_s)
-                    cnt_v = vmask.astype(jnp.int64)
-                    frame_cnt = segmented_cumsum(cnt_v, seg_start)[pend_idx]
+                    lo, hi = frame_lo_hi(extra)
+                    frame_cnt = csum_range(vmask.astype(jnp.int64), lo, hi)
                     if fn == "count":
                         res_sorted = frame_cnt
                     elif fn == "sum":
@@ -1284,32 +1519,45 @@ class Executor:
                             else av_s.dtype
                         )
                         mv = jnp.where(vmask, av_s.astype(acc), 0)
-                        res_sorted = segmented_cumsum(mv, seg_start)[pend_idx]
+                        res_sorted = csum_range(mv, lo, hi)
                         res_valid_sorted = frame_cnt > 0
                     elif fn in ("min", "max"):
                         is_min = fn == "min"
                         ident = agg_identity(av_s.dtype, is_min)
                         mv = jnp.where(vmask, av_s, ident)
-                        res_sorted = segmented_scan_minmax(
-                            mv, new_seg, is_min
-                        )[pend_idx]
+                        lo_unbounded = extra is None or extra[1] is None
+                        if lo_unbounded:
+                            res_sorted = segmented_scan_minmax(
+                                mv, new_seg, is_min
+                            )[jnp.clip(hi, 0, n - 1)]
+                        else:
+                            # hi unbounded (resolver guarantees one end)
+                            res_sorted = suffix_scan_minmax(
+                                mv, new_seg, is_min
+                            )[jnp.clip(lo, 0, n - 1)]
                         res_valid_sorted = frame_cnt > 0
                     else:
                         raise NotImplementedError(f"window function {fn}")
 
                 dt = window_out_type(fn, arg, child.schema)
-                res = res_sorted[inv].astype(dt.storage_np)
-                out_cols[name] = res
+                pending_cols[name] = res_sorted.astype(dt.storage_np)
                 if res_valid_sorted is not None:
-                    out_valid[name] = res_valid_sorted[inv]
+                    pending_valid[name] = res_valid_sorted
                     dt = dt.with_nullable(True)
                 fields.append(Field(name, dt))
                 if (
-                    fn in ("min", "max")
+                    fn in ("min", "max", "lag", "lead",
+                           "first_value", "last_value")
                     and isinstance(arg, E.ColRef)
                     and arg.name in child.dicts
                 ):
                     out_dicts[name] = child.dicts[arg.name]
+
+            # ONE packed writeback gather per window spec group (the
+            # per-func res[inv] element gathers were the hot cost)
+            wc, wv, _ = gather_payload(pending_cols, pending_valid, inv)
+            out_cols.update(wc)
+            out_valid.update(wv)
 
         out = ColumnBatch(
             cols=out_cols, valid=out_valid, sel=child.sel, nrows=child.nrows,
@@ -1340,10 +1588,11 @@ class Executor:
                 pair_sel = pair_sel & (lv[pr] == rv[br])
         merged_dicts = {**left.dicts, **right.dicts}
         if op.residual is not None:
-            pair_cols = {n: c[pr] for n, c in left.cols.items()}
-            pair_cols.update({n: c[br] for n, c in right.cols.items()})
-            pair_valid = {n: v[pr] for n, v in left.valid.items()}
-            pair_valid.update({n: v[br] for n, v in right.valid.items()})
+            pair_cols, pair_valid, _ = gather_payload(
+                left.cols, left.valid, pr)
+            _rc, _rv, _ = gather_payload(right.cols, right.valid, br)
+            pair_cols.update(_rc)
+            pair_valid.update(_rv)
             pair_batch = ColumnBatch(
                 cols=pair_cols, valid=pair_valid, sel=pair_sel,
                 nrows=jnp.sum(pair_sel, dtype=jnp.int64),
@@ -1356,21 +1605,23 @@ class Executor:
         has_r = (
             jnp.zeros(nr, dtype=jnp.bool_).at[br].max(pair_sel, mode="drop")
         )
+        lc_pr, lv_pr, _ = gather_payload(left.cols, left.valid, pr)
+        rc_br, rv_br, _ = gather_payload(right.cols, right.valid, br)
         cols, valid = {}, {}
         for n, c in left.cols.items():
             cols[n] = jnp.concatenate(
-                [c[pr], c, jnp.zeros_like(c, shape=(nr,))]
+                [lc_pr[n], c, jnp.zeros_like(c, shape=(nr,))]
             )
             lv = left.valid.get(n)
-            mv = lv[pr] if lv is not None else jnp.ones(cap, jnp.bool_)
+            mv = lv_pr[n] if n in lv_pr else jnp.ones(cap, jnp.bool_)
             tv = lv if lv is not None else jnp.ones(nl, jnp.bool_)
             valid[n] = jnp.concatenate([mv, tv, jnp.zeros(nr, jnp.bool_)])
         for n, c in right.cols.items():
             cols[n] = jnp.concatenate(
-                [c[br], jnp.zeros_like(c, shape=(nl,)), c]
+                [rc_br[n], jnp.zeros_like(c, shape=(nl,)), c]
             )
             rv = right.valid.get(n)
-            mv = rv[br] if rv is not None else jnp.ones(cap, jnp.bool_)
+            mv = rv_br[n] if n in rv_br else jnp.ones(cap, jnp.bool_)
             tv = rv if rv is not None else jnp.ones(nr, jnp.bool_)
             valid[n] = jnp.concatenate([mv, jnp.zeros(nl, jnp.bool_), tv])
         sel = jnp.concatenate(
@@ -1401,17 +1652,23 @@ class Executor:
         # semantics; count(*) has arg None and counts all live rows)
         agg_ops, agg_vals, agg_masks = [], [], []
         for name, fn, arg, distinct in op.aggs:
-            if distinct:
-                raise NotImplementedError("DISTINCT aggregates")
             if arg is None:
                 agg_ops.append("count")
                 agg_vals.append(None)
                 agg_masks.append(child.sel)
             else:
                 v, vv = evaluate(arg, child)
+                am = child.sel if vv is None else child.sel & vv
+                if distinct and fn in ("count", "sum", "avg"):
+                    # DISTINCT: restrict the agg's mask to the first live
+                    # occurrence of each (group keys, value); min/max are
+                    # distinct-invariant and skip the extra sort
+                    from ..ops.hashagg import distinct_first_mask
+
+                    am = am & distinct_first_mask(key_vals, v, am)
                 agg_ops.append(fn)
                 agg_vals.append(None if fn == "count" else v)
-                agg_masks.append(child.sel if vv is None else child.sel & vv)
+                agg_masks.append(am)
 
         out_schema = _agg_schema(op, child.schema)
 
@@ -1499,9 +1756,10 @@ class Executor:
 
             if plan_input_bytes(self, plan) > self.device_budget:
                 try:
-                    stream, agg = _find_stream_split(self, plan, self.device_budget)
+                    stream, split, kind = _find_stream_split(
+                        self, plan, self.device_budget)
                     return ChunkedPreparedPlan(
-                        self, plan, stream, agg, self.chunk_rows
+                        self, plan, stream, split, kind, self.chunk_rows
                     )
                 except NotStreamable:
                     pass  # whole-table upload; may exhaust device memory
@@ -1565,19 +1823,30 @@ class PreparedPlan:
         raise AssertionError
 
 
-def _affine_probe(build_key, build_sel, probe_key, probe_sel, aff):
-    """Direct-address unique join against an affine build key column:
-    match_row = (key - a0) / stride, one verify gather — no sorts."""
+def _affine_candidates(probe_key, aff, nb):
+    """Direct-address candidate build rows against an affine build key
+    column: cand = (key - a0) / stride — no sorts, no gathers. Callers
+    verify via gathered build key + liveness (folded into the packed
+    payload gather so the verify costs no extra gather pass)."""
     a0, stride = aff
-    nb = build_key.shape[0]
     off = probe_key.astype(jnp.int64) - a0
     cand = off // stride
     in_range = (off >= 0) & (off % stride == 0) & (cand < nb)
     candc = jnp.clip(cand, 0, nb - 1).astype(jnp.int32)
+    return candc, in_range
+
+
+def _affine_probe(build_key, build_sel, probe_key, probe_sel, aff):
+    """Verified affine probe for callers that need ONLY the match row
+    (semi/anti). The verify gather rides one packed row-gather."""
+    candc, in_range = _affine_candidates(probe_key, aff, build_key.shape[0])
+    got = gather_payload(
+        {"#k": build_key}, {}, candc, build_sel
+    )
     hit = (
         probe_sel & in_range
-        & (build_key[candc] == probe_key)
-        & build_sel[candc]
+        & (got[0]["#k"] == probe_key)
+        & got[2]
     )
     return jnp.where(hit, candc, -1)
 

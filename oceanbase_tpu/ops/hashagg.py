@@ -237,6 +237,36 @@ def groupby_direct(
     return slot_used, aggs
 
 
+def distinct_first_mask(
+    key_vals: list[jnp.ndarray], val: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """First-occurrence mask for DISTINCT aggregates: True for exactly one
+    live row per (group keys, value) combination, in ORIGINAL row order.
+
+    The reference routes distinct aggregates through a dedicated hash-set
+    pass (sql/engine/aggregate distinct-agg infra); the TPU redesign is the
+    usual scatter-free recipe: one combined sort with the row index as the
+    trailing operand, run-boundary detection, and an argsort-based inverse
+    permutation to map the per-run winner bit back."""
+    n = mask.shape[0]
+    dead = (~mask).astype(jnp.int32)
+    ops = (
+        (dead,)
+        + tuple(key_vals)
+        + (val, jnp.arange(n, dtype=jnp.int32))
+    )
+    sorted_ = jax.lax.sort(ops, num_keys=len(ops) - 1)
+    sdead = sorted_[0]
+    sidx = sorted_[-1]
+    new_run = jnp.zeros(n, jnp.bool_)
+    for sv in sorted_[:-1]:
+        new_run = new_run | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), sv[1:] != sv[:-1]]
+        )
+    first = new_run & (sdead == 0)
+    return first[jnp.argsort(sidx)]
+
+
 def sort_groupby(
     key_cols: list[jnp.ndarray],
     mask: jnp.ndarray,

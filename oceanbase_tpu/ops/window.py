@@ -80,6 +80,20 @@ def segmented_scan_minmax(
     return out
 
 
+def suffix_scan_minmax(
+    values: jnp.ndarray, new_seg: jnp.ndarray, is_min: bool
+) -> jnp.ndarray:
+    """Inclusive segmented running min/max from the SEGMENT END backwards:
+    out[i] = min/max over [i, seg_end]. Implemented by reversing, running
+    the forward scan with reversed segment-start flags (= forward segment
+    ENDS), and reversing back."""
+    n = new_seg.shape[0]
+    # forward seg-last flag: next row starts a new segment (or is row n-1)
+    seg_last = jnp.concatenate([new_seg[1:], jnp.ones(1, jnp.bool_)])
+    out_rev = segmented_scan_minmax(values[::-1], seg_last[::-1], is_min)
+    return out_rev[::-1]
+
+
 def agg_identity(dtype, is_min: bool):
     if jnp.issubdtype(dtype, jnp.integer):
         info = jnp.iinfo(dtype)

@@ -56,6 +56,7 @@ from ..engine.executor import (
     _dict_domain,
     _number_nodes,
 )
+from ..expr import ir as E
 from ..expr.compile import evaluate
 from ..ops.hashing import hash32_combine, next_pow2
 from ..sql.logical import (
@@ -133,15 +134,25 @@ class PxExecutor(Executor):
                  broadcast_threshold: int = 1 << 16,
                  join_bloom: bool = True,
                  bloom_max_bits: int = 1 << 20,
-                 hybrid_hash: bool = False):
+                 hybrid_hash: "bool | str" = "auto", stats=None):
+        if stats is None:
+            # histogram-backed cardinalities drive the exchange-method
+            # choice (broadcast-vs-hash cost, skew-triggered hybrid hash)
+            from ..share.stats import StatsManager
+
+            stats = StatsManager(catalog)
         super().__init__(catalog, unique_keys=unique_keys,
-                         default_rows_estimate=default_rows_estimate)
+                         default_rows_estimate=default_rows_estimate,
+                         stats=stats)
         self.mesh = mesh
         self.nsh = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         self.broadcast_threshold = broadcast_threshold
         self.join_bloom = join_bloom
         self.bloom_max_bits = bloom_max_bits
-        # skew-adaptive hybrid-hash joins (HYBRID_HASH_BROADCAST/RANDOM)
+        # skew-adaptive hybrid-hash joins (HYBRID_HASH_BROADCAST/RANDOM):
+        # "auto" consults the optimizer histograms (the planner-side analog
+        # of the reference's runtime sampling datahub decision,
+        # ob_sql_define.h:393); True forces it, False disables
         self.hybrid_hash = hybrid_hash
         self._dist: dict[int, str] = {}
 
@@ -193,12 +204,26 @@ class PxExecutor(Executor):
                     est(op.left))
                 params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)] = lane_cap(
                     est(op.right))
-            if isinstance(op, Aggregate) and op.group_keys:
+            if isinstance(op, Aggregate) and (
+                op.group_keys
+                # scalar DISTINCT aggs exchange by the distinct argument
+                or any(a[3] for a in op.aggs)
+            ):
                 params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
                     est(op.child))
             if isinstance(op, Sort) and self._sortable_by_range(op):
                 params.exchange_cap[_exch_id(nid, _SORT_CHILD)] = lane_cap(
                     est(op.child))
+            if isinstance(op, Distinct):
+                params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
+                    est(op.child))
+            if isinstance(op, SetOp) and not (op.kind == "union" and op.all):
+                # UNION ALL never exchanges; every other set op
+                # co-partitions both sides by whole-row hash
+                params.exchange_cap[_exch_id(nid, _JOIN_LEFT)] = lane_cap(
+                    est(op.left))
+                params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)] = lane_cap(
+                    est(op.right))
             if isinstance(op, Window) and self._window_common_pk(op):
                 params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
                     est(op.child))
@@ -224,7 +249,7 @@ class PxExecutor(Executor):
         With a common non-empty PARTITION BY, hash repartitioning on it is
         semantics-preserving (each partition lands whole on one shard) —
         the reference's range-dist parallel window (datahub winbuf) analog."""
-        pks = {pk for _n, _f, _a, pk, _ok in op.funcs}
+        pks = {pk for _n, _f, _a, pk, _ok, _x in op.funcs}
         if len(pks) == 1:
             pk = next(iter(pks))
             if pk:
@@ -375,12 +400,51 @@ class PxExecutor(Executor):
         if isinstance(op, Window):
             return self._emit_window_px(op, nid, inputs, emit, params, id_of)
 
-        if isinstance(op, (Limit, Distinct)):
-            # offset/dedup need the global row set: gather first (distinct
-            # could also hash-repartition; gathered inputs at these plan
-            # positions are small)
+        if isinstance(op, Limit):
+            # per-shard prelimit + compacted gather: moves O(n + offset)
+            # rows per shard, never the relation
             child, covf = emit(op.child, inputs)
             if self._dist[id(op.child)] == SHARDED:
+                from ..engine.executor import compact_batch
+
+                k = op.n + op.offset
+                pos = jnp.cumsum(child.sel.astype(jnp.int64)) - 1
+                local = child.with_sel(child.sel & (pos < k))
+                cap2 = min(child.capacity, max(8, -(-k // 8) * 8))
+                local, _oc = compact_batch(local, cap2)  # k <= cap2: no ovf
+                child = self._gather_batch(local)
+                covf = dict(covf)
+            out, ovf = super()._emit_node(
+                op, inputs, _override(emit, op.child, (child, covf)),
+                params, id_of)
+            self._dist[id(op)] = REPLICATED
+            return out, ovf
+
+        if isinstance(op, Distinct):
+            # hash-repartition on the whole row, then each shard owns its
+            # value space: local dedup is globally exact and no shard ever
+            # holds the relation (the reference's HASH distinct,
+            # ObPQDistributeMethod::HASH)
+            child, covf = emit(op.child, inputs)
+            cd = self._dist[id(op.child)]
+            exch = _exch_id(nid, _AGG_CHILD)
+            if (
+                cd == SHARDED
+                and exch in params.exchange_cap
+                and self._est_rows(op.child) > self.broadcast_threshold
+            ):
+                keys = self._row_hash_keys(child)
+                child2, xovf = self._exchange_dest(
+                    child, dest_by_hash(keys, self.nsh),
+                    params.exchange_cap[exch])
+                out, ovf = super()._emit_node(
+                    op, inputs, _override(emit, op.child, (child2, covf)),
+                    params, id_of)
+                ovf = dict(ovf)
+                ovf[exch] = xovf
+                self._dist[id(op)] = SHARDED
+                return out, ovf
+            if cd == SHARDED:
                 child = self._gather_batch(child)
             out, ovf = super()._emit_node(
                 op, inputs, _override(emit, op.child, (child, covf)),
@@ -389,23 +453,89 @@ class PxExecutor(Executor):
             return out, ovf
 
         if isinstance(op, SetOp):
-            left, lovf = emit(op.left, inputs)
-            right, rovf = emit(op.right, inputs)
-            if self._dist[id(op.left)] == SHARDED:
-                left = self._gather_batch(left)
-            if self._dist[id(op.right)] == SHARDED:
-                right = self._gather_batch(right)
-            emit2 = _override(
-                _override(emit, op.left, (left, lovf)),
-                op.right, (right, rovf))
-            out, ovf = super()._emit_node(op, inputs, emit2, params, id_of)
-            self._dist[id(op)] = REPLICATED
-            return out, ovf
+            return self._emit_setop_px(op, nid, inputs, emit, params, id_of)
 
         # Filter / Project: local, distribution-preserving
         out, ovf = super()._emit_node(op, inputs, emit, params, id_of)
         child = getattr(op, "child", None)
         self._dist[id(op)] = self._dist[id(child)] if child is not None else SHARDED
+        return out, ovf
+
+    # ---- set operations --------------------------------------------------
+    def _row_hash_keys(self, b: ColumnBatch):
+        """Whole-row hash key columns with set-op NULL normalization
+        (validity bits join as int32 so hash32_combine sees integers)."""
+        keys = self._setop_key_cols(b.cols, b.valid, b.schema)
+        return [
+            k.astype(jnp.int32) if k.dtype == jnp.bool_ else k for k in keys
+        ]
+
+    def _copartition_side(self, b: ColumnBatch, dist: str, cap: int):
+        """Bring one promoted set-op side onto the whole-row hash
+        partitioning. SHARDED: all_to_all exchange. REPLICATED: free —
+        every shard already holds all rows, so each just keeps the ones
+        hashing to itself (a mask, no collective)."""
+        dest = dest_by_hash(self._row_hash_keys(b), self.nsh)
+        if dist == REPLICATED:
+            me = lax.axis_index(SHARD_AXIS).astype(dest.dtype)
+            return b.with_sel(b.sel & (dest == me)), None
+        return self._exchange_dest(b, dest, cap)
+
+    def _emit_setop_px(self, op: SetOp, nid, inputs, emit, params, id_of):
+        left, lovf = emit(op.left, inputs)
+        right, rovf = emit(op.right, inputs)
+        ld, rd = self._dist[id(op.left)], self._dist[id(op.right)]
+        ovf = {**lovf, **rovf}
+        lb, rb, out_schema, dicts = self._setop_promote(op, left, right)
+
+        if op.kind == "union" and op.all:
+            # pure concatenation: SHARDED++SHARDED stays sharded with no
+            # exchange; a REPLICATED side spreads by row index so its rows
+            # exist exactly once globally
+            if ld == rd == REPLICATED:
+                out, ovf = self._setop_combine(
+                    op, lb, rb, out_schema, dicts, ovf)
+                self._dist[id(op)] = REPLICATED
+                return out, ovf
+            me = lax.axis_index(SHARD_AXIS)
+            if ld == REPLICATED:
+                ridx = jnp.arange(lb.capacity) % self.nsh
+                lb = lb.with_sel(lb.sel & (ridx == me))
+            if rd == REPLICATED:
+                ridx = jnp.arange(rb.capacity) % self.nsh
+                rb = rb.with_sel(rb.sel & (ridx == me))
+            out, ovf = self._setop_combine(op, lb, rb, out_schema, dicts, ovf)
+            self._dist[id(op)] = SHARDED
+            return out, ovf
+
+        cap_l = params.exchange_cap.get(_exch_id(nid, _JOIN_LEFT))
+        cap_r = params.exchange_cap.get(_exch_id(nid, _JOIN_RIGHT))
+        big = (
+            self._est_rows(op.left) + self._est_rows(op.right)
+            > self.broadcast_threshold
+        )
+        if big and cap_l is not None and cap_r is not None \
+                and (ld == SHARDED or rd == SHARDED):
+            # co-partition both sides by whole-row hash: every equal row
+            # lands on one shard, so the local dedup/bag kernels are
+            # globally exact and the output stays SHARDED
+            lb2, xl = self._copartition_side(lb, ld, cap_l)
+            rb2, xr = self._copartition_side(rb, rd, cap_r)
+            out, ovf = self._setop_combine(op, lb2, rb2, out_schema, dicts, ovf)
+            ovf = dict(ovf)
+            if xl is not None:
+                ovf[_exch_id(nid, _JOIN_LEFT)] = xl
+            if xr is not None:
+                ovf[_exch_id(nid, _JOIN_RIGHT)] = xr
+            self._dist[id(op)] = SHARDED
+            return out, ovf
+
+        if ld == SHARDED:
+            lb = self._gather_batch(lb)
+        if rd == SHARDED:
+            rb = self._gather_batch(rb)
+        out, ovf = self._setop_combine(op, lb, rb, out_schema, dicts, ovf)
+        self._dist[id(op)] = REPLICATED
         return out, ovf
 
     # ---- sort / window --------------------------------------------------
@@ -487,6 +617,47 @@ class PxExecutor(Executor):
         return out, ovf
 
     # ---- joins ----------------------------------------------------------
+    def _skewed_key(self, side_op, keys) -> bool:
+        """Histogram skew signal for auto hybrid-hash: a value repeated
+        across r consecutive equi-height bucket edges carries >= (r-1)/N
+        of the rows; when one value would overload a shard's fair lane by
+        2x, plain hash distribution will hot-spot that shard."""
+        from ..share.stats import N_BUCKETS
+        from ..sql.logical import Filter, Project, Scan
+
+        if len(keys) != 1 or self.stats is None:
+            return False
+        e = keys[0]
+        name = e.name if isinstance(e, E.ColRef) else None
+        if name is None:
+            return False
+        node = side_op
+        while isinstance(node, (Filter, Project)):
+            if isinstance(node, Project):
+                nxt = dict(node.exprs).get(name)
+                if not isinstance(nxt, E.ColRef):
+                    return False
+                name = nxt.name
+            node = node.child
+        if not isinstance(node, Scan) or "." not in name:
+            return False
+        alias, col = name.split(".", 1)
+        if alias != node.alias:
+            return False
+        ts = self.stats.table_stats(node.table)
+        cs = ts.cols.get(col) if ts is not None else None
+        if cs is None or cs.edges is None:
+            return False
+        edges = np.asarray(cs.edges)
+        # longest run of identical consecutive edges
+        eq = edges[1:] == edges[:-1]
+        best = run = 0
+        for x in eq:
+            run = run + 1 if x else 0
+            best = max(best, run)
+        hot_frac = best / N_BUCKETS
+        return hot_frac >= 2.0 / self.nsh
+
     def _emit_join_px(self, op, nid, inputs, emit, params, id_of):
         left, lovf = emit(op.left, inputs)
         right, rovf = emit(op.right, inputs)
@@ -504,7 +675,12 @@ class PxExecutor(Executor):
             method = "broadcast"  # cross join: replicate the build side
         elif ld == REPLICATED:
             method = "broadcast"  # make both sides replicated
-        elif self._est_rows(op.right) <= self.broadcast_threshold:
+        elif self._est_rows(op.right) <= self.broadcast_threshold or (
+            # cost model: broadcast ships est_r to every shard; hash moves
+            # each row of both sides once (ObLogPlan's exchange costing)
+            self._est_rows(op.right) * (self.nsh - 1)
+            <= self._est_rows(op.left)
+        ):
             method = "broadcast"
         else:
             method = "hash"
@@ -519,7 +695,17 @@ class PxExecutor(Executor):
                     self._est_rows(op.right))
             cap_l = params.exchange_cap[_exch_id(nid, _JOIN_LEFT)]
             cap_r = params.exchange_cap[_exch_id(nid, _JOIN_RIGHT)]
-            if self.hybrid_hash and op.kind == "inner":
+            use_hybrid = op.kind == "inner" and (
+                self.hybrid_hash is True
+                or (
+                    self.hybrid_hash == "auto"
+                    and (
+                        self._skewed_key(op.left, op.left_keys)
+                        or self._skewed_key(op.right, op.right_keys)
+                    )
+                )
+            )
+            if use_hybrid:
                 left, right, xl, xr = self._hybrid_exchange(
                     left, op.left_keys, right, op.right_keys, cap_l, cap_r)
             else:
@@ -566,6 +752,32 @@ class PxExecutor(Executor):
             and all(d is not None for d in domains)
             and int(np.prod([d for d in domains])) <= DIRECT_GROUPBY_MAX_DOMAIN
         )
+
+        # DISTINCT aggregates: a shard's partial over its local first
+        # occurrences double-counts values present on other shards, so the
+        # rows must be colocated by the dedup domain BEFORE aggregating.
+        # Grouped: the generic hash-repartition on group keys below already
+        # does that. Scalar: repartition on the (single) distinct argument,
+        # then partials are disjoint and psum-merge correctly.
+        distinct_args = {a[2] for a in op.aggs if a[3]}
+        if distinct_args and not op.group_keys:
+            if len(distinct_args) == 1:
+                cap = params.exchange_cap[_exch_id(nid, _AGG_CHILD)]
+                child, xovf = self._exchange_hash(
+                    child, [next(iter(distinct_args))], cap)
+                covf = dict(covf)
+                covf[_exch_id(nid, _AGG_CHILD)] = xovf
+            else:
+                # two different distinct domains cannot both colocate by
+                # one exchange: replicate (rare shape; correct, not fast)
+                child = self._gather_batch(child)
+                out, ovf = super()._emit_aggregate(
+                    op, nid, inputs,
+                    _override(emit, op.child, (child, covf)), params)
+                self._dist[id(op)] = REPLICATED
+                return out, ovf
+        elif distinct_args:
+            direct = False  # partials+psum would double-count: repartition
 
         if direct or not op.group_keys:
             # local partials + datahub-rollup merge: moves O(groups), not

@@ -124,10 +124,13 @@ class WindowCall(Node):
     Frames: the SQL-default frame only (RANGE UNBOUNDED PRECEDING..CURRENT
     ROW with ORDER BY; the whole partition without)."""
 
-    name: str  # row_number | rank | dense_rank | sum | count | min | max | avg
+    name: str  # ranking | aggregate | lag/lead | ntile | first/last_value
     args: tuple[Node, ...]
     partition_by: tuple[Node, ...] = ()
     order_by: tuple["OrderItem", ...] = ()
+    # (unit, lo, hi): unit in {rows, range}; bounds are signed offsets
+    # (negative = PRECEDING, 0 = CURRENT ROW, None = UNBOUNDED that way)
+    frame: tuple | None = None
 
 
 @dataclass(frozen=True)

@@ -115,8 +115,11 @@ class Window(LogicalOp):
     """Window functions over the child relation. Output = child columns +
     one column per window function; row set and order are unchanged.
     funcs: (name, fn, arg expr | None, partition key exprs,
-    ((order expr, descending), ...)). Reference:
-    src/sql/engine/window_function (ObWindowFunctionVecOp)."""
+    ((order expr, descending), ...), extra) where `extra` is the frame
+    tuple (unit, lo, hi) for aggregates/first_value/last_value, (offset,
+    default expr | None) for lag/lead, the bucket count for ntile, None
+    otherwise. Reference: src/sql/engine/window_function
+    (ObWindowFunctionVecOp)."""
 
     child: LogicalOp
     funcs: tuple[
@@ -124,6 +127,7 @@ class Window(LogicalOp):
             str, str, "E.Expr | None",
             tuple["E.Expr", ...],
             tuple[tuple["E.Expr", bool], ...],
+            object,
         ],
         ...,
     ]
@@ -182,7 +186,7 @@ def output_schema(op: LogicalOp) -> Schema:
     if isinstance(op, Window):
         child_s = output_schema(op.child)
         fields = list(child_s.fields)
-        for name, fn, arg, _pk, _ok in op.funcs:
+        for name, fn, arg, _pk, _ok, _x in op.funcs:
             fields.append(Field(name, window_out_type(fn, arg, child_s)))
         return Schema(tuple(fields))
     raise AssertionError(type(op))
@@ -192,7 +196,7 @@ def window_out_type(fn: str, arg, child_s: Schema) -> DataType:
     """Result type of one window function (mirrors aggregate typing)."""
     from ..expr.compile import infer_type
 
-    if fn in ("row_number", "rank", "dense_rank", "count"):
+    if fn in ("row_number", "rank", "dense_rank", "count", "ntile"):
         return DataType.int64()
     if fn == "avg":
         return DataType.float64()
@@ -201,6 +205,9 @@ def window_out_type(fn: str, arg, child_s: Schema) -> DataType:
         t = DataType.decimal(18, t.scale)
     elif fn == "sum" and t.is_integer:
         t = DataType.int64()
+    if fn in ("lag", "lead", "first_value", "last_value"):
+        # outside-partition reads / empty frames produce NULL
+        return t.with_nullable(True)
     # frames can be empty only for sum/min/max of all-NULL inputs; keep
     # nullability from the argument
     return t
@@ -427,35 +434,94 @@ class Resolver:
         else:
             arg = self.expr(node.args[0])
         if fn == "avg":
-            # avg(x) = sum(x) / count(x): count of NON-NULL x, per SQL
-            s = self._add_agg("sum", arg, False)
+            # avg(x) = sum(x) / count(x): count of NON-NULL x, per SQL;
+            # AVG(DISTINCT x) needs BOTH halves deduplicated
+            s = self._add_agg("sum", arg, node.distinct)
             c = self._add_agg("count", arg, node.distinct)
             return E.BinaryOp("/", E.ColRef(s), E.ColRef(c))
         name = self._add_agg(fn, arg, node.distinct)
         return E.ColRef(name)
 
     _WINDOW_FUNCS = {
-        "row_number", "rank", "dense_rank", "sum", "count", "min", "max", "avg",
+        "row_number", "rank", "dense_rank", "sum", "count", "min", "max",
+        "avg", "lag", "lead", "ntile", "first_value", "last_value",
     }
+    # functions whose frame is fixed by the standard (frame clause invalid)
+    _NO_FRAME = {"row_number", "rank", "dense_rank", "lag", "lead", "ntile"}
 
     def _window_call(self, node: "A.WindowCall", allow_agg: bool) -> E.Expr:
         """Resolve fn(args) OVER (...) to a ColRef on a window output column;
         the spec is recorded in win_exprs for the planner's Window node.
-        avg decomposes into sum/count window functions (like _agg_call)."""
+        avg decomposes into sum/count window functions (like _agg_call).
+
+        The per-func `extra` slot carries the fn-specific spec: the frame
+        tuple for aggregates/first_value/last_value; (offset, default expr)
+        for lag/lead; the bucket count for ntile; None for ranking funcs.
+        Reference: frame resolution in
+        src/sql/engine/window_function/ob_window_function_vec_op.cpp."""
         fn = node.name
         if fn not in self._WINDOW_FUNCS:
             raise ResolveError(f"unknown window function {fn}")
+        if node.frame is not None and fn in self._NO_FRAME:
+            raise ResolveError(f"{fn}() does not accept a frame clause")
+        frame = node.frame
+        if frame is not None:
+            if not node.order_by:
+                raise ResolveError("a frame clause requires ORDER BY")
+            unit, lo, hi = frame
+            if lo is not None and hi is not None and lo > hi:
+                raise ResolveError("frame start is after frame end")
+            if unit == "range" and (lo not in (None, 0) or hi not in (None, 0)):
+                if len(node.order_by) != 1:
+                    raise ResolveError(
+                        "RANGE frame with a value offset requires exactly "
+                        "one ORDER BY key"
+                    )
+        extra = frame
+        arg = None
         if fn in ("row_number", "rank", "dense_rank"):
             if node.args:
                 raise ResolveError(f"{fn}() takes no arguments")
-            arg = None
-        elif fn == "count" and (not node.args or isinstance(node.args[0], A.Star)):
+            extra = None
+        elif fn == "ntile":
+            if len(node.args) != 1 or not isinstance(node.args[0], A.NumberLit):
+                raise ResolveError("ntile() takes one integer literal")
+            k = int(node.args[0].value)
+            if k <= 0:
+                raise ResolveError("ntile() bucket count must be positive")
+            extra = k
+        elif fn in ("lag", "lead"):
+            if not 1 <= len(node.args) <= 3:
+                raise ResolveError(f"{fn}(expr [, offset [, default]])")
+            arg = self.expr(node.args[0], allow_agg)
+            off = 1
+            if len(node.args) >= 2:
+                if not isinstance(node.args[1], A.NumberLit):
+                    raise ResolveError(f"{fn}() offset must be a literal")
+                off = int(node.args[1].value)
+                if off < 0:
+                    raise ResolveError(f"{fn}() offset must be >= 0")
+            dflt = (
+                self.expr(node.args[2], allow_agg)
+                if len(node.args) == 3 else None
+            )
+            extra = (off, dflt)
+        elif fn == "count" and (
+            not node.args or isinstance(node.args[0], A.Star)
+        ):
             arg = None
         else:
             if len(node.args) != 1:
                 raise ResolveError(f"window {fn} takes one argument")
             arg = self.expr(node.args[0], allow_agg)
-        if fn in ("rank", "dense_rank") and not node.order_by:
+        if fn in ("min", "max") and frame is not None:
+            _u, lo, hi = frame
+            if lo is not None and hi is not None:
+                raise ResolveError(
+                    "min/max windows support frames bounded on one end only"
+                )
+        if fn in ("rank", "dense_rank", "ntile", "lag", "lead") \
+                and not node.order_by:
             raise ResolveError(f"{fn}() requires ORDER BY in its window")
         pk = tuple(self.expr(p, allow_agg) for p in node.partition_by)
         ok = tuple(
@@ -463,17 +529,17 @@ class Resolver:
             for oi in node.order_by
         )
         if fn == "avg":
-            s = self._add_window("sum", arg, pk, ok)
-            c = self._add_window("count", arg, pk, ok)
+            s = self._add_window("sum", arg, pk, ok, extra)
+            c = self._add_window("count", arg, pk, ok, extra)
             return E.BinaryOp("/", E.ColRef(s), E.ColRef(c))
-        return E.ColRef(self._add_window(fn, arg, pk, ok))
+        return E.ColRef(self._add_window(fn, arg, pk, ok, extra))
 
-    def _add_window(self, fn, arg, pk, ok) -> str:
-        for name, f2, a2, p2, o2 in self.win_exprs:
-            if (f2, a2, p2, o2) == (fn, arg, pk, ok):
+    def _add_window(self, fn, arg, pk, ok, extra=None) -> str:
+        for name, f2, a2, p2, o2, x2 in self.win_exprs:
+            if (f2, a2, p2, o2, x2) == (fn, arg, pk, ok, extra):
                 return name
         name = f"$win{next(_counter)}"
-        self.win_exprs.append((name, fn, arg, pk, ok))
+        self.win_exprs.append((name, fn, arg, pk, ok, extra))
         return name
 
     def _add_agg(self, fn: str, arg: E.Expr | None, distinct: bool) -> str:

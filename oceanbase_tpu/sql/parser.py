@@ -33,6 +33,8 @@ KEYWORDS = {
     "right", "full", "cross", "outer", "on", "date", "interval", "year",
     "month", "day", "asc", "desc", "union", "all", "any", "some", "with",
     "intersect", "except", "over", "partition",
+    # window frames
+    "rows", "range", "unbounded", "preceding", "following", "current", "row",
     # statements
     "create", "drop", "table", "primary", "key", "if", "insert", "into",
     "values", "update", "set", "delete", "begin", "start", "transaction",
@@ -758,11 +760,13 @@ class Parser:
                         order_by = [self.order_item()]
                         while self.accept(","):
                             order_by.append(self.order_item())
+                    frame = self._frame_clause()
                     self.expect(")")
                     if distinct:
                         raise SyntaxError("DISTINCT window aggregates unsupported")
                     return A.WindowCall(
-                        t.value, args, tuple(partition_by), tuple(order_by)
+                        t.value, args, tuple(partition_by), tuple(order_by),
+                        frame,
                     )
                 return A.FuncCall(t.value, args, distinct)
             parts = [t.value]
@@ -771,6 +775,51 @@ class Parser:
                 parts.append(self.next().value)
             return A.Name(tuple(parts))
         raise SyntaxError(f"unexpected token {t.value!r} @{t.pos}")
+
+    def _frame_clause(self):
+        """[ROWS|RANGE [BETWEEN <bound> AND <bound> | <bound>]] inside an
+        OVER(). Returns (unit, lo, hi) or None; bounds are signed row/value
+        offsets (negative = PRECEDING), 0 = CURRENT ROW, None = UNBOUNDED
+        toward that end."""
+        if self.peek().kind != "kw" or self.peek().value not in ("rows", "range"):
+            return None
+        unit = self.next().value
+
+        def bound(direction_required=None):
+            if self.accept("unbounded"):
+                d = self.next().value  # preceding | following
+                if d not in ("preceding", "following"):
+                    raise SyntaxError(f"UNBOUNDED {d.upper()}?")
+                return None, d
+            if self.accept("current"):
+                self.expect("row")
+                return 0, "current"
+            n = self.next()
+            if n.kind != "num":
+                raise SyntaxError(f"frame bound needs a number, got {n.value!r}")
+            k = int(n.value)
+            d = self.next().value
+            if d == "preceding":
+                return -k, d
+            if d == "following":
+                return k, d
+            raise SyntaxError(f"frame bound direction {d!r}")
+
+        if self.accept("between"):
+            lo, lod = bound()
+            self.expect("and")
+            hi, hid = bound()
+        else:
+            lo, lod = bound()
+            if lod == "following":
+                raise SyntaxError("frame start cannot be FOLLOWING without BETWEEN")
+            hi, hid = 0, "current"
+        if lod == "following" and lo is None:
+            raise SyntaxError("frame start cannot be UNBOUNDED FOLLOWING")
+        if hid == "preceding" and hi is None:
+            raise SyntaxError("frame end cannot be UNBOUNDED PRECEDING")
+        # normalize UNBOUNDED: start-side None means -inf, end-side +inf
+        return (unit, lo, hi)
 
     def case_expr(self) -> A.Node:
         self.expect("case")

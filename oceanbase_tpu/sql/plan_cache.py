@@ -184,6 +184,17 @@ class _Paramizer:
                 op, left=self.plan(op.left), right=self.plan(op.right)
             )
         if isinstance(op, Window):
+            def fix_extra(fn, x):
+                # frame bounds / ntile buckets are ints shaping the kernel:
+                # structural. lag/lead defaults are exprs: parameterize.
+                if fn in ("lag", "lead") and x is not None:
+                    off, dflt = x
+                    self.baked.append(("winoff", off))
+                    return (off, self.expr(dflt) if dflt is not None else None)
+                if x is not None:
+                    self.baked.append(("winextra", fn, x))
+                return x
+
             return dc_replace(
                 op,
                 child=self.plan(op.child),
@@ -192,8 +203,9 @@ class _Paramizer:
                         n, fn, self.expr(a),
                         tuple(self.expr(p) for p in pk),
                         tuple((self.expr(o), d) for o, d in ok),
+                        fix_extra(fn, x),
                     )
-                    for n, fn, a, pk, ok in op.funcs
+                    for n, fn, a, pk, ok, x in op.funcs
                 ),
             )
         raise NotImplementedError(type(op))

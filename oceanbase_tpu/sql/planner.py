@@ -474,13 +474,35 @@ class Planner:
 
         # ---- window functions (after grouping/HAVING, before projection)
         if r.win_exprs:
+            from ..expr.compile import infer_type
+            from ..sql.logical import output_schema as _oschema
+
             specs = []
-            for name, fn, arg, pk, ok in r.win_exprs:
+            for name, fn, arg, pk, ok, extra in r.win_exprs:
                 if agg_out_sub:
                     arg = _substitute(arg, agg_out_sub) if arg is not None else None
                     pk = tuple(_substitute(p, agg_out_sub) for p in pk)
                     ok = tuple((_substitute(o, agg_out_sub), d) for o, d in ok)
-                specs.append((name, fn, arg, pk, ok))
+                    if fn in ("lag", "lead") and extra is not None \
+                            and extra[1] is not None:
+                        extra = (extra[0], _substitute(extra[1], agg_out_sub))
+                if (
+                    isinstance(extra, tuple) and len(extra) == 3
+                    and extra[0] == "range"
+                    and (extra[1] not in (None, 0) or extra[2] not in (None, 0))
+                ):
+                    # value-offset RANGE frames run on the integer storage
+                    # domain (ints, dates, scaled decimals); float keys
+                    # would silently truncate
+                    kt = infer_type(ok[0][0], _oschema(plan))
+                    import numpy as _np
+
+                    if not _np.issubdtype(kt.storage_np, _np.integer):
+                        raise ResolveError(
+                            "RANGE frame with a value offset requires an "
+                            "integer-domain ORDER BY key (int/date/decimal)"
+                        )
+                specs.append((name, fn, arg, pk, ok, extra))
             plan = Window(plan, tuple(specs))
 
         visible = tuple(n for n, _ in out_items)
@@ -518,14 +540,12 @@ class Planner:
         """Build the Aggregate node; expands DISTINCT aggregates into a
         pre-dedup (Distinct over keys+arg) + plain aggregate."""
         distinct_aggs = [a for a in agg_exprs if a[3]]
-        if distinct_aggs:
-            if len(agg_exprs) != len(distinct_aggs) or len(distinct_aggs) != 1:
-                raise ResolveError(
-                    "mixing DISTINCT and plain aggregates is not supported yet"
-                )
+        if len(distinct_aggs) == 1 and len(agg_exprs) == 1 \
+                and distinct_aggs[0][1] == "count":
+            # lone COUNT(DISTINCT): pre-dedup (Distinct over keys+arg) +
+            # plain count — two-phase, so under PX the dedup repartitions
+            # before any aggregation state exists
             name, fn, arg, _ = distinct_aggs[0]
-            if fn != "count":
-                raise ResolveError(f"{fn}(DISTINCT) not supported yet")
             proj = [(n, e) for n, e in key_exprs] + [("$darg", arg)]
             plan = Distinct(Project(plan, tuple(proj)))
             key_refs = [(n, E.ColRef(n)) for n, _ in key_exprs]
@@ -535,6 +555,8 @@ class Planner:
             )
             sub = {e: E.ColRef(n) for n, e in key_exprs}
             return plan, sub
+        # mixed / multiple / non-count DISTINCT aggregates flow through:
+        # the executor masks each distinct agg to first occurrences
         plan = Aggregate(plan, tuple(key_exprs), tuple(agg_exprs))
         sub = {e: E.ColRef(n) for n, e in key_exprs}
         return plan, sub

@@ -9,7 +9,7 @@ from oceanbase_tpu.core.column import batch_rows_normalized
 from oceanbase_tpu.engine.chunked import ChunkedPreparedPlan, NotStreamable
 from oceanbase_tpu.engine.executor import Executor
 from oceanbase_tpu.models.tpch import datagen
-from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS  # noqa
 from oceanbase_tpu.sql.parser import parse
 from oceanbase_tpu.sql.planner import Planner
 
@@ -45,18 +45,64 @@ def test_chunked_matches_whole(tables, qid):
     assert got == want, f"Q{qid} chunked mismatch"
 
 
-def test_chunk_split_requires_aggregate(tables):
-    ex = Executor(tables, unique_keys=UNIQUE_KEYS, device_budget=BUDGET,
+def _chunk_check(tables, sql, want_kind, budget=256 << 10):
+    """Chunked execution must engage with the expected split kind and
+    match whole-table execution."""
+    whole_exec = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole_exec, tables, sql)
+    # budget below the streamed projection of lineitem at sf=0.01
+    ex = Executor(tables, unique_keys=UNIQUE_KEYS, device_budget=budget,
                   chunk_rows=CHUNK)
-    pq = Planner(tables).plan(parse(
-        "select l_orderkey from lineitem where l_quantity < 2 order by l_orderkey limit 5"
-    ))
-    # falls back to whole-table upload (no accumulation point): still correct
-    prepared = ex.prepare(pq.plan)
-    assert not isinstance(prepared, ChunkedPreparedPlan)
-    out = prepared.run()
-    rows = batch_rows_normalized(out, pq.output_names)
-    assert len(rows) == 5
+    prepared, got = _rows(ex, tables, sql)
+    assert isinstance(prepared, ChunkedPreparedPlan), "did not chunk"
+    assert prepared.kind == want_kind, (prepared.kind, want_kind)
+    assert got == want
+
+
+def test_chunked_topn_split(tables):
+    _chunk_check(tables, """
+        select l_orderkey from lineitem where l_quantity < 2
+        order by l_orderkey limit 5
+    """, "topn")
+
+
+def test_chunked_distinct_split(tables):
+    _chunk_check(tables, """
+        select distinct l_shipmode from lineitem
+    """, "distinct", budget=128 << 10)
+
+
+def test_chunked_passthrough_orderby(tables):
+    # full ORDER BY root: filters stream, the sort runs on $partials
+    _chunk_check(tables, """
+        select l_orderkey, l_quantity from lineitem
+        where l_quantity < 3 and l_discount < 0.03
+        order by l_orderkey, l_quantity
+    """, "passthrough")
+
+
+def test_chunked_join_rooted(tables):
+    # join-rooted (no aggregate): resident build, streamed probe,
+    # emitted pair chunks ride passthrough
+    _chunk_check(tables, """
+        select o.o_orderpriority, l.l_quantity
+        from lineitem l, orders o
+        where l.l_orderkey = o.o_orderkey and l.l_quantity < 2
+          and o.o_orderdate < date '1992-03-01'
+        order by o.o_orderpriority, l.l_quantity
+    """, "passthrough")
+
+
+def test_chunked_window_over_stream(tables):
+    # the window blocks mid-plan streaming, so the SCAN itself streams
+    # (pushed filter reduces per chunk) and the window runs on $partials
+    _chunk_check(tables, """
+        select l_orderkey, l_quantity,
+               row_number() over (partition by l_orderkey
+                                  order by l_quantity, l_linenumber) as rn
+        from lineitem where l_quantity < 2
+        order by l_orderkey, rn
+    """, "scan", budget=512 << 10)
 
 
 def test_chunked_scalar_aggregate_empty_chunks(tables):

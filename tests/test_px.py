@@ -67,6 +67,133 @@ def test_small_groupby_is_merge_not_exchange(env):
     _check(env, QUERIES[1])
 
 
+def test_distinct_aggs_distributed(env):
+    """DISTINCT aggregates must not double-count across shards: grouped
+    distinct repartitions by group keys; scalar distinct repartitions by
+    the distinct argument before psum-merging partials."""
+    _check(env, """
+        select c_nationkey, count(distinct c_mktsegment) as d,
+               count(*) as n
+        from customer group by c_nationkey
+    """)
+    _check(env, """
+        select count(distinct c_nationkey) as d, count(*) as n
+        from customer
+    """)
+    _check(env, """
+        select sum(distinct o_shippriority) as sd
+        from orders
+    """)
+
+
+def test_big_distinct_repartitions_not_gathers(env):
+    """A DISTINCT over a sharded relation above broadcast_threshold must
+    hash-repartition: the only gather in the program is the compacted
+    root result, never the full input capacity."""
+    from oceanbase_tpu.parallel.mesh import make_mesh
+
+    tables = env["tables"]
+    gathered = []
+
+    class Spy(PxExecutor):
+        def _gather_batch(self, b):
+            gathered.append(b.capacity)
+            return super()._gather_batch(b)
+
+    px = Spy(tables, make_mesh(8), unique_keys=UNIQUE_KEYS,
+             broadcast_threshold=1024)
+    planned = Planner(tables).plan(
+        parse("select distinct l_suppkey from lineitem"))
+    out = px.execute(planned.plan)
+    want = sorted(
+        batch_rows_normalized(env["single"].execute(planned.plan),
+                              planned.output_names))
+    got = sorted(batch_rows_normalized(out, planned.output_names))
+    assert got == want
+    li_cap = tables["lineitem"].nrows  # full relation scale
+    assert gathered, "root gather expected"
+    assert all(c < li_cap for c in gathered), (
+        f"full-capacity gather seen: {gathered} vs {li_cap}")
+
+
+def test_big_setops_copartition_not_gather(env):
+    """INTERSECT/EXCEPT/UNION over big sharded inputs co-partition by
+    whole-row hash; UNION ALL concatenates with no exchange at all."""
+    from oceanbase_tpu.parallel.mesh import make_mesh
+
+    tables = env["tables"]
+    gathered = []
+
+    class Spy(PxExecutor):
+        def _gather_batch(self, b):
+            gathered.append(b.capacity)
+            return super()._gather_batch(b)
+
+    for sql in (
+        "select l_suppkey from lineitem union select s_suppkey from supplier",
+        "select l_suppkey from lineitem union all select s_suppkey from supplier",
+        "select l_suppkey from lineitem intersect select s_suppkey from supplier",
+        "select l_suppkey from lineitem except all select s_suppkey from supplier",
+    ):
+        gathered.clear()
+        px = Spy(tables, make_mesh(8), unique_keys=UNIQUE_KEYS,
+                 broadcast_threshold=1024)
+        planned = Planner(tables).plan(parse(sql))
+        got = sorted(batch_rows_normalized(
+            px.execute(planned.plan), planned.output_names))
+        want = sorted(batch_rows_normalized(
+            env["single"].execute(planned.plan), planned.output_names))
+        assert got == want, sql
+        li_cap = tables["lineitem"].nrows
+        assert all(c < li_cap for c in gathered), (sql, gathered, li_cap)
+
+
+def test_auto_hybrid_hash_on_skew(env):
+    """A join key where one value dominates must pick hybrid-hash from
+    the histograms alone — no explicit flag (the reference decides via
+    the runtime sampling datahub, ob_sql_define.h:393)."""
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.parallel.mesh import make_mesh
+
+    I64 = DataType.int64()
+    rng = np.random.default_rng(5)
+    n = 200_000
+    nd = 100_000  # dim big enough that broadcast loses to hash on cost
+    # 60% of fact rows hit key 7; the rest spread over the dim domain
+    fk = np.where(rng.random(n) < 0.6, 7,
+                  rng.integers(0, nd, n)).astype(np.int64)
+    fact = Table.from_pydict(
+        "fact", Schema((Field("fk", I64), Field("v", I64))),
+        {"fk": fk, "v": np.arange(n, dtype=np.int64)})
+    dim = Table.from_pydict(
+        "dim", Schema((Field("dk", I64), Field("dv", I64))),
+        {"dk": np.arange(nd, dtype=np.int64),
+         "dv": np.arange(nd, dtype=np.int64) * 3})
+    tables = {"fact": fact, "dim": dim}
+
+    hybrid_calls = []
+
+    class Spy(PxExecutor):
+        def _hybrid_exchange(self, *a, **kw):
+            hybrid_calls.append(1)
+            return super()._hybrid_exchange(*a, **kw)
+
+    px = Spy(tables, make_mesh(8), unique_keys={"dim": ("dk",)},
+             broadcast_threshold=256)
+    planned = Planner(tables).plan(parse(
+        "select sum(d.dv) as s from fact f, dim d where f.fk = d.dk"))
+    out = px.execute(planned.plan)
+    single = Executor(tables, unique_keys={"dim": ("dk",)}).execute(
+        planned.plan)
+    got = batch_rows_normalized(out, planned.output_names)
+    want = batch_rows_normalized(single, planned.output_names)
+    assert got == want
+    assert hybrid_calls, "skewed join did not choose hybrid-hash"
+
+
 def test_admission_quota():
     adm = PxAdmission(target=10)
     g1 = adm.acquire(8)

@@ -211,6 +211,41 @@ def test_setop_with_aggregates(db):
     """)
 
 
+# ------------------------------------------------------ distinct aggregates
+
+def test_count_distinct_grouped(db):
+    check(db, """
+        select c_nationkey as k, count(distinct c_mktsegment) as d
+        from customer group by c_nationkey
+    """)
+
+
+def test_mixed_distinct_and_plain_aggs(db):
+    check(db, """
+        select c_nationkey as k,
+               count(distinct c_mktsegment) as d,
+               count(*) as n,
+               sum(c_acctbal) as s
+        from customer group by c_nationkey
+    """)
+
+
+def test_sum_avg_distinct(db):
+    check(db, """
+        select o_orderpriority as p,
+               sum(distinct o_shippriority) as sd,
+               avg(distinct o_shippriority) as ad
+        from orders group by o_orderpriority
+    """)
+
+
+def test_scalar_distinct_aggs(db):
+    check(db, """
+        select count(distinct c_nationkey) as d, count(*) as n
+        from customer
+    """)
+
+
 # ---------------------------------------------------------------- windows
 
 def test_row_number(db):
@@ -311,6 +346,237 @@ def test_window_then_orderby_alias(db):
     assert [(int(a), int(b)) for a, b in got] == [
         (int(a), int(b)) for a, b in want
     ]
+
+
+# ------------------------------------------------- new funcs + frames (r3)
+
+def test_lag_lead(db):
+    check(db, """
+        select o_orderkey,
+               lag(o_totalprice) over (partition by o_custkey
+                                       order by o_orderdate, o_orderkey) as p,
+               lead(o_totalprice) over (partition by o_custkey
+                                        order by o_orderdate, o_orderkey) as nx
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_lag_offset_and_default(db):
+    check(db, """
+        select o_orderkey,
+               lag(o_shippriority, 2, -1) over (
+                   partition by o_custkey
+                   order by o_orderdate, o_orderkey) as p2
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_ntile(db):
+    check(db, """
+        select c_custkey, ntile(4) over (
+            partition by c_nationkey order by c_acctbal, c_custkey) as q
+        from customer
+    """)
+
+
+def test_first_last_value_default_frame(db):
+    check(db, """
+        select o_orderkey,
+               first_value(o_totalprice) over (
+                   partition by o_custkey
+                   order by o_orderdate, o_orderkey) as fv,
+               last_value(o_totalprice) over (
+                   partition by o_custkey
+                   order by o_orderdate, o_orderkey) as lv
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_rows_frame_moving_sum(db):
+    check(db, """
+        select o_orderkey,
+               sum(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey
+                   rows between 2 preceding and current row) as mv,
+               count(*) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey
+                   rows between 1 preceding and 1 following) as c3
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_rows_frame_unbounded_following(db):
+    check(db, """
+        select o_orderkey,
+               sum(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey
+                   rows between current row and unbounded following) as rest,
+               max(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey
+                   rows between current row and unbounded following) as mx
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_rows_frame_shorthand(db):
+    # "ROWS 3 PRECEDING" == BETWEEN 3 PRECEDING AND CURRENT ROW
+    check(db, """
+        select o_orderkey,
+               sum(o_shippriority) over (
+                   order by o_orderkey rows 3 preceding) as s
+        from orders where o_orderkey <= 2000
+    """)
+
+
+def test_range_frame_value_offset(db):
+    # value-based frame over a date key: orders within 30 days back.
+    # sqlite stores our dates as TEXT, so its oracle must order by
+    # julianday() to get numeric RANGE arithmetic
+    check(db, """
+        select o_orderkey,
+               count(*) over (
+                   partition by o_custkey order by o_orderdate
+                   range between 30 preceding and current row) as recent
+        from orders where o_orderkey <= 3000
+    """, sqlite_sql="""
+        select o_orderkey,
+               count(*) over (
+                   partition by o_custkey order by julianday(o_orderdate)
+                   range between 30 preceding and current row) as recent
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_range_frame_int_key(db):
+    check(db, """
+        select o_orderkey,
+               sum(o_shippriority) over (
+                   order by o_orderkey
+                   range between 500 preceding and 500 following) as s
+        from orders where o_orderkey <= 4000
+    """)
+
+
+def test_range_frame_desc_key(db):
+    check(db, """
+        select o_orderkey,
+               count(*) over (
+                   partition by o_custkey order by o_orderdate desc
+                   range between 30 preceding and current row) as upcoming
+        from orders where o_orderkey <= 3000
+    """, sqlite_sql="""
+        select o_orderkey,
+               count(*) over (
+                   partition by o_custkey order by julianday(o_orderdate) desc
+                   range between 30 preceding and current row) as upcoming
+        from orders where o_orderkey <= 3000
+    """)
+
+
+def test_small_table_frames_ignore_capacity_padding():
+    """Dead/padding rows beyond nrows must not leak into segment ends:
+    ntile bucket counts, lead defaults, and UNBOUNDED FOLLOWING frames on
+    a 6-row table padded to capacity 1024 (review r3 finding)."""
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+
+    I64 = DataType.int64()
+    t = Table.from_pydict(
+        "t", Schema((Field("k", I64), Field("v", I64))),
+        {"k": np.arange(6), "v": (np.arange(6) + 1) * 10})
+    sess = Session({"t": t})
+    rs = sess.sql("select k, ntile(3) over (order by k) as b from t")
+    assert [int(v) for v in rs.columns["b"][: rs.nrows]] == [1, 1, 2, 2, 3, 3]
+    rs = sess.sql("select k, lead(v, 1, -99) over (order by k) as nx from t")
+    got = [int(rs.columns["nx"][i]) for i in range(rs.nrows)]
+    assert got == [20, 30, 40, 50, 60, -99]
+    rs = sess.sql("""
+        select k, sum(v) over (order by k
+            rows between current row and unbounded following) as rest,
+            last_value(v) over (order by k
+            rows between current row and unbounded following) as lv
+        from t""")
+    rests = [int(rs.columns["rest"][i]) for i in range(rs.nrows)]
+    assert rests == [210, 200, 180, 150, 110, 60]
+    lvs = [int(rs.columns["lv"][i]) for i in range(rs.nrows)]
+    assert lvs == [60] * 6
+
+
+def test_range_frame_outside_domain_is_empty():
+    """A value-offset frame lying wholly outside the key domain is EMPTY:
+    sum -> NULL, count -> 0 (review r3 finding: edge clamping admitted
+    the boundary rows)."""
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+
+    I64 = DataType.int64()
+    t = Table.from_pydict(
+        "t", Schema((Field("k", I64), Field("v", I64))),
+        {"k": np.arange(6), "v": (np.arange(6) + 1) * 10})
+    sess = Session({"t": t})
+    rs = sess.sql("""
+        select k,
+            sum(v) over (order by k
+                range between 5 preceding and 3 preceding) as s,
+            count(v) over (order by k
+                range between 3 following and 5 following) as c
+        from t""")
+    svals = [rs.columns["s"][i] for i in range(rs.nrows)]
+    for i in (0, 1, 2):  # frames [-5,-3]..[-3,-1]: below the domain
+        assert svals[i] is None or (
+            isinstance(svals[i], float) and math.isnan(svals[i])), svals
+    assert int(rs.columns["s"][4]) == 10 + 20  # [ -1, 1 ] -> k in {0,1}
+    cvals = [int(rs.columns["c"][i]) for i in range(rs.nrows)]
+    assert cvals == [3, 2, 1, 0, 0, 0]
+
+
+def test_range_frame_float_key_rejected():
+    import numpy as np
+    import pytest as _p
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.sql.logical import ResolveError
+
+    t = Table.from_pydict(
+        "t", Schema((Field("k", DataType.float64()),
+                     Field("v", DataType.int64()))),
+        {"k": np.array([1.2, 2.5]), "v": np.array([1, 2])})
+    sess = Session({"t": t})
+    with _p.raises(ResolveError, match="integer-domain"):
+        sess.sql("""
+            select count(v) over (order by k
+                range between 1 preceding and current row) as c from t
+        """)
+
+
+def test_min_bounded_frame_rejected(db):
+    tables, sess, conn = db
+    import pytest as _p
+
+    from oceanbase_tpu.sql.logical import ResolveError
+
+    with _p.raises(ResolveError, match="one end"):
+        sess.sql("""
+            select min(o_totalprice) over (
+                order by o_orderkey
+                rows between 2 preceding and current row) as m
+            from orders
+        """)
+
+
+def test_avg_window_frame(db):
+    check(db, """
+        select o_orderkey,
+               avg(o_totalprice) over (
+                   partition by o_custkey order by o_orderdate, o_orderkey
+                   rows between 2 preceding and current row) as a
+        from orders where o_orderkey <= 3000
+    """)
 
 
 # ---------------------------------------------------------------- PX paths
