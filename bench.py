@@ -221,12 +221,6 @@ def main():
     }
 
     from oceanbase_tpu.models.tpch.queries import q1_numpy_fast, q6_numpy
-    from oceanbase_tpu.sql import parser as P
-    from oceanbase_tpu.sql.plan_cache import (
-        bind,
-        parameterize,
-        plan_fingerprint,
-    )
 
     cpu_fns = {
         "q6": lambda: q6_numpy(li),
@@ -274,15 +268,9 @@ def main():
             # device-path timing through the SAME cached executable the
             # session compiled (a separately prepared plan would re-trace
             # and pay a second ~100s remote compile on the axon tunnel)
-            norm_key, _n = P.normalize_for_cache(text)
-            pq = sess.planner.plan(P.parse(text))
-            pz = parameterize(pq.plan)
-            key = (id(sess.executor.catalog), norm_key, pz.sig, pz.baked,
-                   plan_fingerprint(pz.plan), ())
-            entry = sess.plan_cache.get(key)
+            entry, qp = sess.cached_entry(text)
             assert entry is not None, "plan cache miss on timed re-fetch"
             prepared = entry.prepared
-            qp = bind(pz.values, entry.dtypes)
             prepared.run(qparams=qp)  # warm
             # amortized dispatch: K back-to-back executions, one sync —
             # a single dispatch+fetch mostly measures host<->device

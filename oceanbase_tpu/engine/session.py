@@ -79,16 +79,21 @@ class Session:
         ast = P.parse(text)
         return self.run_ast(ast, norm_key)
 
-    def run_ast(self, ast, norm_key: str, use_cache: bool | None = None) -> ResultSet:
-        """Plan + execute an already-parsed SELECT under the plan cache.
-
-        Shared by text queries and internal consumers (the DML layer's
-        UPDATE/DELETE qualification scans, virtual-table queries).
-        use_cache=False bypasses the plan cache entirely (virtual-table
-        statements: their per-materialization dictionaries make entries
-        never reusable, and caching them would evict user plans)."""
-        planned = self.planner.plan(ast)
+    def cached_entry(self, text: str):
+        """(CacheEntry, bound qparams) for a statement already run through
+        sql() — the compiled-executable surface consumers (bench timing
+        loops) use to re-run the exact cached artifact without a second
+        trace/compile. Returns (None, None) on a cache miss."""
+        norm_key, _ = P.normalize_for_cache(text)
+        planned = self.planner.plan(P.parse(text))
         pz = parameterize(planned.plan)
+        key = self._cache_key(norm_key, pz)
+        entry = self.plan_cache.get(key)
+        if entry is None:
+            return None, None
+        return entry, bind(pz.values, entry.dtypes)
+
+    def _cache_key(self, norm_key: str, pz) -> tuple:
         extra = ()
         if self.key_extra_fn is not None:
             tables = tuple(sorted(
@@ -99,8 +104,20 @@ class Session:
         # tenant = per catalog; entries pin their executor -> catalog, so the
         # id cannot be recycled while the entry lives); the plan fingerprint
         # catches literals consumed at plan time (ORDER BY ordinals etc.)
-        key = (id(self.catalog), norm_key, pz.sig, pz.baked,
-               plan_fingerprint(pz.plan), extra)
+        return (id(self.catalog), norm_key, pz.sig, pz.baked,
+                plan_fingerprint(pz.plan), extra)
+
+    def run_ast(self, ast, norm_key: str, use_cache: bool | None = None) -> ResultSet:
+        """Plan + execute an already-parsed SELECT under the plan cache.
+
+        Shared by text queries and internal consumers (the DML layer's
+        UPDATE/DELETE qualification scans, virtual-table queries).
+        use_cache=False bypasses the plan cache entirely (virtual-table
+        statements: their per-materialization dictionaries make entries
+        never reusable, and caching them would evict user plans)."""
+        planned = self.planner.plan(ast)
+        pz = parameterize(planned.plan)
+        key = self._cache_key(norm_key, pz)
         if use_cache is None:
             use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
         entry = self.plan_cache.get(key) if use_cache else None

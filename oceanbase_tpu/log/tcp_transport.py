@@ -6,34 +6,46 @@ multi-node behavior by forking three observer processes as three zones
 (mittest/multi_replica/env/ob_multi_replica_test_base.cpp:472); the
 rebuild's TcpBus lets the SAME PalfReplica state machine run across real
 processes: it exposes the LocalBus surface palf uses (`now`, `send`,
-`register`) over length-prefixed pickled frames.
+`register`).
 
-Wire safety note: frames are pickled (trusted in-cluster links only, like
-the reference's internal RPC); a hardened codec swaps in at this one
-boundary.
+Frames ride the typed, versioned codec in log/wire.py (tagged binary
+messages — no pickle, a hostile frame cannot execute code), and every
+connection must present the cluster auth token in a HELLO frame before
+any message is accepted.
 """
 
 from __future__ import annotations
 
-import pickle
+import hmac
 import socket
-import struct
 import threading
 import time
 
-_FRAME = struct.Struct("<II")  # dst node id, payload length
+from .wire import (
+    FRAME,
+    KIND_HELLO,
+    KIND_MSG,
+    MAGIC,
+    VERSION,
+    DecodeError,
+    decode_msg,
+    encode_msg,
+)
 
 
 class TcpBus:
     """One process's endpoint. `route` maps every node id to the
     (host, port) of the process hosting it; ids listed in `local_nodes`
-    are served by this process."""
+    are served by this process. `auth_token` (bytes) gates inbound
+    connections: peers must HELLO with the same token first."""
 
     def __init__(self, listen_port: int, route: dict[int, tuple[str, int]],
-                 local_nodes: set[int] | None = None):
+                 local_nodes: set[int] | None = None,
+                 auth_token: bytes = b""):
         self.listen_port = listen_port
         self.route = route
         self.local_nodes = set(local_nodes or ())
+        self.auth_token = auth_token
         self._handlers: dict[int, object] = {}
         self._conns: dict[tuple[str, int], socket.socket] = {}
         self._t0 = time.monotonic()
@@ -45,6 +57,7 @@ class TcpBus:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
+        self.rejected_frames = 0  # malformed / unauthenticated (observability)
 
     @property
     def now(self) -> float:
@@ -55,6 +68,10 @@ class TcpBus:
         self.local_nodes.add(node_id)
 
     # ---------------------------------------------------------- sending
+    @staticmethod
+    def _frame(kind: int, dst: int, payload: bytes) -> bytes:
+        return FRAME.pack(MAGIC, VERSION, kind, dst, len(payload)) + payload
+
     def send(self, src: int, dst: int, msg) -> None:
         if dst in self.local_nodes:
             h = self._handlers.get(dst)
@@ -64,8 +81,7 @@ class TcpBus:
         addr = self.route.get(dst)
         if addr is None:
             return
-        payload = pickle.dumps((src, msg), protocol=pickle.HIGHEST_PROTOCOL)
-        frame = _FRAME.pack(dst, len(payload)) + payload
+        frame = self._frame(KIND_MSG, dst, encode_msg(src, msg))
         with self._lock:
             dlock = self._dst_locks.setdefault(addr, threading.Lock())
         try:
@@ -75,6 +91,10 @@ class TcpBus:
                 if conn is None:
                     conn = socket.create_connection(addr, timeout=1.0)
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # authenticate the connection before the first message
+                    conn.sendall(
+                        self._frame(KIND_HELLO, 0, self.auth_token)
+                    )
                     with self._lock:
                         self._conns[addr] = conn
                 conn.sendall(frame)
@@ -117,7 +137,9 @@ class TcpBus:
     def _reader(self, conn: socket.socket) -> None:
         conn.settimeout(0.5)
         buf = b""
-        while not self._stop.is_set():
+        authed = not self.auth_token
+        drop = False
+        while not self._stop.is_set() and not drop:
             try:
                 chunk = conn.recv(65536)
             except socket.timeout:
@@ -127,16 +149,33 @@ class TcpBus:
             if not chunk:
                 break
             buf += chunk
-            while len(buf) >= _FRAME.size:
-                dst, plen = _FRAME.unpack_from(buf)
-                if len(buf) < _FRAME.size + plen:
+            while len(buf) >= FRAME.size:
+                magic, ver, kind, dst, plen = FRAME.unpack_from(buf)
+                if magic != MAGIC or ver != VERSION or plen > (64 << 20):
+                    self.rejected_frames += 1
+                    drop = True  # unframed garbage: drop the connection
                     break
-                payload = buf[_FRAME.size : _FRAME.size + plen]
-                buf = buf[_FRAME.size + plen :]
-                try:
-                    src, msg = pickle.loads(payload)
-                except Exception:  # noqa: BLE001 - corrupt frame: drop
+                if len(buf) < FRAME.size + plen:
+                    break
+                payload = buf[FRAME.size:FRAME.size + plen]
+                buf = buf[FRAME.size + plen:]
+                if kind == KIND_HELLO:
+                    if hmac.compare_digest(payload, self.auth_token):
+                        authed = True
+                    else:
+                        self.rejected_frames += 1
+                        drop = True
+                        break
                     continue
+                if not authed:
+                    self.rejected_frames += 1
+                    drop = True  # message before a valid HELLO
+                    break
+                try:
+                    src, msg = decode_msg(payload)
+                except (DecodeError, TypeError):
+                    self.rejected_frames += 1
+                    continue  # typed decode failed: drop the frame
                 h = self._handlers.get(dst)
                 if h is not None:
                     h(src, msg)

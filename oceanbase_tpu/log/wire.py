@@ -1,0 +1,194 @@
+"""Typed, versioned wire codec for the inter-node bus.
+
+Reference surface: obrpc packet framing + pcode-dispatched typed payloads
+(deps/oblib/src/rpc/obrpc/ob_rpc_packet_list.h — 1089 pcodes;
+ob_rpc_proxy_macros.h — macro-generated typed proxies). The rebuild's
+control plane is small, so the codec is hand-rolled: one tag byte per
+message type ("pcode"), fixed-width little-endian fields, length-prefixed
+bytes. No pickle anywhere: a malformed or adversarial frame can at worst
+fail to decode (DecodeError) — it cannot execute code.
+
+Framing (tcp_transport.py): every frame is
+    magic u16 | version u8 | kind u8 | dst u32 | len u32 | payload
+kind 0 = HELLO (payload = auth token), kind 1 = MSG (payload =
+src u32 | tag u8 | body). Connections must HELLO first when the bus has
+an auth token; frames before a valid HELLO are rejected and the
+connection dropped.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+MAGIC = 0x0BA5
+VERSION = 1
+FRAME = struct.Struct("<HBBII")  # magic, version, kind, dst, payload len
+KIND_HELLO = 0
+KIND_MSG = 1
+
+_HDR = struct.Struct("<IB")  # src, tag
+
+
+class DecodeError(Exception):
+    pass
+
+
+# ---- primitive packers -----------------------------------------------------
+
+def _pb(out: list, b: bytes):
+    out.append(struct.pack("<I", len(b)))
+    out.append(b)
+
+
+def _rb(buf: memoryview, off: int) -> tuple[bytes, int]:
+    if off + 4 > len(buf):
+        raise DecodeError("short bytes length")
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    if off + n > len(buf):
+        raise DecodeError("short bytes body")
+    return bytes(buf[off:off + n]), off + n
+
+
+# ---- message registry ------------------------------------------------------
+
+_ENCODERS: dict[type, tuple[int, object]] = {}
+_DECODERS: dict[int, object] = {}
+
+
+def register(tag: int, cls, fmt: str, fields: tuple[str, ...],
+             bytes_fields: tuple[str, ...] = ()):
+    """Register a flat dataclass: `fmt` packs the non-bytes `fields` in
+    order; `bytes_fields` follow as length-prefixed blobs."""
+    st = struct.Struct(fmt)
+
+    def enc(msg, out: list):
+        out.append(st.pack(*[
+            int(getattr(msg, f)) if not isinstance(getattr(msg, f), float)
+            else getattr(msg, f)
+            for f in fields
+        ]))
+        for f in bytes_fields:
+            _pb(out, getattr(msg, f))
+
+    def dec(buf: memoryview, off: int):
+        if off + st.size > len(buf):
+            raise DecodeError(f"short {cls.__name__}")
+        vals = st.unpack_from(buf, off)
+        off += st.size
+        kw = dict(zip(fields, vals))
+        for f in bytes_fields:
+            kw[f], off = _rb(buf, off)
+        return cls(**_coerce(cls, kw)), off
+
+    _ENCODERS[cls] = (tag, enc)
+    _DECODERS[tag] = dec
+    return cls
+
+
+def _coerce(cls, kw):
+    # struct returns ints; dataclasses with bool fields need real bools
+    hints = getattr(cls, "__annotations__", {})
+    for k, t in hints.items():
+        if k in kw and t in ("bool", bool):
+            kw[k] = bool(kw[k])
+    return kw
+
+
+# palf messages --------------------------------------------------------------
+
+from .palf import (  # noqa: E402 — registry must see the classes
+    AppendAck,
+    AppendReq,
+    LogEntry,
+    TimeoutNow,
+    VoteReq,
+    VoteResp,
+)
+
+_ENTRY = struct.Struct("<qqq")  # lsn, term, scn (+ payload bytes)
+
+
+def _enc_append_req(msg: AppendReq, out: list):
+    out.append(struct.pack(
+        "<qiqqqI", msg.term, msg.leader_id, msg.prev_lsn, msg.prev_term,
+        msg.commit_lsn, len(msg.entries),
+    ))
+    for e in msg.entries:
+        out.append(_ENTRY.pack(e.lsn, e.term, e.scn))
+        _pb(out, e.payload)
+
+
+def _dec_append_req(buf: memoryview, off: int):
+    st = struct.Struct("<qiqqqI")
+    if off + st.size > len(buf):
+        raise DecodeError("short AppendReq")
+    term, leader, prev_lsn, prev_term, commit, n = st.unpack_from(buf, off)
+    off += st.size
+    if n > 1 << 22:
+        raise DecodeError("absurd entry count")
+    entries = []
+    for _ in range(n):
+        if off + _ENTRY.size > len(buf):
+            raise DecodeError("short LogEntry")
+        lsn, eterm, scn = _ENTRY.unpack_from(buf, off)
+        off += _ENTRY.size
+        payload, off = _rb(buf, off)
+        entries.append(LogEntry(lsn, eterm, scn, payload))
+    return AppendReq(
+        term, leader, prev_lsn, prev_term, tuple(entries), commit
+    ), off
+
+
+_ENCODERS[AppendReq] = (1, _enc_append_req)
+_DECODERS[1] = _dec_append_req
+
+register(2, AppendAck, "<qqB", ("term", "ack_lsn", "success"))
+register(3, VoteReq, "<qiqqB",
+         ("term", "candidate_id", "last_lsn", "last_term", "force"))
+register(4, VoteResp, "<qB", ("term", "granted"))
+register(5, TimeoutNow, "<q", ("term",))
+
+# keepalive ------------------------------------------------------------------
+
+from ..ha.detect import _Ping, _Pong  # noqa: E402
+
+register(6, _Ping, "<d", ("t",))
+register(7, _Pong, "<d", ("t",))
+
+# distributed deadlock probes ------------------------------------------------
+
+from ..share.deadlock import LockProbe  # noqa: E402
+
+register(8, LockProbe, "<qqqB",
+         ("initiator", "holder", "max_seen", "hops"))
+
+
+# ---- top level -------------------------------------------------------------
+
+def encode_msg(src: int, msg) -> bytes:
+    try:
+        tag, enc = _ENCODERS[type(msg)]
+    except KeyError:
+        raise TypeError(
+            f"unregistered bus message type {type(msg).__name__}; add it "
+            f"to log/wire.py's registry"
+        ) from None
+    out: list[bytes] = [_HDR.pack(src, tag)]
+    enc(msg, out)
+    return b"".join(out)
+
+
+def decode_msg(payload: bytes) -> tuple[int, object]:
+    buf = memoryview(payload)
+    if len(buf) < _HDR.size:
+        raise DecodeError("short header")
+    src, tag = _HDR.unpack_from(buf, 0)
+    dec = _DECODERS.get(tag)
+    if dec is None:
+        raise DecodeError(f"unknown tag {tag}")
+    msg, off = dec(buf, _HDR.size)
+    if off != len(buf):
+        raise DecodeError("trailing bytes")
+    return src, msg

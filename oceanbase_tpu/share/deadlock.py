@@ -1,0 +1,120 @@
+"""Distributed deadlock detection: edge-chasing probes over the bus.
+
+Reference surface: share/deadlock — OceanBase's LCL (lock-chain-length)
+distributed detector, which propagates labels along wait-for edges between
+nodes and deterministically kills one participant of any cycle.
+
+Rebuild: the Chandy-Misra-Haas edge-chasing form of the same idea. Every
+node runs a DeadlockService next to its LockManager:
+
+  * locally, each waiting tx periodically originates a LockProbe for every
+    tx it waits on;
+  * a node that hosts the chased tx's wait state forwards the probe along
+    that tx's own wait edges (local chains collapse in one step because
+    wait_for() already walks local edges transitively);
+  * a probe arriving back at a tx that IS its initiator proves a cycle;
+    the node hosting the LARGEST tx id in the closing edge aborts it
+    (youngest-victim policy — deterministic cluster-wide because every
+    waiter originates probes, so the max-id member of the cycle is always
+    chased by someone).
+
+The victim is aborted by marking it in its LockManager; the blocked
+session's next lock() retry raises DeadlockDetected, exactly like a
+locally-detected cycle.
+
+Probes ride the typed wire codec (log/wire.py tag 8) between the
+bus endpoints DEADLOCK_EP + node_id; they are idle-cheap (no probes
+without waiters) and cycles are found within ~2 probe periods.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+# bus endpoint namespace offset (palf replicas use the raw node ids)
+DEADLOCK_EP = 1_000_000
+
+
+@dataclass(frozen=True)
+class LockProbe:
+    initiator: int  # tx id whose wait started the chase
+    holder: int     # tx id being chased
+    max_seen: int   # largest tx id on the chase path (victim arbitration)
+    hops: int
+
+
+class DeadlockService:
+    """One node's detector. `peers` lists the OTHER node ids; the bus
+    routes DEADLOCK_EP + node endpoints."""
+
+    def __init__(self, node_id: int, bus, lock_mgr, peers,
+                 period: float = 0.05, max_hops: int = 32):
+        self.node_id = node_id
+        self.bus = bus
+        self.lock_mgr = lock_mgr
+        self.peers = [p for p in peers if p != node_id]
+        self.period = period
+        self.max_hops = max_hops
+        self.cycles_found = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        bus.register(DEADLOCK_EP + node_id, self._on_message)
+
+    # ------------------------------------------------------------ probes
+    def _broadcast(self, probe: LockProbe) -> None:
+        for p in self.peers:
+            self.bus.send(
+                DEADLOCK_EP + self.node_id, DEADLOCK_EP + p, probe
+            )
+
+    def _chase(self, initiator: int, holder: int, max_seen: int,
+               hops: int) -> None:
+        """Follow `holder`'s local wait edges; close the cycle or forward.
+
+        max_seen accumulates the largest tx id along the chase; a probe
+        that closes a cycle aborts its holder ONLY when the holder is
+        that maximum — so among the N probes circulating one N-cycle,
+        exactly the one whose path ends at the max-id member kills it
+        (one victim per cycle, the youngest-tx policy)."""
+        if hops > self.max_hops:
+            return
+        max_seen = max(max_seen, holder)
+        edges = self.lock_mgr.wait_edges_of(holder)
+        for t in edges:
+            if t == initiator:
+                # cycle: the closing edge is holder -> initiator
+                self.cycles_found += 1
+                if holder >= max_seen:
+                    self.lock_mgr.abort(holder)
+                continue
+            if self.lock_mgr.hosts_wait(t):
+                self._chase(initiator, t, max_seen, hops + 1)
+            else:
+                self._broadcast(
+                    LockProbe(initiator, t, max_seen, hops + 1))
+
+    def _on_message(self, src: int, msg) -> None:
+        if isinstance(msg, LockProbe) and self.lock_mgr.hosts_wait(msg.holder):
+            self._chase(msg.initiator, msg.holder, msg.max_seen, msg.hops)
+
+    # ----------------------------------------------------------- driving
+    def scan_once(self) -> None:
+        """Originate probes for every local waiter (one detection round)."""
+        for tx, holders in self.lock_mgr.waiting_snapshot().items():
+            for h in holders:
+                if self.lock_mgr.hosts_wait(h):
+                    self._chase(tx, h, tx, 1)
+                else:
+                    self._broadcast(LockProbe(tx, h, tx, 1))
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.period):
+                self.scan_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -486,7 +486,11 @@ class Resolver:
         elif fn == "ntile":
             if len(node.args) != 1 or not isinstance(node.args[0], A.NumberLit):
                 raise ResolveError("ntile() takes one integer literal")
-            k = int(node.args[0].value)
+            try:
+                k = int(node.args[0].value)
+            except ValueError:
+                raise ResolveError("ntile() bucket count must be an integer") \
+                    from None
             if k <= 0:
                 raise ResolveError("ntile() bucket count must be positive")
             extra = k
@@ -498,7 +502,11 @@ class Resolver:
             if len(node.args) >= 2:
                 if not isinstance(node.args[1], A.NumberLit):
                     raise ResolveError(f"{fn}() offset must be a literal")
-                off = int(node.args[1].value)
+                try:
+                    off = int(node.args[1].value)
+                except ValueError:
+                    raise ResolveError(
+                        f"{fn}() offset must be an integer") from None
                 if off < 0:
                     raise ResolveError(f"{fn}() offset must be >= 0")
             dflt = (

@@ -52,6 +52,9 @@ class LockManager:
     # tx_id -> (lock_id, mode) one outstanding wait
     _waiting: dict[int, tuple[object, LockMode]] = field(default_factory=dict)
     _lock: threading.RLock = field(default_factory=threading.RLock)
+    # txs killed by the distributed detector (share/deadlock): surfaced as
+    # DeadlockDetected on the victim's next lock() retry
+    _aborted: set[int] = field(default_factory=set)
     deadlocks: int = 0
 
     @staticmethod
@@ -85,10 +88,37 @@ class LockManager:
             stack.extend(self._wait_edges(t))
         return False
 
+    # ---------------------------------------- distributed-detector hooks
+    def waiting_snapshot(self) -> dict[int, set[int]]:
+        """tx -> conflicting holder txs, for every locally-waiting tx."""
+        with self._lock:
+            return {t: self._wait_edges(t) for t in list(self._waiting)}
+
+    def wait_edges_of(self, tx_id: int) -> set[int]:
+        with self._lock:
+            return self._wait_edges(tx_id)
+
+    def hosts_wait(self, tx_id: int) -> bool:
+        with self._lock:
+            return tx_id in self._waiting
+
+    def abort(self, tx_id: int) -> None:
+        """Mark a tx as a deadlock victim (distributed detector verdict);
+        its next lock() retry raises DeadlockDetected."""
+        with self._lock:
+            self.deadlocks += 1
+            self._aborted.add(tx_id)
+            self._waiting.pop(tx_id, None)
+
     # -------------------------------------------------------------- API
     def lock(self, tx_id: int, lock_id, mode: LockMode) -> None:
         """Grant, or raise WouldBlock/DeadlockDetected."""
         with self._lock:
+            if tx_id in self._aborted:
+                self._aborted.discard(tx_id)
+                raise DeadlockDetected(
+                    f"tx {tx_id} chosen as distributed deadlock victim"
+                )
             holders = self._granted.setdefault(lock_id, {})
             held = holders.get(tx_id, set())
             if mode in held or LockMode.EXCLUSIVE in held:
@@ -112,6 +142,7 @@ class LockManager:
     def release_all(self, tx_id: int) -> None:
         with self._lock:
             self._waiting.pop(tx_id, None)
+            self._aborted.discard(tx_id)
             for lock_id in [
                 k for k, hs in self._granted.items() if tx_id in hs
             ]:

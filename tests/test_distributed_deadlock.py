@@ -1,0 +1,176 @@
+"""Distributed deadlock detection: a cross-process lock cycle must abort
+exactly one victim within the detection period.
+
+Reference: share/deadlock (the LCL detector). Harness: the tier-4
+forked-process pattern (mittest/multi_replica) — two processes, each with
+its own LockManager + DeadlockService over an authenticated TcpBus; the
+cycle is invisible to either node alone."""
+
+import multiprocessing as mp
+import socket
+import time
+
+import pytest
+
+pytestmark = pytest.mark.multidevice
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _node_main(node, ports, conn):
+    from oceanbase_tpu.log.tcp_transport import TcpBus
+    from oceanbase_tpu.share.deadlock import DEADLOCK_EP, DeadlockService
+    from oceanbase_tpu.tx.tablelock import (
+        DeadlockDetected,
+        LockManager,
+        LockMode,
+        WouldBlock,
+    )
+
+    route = {}
+    for n in range(2):
+        route[n] = ("127.0.0.1", ports[n])
+        route[DEADLOCK_EP + n] = ("127.0.0.1", ports[n])
+    bus = TcpBus(ports[node], route,
+                 local_nodes={node, DEADLOCK_EP + node},
+                 auth_token=b"dlk")
+    mgr = LockManager()
+    svc = DeadlockService(node, bus, mgr, peers=[0, 1], period=0.02)
+    bus.start()
+    svc.start()
+    try:
+        while True:
+            if not conn.poll(0.005):
+                continue
+            cmd, tx, lock_id, mode = conn.recv()
+            if cmd == "grant":
+                mgr.lock(tx, lock_id, LockMode(mode))
+                conn.send(("ok", None))
+            elif cmd == "try":
+                # one blocked attempt: registers the wait edge
+                try:
+                    mgr.lock(tx, lock_id, LockMode(mode))
+                    conn.send(("ok", None))
+                except WouldBlock:
+                    conn.send(("blocked", None))
+                except DeadlockDetected as e:
+                    conn.send(("deadlock", str(e)))
+            elif cmd == "stats":
+                conn.send(("stats", (mgr.deadlocks, svc.cycles_found)))
+            elif cmd == "stop":
+                conn.send(("bye", None))
+                return
+    finally:
+        svc.stop()
+        bus.stop()
+
+
+@pytest.fixture
+def cluster():
+    ports = _free_ports(2)
+    ctx = mp.get_context("fork")
+    procs, conns = [], []
+    for node in range(2):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_node_main, args=(node, ports, child),
+                        daemon=True)
+        p.start()
+        procs.append(p)
+        conns.append(parent)
+    yield conns
+    for c in conns:
+        try:
+            c.send(("stop", 0, 0, 0))
+            c.recv()
+        except (EOFError, OSError):
+            pass
+    for p in procs:
+        p.join(timeout=3)
+        if p.is_alive():
+            p.terminate()
+
+
+def _rpc(conn, *args):
+    conn.send(args)
+    return conn.recv()
+
+
+def test_cross_node_cycle_aborts_one_victim(cluster):
+    a, b = cluster
+    X = 2  # LockMode.EXCLUSIVE
+    # tx1 holds L1 at node A; tx2 holds L2 at node B
+    assert _rpc(a, "grant", 1, "L1", X)[0] == "ok"
+    assert _rpc(b, "grant", 2, "L2", X)[0] == "ok"
+    # cross waits: tx2 wants L1 (at A), tx1 wants L2 (at B) -> cycle
+    assert _rpc(a, "try", 2, "L1", X)[0] == "blocked"
+    assert _rpc(b, "try", 1, "L2", X)[0] == "blocked"
+
+    # within the detection period, retries must kill exactly ONE tx —
+    # deterministically the max-id one (tx2, waiting at node A)
+    deadline = time.time() + 3.0
+    verdicts = {}
+    while time.time() < deadline and "deadlock" not in verdicts.values():
+        st_a = _rpc(a, "try", 2, "L1", X)
+        st_b = _rpc(b, "try", 1, "L2", X)
+        verdicts = {"tx2@A": st_a[0], "tx1@B": st_b[0]}
+        time.sleep(0.05)
+    assert verdicts["tx2@A"] == "deadlock", verdicts
+    assert verdicts["tx1@B"] == "blocked", verdicts
+    _, (dl_a, cycles_a) = _rpc(a, "stats", 0, 0, 0)
+    assert dl_a >= 1
+    assert cycles_a >= 1
+
+
+def test_three_cycle_single_victim(cluster):
+    """A 3-tx cycle spanning both nodes kills exactly ONE tx — the max-id
+    member (probes carry the path maximum for victim arbitration)."""
+    a, b = cluster
+    X = 2
+    # cycle: tx1 -> tx3 -> tx2 -> tx1
+    # tx1 holds La@A, tx3 holds Lc@A, tx2 holds Lb@B
+    assert _rpc(a, "grant", 1, "La", X)[0] == "ok"
+    assert _rpc(a, "grant", 3, "Lc", X)[0] == "ok"
+    assert _rpc(b, "grant", 2, "Lb", X)[0] == "ok"
+    # waits: tx1 wants Lc (held by tx3, at A); tx3 wants Lb (tx2, at B);
+    # tx2 wants La (tx1, at A)
+    assert _rpc(a, "try", 1, "Lc", X)[0] == "blocked"
+    assert _rpc(b, "try", 3, "Lb", X)[0] == "blocked"
+    assert _rpc(a, "try", 2, "La", X)[0] == "blocked"
+
+    deadline = time.time() + 3.0
+    verdicts = {}
+    while time.time() < deadline and "deadlock" not in verdicts.values():
+        verdicts = {
+            "tx1": _rpc(a, "try", 1, "Lc", X)[0],
+            "tx3": _rpc(b, "try", 3, "Lb", X)[0],
+            "tx2": _rpc(a, "try", 2, "La", X)[0],
+        }
+        time.sleep(0.05)
+    # exactly tx3 (the max id) dies; the others stay blocked
+    assert verdicts["tx3"] == "deadlock", verdicts
+    assert verdicts["tx1"] == "blocked", verdicts
+    assert verdicts["tx2"] == "blocked", verdicts
+
+
+def test_no_false_positives(cluster):
+    a, b = cluster
+    X = 2
+    # plain cross-node waits WITHOUT a cycle: tx1 holds L1@A, tx2 waits;
+    # tx3 holds L2@B, tx1 waits on it — a chain, not a cycle
+    assert _rpc(a, "grant", 1, "L1", X)[0] == "ok"
+    assert _rpc(b, "grant", 3, "L2", X)[0] == "ok"
+    assert _rpc(a, "try", 2, "L1", X)[0] == "blocked"
+    assert _rpc(b, "try", 1, "L2", X)[0] == "blocked"
+    time.sleep(0.5)  # many detection periods
+    assert _rpc(a, "try", 2, "L1", X)[0] == "blocked"
+    assert _rpc(b, "try", 1, "L2", X)[0] == "blocked"
