@@ -306,33 +306,21 @@ class _OverlayCatalog:
         return f(name) if f is not None else False
 
 
-class _ChunkSourceExecutor(Executor):
-    """Executor whose streamed table reads one fixed-capacity chunk."""
-
-    chunking_enabled = False
-
-    def __init__(self, catalog, stream_table: str, chunk_rows: int, **kw):
-        super().__init__(catalog, **kw)
-        self.stream_table = stream_table
-        self.chunk_rows = chunk_rows
-        self._chunk: tuple[int, int] | None = None
+class ChunkWindowMixin:
+    """Shared chunk-window behavior of the single-chip and PX chunk
+    executors: the [start, end) slice state, the host-side slice batch,
+    and chunk-sized cardinality estimates. Subclasses provide
+    `table_batch` (the device placement differs: plain arrays vs sharded
+    device_put)."""
 
     def set_chunk(self, start: int, end: int):
         self._chunk = (start, end)
         # drop only the streamed table's cached device batch
         self.invalidate_table(self.stream_table)
 
-    def table_batch(self, name, cols):
-        # the streamed table must NOT ride the per-column device cache
-        # (each chunk is a different host slice); every read rebuilds
-        # from the current chunk window
-        if name == self.stream_table and self._chunk is not None:
-            return self._build_batch(name, cols)
-        return super().table_batch(name, cols)
-
-    def _build_batch(self, name, cols):
-        if name != self.stream_table or self._chunk is None:
-            return super()._build_batch(name, cols)
+    def _chunk_slice_batch(self, name, cols):
+        """Host ColumnBatch of the current chunk window, padded to the
+        constant chunk capacity (one XLA compile for every chunk)."""
         from ..core.column import make_batch
 
         s, e = self._chunk
@@ -363,6 +351,31 @@ class _ChunkSourceExecutor(Executor):
                     )
             return max(est, 1.0)
         return super()._est_rows(op)
+
+
+class _ChunkSourceExecutor(ChunkWindowMixin, Executor):
+    """Executor whose streamed table reads one fixed-capacity chunk."""
+
+    chunking_enabled = False
+
+    def __init__(self, catalog, stream_table: str, chunk_rows: int, **kw):
+        super().__init__(catalog, **kw)
+        self.stream_table = stream_table
+        self.chunk_rows = chunk_rows
+        self._chunk: tuple[int, int] | None = None
+
+    def table_batch(self, name, cols):
+        # the streamed table must NOT ride the per-column device cache
+        # (each chunk is a different host slice); every read rebuilds
+        # from the current chunk window
+        if name == self.stream_table and self._chunk is not None:
+            return self._chunk_slice_batch(name, cols)
+        return super().table_batch(name, cols)
+
+    def _build_batch(self, name, cols):
+        if name != self.stream_table or self._chunk is None:
+            return super()._build_batch(name, cols)
+        return self._chunk_slice_batch(name, cols)
 
 
 class ChunkedPreparedPlan:
@@ -411,9 +424,8 @@ class ChunkedPreparedPlan:
             self.above_plan = _replace_node(plan, split, merge_node)
             self.partial_schema = output_schema(split)
 
-        self.chunk_exec = _ChunkSourceExecutor(
-            executor.catalog, stream.table, chunk_rows,
-            unique_keys=executor.unique_keys, stats=executor.stats,
+        self.chunk_exec = executor.make_chunk_source(
+            stream.table, chunk_rows
         )
         self.chunk_prepared = self.chunk_exec.prepare(chunk_plan)
 

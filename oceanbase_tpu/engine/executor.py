@@ -82,6 +82,10 @@ DIRECT_GROUPBY_MAX_DOMAIN = 1 << 6
 # synthetic PhysicalParams id for the root result-compaction capacity
 ROOT_COMPACT = -1
 
+# synthetic overflow-node id space for the pack-validity guards (disjoint
+# from plan node ids and the PX exchange-lane ids, parallel/px.py)
+PACK_GUARD_BASE = 5_000_000
+
 
 def gather_payload(cols: dict, valid: dict, idx, sel=None):
     """Gather a whole batch payload by one index array via the packed
@@ -131,9 +135,17 @@ class PhysicalParams:
     groupby_size: dict[int, int] = field(default_factory=dict)
     join_cap: dict[int, int] = field(default_factory=dict)
     exchange_cap: dict[int, int] = field(default_factory=dict)
+    # stats-packed group keys: nid -> ((vmin, bits) per key). A runtime
+    # pack-validity counter rides the overflow channel (PACK_GUARD_BASE +
+    # nid); overflow disables packing for that node and recompiles.
+    pack_guard: dict[int, tuple] = field(default_factory=dict)
+    groupby_nopack: set = field(default_factory=set)
 
     def bump(self, overflows: dict[int, int]):
         for nid in overflows:
+            if nid >= PACK_GUARD_BASE:
+                self.groupby_nopack.add(nid - PACK_GUARD_BASE)
+                continue
             if nid in self.groupby_size:
                 self.groupby_size[nid] *= 4
             if nid in self.join_cap:
@@ -166,20 +178,23 @@ def _children(op: LogicalOp):
 def _row_key_operands(cols, valid, schema):
     """Whole-row lexicographic sort operands with NULLs-compare-equal
     semantics: nullable columns contribute (zeroed values, validity flag)
-    pairs. Returns (operands, spec) where spec records (name, nullable)
-    for _unpack_sorted. Shared by dedup and bag set-op kernels."""
+    pairs; int64 columns split into two int32 planes (the multi-i64
+    sort cliff, ops/sort.py). Returns (operands, spec) where spec records
+    (name, nullable, dtype, nplanes) for _unpack_sorted. Shared by dedup
+    and bag set-op kernels."""
+    from ..ops.sort import split_sort_key
+
     operands: list[jnp.ndarray] = []
-    spec: list[tuple[str, bool]] = []
+    spec: list[tuple[str, bool, object, int]] = []
     for f in schema.fields:
         c = cols[f.name]
         v = valid.get(f.name)
+        cz = jnp.where(v, c, jnp.zeros((), c.dtype)) if v is not None else c
+        planes = split_sort_key(cz)
+        operands.extend(planes)
         if v is not None:
-            operands.append(jnp.where(v, c, jnp.zeros((), c.dtype)))
             operands.append(v)
-            spec.append((f.name, True))
-        else:
-            operands.append(c)
-            spec.append((f.name, False))
+        spec.append((f.name, v is not None, c.dtype, len(planes)))
     return operands, spec
 
 
@@ -197,12 +212,17 @@ def _run_boundaries(sorted_operands):
 
 def _unpack_sorted(svals, spec):
     """Rebuild (cols, valid) dicts from sorted operands per the spec that
-    _row_key_operands produced."""
+    _row_key_operands produced (int64 columns reassemble from planes)."""
+    from ..ops.sort import rebuild_i64
+
     cols, valid = {}, {}
     i = 0
-    for name, nullable in spec:
-        cols[name] = svals[i]
-        i += 1
+    for name, nullable, dtype, nplanes in spec:
+        if nplanes == 2:
+            cols[name] = rebuild_i64(svals[i], svals[i + 1])
+        else:
+            cols[name] = svals[i].astype(dtype)
+        i += nplanes
         if nullable:
             valid[name] = svals[i]
             i += 1
@@ -444,6 +464,62 @@ class Executor:
             return l  # except
         return float(self.default_rows_estimate)
 
+    def _static_key_range(self, child: LogicalOp, e) -> tuple[int, int] | None:
+        """(vmin, bits) for a group-key expr whose value domain is known
+        statically: dictionary codes (exact domain from the dict length)
+        or stats min/max (exact at collection; 4x headroom covers drift,
+        and the runtime pack guard catches anything beyond). None = not
+        packable."""
+        name = e.name if isinstance(e, E.ColRef) else None
+        if name is None:
+            return None
+
+        def resolve(node, name):
+            if isinstance(node, Filter):
+                return resolve(node.child, name)
+            if isinstance(node, Project):
+                nxt = dict(node.exprs).get(name)
+                if not isinstance(nxt, E.ColRef):
+                    return None
+                return resolve(node.child, nxt.name)
+            if isinstance(node, JoinOp):
+                return resolve(node.left, name) or resolve(node.right, name)
+            if isinstance(node, Scan) and "." in name:
+                alias, col = name.split(".", 1)
+                if alias == node.alias:
+                    return (node.table, col)
+            return None
+
+        hit = resolve(child, name)
+        if hit is None:
+            return None
+        table, col = hit
+        try:
+            t = self.catalog[table]
+        except KeyError:
+            return None
+        d = t.dicts.get(col)
+        if d is not None:
+            dom = max(len(d), 1)
+            # append-dictionaries can grow: headroom + runtime guard
+            return 0, max((4 * dom - 1).bit_length(), 1)
+        try:
+            ct = t.schema[col]
+        except Exception:
+            return None
+        if not np.issubdtype(ct.storage_np, np.integer):
+            # float keys would TRUNCATE into the packed int domain and
+            # merge distinct groups without tripping the range guard
+            return None
+        ts = self.stats.table_stats(table) if self.stats else None
+        cs = ts.cols.get(col) if ts is not None else None
+        if cs is None or cs.ndv <= 0:
+            return None
+        span = int(cs.vmax) - int(cs.vmin) + 1
+        if span <= 0:
+            return None
+        return int(cs.vmin), max((4 * span - 1).bit_length(), 1)
+
     def seed_params(self, plan: LogicalOp) -> PhysicalParams:
         params = PhysicalParams()
         nodes = _number_nodes(plan)
@@ -459,6 +535,19 @@ class Executor:
         # the input capacity, so no table sizes (and no overflow retries)
         # are seeded for them
         for nid, op in nodes.items():
+            if isinstance(op, Aggregate) and len(op.group_keys) > 1:
+                # multi-key sort group-bys pack into ONE int64 sort key
+                # when every key's domain is statically known: wide
+                # multi-operand sorts go superlinear past ~16M rows on
+                # v5e, a packed key keeps the canonical fast sort shape
+                ranges = [
+                    self._static_key_range(op.child, e)
+                    for _n, e in op.group_keys
+                ]
+                if all(r is not None for r in ranges) and sum(
+                    b for _v, b in ranges
+                ) <= 62:
+                    params.pack_guard[nid] = tuple(ranges)
             if isinstance(op, JoinOp):
                 needs_cap = (
                     (op.kind in ("inner", "cross")
@@ -681,6 +770,11 @@ class Executor:
 
         overflow_nodes: list[int] = sorted(
             set(params.groupby_size) | set(params.join_cap)
+            | {
+                PACK_GUARD_BASE + nid
+                for nid in params.pack_guard
+                if nid not in params.groupby_nopack
+            }
         )
 
         def emit(op, inputs) -> tuple[ColumnBatch, dict[int, jnp.ndarray]]:
@@ -1702,12 +1796,45 @@ class Executor:
             sel = slot_used
         elif op.group_keys:
             # sort-based group-by: no hash table, no scatter, no capacity
-            skeys, sel, agg_cols, order = sort_groupby(
-                key_vals, child.sel, agg_ops, agg_vals, agg_masks
+            pack_spec = (
+                params.pack_guard.get(nid)
+                if nid not in params.groupby_nopack else None
             )
-            cols = {}
-            for (name, _e), kv in zip(op.group_keys, skeys):
-                cols[name] = kv
+            if pack_spec is not None:
+                # pack all keys into ONE int64 sort key (static bits from
+                # stats/dict domains); a validity counter rides the
+                # overflow channel — domain drift disables packing and
+                # recompiles rather than mis-grouping
+                pk = jnp.zeros(child.capacity, dtype=jnp.int64)
+                invalid = jnp.zeros(child.capacity, dtype=jnp.bool_)
+                for v, (vmin, bits) in zip(key_vals, pack_spec):
+                    off = v.astype(jnp.int64) - vmin
+                    invalid = invalid | (off < 0) | (off >= (1 << bits))
+                    pk = (pk << bits) | jnp.clip(off, 0, (1 << bits) - 1)
+                ovf = dict(ovf)
+                ovf[PACK_GUARD_BASE + nid] = jnp.sum(
+                    invalid & child.sel, dtype=jnp.int64
+                )
+                skeys_p, sel, agg_cols, order = sort_groupby(
+                    [pk], child.sel, agg_ops, agg_vals, agg_masks
+                )
+                # decode the original key columns from the packed bits
+                cols = {}
+                shift = 0
+                for (name, _e), v, (vmin, bits) in zip(
+                    reversed(op.group_keys), reversed(key_vals),
+                    reversed(pack_spec),
+                ):
+                    part = (skeys_p[0] >> shift) & ((1 << bits) - 1)
+                    cols[name] = (part + vmin).astype(v.dtype)
+                    shift += bits
+            else:
+                skeys, sel, agg_cols, order = sort_groupby(
+                    key_vals, child.sel, agg_ops, agg_vals, agg_masks
+                )
+                cols = {}
+                for (name, _e), kv in zip(op.group_keys, skeys):
+                    cols[name] = kv
             for (name, _, _, _), av in zip(op.aggs, agg_cols):
                 cols[name] = av
         else:
@@ -1741,6 +1868,16 @@ class Executor:
         return out, ovf
 
     # ---- execution ------------------------------------------------------
+    def make_chunk_source(self, stream_table: str, chunk_rows: int):
+        """Chunk-program executor for out-of-core streaming (overridden by
+        the PX layer so each chunk dispatches as one shard_map program)."""
+        from .chunked import _ChunkSourceExecutor
+
+        return _ChunkSourceExecutor(
+            self.catalog, stream_table, chunk_rows,
+            unique_keys=self.unique_keys, stats=self.stats,
+        )
+
     def prepare(self, plan: LogicalOp):
         """Compile once; the returned PreparedPlan caches the XLA executable
         (the expensive artifact — this is what the plan cache stores).

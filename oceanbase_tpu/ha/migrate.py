@@ -1,0 +1,187 @@
+"""Live replica migration + load balancing.
+
+Reference surface: storage/high_availability — ObLSMigrationHandler
+(ob_ls_migration_handler.h:88) moves a healthy replica between servers
+(snapshot copy + log catch-up + member-list change) while the group keeps
+serving; src/rootserver/balance drives such moves when servers join or
+load skews.
+
+Rebuild shape (reusing the rebuild machinery in ha/rebuild.py):
+
+  1. cut a storage snapshot from the group's READY leader;
+  2. start the destination replica seeded at the snapshot LSN;
+  3. leader logs ADD(dst) (palf single-member config change) — ordinary
+     replication back-fills dst from the snapshot point;
+  4. once dst is caught up, leader logs REMOVE(src); the source replica
+     is detached and its node forgets the LS.
+
+The group serves reads/writes throughout: quorum during the 4-member
+window is 3, and the leader never moves (a leader migration first
+transfers leadership away).
+
+`balance_cluster` is the rootserver balance loop: after add_node(), it
+migrates replicas from the most- to the least-loaded nodes until replica
+counts are level (the reference's ob_balance_group_ls_stat / LS balance)."""
+
+from __future__ import annotations
+
+from .rebuild import RebuildError, snapshot_source
+
+
+class MigrateError(Exception):
+    pass
+
+
+def migrate_replica(cluster, ls_id: int, src_node: int, dst_node: int,
+                    max_time: float = 30.0):
+    """Move the (ls, src_node) replica to dst_node while serving."""
+    from ..log.palf import LogView, PalfReplica
+    from ..tx.ls import LSReplica
+
+    group = cluster.ls_groups[ls_id]
+    if dst_node in group:
+        raise MigrateError(f"ls {ls_id} already has a replica on {dst_node}")
+    if src_node not in group:
+        raise MigrateError(f"ls {ls_id} has no replica on {src_node}")
+    src = group[src_node]
+    addr_src = src.palf.node_id
+    base = addr_src - src_node  # group addressing: base + node id
+    addr_dst = base + dst_node
+
+    # the leader must survive the move: shift leadership off src first
+    if cluster.leader_node(ls_id) == src_node:
+        other = next(n for n in group if n != src_node)
+        cluster.transfer_leader(ls_id, other)
+
+    def ready_leader():
+        return next((r for r in group.values() if r.is_ready), None)
+
+    cluster.leader_node(ls_id)
+    leader = ready_leader()
+
+    # 1) snapshot (retry while the leader has in-flight staged txs)
+    state = None
+    def try_snap():
+        nonlocal state
+        try:
+            state = snapshot_source(leader)
+            return True
+        except RebuildError:
+            return False
+    if not cluster.drive_until(try_snap, max_time=max_time):
+        raise MigrateError(f"ls {ls_id}: leader never quiesced for snapshot")
+    covered = state["applied_lsn"]
+    if covered >= leader.palf.log.base:
+        prev_term = leader.palf.log[covered].term
+    else:
+        prev_term = leader.palf.log.base_prev_term
+
+    # 2) destination replica seeded at the snapshot point
+    store = None
+    if cluster.data_dir is not None:
+        import os
+        import shutil
+
+        from ..log.store import LogStore
+
+        root = os.path.join(cluster.data_dir, f"n{dst_node}", f"ls_{ls_id}")
+        shutil.rmtree(root, ignore_errors=True)
+        store = LogStore(root, fsync=cluster.fsync)
+        store.set_base_info(covered, prev_term)
+    palf = PalfReplica(
+        addr_dst, list(leader.palf.peers) + [addr_dst], cluster.bus,
+        store=store,
+    )
+    palf.log = LogView(covered + 1, [], prev_term)
+    palf.commit_lsn = covered
+    palf.applied_lsn = covered
+    rep = LSReplica(ls_id, dst_node, palf)
+    rep.tablets = state["tablets"]
+    rep.tx_table = dict(state["tx_table"])
+    rep._pending_redo = dict(state["pending_redo"])
+    rep.on_record = src.on_record
+    group[dst_node] = rep
+    svc = cluster.services.get(dst_node)
+    if svc is not None:
+        svc.replicas[ls_id] = rep
+        # the dst node's TransService must learn about applies on its new
+        # replica (tx completion acks); src's chained callback stays as
+        # prev so tenant observers keep firing (foreign tx ids are
+        # ignored by the src service's lookup)
+        rep.on_tx_applied = svc._make_applied_cb(ls_id, src.on_tx_applied)
+    else:
+        rep.on_tx_applied = src.on_tx_applied
+
+    # 3) ADD(dst), drive to commit + dst catch-up
+    add_lsn = leader.palf.submit_config(
+        list(leader.palf.peers) + [addr_dst])
+    if add_lsn is None:
+        raise MigrateError("leader lost leadership during ADD")
+    ok = cluster.drive_until(
+        lambda: rep.palf.commit_lsn >= add_lsn
+        and rep.palf.applied_lsn >= add_lsn,
+        max_time=max_time,
+    )
+    if not ok:
+        raise MigrateError(f"ls {ls_id}: dst never caught up past ADD")
+
+    # 4) REMOVE(src), detach. The lease can lapse between steps: drive
+    # until a ready leader exists again (and retry the submit on it)
+    rm_holder: list = [None]
+
+    def try_remove():
+        lead = ready_leader()
+        if lead is None:
+            return False
+        lsn = lead.palf.submit_config(
+            [p for p in lead.palf.peers if p != addr_src])
+        if lsn is None:
+            return False
+        rm_holder[0] = (lead, lsn)
+        return True
+
+    if not cluster.drive_until(try_remove, max_time=max_time):
+        raise MigrateError("no ready leader to log REMOVE")
+    leader2, rm_lsn = rm_holder[0]
+    ok = cluster.drive_until(
+        lambda: leader2.palf.commit_lsn >= rm_lsn, max_time=max_time
+    )
+    if not ok:
+        raise MigrateError(f"ls {ls_id}: REMOVE never committed")
+    cluster.bus.kill(addr_src)  # the retired address goes dark
+    del group[src_node]
+    src_svc = cluster.services.get(src_node)
+    if src_svc is not None:
+        src_svc.replicas.pop(ls_id, None)
+    return rep
+
+
+def replica_counts(cluster) -> dict[int, int]:
+    counts = {n: 0 for n in cluster.services}
+    for g in cluster.ls_groups.values():
+        for n in g:
+            counts[n] = counts.get(n, 0) + 1
+    return counts
+
+
+def balance_cluster(cluster, max_moves: int = 64) -> int:
+    """Migrate replicas from the most- to the least-loaded nodes until
+    per-node replica counts are level (spread <= 1). Returns moves made."""
+    moves = 0
+    while moves < max_moves:
+        counts = replica_counts(cluster)
+        hi = max(counts, key=lambda n: counts[n])
+        lo = min(counts, key=lambda n: counts[n])
+        if counts[hi] - counts[lo] <= 1:
+            return moves
+        # an LS hosted on hi but not on lo
+        ls_id = next(
+            (ls for ls, g in cluster.ls_groups.items()
+             if hi in g and lo not in g),
+            None,
+        )
+        if ls_id is None:
+            return moves
+        migrate_replica(cluster, ls_id, hi, lo)
+        moves += 1
+    return moves

@@ -150,6 +150,23 @@ LEASE_TIMEOUT = 0.25
 ELECTION_JITTER = 0.05
 MAX_INFLIGHT = 1024  # sliding-window cap (entries per follower burst)
 
+# membership-change log entries (LogConfigMgr analog): the payload is a
+# reserved marker + the new member list; replicas adopt the config when
+# the entry is APPENDED (Raft's rule), and the apply path never surfaces
+# these to the state machine
+CONFIG_PREFIX = b"\x00\x00CFG1:"
+
+
+def _encode_config(peers: list[int]) -> bytes:
+    return CONFIG_PREFIX + ",".join(str(p) for p in sorted(peers)).encode()
+
+
+def _decode_config(payload: bytes) -> list[int] | None:
+    if not payload.startswith(CONFIG_PREFIX):
+        return None
+    body = payload[len(CONFIG_PREFIX):]
+    return [int(x) for x in body.split(b",") if x]
+
 
 @dataclass
 class PalfReplica:
@@ -182,6 +199,9 @@ class PalfReplica:
     _last_ack: dict[int, float] = field(default_factory=dict)
 
     def __post_init__(self):
+        # constructor-provided membership = the config floor a truncation
+        # can fall back to when every in-log config entry is cut away
+        self._base_config = list(self.peers)
         if self.store is not None:
             entries, base, term, voted_for = self.store.load()
             if entries or term:
@@ -192,6 +212,12 @@ class PalfReplica:
                 self.voted_for = voted_for
                 if entries:
                     self._scn = entries[-1].scn
+                # re-adopt the newest membership recorded in the log
+                for e in reversed(entries):
+                    cfg = _decode_config(e.payload)
+                    if cfg is not None:
+                        self.peers = list(cfg)
+                        break
         self.bus.register(self.node_id, self._on_message)
         self.next_election_at = (
             self.bus.now + LEASE_TIMEOUT + self._jitter()
@@ -308,6 +334,46 @@ class PalfReplica:
         if len(self.peers) == 1:
             self._become_leader()
 
+    def submit_config(self, new_peers: list[int]) -> int | None:
+        """Single-member-change membership update (LogConfigMgr analog):
+        the leader logs the new member list and adopts it immediately
+        (Raft: a config is effective once appended); followers adopt on
+        append. Safe for one add OR one remove at a time — the migration
+        path drives each change to commit before the next."""
+        if self.role is not Role.LEADER:
+            return None
+        cur, new = set(self.peers), set(new_peers)
+        if len(cur.symmetric_difference(new)) > 1:
+            raise ValueError("one membership change at a time")
+        lsn = self.submit_log(_encode_config(list(new_peers)))
+        if lsn is not None:
+            self._adopt_config(list(new_peers))
+        return lsn
+
+    def _readopt_config_from_log(self) -> None:
+        """Adopt the newest config still in the log, else the base config
+        the replica was constructed with (post-truncation recovery)."""
+        for e in reversed(self.log.entries):
+            cfg = _decode_config(e.payload)
+            if cfg is not None:
+                self._adopt_config(cfg)
+                return
+        self._adopt_config(list(self._base_config))
+
+    def _adopt_config(self, new_peers: list[int]) -> None:
+        self.peers = list(new_peers)
+        if self.role is Role.LEADER:
+            nxt = len(self.log)
+            for p in self.peers:
+                if p != self.node_id:
+                    self._next_lsn.setdefault(p, nxt)
+                    self._match_lsn.setdefault(p, -1)
+                    self._last_ack.setdefault(p, self.bus.now)
+            for m in (self._next_lsn, self._match_lsn, self._last_ack):
+                for p in list(m):
+                    if p not in self.peers:
+                        del m[p]
+
     def transfer_leader(self, target: int) -> bool:
         """Hand leadership to `target` (must be caught up). Returns False if
         not leader or target is behind — caller keeps driving and retries."""
@@ -382,8 +448,13 @@ class PalfReplica:
     def _apply(self) -> None:
         while self.applied_lsn < self.commit_lsn:
             self.applied_lsn += 1
+            e = self.log[self.applied_lsn]
+            # membership entries are consensus-internal: never surfaced
+            # to the state machine
+            if e.payload.startswith(CONFIG_PREFIX):
+                continue
             if self.on_commit is not None:
-                self.on_commit(self.log[self.applied_lsn])
+                self.on_commit(e)
 
     # ------------------------------------------------------ msg handling
     def _on_message(self, src: int, msg: Any) -> None:
@@ -422,9 +493,17 @@ class PalfReplica:
                         raise AssertionError(
                             f"node {self.node_id}: conflicting entry at committed lsn {e.lsn}"
                         )
+                    had_config = any(
+                        en.payload.startswith(CONFIG_PREFIX)
+                        for en in self.log.entries[e.lsn - self.log.base:]
+                    )
                     del self.log[e.lsn :]
                     if self.store is not None:
                         self.store.truncate_from(e.lsn)
+                    if had_config:
+                        # an adopted-but-uncommitted membership was cut:
+                        # fall back to the newest surviving config
+                        self._readopt_config_from_log()
                     appended = [a for a in appended if a.lsn < e.lsn]
                     self.log.append(e)
                     appended.append(e)
@@ -434,6 +513,12 @@ class PalfReplica:
                 appended.append(e)
         if appended:
             self._persist_append(appended)
+            # adopt any membership change in the appended suffix (config
+            # is effective at append; the newest one wins)
+            for e in appended:
+                cfg = _decode_config(e.payload)
+                if cfg is not None:
+                    self._adopt_config(cfg)
         self._persist_sync()  # durable BEFORE the ack joins a commit quorum
         new_commit = min(m.commit_lsn, len(self.log) - 1)
         if new_commit > self.commit_lsn:

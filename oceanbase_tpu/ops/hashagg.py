@@ -248,13 +248,14 @@ def distinct_first_mask(
     usual scatter-free recipe: one combined sort with the row index as the
     trailing operand, run-boundary detection, and an argsort-based inverse
     permutation to map the per-run winner bit back."""
+    from .sort import split_sort_key
+
     n = mask.shape[0]
     dead = (~mask).astype(jnp.int32)
-    ops = (
-        (dead,)
-        + tuple(key_vals)
-        + (val, jnp.arange(n, dtype=jnp.int32))
-    )
+    planes: list[jnp.ndarray] = [dead]
+    for k in (*key_vals, val):
+        planes.extend(split_sort_key(k))
+    ops = tuple(planes) + (jnp.arange(n, dtype=jnp.int32),)
     sorted_ = jax.lax.sort(ops, num_keys=len(ops) - 1)
     sdead = sorted_[0]
     sidx = sorted_[-1]
@@ -288,16 +289,34 @@ def sort_groupby(
     agg_masks[i] (optional) restricts which rows feed aggregate i (SQL
     null-skipping); rows outside `mask` never contribute.
     """
+    from .sort import rebuild_i64, split_sort_key
     from .window import peer_ends, segmented_cumsum, segmented_scan_minmax
 
     n = key_cols[0].shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    operands = (~mask,) + tuple(key_cols) + (idx,)
-    sorted_ = jax.lax.sort(operands, num_keys=1 + len(key_cols))
+    # int64 keys split into i32 planes: multi-i64-operand sorts hit a
+    # superlinear cliff past ~16M rows on v5e (see ops/sort.py)
+    planes: list[jnp.ndarray] = []
+    plane_spec: list[tuple[object, int]] = []  # (orig dtype, nplanes)
+    for k in key_cols:
+        p = split_sort_key(k)
+        plane_spec.append((k.dtype, len(p)))
+        planes.extend(p)
+    operands = (~mask,) + tuple(planes) + (idx,)
+    sorted_ = jax.lax.sort(operands, num_keys=1 + len(planes))
     sdead = sorted_[0]
-    skeys = list(sorted_[1:-1])
+    sp = list(sorted_[1:-1])
     order = sorted_[-1]
     ssel = ~sdead
+    # reconstruct the sorted key columns from their planes
+    skeys: list[jnp.ndarray] = []
+    i = 0
+    for dtype, np_ in plane_spec:
+        if np_ == 2:
+            skeys.append(rebuild_i64(sp[i], sp[i + 1]))
+        else:
+            skeys.append(sp[i].astype(dtype))
+        i += np_
 
     new_seg = jnp.zeros(n, jnp.bool_).at[0].set(True)
     for k in skeys:
@@ -313,15 +332,30 @@ def sort_groupby(
     seg_start = jax.lax.cummax(jnp.where(new_seg, pos, 0))
     seg_end = peer_ends(new_seg)
 
-    aggs_out: list[jnp.ndarray] = []
-    for i, (op, v) in enumerate(zip(agg_ops, agg_values)):
+    # ONE packed row-gather brings every agg value/mask into sorted order
+    # (per-agg element gathers at int64 cost ~42M/s each; the packed form
+    # moves all of them at ~175M rows/s — ops/gather.py)
+    from .gather import gather_rows
+
+    to_sort: dict = {}
+    for i, (v, op) in enumerate(zip(agg_values, agg_ops)):
+        if v is not None:
+            to_sort[("v", i)] = v
         am = agg_masks[i] if agg_masks is not None else None
-        vm = ssel if am is None else (ssel & am[order])
+        if am is not None:
+            to_sort[("m", i)] = am
+    sorted_in = gather_rows(to_sort, order) if to_sort else {}
+
+    # accumulate every per-agg running array, then ONE packed gather at
+    # the segment ends materializes all the results together
+    running: dict = {}
+    for i, (op, v) in enumerate(zip(agg_ops, agg_values)):
+        am_s = sorted_in.get(("m", i))
+        vm = ssel if am_s is None else (ssel & am_s)
         if op == "count":
-            cnt = segmented_cumsum(vm.astype(jnp.int64), seg_start)
-            aggs_out.append(cnt[seg_end])
+            running[i] = segmented_cumsum(vm.astype(jnp.int64), seg_start)
             continue
-        sv = v[order]
+        sv = sorted_in[("v", i)]
         if op == "sum":
             acc = (
                 jnp.int64
@@ -329,7 +363,7 @@ def sort_groupby(
                 else sv.dtype
             )
             mv = jnp.where(vm, sv.astype(acc), 0)
-            aggs_out.append(segmented_cumsum(mv, seg_start)[seg_end])
+            running[i] = segmented_cumsum(mv, seg_start)
         elif op in ("min", "max"):
             is_min = op == "min"
             ident = (
@@ -338,11 +372,11 @@ def sort_groupby(
                 else (jnp.inf if is_min else -jnp.inf)
             )
             mv = jnp.where(vm, sv, ident)
-            aggs_out.append(
-                segmented_scan_minmax(mv, new_seg, is_min)[seg_end]
-            )
+            running[i] = segmented_scan_minmax(mv, new_seg, is_min)
         else:
             raise NotImplementedError(op)
+    ends = gather_rows(running, seg_end) if running else {}
+    aggs_out = [ends[i] for i in range(len(agg_ops))]
     sel = new_seg & ssel
     return skeys, sel, aggs_out, order
 

@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.column import ColumnBatch
 from ..core.dtypes import Schema
+from ..engine.chunked import ChunkWindowMixin
 from ..engine.executor import (
     DIRECT_GROUPBY_MAX_DOMAIN,
     Executor,
@@ -119,9 +120,24 @@ class PxAdmission:
 class PxExecutor(Executor):
     """Compiles logical plans into shard_map SPMD programs over a mesh."""
 
-    # chunked (out-of-core) streaming composes with PX via PxChunked (TODO);
-    # the single-chip chunker must not capture a shard_map executor
-    chunking_enabled = False
+    # out-of-core streaming composes with PX: each chunk of the streamed
+    # table dispatches as one shard_map program over the mesh; partials
+    # merge on the (small) single-chip merge plan exactly as single-chip
+    chunking_enabled = True
+
+    def make_chunk_source(self, stream_table: str, chunk_rows: int):
+        # per-shard granularity: the chunk capacity must shard evenly
+        unit = 1024 * self.nsh
+        rows = -(-chunk_rows // unit) * unit
+        return _PxChunkSourceExecutor(
+            self.catalog, stream_table, rows, mesh=self.mesh,
+            unique_keys=self.unique_keys, stats=self.stats,
+            default_rows_estimate=self.default_rows_estimate,
+            broadcast_threshold=self.broadcast_threshold,
+            join_bloom=self.join_bloom,
+            bloom_max_bits=self.bloom_max_bits,
+            hybrid_hash=self.hybrid_hash,
+        )
 
     def _affine_build_info(self, op):
         # inside shard_map every batch is a per-shard SLICE (and hash
@@ -134,7 +150,8 @@ class PxExecutor(Executor):
                  broadcast_threshold: int = 1 << 16,
                  join_bloom: bool = True,
                  bloom_max_bits: int = 1 << 20,
-                 hybrid_hash: "bool | str" = "auto", stats=None):
+                 hybrid_hash: "bool | str" = "auto", stats=None,
+                 device_budget=None, chunk_rows=None):
         if stats is None:
             # histogram-backed cardinalities drive the exchange-method
             # choice (broadcast-vs-hash cost, skew-triggered hybrid hash)
@@ -143,7 +160,8 @@ class PxExecutor(Executor):
             stats = StatsManager(catalog)
         super().__init__(catalog, unique_keys=unique_keys,
                          default_rows_estimate=default_rows_estimate,
-                         stats=stats)
+                         stats=stats, device_budget=device_budget,
+                         chunk_rows=chunk_rows)
         self.mesh = mesh
         self.nsh = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         self.broadcast_threshold = broadcast_threshold
@@ -843,9 +861,16 @@ class PxExecutor(Executor):
                 {c: d for c, d in t.dicts.items() if c in cols},
             )
 
+        from ..engine.executor import PACK_GUARD_BASE
+
         overflow_nodes = sorted(
             set(params.groupby_size) | set(params.join_cap)
             | set(params.exchange_cap)
+            | {
+                PACK_GUARD_BASE + nid
+                for nid in params.pack_guard
+                if nid not in params.groupby_nopack
+            }
         )
 
         def emit(op, inputs):
@@ -910,6 +935,33 @@ class PxExecutor(Executor):
             )(raw_inputs, qparams)
 
         return jax.jit(run), input_spec, overflow_nodes
+
+
+class _PxChunkSourceExecutor(ChunkWindowMixin, PxExecutor):
+    """PxExecutor whose streamed table reads one fixed-capacity chunk —
+    every chunk of the out-of-core loop is one shard_map dispatch over
+    the mesh (engine/chunked.py drives it exactly like the single-chip
+    chunk executor; the slice/estimate logic lives in ChunkWindowMixin)."""
+
+    chunking_enabled = False
+
+    def __init__(self, catalog, stream_table: str, chunk_rows: int,
+                 mesh=None, **kw):
+        super().__init__(catalog, mesh, **kw)
+        self.stream_table = stream_table
+        self.chunk_rows = chunk_rows
+        self._chunk: tuple[int, int] | None = None
+
+    def table_batch(self, name: str, cols: tuple[str, ...]):
+        if name != self.stream_table or self._chunk is None:
+            return super().table_batch(name, cols)
+        b = self._chunk_slice_batch(name, cols)
+        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return {
+            "cols": {n: jax.device_put(a, shard) for n, a in b.cols.items()},
+            "valid": {n: jax.device_put(a, shard) for n, a in b.valid.items()},
+            "sel": jax.device_put(b.sel, shard),
+        }
 
 
 def _override(emit, node, result):

@@ -2,24 +2,32 @@
 
 Reference surface: the MySQL command layer — connection handshake and
 COM_QUERY dispatch (src/observer/mysql/obmp_query.cpp:53, obmp_connect),
+prepared statements (obmp_stmt_prepare.cpp / obmp_stmt_execute.cpp),
 packet codecs (deps/oblib/src/rpc/obmysql). The rebuild speaks classic
-protocol v10 / CLIENT_PROTOCOL_41 with the text resultset encoding:
+protocol v10 / CLIENT_PROTOCOL_41:
 
-  greeting -> login (any credentials accepted) -> OK
-  COM_QUERY    -> resultset (column defs, EOF, text rows, EOF)
-                  or OK (DML/DDL with affected-rows) or ERR
-  COM_PING     -> OK,  COM_INIT_DB -> OK,  COM_QUIT -> close
+  greeting -> login (mysql_native_password verified against the user
+  table) -> OK
+  COM_QUERY         -> text resultset (typed column defs, EOF, rows, EOF)
+                       or OK (DML/DDL with affected-rows) or ERR
+  COM_STMT_PREPARE  -> stmt id + param count ('?' placeholders)
+  COM_STMT_EXECUTE  -> binary resultset (typed rows, NULL bitmap); bound
+                       parameters substitute as literals and ride the
+                       plan cache's parameterization, so re-executions
+                       reuse the compiled XLA artifact
+  COM_STMT_CLOSE / COM_PING / COM_INIT_DB / COM_QUIT
 
 Each connection binds one DbSession (transactions span statements on the
-same connection, like a real server thread). Values travel as text; NULL
-is the 0xFB marker — the lowest common denominator every client and
-driver understands.
+same connection, like a real server thread). Column defs carry real
+types (LONGLONG / DOUBLE / VAR_STRING) derived from the result arrays.
 """
 
 from __future__ import annotations
 
+import hashlib
 import socket
 import socketserver
+import struct
 import threading
 
 import numpy as np
@@ -30,7 +38,19 @@ CLIENT_PROTOCOL_41 = 0x0200
 CLIENT_CONNECT_WITH_DB = 0x0008
 CLIENT_SECURE_CONNECTION = 0x8000
 
+MYSQL_TYPE_LONGLONG = 8
+MYSQL_TYPE_DOUBLE = 5
 MYSQL_TYPE_VAR_STRING = 253
+
+
+def native_password_scramble(password: str, salt: bytes) -> bytes:
+    """mysql_native_password: SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
 
 
 def _lenenc_int(n: int) -> bytes:
@@ -95,16 +115,27 @@ def _err_packet(code: int, msg: str) -> bytes:
     )
 
 
-def _coldef(name: str) -> bytes:
+def _coldef(name: str, mysql_type: int = MYSQL_TYPE_VAR_STRING) -> bytes:
     return (
         _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
         + _lenenc_str(b"") + _lenenc_str(name.encode())
         + _lenenc_str(name.encode())
         + b"\x0c" + (33).to_bytes(2, "little")  # utf8
         + (255).to_bytes(4, "little")
-        + bytes([MYSQL_TYPE_VAR_STRING])
+        + bytes([mysql_type])
         + (0).to_bytes(2, "little") + b"\x00" + b"\x00\x00"
     )
+
+
+def _col_mysql_type(col) -> int:
+    """Real wire type from the host result array (the typed-resultset
+    surface obmp_query builds from ObField types)."""
+    a = np.asarray(col)
+    if np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+        return MYSQL_TYPE_LONGLONG
+    if np.issubdtype(a.dtype, np.floating):
+        return MYSQL_TYPE_DOUBLE
+    return MYSQL_TYPE_VAR_STRING
 
 
 def _cell(v) -> bytes:
@@ -120,10 +151,16 @@ def _cell(v) -> bytes:
 
 
 class MySqlFrontend:
-    """TCP listener translating MySQL protocol to DbSessions."""
+    """TCP listener translating MySQL protocol to DbSessions.
 
-    def __init__(self, db: Database, host: str = "127.0.0.1", port: int = 0):
+    `users` maps user name -> password; None (default) keeps the open
+    door for in-process tests. With users set, logins verify the
+    mysql_native_password scramble against the salt."""
+
+    def __init__(self, db: Database, host: str = "127.0.0.1", port: int = 0,
+                 users: dict[str, str] | None = None):
         self.db = db
+        self.users = users
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -152,9 +189,17 @@ class MySqlFrontend:
     def _serve(self, sock: socket.socket) -> None:
         conn = _Conn(sock)
         sess = self.db.session()
+        # id -> [pieces, nparams, last-bound param types] (drivers send
+        # types only on the FIRST execute; new_params_bound=0 reuses them)
+        stmts: dict[int, list] = {}
+        next_stmt = [1]
         try:
-            self._greet(conn)
-            conn.read_packet()  # login request: all credentials accepted
+            salt = self._greet(conn)
+            login = conn.read_packet()
+            if not self._check_login(login, salt):
+                conn.send_packet(
+                    _err_packet(1045, "Access denied (bad credentials)"))
+                return
             conn.send_packet(_ok_packet())
             while True:
                 conn.reset_seq()
@@ -170,6 +215,17 @@ class MySqlFrontend:
                 if cmd == 0x03:  # COM_QUERY
                     self._query(conn, sess, pkt[1:].decode())
                     continue
+                if cmd == 0x16:  # COM_STMT_PREPARE
+                    self._stmt_prepare(conn, pkt[1:].decode(), stmts,
+                                       next_stmt)
+                    continue
+                if cmd == 0x17:  # COM_STMT_EXECUTE
+                    self._stmt_execute(conn, sess, pkt, stmts)
+                    continue
+                if cmd == 0x19:  # COM_STMT_CLOSE (no response)
+                    if len(pkt) >= 5:
+                        stmts.pop(int.from_bytes(pkt[1:5], "little"), None)
+                    continue
                 conn.send_packet(_err_packet(1047, "unsupported command"))
         except (ConnectionError, OSError):
             pass
@@ -179,12 +235,36 @@ class MySqlFrontend:
             except OSError:
                 pass
 
-    def _greet(self, conn: _Conn) -> None:
+    def _check_login(self, login: bytes, salt: bytes) -> bool:
+        if self.users is None:
+            return True  # open door (in-process harness mode)
+        try:
+            # HandshakeResponse41: caps u32, max packet u32, charset u8,
+            # 23 reserved, user\0, lenenc auth response
+            off = 4 + 4 + 1 + 23
+            end = login.index(b"\x00", off)
+            user = login[off:end].decode()
+            off = end + 1
+            alen = login[off]
+            off += 1
+            auth = login[off:off + alen]
+        except (ValueError, IndexError):
+            return False
+        if user not in self.users:
+            return False
+        want = native_password_scramble(self.users[user], salt)
+        return auth == want
+
+    def _greet(self, conn: _Conn) -> bytes:
         caps = (
             CLIENT_PROTOCOL_41 | CLIENT_CONNECT_WITH_DB
             | CLIENT_SECURE_CONNECTION
         )
-        salt = b"0123456789abcdefghij"
+        import os
+
+        salt = bytes(
+            (b % 94) + 33 for b in os.urandom(20)  # printable, no NULs
+        )
         payload = (
             b"\x0a" + b"5.7.0-oceanbase-tpu\x00"
             + (1).to_bytes(4, "little")
@@ -199,6 +279,7 @@ class MySqlFrontend:
             + b"mysql_native_password\x00"
         )
         conn.send_packet(payload)
+        return salt
 
     def _query(self, conn: _Conn, sess, sql: str) -> None:
         try:
@@ -209,11 +290,203 @@ class MySqlFrontend:
         if not rs.names:
             conn.send_packet(_ok_packet(affected=rs.affected))
             return
-        conn.send_packet(_lenenc_int(len(rs.names)))
-        for n in rs.names:
-            conn.send_packet(_coldef(n))
-        conn.send_packet(_eof_packet())
         cols = [rs.columns[n] for n in rs.names]
+        conn.send_packet(_lenenc_int(len(rs.names)))
+        for n, c in zip(rs.names, cols):
+            conn.send_packet(_coldef(n, _col_mysql_type(c)))
+        conn.send_packet(_eof_packet())
         for i in range(rs.nrows):
             conn.send_packet(b"".join(_cell(c[i]) for c in cols))
+        conn.send_packet(_eof_packet())
+
+    # ------------------------------------------------- prepared statements
+    @staticmethod
+    def _split_placeholders(sql: str) -> list[str]:
+        """SQL split at '?' placeholders outside string literals."""
+        pieces, cur, in_str = [], [], False
+        i = 0
+        while i < len(sql):
+            ch = sql[i]
+            if in_str:
+                cur.append(ch)
+                if ch == "'":
+                    # '' escape stays inside the literal
+                    if i + 1 < len(sql) and sql[i + 1] == "'":
+                        cur.append("'")
+                        i += 1
+                    else:
+                        in_str = False
+            elif ch == "'":
+                in_str = True
+                cur.append(ch)
+            elif ch == "?":
+                pieces.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+            i += 1
+        pieces.append("".join(cur))
+        return pieces
+
+    def _stmt_prepare(self, conn: _Conn, sql: str, stmts, next_stmt) -> None:
+        pieces = self._split_placeholders(sql)
+        nparams = len(pieces) - 1
+        sid = next_stmt[0]
+        next_stmt[0] += 1
+        stmts[sid] = [pieces, nparams, None]
+        # COM_STMT_PREPARE_OK: status, stmt id, 0 columns (deferred to
+        # execute), param count, filler, warnings
+        conn.send_packet(
+            b"\x00" + sid.to_bytes(4, "little")
+            + (0).to_bytes(2, "little")
+            + nparams.to_bytes(2, "little")
+            + b"\x00" + (0).to_bytes(2, "little")
+        )
+        for _ in range(nparams):
+            conn.send_packet(_coldef("?", MYSQL_TYPE_VAR_STRING))
+        if nparams:
+            conn.send_packet(_eof_packet())
+
+    @staticmethod
+    def _decode_params(pkt: bytes, nparams: int,
+                       prev_types: list[int] | None) -> tuple[list, list[int]]:
+        """Binary parameter block of COM_STMT_EXECUTE. Returns
+        (values, types); `prev_types` supplies the types when the driver
+        sets new_params_bound_flag=0 (every re-execution)."""
+        if nparams == 0:
+            # bitmap/flag/types are OMITTED entirely for param-less stmts
+            return [], []
+        off = 1 + 4 + 1 + 4  # cmd, stmt id, flags, iteration count
+        nb = (nparams + 7) // 8
+        null_bitmap = pkt[off:off + nb]
+        off += nb
+        new_bound = pkt[off]
+        off += 1
+        types: list[int] = []
+        if new_bound:
+            for _ in range(nparams):
+                types.append(pkt[off] | (pkt[off + 1] << 8))
+                off += 2
+        elif prev_types is not None:
+            types = prev_types
+        else:
+            types = [MYSQL_TYPE_VAR_STRING] * nparams
+
+        def lenenc():
+            nonlocal off
+            b0 = pkt[off]
+            off += 1
+            if b0 < 251:
+                n = b0
+            elif b0 == 0xFC:
+                n = int.from_bytes(pkt[off:off + 2], "little")
+                off += 2
+            elif b0 == 0xFD:
+                n = int.from_bytes(pkt[off:off + 3], "little")
+                off += 3
+            else:
+                n = int.from_bytes(pkt[off:off + 8], "little")
+                off += 8
+            s = pkt[off:off + n]
+            off += n
+            return s
+
+        out = []
+        for i in range(nparams):
+            if null_bitmap[i // 8] & (1 << (i % 8)):
+                out.append(None)
+                continue
+            t = types[i] & 0xFF
+            if t == 1:  # TINY
+                out.append(int.from_bytes(
+                    pkt[off:off + 1], "little", signed=True))
+                off += 1
+            elif t == 2:  # SHORT
+                out.append(int.from_bytes(
+                    pkt[off:off + 2], "little", signed=True))
+                off += 2
+            elif t == 3:  # LONG
+                out.append(int.from_bytes(
+                    pkt[off:off + 4], "little", signed=True))
+                off += 4
+            elif t == 8:  # LONGLONG
+                out.append(int.from_bytes(
+                    pkt[off:off + 8], "little", signed=True))
+                off += 8
+            elif t == 4:  # FLOAT
+                out.append(struct.unpack_from("<f", pkt, off)[0])
+                off += 4
+            elif t == 5:  # DOUBLE
+                out.append(struct.unpack_from("<d", pkt, off)[0])
+                off += 8
+            else:  # strings, decimals, dates: length-encoded text
+                out.append(lenenc().decode())
+        return out, types
+
+    @staticmethod
+    def _literal(v) -> str:
+        if v is None:
+            return "NULL"
+        if isinstance(v, float):
+            return repr(v)
+        if isinstance(v, int):
+            return str(v)
+        s = str(v).replace("'", "''")
+        return f"'{s}'"
+
+    def _stmt_execute(self, conn: _Conn, sess, pkt: bytes, stmts) -> None:
+        sid = int.from_bytes(pkt[1:5], "little")
+        entry = stmts.get(sid)
+        if entry is None:
+            conn.send_packet(_err_packet(1243, "unknown statement id"))
+            return
+        pieces, nparams, prev_types = entry
+        try:
+            params, types_used = self._decode_params(pkt, nparams, prev_types)
+        except (IndexError, struct.error):
+            conn.send_packet(_err_packet(1210, "malformed execute packet"))
+            return
+        entry[2] = types_used  # remembered for new_params_bound=0 rounds
+        # substitute as literals: the plan cache re-parameterizes them, so
+        # repeated executions of one statement reuse the compiled artifact
+        sql = "".join(
+            p + (self._literal(params[i]) if i < nparams else "")
+            for i, p in enumerate(pieces)
+        )
+        try:
+            rs = sess.sql(sql)
+        except Exception as e:
+            conn.send_packet(_err_packet(1064, f"{type(e).__name__}: {e}"))
+            return
+        if not rs.names:
+            conn.send_packet(_ok_packet(affected=rs.affected))
+            return
+        cols = [rs.columns[n] for n in rs.names]
+        types = [_col_mysql_type(c) for c in cols]
+        conn.send_packet(_lenenc_int(len(rs.names)))
+        for n, t in zip(rs.names, types):
+            conn.send_packet(_coldef(n, t))
+        conn.send_packet(_eof_packet())
+        ncols = len(cols)
+        nb = (ncols + 2 + 7) // 8
+        for i in range(rs.nrows):
+            bitmap = bytearray(nb)
+            body = bytearray()
+            for j, (c, t) in enumerate(zip(cols, types)):
+                v = c[i]
+                is_null = v is None or (
+                    isinstance(v, float) and v != v
+                )
+                if is_null:
+                    # binary-row NULL bitmap has a 2-bit offset
+                    bit = j + 2
+                    bitmap[bit // 8] |= 1 << (bit % 8)
+                    continue
+                if t == MYSQL_TYPE_LONGLONG:
+                    body += int(v).to_bytes(8, "little", signed=True)
+                elif t == MYSQL_TYPE_DOUBLE:
+                    body += struct.pack("<d", float(v))
+                else:
+                    body += _lenenc_str(str(v).encode())
+            conn.send_packet(b"\x00" + bytes(bitmap) + bytes(body))
         conn.send_packet(_eof_packet())

@@ -329,6 +329,11 @@ class Resolver:
                 if isinstance(inner, E.Literal):
                     return E.Literal(-inner.value, inner.dtype)
                 return E.Func("neg", (inner,))
+            if self._contains_null_comparison(node.operand):
+                # 3-valued logic: push the negation down (De Morgan) so
+                # every NULL-comparison leaf folds in place — NOT(U OR p)
+                # = (U AND NOT p) = false-in-WHERE, etc.
+                return self._resolve_bool(node.operand, True, allow_agg)
             return E.Not(self.expr(node.operand, allow_agg))
         if isinstance(node, A.BinOp):
             return self._binop(node, allow_agg)
@@ -404,6 +409,49 @@ class Resolver:
             raise ResolveError("interval outside date arithmetic")
         raise ResolveError(f"cannot resolve {node!r}")
 
+    @staticmethod
+    def _is_null_comparison(node) -> bool:
+        """A comparison with a bare NULL literal on either side."""
+        def is_null_lit(n):
+            return isinstance(n, A.Name) and n.parts == ("null",)
+
+        return (
+            isinstance(node, A.BinOp)
+            and node.op in ("=", "!=", "<>", "<", "<=", ">", ">=")
+            and (is_null_lit(node.left) or is_null_lit(node.right))
+        )
+
+    @classmethod
+    def _contains_null_comparison(cls, node) -> bool:
+        if cls._is_null_comparison(node):
+            return True
+        if isinstance(node, A.BinOp) and node.op in ("and", "or"):
+            return (cls._contains_null_comparison(node.left)
+                    or cls._contains_null_comparison(node.right))
+        if isinstance(node, A.UnaryOp) and node.op != "-":
+            return cls._contains_null_comparison(node.operand)
+        return False
+
+    _FALSE = None  # class-level constant-false built lazily
+
+    def _resolve_bool(self, node, neg: bool, allow_agg) -> E.Expr:
+        """Resolve a boolean skeleton with the negation pushed to the
+        leaves, so NULL-comparison leaves fold to WHERE-false in any
+        composition (a NULL result and FALSE are indistinguishable to a
+        filter; the fold is only ever applied in predicate position)."""
+        false_ = E.Compare("=", E.lit(0), E.lit(1))
+        if self._is_null_comparison(node):
+            return false_  # U and NOT U are both never-satisfied
+        if isinstance(node, A.BinOp) and node.op in ("and", "or"):
+            op = node.op if not neg else ("or" if node.op == "and" else "and")
+            l = self._resolve_bool(node.left, neg, allow_agg)
+            r = self._resolve_bool(node.right, neg, allow_agg)
+            return E.and_(l, r) if op == "and" else E.or_(l, r)
+        if isinstance(node, A.UnaryOp) and node.op != "-":
+            return self._resolve_bool(node.operand, not neg, allow_agg)
+        inner = self.expr(node, allow_agg)
+        return E.Not(inner) if neg else inner
+
     def _binop(self, node: A.BinOp, allow_agg) -> E.Expr:
         op = node.op
         if op in ("and", "or"):
@@ -411,6 +459,11 @@ class Resolver:
             r = self.expr(node.right, allow_agg)
             return E.and_(l, r) if op == "and" else E.or_(l, r)
         if op in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            if self._is_null_comparison(node):
+                # any comparison against NULL is SQL NULL, which a WHERE
+                # treats as not-satisfied: fold to constant false (use
+                # IS NULL for null tests)
+                return E.Compare("=", E.lit(0), E.lit(1))
             return E.Compare(
                 op,
                 self.expr(node.left, allow_agg),

@@ -67,6 +67,14 @@ class LocalCluster:
             )
         self.elect_all()
 
+    def add_node(self, node: int) -> None:
+        """Join an empty node (no replicas yet); the balance loop
+        (ha/migrate.balance_cluster) migrates replicas onto it."""
+        if node in self.services:
+            raise ValueError(f"node {node} already exists")
+        self.services[node] = TransService(node, self.gts, {})
+        self.n_nodes = max(self.n_nodes, node + 1)
+
     # ------------------------------------------------------------- drive
     def _palfs(self):
         return [r.palf for g in self.ls_groups.values() for r in g.values()]

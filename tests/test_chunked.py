@@ -123,6 +123,32 @@ def test_chunked_scalar_aggregate_empty_chunks(tables):
     assert got == want
 
 
+@pytest.mark.multidevice
+@pytest.mark.parametrize("qid", [6, 1, 3])
+def test_px_chunked_streams_over_mesh(tables, qid):
+    """Out-of-core composes with PX: every chunk dispatches as one
+    shard_map program over the 8-device mesh; results match single-chip
+    whole-table execution (VERDICT r2 item 3b)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs a multi-device mesh")
+    from oceanbase_tpu.parallel.mesh import make_mesh
+    from oceanbase_tpu.parallel.px import PxExecutor
+
+    sql = QUERIES[qid]
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, sql)
+    px = PxExecutor(tables, make_mesh(8), unique_keys=UNIQUE_KEYS,
+                    device_budget=BUDGET, chunk_rows=CHUNK)
+    prepared, got = _rows(px, tables, sql)
+    assert isinstance(prepared, ChunkedPreparedPlan), f"Q{qid} did not chunk"
+    from oceanbase_tpu.parallel.px import _PxChunkSourceExecutor
+
+    assert isinstance(prepared.chunk_exec, _PxChunkSourceExecutor)
+    assert got == want, f"Q{qid} px-chunked mismatch"
+
+
 def test_chunked_via_session(tables):
     """Session-level: a budget-constrained executor runs SQL transparently."""
     from oceanbase_tpu.engine import Session

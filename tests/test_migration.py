@@ -1,0 +1,121 @@
+"""Live replica migration + load balancing (VERDICT r2 missing item 1;
+reference: storage/high_availability ObLSMigrationHandler +
+src/rootserver/balance).
+
+A healthy replica moves between nodes while the group serves traffic:
+snapshot copy, palf single-member config changes (ADD then REMOVE), log
+catch-up; balance_cluster levels replica counts after a node joins."""
+
+import pytest
+
+from oceanbase_tpu.core.dtypes import DataType, Schema
+from oceanbase_tpu.ha.migrate import (
+    balance_cluster,
+    migrate_replica,
+    replica_counts,
+)
+from oceanbase_tpu.rootserver import RootService
+from oceanbase_tpu.storage import OP_PUT
+
+SCHEMA = Schema.of(k=DataType.int64(), v=DataType.int64())
+
+
+def _mk(n_ls=2):
+    cluster, rs = RootService.bootstrap(3, n_ls)
+    for ls in range(1, n_ls + 1):
+        cluster.create_tablet(ls, 100 + ls, SCHEMA, ["k"])
+    return cluster
+
+
+def _write(cluster, ls, kv):
+    svc = cluster.service_for(ls)
+    ctx = svc.begin()
+    for k, v in kv.items():
+        svc.write(ctx, ls, 100 + ls, (k,), OP_PUT, (k, v))
+    cluster.commit_sync(svc, ctx)
+
+
+def _rows(rep, tablet, snapshot):
+    got = rep.tablets[tablet].scan(snapshot)
+    return dict(zip(got["k"].tolist(), got["v"].tolist()))
+
+
+def test_migrate_follower_replica_while_serving():
+    cluster = _mk(n_ls=1)
+    _write(cluster, 1, {1: 10, 2: 20})
+    cluster.add_node(3)
+
+    group = cluster.ls_groups[1]
+    leader = cluster.leader_node(1)
+    src = next(n for n in group if n != leader)
+    rep = migrate_replica(cluster, 1, src, 3)
+
+    assert src not in group and 3 in group
+    assert cluster.services[3].replicas[1] is rep
+    assert 1 not in cluster.services[src].replicas
+    # membership is now {leader, other, 3}: 3 members
+    assert len(rep.palf.peers) == 3
+
+    # traffic keeps flowing; the migrated replica applies it
+    _write(cluster, 1, {3: 30})
+    lead_rep = group[cluster.leader_node(1)]
+    ok = cluster.drive_until(
+        lambda: rep.palf.applied_lsn == lead_rep.palf.applied_lsn
+    )
+    assert ok
+    snap = cluster.gts.next_ts()
+    assert _rows(rep, 101, snap) == {1: 10, 2: 20, 3: 30}
+
+
+def test_migrate_leader_replica_transfers_first():
+    cluster = _mk(n_ls=1)
+    _write(cluster, 1, {1: 1})
+    cluster.add_node(3)
+    leader = cluster.leader_node(1)
+    rep = migrate_replica(cluster, 1, leader, 3)
+    # the old leader node no longer hosts the LS; a leader exists elsewhere
+    new_leader = cluster.leader_node(1)
+    assert new_leader != leader
+    _write(cluster, 1, {2: 2})
+    snap = cluster.gts.next_ts()
+    lead_rep = cluster.ls_groups[1][new_leader]
+    assert _rows(lead_rep, 101, snap) == {1: 1, 2: 2}
+
+
+def test_balance_after_add_node():
+    """Add a 4th node to a 3-node/4-LS cluster: balance moves replicas
+    onto it until counts are level; reads and writes keep working."""
+    cluster = _mk(n_ls=4)
+    for ls in range(1, 5):
+        _write(cluster, ls, {ls: ls * 10})
+    cluster.add_node(3)
+    assert replica_counts(cluster)[3] == 0
+
+    moves = balance_cluster(cluster)
+    counts = replica_counts(cluster)
+    assert moves >= 3, (moves, counts)
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+    assert counts[3] >= 2, counts
+
+    # cluster still serves every LS
+    for ls in range(1, 5):
+        _write(cluster, ls, {100 + ls: ls})
+        lead = cluster.ls_groups[ls][cluster.leader_node(ls)]
+        snap = cluster.gts.next_ts()
+        got = _rows(lead, 100 + ls, snap)
+        assert got[ls] == ls * 10
+        assert got[100 + ls] == ls
+
+
+def test_migrated_replica_can_lead():
+    cluster = _mk(n_ls=1)
+    _write(cluster, 1, {1: 1})
+    cluster.add_node(3)
+    leader = cluster.leader_node(1)
+    src = next(n for n in cluster.ls_groups[1] if n != leader)
+    rep = migrate_replica(cluster, 1, src, 3)
+    cluster.transfer_leader(1, 3)
+    assert cluster.drive_until(lambda: rep.is_ready)
+    _write(cluster, 1, {2: 2})
+    snap = cluster.gts.next_ts()
+    assert _rows(rep, 101, snap) == {1: 1, 2: 2}

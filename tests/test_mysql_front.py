@@ -15,21 +15,33 @@ from oceanbase_tpu.server.mysql_front import MySqlFrontend
 
 
 class MiniMySqlClient:
-    def __init__(self, port: int):
+    def __init__(self, port: int, user: str = "root", password: str = ""):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
         self.seq = 0
         greeting = self._read()
         assert greeting[0] == 10  # protocol version
-        self.server_version = greeting[1:greeting.index(b"\x00", 1)]
+        nul = greeting.index(b"\x00", 1)
+        self.server_version = greeting[1:nul]
+        # salt part 1 (8B) after connection id; part 2 after the 10-byte
+        # reserved block (length-prefixed, NUL-terminated)
+        p = nul + 1 + 4
+        salt = greeting[p:p + 8]
+        p += 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        salt += greeting[p:greeting.index(b"\x00", p)]
+        from oceanbase_tpu.server.mysql_front import native_password_scramble
+
+        auth = native_password_scramble(password, salt[:20])
         # login: CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
         caps = 0x0200 | 0x8000
         payload = (
             struct.pack("<IIB23x", caps, 1 << 24, 33)
-            + b"root\x00" + b"\x00"
+            + user.encode() + b"\x00"
+            + bytes([len(auth)]) + auth
         )
         self._send(payload)
         ok = self._read()
-        assert ok[0] == 0x00, ok
+        if ok[0] != 0x00:
+            raise PermissionError(ok[9:].decode(errors="replace"))
 
     # ---- packet plumbing -------------------------------------------------
     def _read(self) -> bytes:
@@ -112,6 +124,103 @@ class MiniMySqlClient:
         self._send(b"\x0e")
         return self._read()[0] == 0x00
 
+    # ---- prepared statements (binary protocol) ---------------------------
+    def prepare(self, sql: str) -> tuple[int, int]:
+        self.seq = 0
+        self._send(b"\x16" + sql.encode())
+        ok = self._read()
+        assert ok[0] == 0x00, ok
+        sid = int.from_bytes(ok[1:5], "little")
+        ncols = int.from_bytes(ok[5:7], "little")
+        nparams = int.from_bytes(ok[7:9], "little")
+        for _ in range(nparams):
+            self._read()  # param defs
+        if nparams:
+            self._read()  # EOF
+        return sid, nparams
+
+    def execute(self, sid: int, params: tuple = (), send_types: bool = True):
+        """Binary COM_STMT_EXECUTE; returns affected count or (types, rows).
+        send_types=False mimics drivers re-executing with
+        new_params_bound_flag=0 (types sent only on the first execute)."""
+        self.seq = 0
+        nb = (len(params) + 7) // 8
+        bitmap = bytearray(nb)
+        types = bytearray()
+        values = bytearray()
+        for i, v in enumerate(params):
+            if v is None:
+                bitmap[i // 8] |= 1 << (i % 8)
+                types += bytes([8, 0])
+            elif isinstance(v, int):
+                types += bytes([8, 0])  # LONGLONG
+                values += v.to_bytes(8, "little", signed=True)
+            elif isinstance(v, float):
+                types += bytes([5, 0])  # DOUBLE
+                values += struct.pack("<d", v)
+            else:
+                s = str(v).encode()
+                types += bytes([253, 0])  # VAR_STRING
+                assert len(s) < 251
+                values += bytes([len(s)]) + s
+        pkt = (
+            b"\x17" + sid.to_bytes(4, "little") + b"\x00"
+            + (1).to_bytes(4, "little")
+            + bytes(bitmap)
+            + ((b"\x01" + bytes(types)) if send_types else b"\x00")
+            + bytes(values)
+        )
+        if not params:
+            pkt = (b"\x17" + sid.to_bytes(4, "little") + b"\x00"
+                   + (1).to_bytes(4, "little"))
+        self._send(pkt)
+        first = self._read()
+        if first[0] == 0xFF:
+            raise RuntimeError(first[9:].decode(errors="replace"))
+        if first[0] == 0x00:
+            affected, _ = self._lenenc(first, 1)
+            return affected
+        ncols, _ = self._lenenc(first, 0)
+        col_types = []
+        for _ in range(ncols):
+            col = self._read()
+            pos = 0
+            for _f in range(6):
+                ln, pos = self._lenenc(col, pos)
+                pos += ln
+            pos += 1 + 2 + 4  # fixed-len marker, charset, column length
+            col_types.append(col[pos])
+        eof = self._read()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt2 = self._read()
+            if pkt2[0] == 0xFE and len(pkt2) < 9:
+                break
+            assert pkt2[0] == 0x00
+            nbm = (ncols + 2 + 7) // 8
+            bm = pkt2[1:1 + nbm]
+            pos = 1 + nbm
+            row = []
+            for j, t in enumerate(col_types):
+                bit = j + 2
+                if bm[bit // 8] & (1 << (bit % 8)):
+                    row.append(None)
+                    continue
+                if t == 8:  # LONGLONG
+                    row.append(int.from_bytes(
+                        pkt2[pos:pos + 8], "little", signed=True))
+                    pos += 8
+                elif t == 5:  # DOUBLE
+                    row.append(struct.unpack_from("<d", pkt2, pos)[0])
+                    pos += 8
+                else:
+                    ln, pos = self._lenenc(pkt2, pos)
+                    row.append(pkt2[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(tuple(row))
+        return col_types, rows
+
     def close(self):
         self.seq = 0
         try:
@@ -186,4 +295,117 @@ def test_q6_over_the_wire(front):
 
     want = q6_numpy(tables["lineitem"])
     assert abs(float(rows[0][0]) - want) <= 1e-6 * max(1.0, abs(want))
+    c.close()
+
+
+def test_password_verification():
+    db = Database(n_nodes=1, n_ls=1)
+    fe = MySqlFrontend(db, users={"root": "s3cret", "ro": ""}).start()
+    try:
+        c = MiniMySqlClient(fe.port, "root", "s3cret")
+        assert c.ping()
+        c.close()
+        c2 = MiniMySqlClient(fe.port, "ro", "")  # empty password user
+        assert c2.ping()
+        c2.close()
+        with pytest.raises(PermissionError):
+            MiniMySqlClient(fe.port, "root", "wrong")
+        with pytest.raises(PermissionError):
+            MiniMySqlClient(fe.port, "nobody", "s3cret")
+    finally:
+        fe.stop()
+
+
+def test_prepared_statements_binary_protocol(front):
+    """COM_STMT_PREPARE/EXECUTE: param binding, binary typed resultsets,
+    plan-cache reuse across executions (obmp_stmt_prepare/execute)."""
+    c = MiniMySqlClient(front.port)
+    c.query("create table pt (id bigint primary key, v bigint, s varchar)")
+    sid, np_ = c.prepare("insert into pt values (?, ?, ?)")
+    assert np_ == 3
+    for i in range(1, 6):
+        assert c.execute(sid, (i, i * 10, f"row{i}")) == 1
+
+    sid2, np2 = c.prepare("select id, v, s from pt where id >= ? order by id")
+    assert np2 == 1
+    types, rows = c.execute(sid2, (3,))
+    assert types[:2] == [8, 8]  # LONGLONG ids/values in BINARY form
+    assert rows == [(3, 30, "row3"), (4, 40, "row4"), (5, 50, "row5")]
+    # re-execute with a different binding: plan-cache hit, new rows
+    _t, rows2 = c.execute(sid2, (5,))
+    assert rows2 == [(5, 50, "row5")]
+
+    # strings with quotes survive literal substitution
+    sid3, _ = c.prepare("select s from pt where s = ?")
+    _t, r3 = c.execute(sid3, ("row2",))
+    assert r3 == [("row2",)]
+    c.execute(sid, (6, 60, "it's"))
+    _t, r4 = c.execute(sid3, ("it's",))
+    assert r4 == [("it's",)]
+
+    # NULL parameter -> no match rows but valid execution
+    sid4, _ = c.prepare("select count(*) as n from pt where v = ?")
+    _t, r5 = c.execute(sid4, (None,))
+    assert r5 == [(0,)]
+    c.close()
+
+
+def test_typed_text_coldefs(front):
+    """Text-protocol column defs carry real types now (not VAR_STRING
+    for everything): read the type byte from the defs."""
+    c = MiniMySqlClient(front.port)
+    c.query("create table ty (id bigint primary key, s varchar)")
+    c.query("insert into ty values (1, 'x')")
+    self_send = c._send
+    c.seq = 0
+    self_send(b"\x03" + b"select id, s from ty")
+    first = c._read()
+    ncols, _ = c._lenenc(first, 0)
+    tys = []
+    for _ in range(ncols):
+        col = c._read()
+        pos = 0
+        for _f in range(6):
+            ln, pos = c._lenenc(col, pos)
+            pos += ln
+        pos += 1 + 2 + 4
+        tys.append(col[pos])
+    assert tys == [8, 253]  # LONGLONG, VAR_STRING
+    # drain remaining packets
+    while True:
+        pkt = c._read()
+        if pkt[0] == 0xFE and len(pkt) < 9:
+            eof_count = getattr(c, "_eofs", 0) + 1
+            c._eofs = eof_count
+            if eof_count == 2:
+                break
+    c.close()
+
+
+def test_stmt_reexecute_without_types(front):
+    """Drivers send param types only on the FIRST execute; re-executions
+    set new_params_bound_flag=0 and the server must reuse the remembered
+    types to parse the binary values."""
+    c = MiniMySqlClient(front.port)
+    c.query("create table rx (id bigint primary key, v bigint)")
+    for i in range(1, 4):
+        c.query(f"insert into rx values ({i}, {i * 7})")
+    sid, _ = c.prepare("select v from rx where id = ?")
+    _t, r1 = c.execute(sid, (2,))
+    assert r1 == [(14,)]
+    _t, r2 = c.execute(sid, (3,), send_types=False)
+    assert r2 == [(21,)]
+    _t, r3 = c.execute(sid, (1,), send_types=False)
+    assert r3 == [(7,)]
+    c.close()
+
+
+def test_stmt_execute_no_params(front):
+    c = MiniMySqlClient(front.port)
+    c.query("create table np0 (id bigint primary key)")
+    c.query("insert into np0 values (1), (2)")
+    sid, np_ = c.prepare("select id from np0 order by id")
+    assert np_ == 0
+    _t, rows = c.execute(sid, ())
+    assert rows == [(1,), (2,)]
     c.close()

@@ -255,3 +255,41 @@ def test_count_col_and_avg_skip_nulls():
     assert list(rs.columns["s"]) == [10, 70]
     assert rs.columns["a"][0] == pytest.approx(10.0)
     assert rs.columns["a"][1] == pytest.approx(35.0)
+
+
+def test_null_comparison_three_valued():
+    """x = NULL is SQL NULL: zero rows, and NOT (x = NULL) is ALSO zero
+    rows (the fold must survive negation)."""
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.engine import Session
+
+    I64 = DataType.int64()
+    t = Table.from_pydict(
+        "t", Schema((Field("k", I64),)), {"k": np.arange(5)})
+    sess = Session({"t": t})
+    assert sess.sql("select k from t where k = null").nrows == 0
+    assert sess.sql("select k from t where not (k = null)").nrows == 0
+    assert sess.sql("select k from t where k <> null").nrows == 0
+
+
+def test_null_comparison_composite_not():
+    """NOT over a composite containing a NULL comparison keeps 3VL WHERE
+    semantics: NOT (k = NULL OR k > 3) excludes every row."""
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.engine import Session
+
+    I64 = DataType.int64()
+    t = Table.from_pydict(
+        "t2", Schema((Field("k", I64),)), {"k": np.arange(6)})
+    sess = Session({"t2": t})
+    assert sess.sql(
+        "select k from t2 where not (k = null or k > 3)").nrows == 0
+    # NOT (U AND p) == NOT p in WHERE terms
+    rs = sess.sql("select k from t2 where not (k = null and k > 3)")
+    assert sorted(int(v) for v in rs.columns["k"][: rs.nrows]) == [0, 1, 2, 3]

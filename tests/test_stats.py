@@ -158,3 +158,57 @@ def test_zero_overflow_retries_on_tpch_q1_style(t):
     for entry in sess.plan_cache._entries.values() if hasattr(
             sess.plan_cache, "_entries") else []:
         assert entry.prepared.retries == 0
+
+
+def test_packed_groupby_guard_survives_domain_drift():
+    """Stats-packed group keys carry a runtime validity counter: values
+    beyond the packed domain (stale stats after heavy DML) trigger the
+    overflow-retry path which recompiles WITHOUT packing — results stay
+    exact, never silently mis-grouped."""
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.engine.executor import Executor
+    from oceanbase_tpu.share.stats import StatsManager
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    I64 = DataType.int64()
+    n = 4096
+    rng = np.random.default_rng(9)
+    a = rng.integers(0, 16, n)
+    b = rng.integers(0, 8, n)
+    t = Table.from_pydict(
+        "t", Schema((Field("a", I64), Field("b", I64), Field("v", I64))),
+        {"a": a, "b": b, "v": np.arange(n)})
+    tables = {"t": t}
+    ex = Executor(tables, stats=StatsManager(tables))
+    pq = Planner(tables).plan(parse(
+        "select a, b, sum(v) as s from t group by a, b"))
+    prepared = ex.prepare(pq.plan)
+    from oceanbase_tpu.engine.executor import PACK_GUARD_BASE
+
+    assert any(i >= PACK_GUARD_BASE for i in prepared.overflow_nodes), \
+        "packing not engaged"
+    out = prepared.run()
+    from oceanbase_tpu.core.column import batch_rows_normalized
+
+    want = {}
+    for ai, bi, vi in zip(a.tolist(), b.tolist(), range(n)):
+        want[(ai, bi)] = want.get((ai, bi), 0) + vi
+    got = batch_rows_normalized(out, pq.output_names)
+    assert {(r[0], r[1]): r[2] for r in got} == want
+
+    # drift FAR beyond the 4x headroom: same plan must retry to unpacked
+    a2 = a.copy()
+    a2[:64] = rng.integers(1 << 40, (1 << 40) + 1000, 64)
+    t.data["a"] = a2
+    ex.invalidate_table("t")
+    out2 = prepared.run()
+    assert prepared.retries >= 1, "guard did not trip"
+    want2 = {}
+    for ai, bi, vi in zip(a2.tolist(), b.tolist(), range(n)):
+        want2[(ai, bi)] = want2.get((ai, bi), 0) + vi
+    got2 = batch_rows_normalized(out2, pq.output_names)
+    assert {(r[0], r[1]): r[2] for r in got2} == want2
