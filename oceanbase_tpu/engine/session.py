@@ -56,7 +56,9 @@ class Session:
         from ..share.stats import StatsManager
 
         self.stats = StatsManager(catalog)
-        self.planner = Planner(catalog, stats=self.stats)
+        self.planner = Planner(
+            catalog, stats=self.stats, unique_keys=unique_keys
+        )
         self.executor = Executor(
             catalog, unique_keys=unique_keys, stats=self.stats
         )

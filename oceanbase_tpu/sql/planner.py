@@ -106,6 +106,35 @@ def hoist_common_or_conjuncts(e: E.Expr) -> list[E.Expr]:
     return common + [E.or_(*rest_branches)]
 
 
+def or_to_in(e: E.Expr) -> E.Expr:
+    """OR of equalities on ONE column against literals -> InList
+    (x=1 OR x=2 OR x=3 -> x IN (1,2,3)): one vectorized membership test
+    instead of an OR chain, and a stabler plan-cache shape. (Reference:
+    sql/rewrite or-expansion / in-list normalization.)"""
+    if not (isinstance(e, E.BoolOp) and e.op == "or"):
+        return e
+    col = None
+    vals = []
+    for b in e.args:
+        if not (
+            isinstance(b, E.Compare) and b.op in ("=", "==")
+            and isinstance(b.left, E.ColRef)
+            and isinstance(b.right, E.Literal)
+        ):
+            return e
+        if col is None:
+            col = b.left.name
+        elif b.left.name != col:
+            return e
+        vals.append(b.right.value)
+    if col is None or len(vals) < 2:
+        return e
+    dtypes = {type(v) for v in vals}
+    if len(dtypes) != 1:
+        return e
+    return E.InList(E.ColRef(col), tuple(vals))
+
+
 def _tables_of(e: E.Expr) -> set[str]:
     return {n.split(".", 1)[0] for n in E.referenced_columns(e)}
 
@@ -145,11 +174,35 @@ def _contains_subquery(node: A.Node) -> bool:
 
 
 class Planner:
-    def __init__(self, catalog, stats=None):
+    def __init__(self, catalog, stats=None, unique_keys=None):
         self.catalog = catalog  # name -> Table
         # share/stats.StatsManager (None = heuristic-only estimates)
         self.stats = stats
+        # table -> unique key column tuple (DISTINCT elimination)
+        self.unique_keys = unique_keys or {}
         self.ctes: dict[str, A.Select] = {}
+
+    def _distinct_redundant(self, plan) -> bool:
+        """True when `plan`'s rows are already unique, so a Distinct above
+        it is a no-op (reference: ob_transform_distinct_elimination):
+        a projection carrying ALL group keys of an Aggregate below it, or
+        ALL unique-key columns of a single base table."""
+        if not isinstance(plan, Project):
+            return False
+        srcs = {
+            e.name for _n, e in plan.exprs if isinstance(e, E.ColRef)
+        }
+        node = plan.child
+        if isinstance(node, Aggregate) and node.group_keys:
+            return {n for n, _ in node.group_keys} <= srcs
+        while isinstance(node, Filter):
+            node = node.child
+        if isinstance(node, Scan):
+            uk = self.unique_keys.get(node.table)
+            if uk:
+                qual = {f"{node.alias}.{c}" for c in uk}
+                return qual <= srcs
+        return False
 
     # -- cardinality estimates (stats-backed with heuristic fallback) --
     def _scan_rows(self, scan: Scan) -> float:
@@ -309,6 +362,7 @@ class Planner:
 
         where_conjs = join_conds + where_conjs
         where_conjs = [h for c in where_conjs for h in hoist_common_or_conjuncts(c)]
+        where_conjs = [or_to_in(c) for c in where_conjs]
 
         # classify: single-relation -> pushdown; equi-join; residual
         by_alias = {rel.alias: rel for rel in relations}
@@ -522,7 +576,7 @@ class Planner:
         order_keys = fixed_order
 
         plan = Project(plan, tuple(out_items))
-        if sel.distinct:
+        if sel.distinct and not self._distinct_redundant(plan):
             plan = Distinct(plan)
         if order_keys and sel.limit is not None:
             # ORDER BY + LIMIT fuse into top-n (ob_pd_topn_sort_filter

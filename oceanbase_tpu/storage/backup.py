@@ -50,6 +50,9 @@ def backup_database(db, root: str) -> int:
             ops=np.zeros(n, np.int8),
             base_version=0, end_version=scn,
         )
+        from ..share.io_manager import GLOBAL_IO
+
+        GLOBAL_IO.account("backup", len(blob))
         with open(os.path.join(root, f"{name}.sst"), "wb") as f:
             f.write(blob)
         meta["tables"].append({

@@ -20,7 +20,8 @@ import numpy as np
 
 
 class TmpFileManager:
-    def __init__(self, root: str | None = None, limit_bytes: int = 8 << 30):
+    def __init__(self, root: str | None = None, limit_bytes: int = 8 << 30,
+                 tenant: object = "sys", io_mgr=None):
         self._own_root = root is None
         self.root = root or tempfile.mkdtemp(prefix="ob_tpu_spill_")
         os.makedirs(self.root, exist_ok=True)
@@ -28,12 +29,22 @@ class TmpFileManager:
         self._bytes = 0
         self._seq = 0
         self._lock = threading.Lock()
+        # per-tenant IO isolation (share/io_manager; ObIOManager analog)
+        self.tenant = tenant
+        if io_mgr is None:
+            from ..share.io_manager import GLOBAL_IO
+
+            io_mgr = GLOBAL_IO
+        self.io_mgr = io_mgr
 
     def write_segment(self, cols: dict[str, np.ndarray]) -> str:
         """Spill one segment (a dict of equal-length column arrays)."""
         with self._lock:
             self._seq += 1
             path = os.path.join(self.root, f"seg_{self._seq:06d}.npz")
+        self.io_mgr.account(
+            self.tenant, sum(a.nbytes for a in cols.values())
+        )
         np.savez(path, **cols)
         sz = os.path.getsize(path)
         with self._lock:
@@ -47,6 +58,7 @@ class TmpFileManager:
         return path
 
     def read_segment(self, path: str) -> dict[str, np.ndarray]:
+        self.io_mgr.account(self.tenant, os.path.getsize(path))
         with np.load(path) as z:
             return {k: z[k] for k in z.files}
 

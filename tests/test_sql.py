@@ -293,3 +293,64 @@ def test_null_comparison_composite_not():
     # NOT (U AND p) == NOT p in WHERE terms
     rs = sess.sql("select k from t2 where not (k = null and k > 3)")
     assert sorted(int(v) for v in rs.columns["k"][: rs.nrows]) == [0, 1, 2, 3]
+
+
+def test_rewrite_or_to_in_and_distinct_elimination():
+    import numpy as np
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.sql.logical import Distinct
+    from oceanbase_tpu.sql.parser import parse
+
+    I64 = DataType.int64()
+    t = Table.from_pydict(
+        "r", Schema((Field("id", I64), Field("g", I64))),
+        {"id": np.arange(20), "g": np.arange(20) % 5})
+    sess = Session({"r": t}, unique_keys={"r": ("id",)})
+
+    # OR chain on one column becomes an IN list (check results + plan)
+    rs = sess.sql("select id from r where g = 1 or g = 3 or g = 4")
+    got = sorted(int(v) for v in rs.columns["id"][: rs.nrows])
+    want = sorted(i for i in range(20) if i % 5 in (1, 3, 4))
+    assert got == want
+    from oceanbase_tpu.expr import ir as E
+
+    pq = sess.planner.plan(parse(
+        "select id from r where g = 1 or g = 3 or g = 4"))
+
+    def find_inlist(op):
+        f = getattr(op, "pushed_filter", None)
+        found = isinstance(f, E.InList)
+        for a in ("child", "left", "right"):
+            c = getattr(op, a, None)
+            if c is not None and not isinstance(c, (str, tuple, int)):
+                found = found or find_inlist(c)
+        return found
+
+    assert find_inlist(pq.plan), "OR chain did not normalize to IN"
+
+    # SELECT DISTINCT over a unique key is a no-op: no Distinct node
+    def has_distinct(op):
+        if isinstance(op, Distinct):
+            return True
+        return any(
+            has_distinct(c)
+            for a in ("child", "left", "right")
+            if (c := getattr(op, a, None)) is not None
+            and not isinstance(c, (str, tuple, int))
+        )
+
+    pq2 = sess.planner.plan(parse("select distinct id, g from r"))
+    assert not has_distinct(pq2.plan)
+    rs2 = sess.sql("select distinct id, g from r")
+    assert rs2.nrows == 20
+    # ...but DISTINCT on a non-unique projection keeps the node
+    pq3 = sess.planner.plan(parse("select distinct g from r"))
+    assert has_distinct(pq3.plan)
+    assert sess.sql("select distinct g from r").nrows == 5
+    # and DISTINCT over a full group-by projection is eliminated too
+    pq4 = sess.planner.plan(parse(
+        "select distinct g, count(*) as n from r group by g"))
+    assert not has_distinct(pq4.plan)
