@@ -6,22 +6,30 @@ engine as the measured baseline. Queries run through the real SQL engine
 (parse -> plan -> stats-seeded capacities -> jitted XLA program, plan-cache
 warm), not hand-built kernels.
 
-Budget-aware by design (round 2 lost every number to a driver timeout):
-- generated tables are cached to .bench_cache/tpch_sf{sf}.npz — datagen is
-  paid once per machine, not per run;
-- the XLA persistent compilation cache lives in .bench_cache/xla — repeat
-  runs skip the 20-40s per-query compiles;
-- queries run cheap-first (q6 -> q1 -> q14 -> q3) and a CUMULATIVE summary
-  line is printed after every query, so at any kill point the last stdout
-  line is a complete, parseable summary of everything measured so far;
-- BENCH_BUDGET_S (default 270) stops starting new queries when the
-  remaining budget is under the worst per-query cost observed so far.
+Budget discipline (round 3 lost both join numbers to the budget):
+- joins run BEFORE Q1 (its 65s 1-core CPU baseline ate the r3 budget);
+- generated tables cache to .bench_cache/*.npz and load via mmap (the r3
+  run spent 52.7s just reading the cache eagerly);
+- CPU baseline times AND values cache to .bench_cache/cpu_base.json —
+  datagen is deterministic (seeded), so a baseline measured once on this
+  machine stays valid and repeat runs spend zero seconds on numpy;
+- a CUMULATIVE summary line prints after every step: at any kill point the
+  last stdout line is a complete, parseable record of everything measured.
 
-Every line (and so the LAST line) honors the one-line summary contract:
+Engine features exercised (and reported in detail):
+- sorted projection on lineitem(l_shipdate) — the TPC-H-legal date-column
+  index (spec 1.5.4); Q6/Q14 scans become contiguous device slices;
+- clustered-FK segment aggregation: Q3's join+group-by ride cumsums over
+  lineitem's l_orderkey clustering plus host-precomputed FK ranges;
+- out-of-core streaming: an SF>=30 section runs Q6/Q1 through the chunked
+  executor with a reduced device budget (streamed: true in detail).
+
+Every line honors the one-line summary contract:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "detail": {...}}
 
-Env knobs: BENCH_SF (default: largest of {10, 1} that fits the budget),
-BENCH_REPS (default 5), BENCH_BUDGET_S (default 270).
+Env knobs: BENCH_SF (default 10), BENCH_REPS (default 5), BENCH_BUDGET_S
+(default 270), BENCH_STREAM_SF (default 30; 0 disables the streamed
+section), OB_TPU_DEVICE_BUDGET for the non-streamed device budget.
 """
 
 import json
@@ -32,9 +40,16 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(REPO, ".bench_cache")
-ORDER = ["q6", "q1", "q14", "q3"]  # cheap-first
+ORDER = ["q6", "q14", "q3", "q1"]  # joins before Q1's 65s CPU baseline
 QID = {"q1": 1, "q6": 6, "q3": 3, "q14": 14}
 START = time.monotonic()
+
+# lineitem columns covered by the l_shipdate sorted projection (every
+# column the four headline queries touch)
+SP_COLS = [
+    "l_shipdate", "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+    "l_returnflag", "l_linestatus", "l_partkey", "l_orderkey",
+]
 
 
 def emit(obj):
@@ -46,33 +61,59 @@ def elapsed():
 
 
 # ---------------------------------------------------------------------------
-# Cached TPC-H tables
+# Cached TPC-H tables (mmap: only touched columns hit the disk)
 # ---------------------------------------------------------------------------
 
 def cache_path(sf: float) -> str:
+    """Directory of raw .npy files — np.load(mmap_mode='r') only works on
+    standalone .npy (inside an npz zip numpy silently reads eagerly: the
+    r3 bench spent 52.7s 'loading the cache')."""
+    return os.path.join(CACHE, f"tpch_sf{sf:g}.d")
+
+
+def _legacy_npz(sf: float) -> str:
     return os.path.join(CACHE, f"tpch_sf{sf:g}.npz")
 
 
+def _write_npy_dir(path: str, arrs: dict) -> None:
+    tmp = path + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    for k, a in arrs.items():
+        np.save(os.path.join(tmp, k + ".npy"), np.asarray(a))
+    os.replace(tmp, path)
+
+
 def load_or_generate(sf: float):
-    """Tables from the on-disk cache, else generate + populate the cache."""
+    """Tables from the on-disk cache (true mmap: columns hit the disk
+    only when touched), else generate + cache. A legacy npz converts to
+    the directory format once."""
     from oceanbase_tpu.core.dictionary import Dictionary
     from oceanbase_tpu.core.table import Table
     from oceanbase_tpu.models.tpch import datagen
     from oceanbase_tpu.models.tpch import schema as S
 
-    p = cache_path(sf)
-    if os.path.exists(p):
-        z = np.load(p, allow_pickle=False)
-        names = set(z.files)
+    d = cache_path(sf)
+    npz = _legacy_npz(sf)
+    if not os.path.isdir(d) and os.path.exists(npz):
+        try:
+            z = np.load(npz, allow_pickle=False)
+            _write_npy_dir(d, {k: z[k] for k in z.files})
+            os.remove(npz)
+        except OSError:
+            pass
+    if os.path.isdir(d):
+        files = set(os.listdir(d))
         tables = {}
         for name, schema in S.TABLES.items():
             data, dicts = {}, {}
             for f in schema.fields:
-                data[f.name] = z[f"{name}|{f.name}"]
-                dk = f"{name}|{f.name}#dict"
-                if dk in names:
+                data[f.name] = np.load(
+                    os.path.join(d, f"{name}|{f.name}.npy"), mmap_mode="r"
+                )
+                dk = f"{name}|{f.name}#dict.npy"
+                if dk in files:
                     dicts[f.name] = Dictionary(
-                        z[dk].tolist(), sorted_=True
+                        np.load(os.path.join(d, dk)).tolist(), sorted_=True
                     )
             tables[name] = Table(name, schema, data, dicts)
         return tables, "cache"
@@ -83,35 +124,129 @@ def load_or_generate(sf: float):
         for n, t in tables.items():
             for c, a in t.data.items():
                 arrs[f"{n}|{c}"] = a
-            for c, d in t.dicts.items():
-                arrs[f"{n}|{c}#dict"] = np.array(d.values())
-        tmp = p + f".tmp{os.getpid()}.npz"
-        np.savez(tmp, **arrs)
-        os.replace(tmp, p)
+            for c, dd in t.dicts.items():
+                arrs[f"{n}|{c}#dict"] = np.array(dd.values())
+        _write_npy_dir(d, arrs)
     except OSError:
         pass  # cache is an optimization; never fail the bench on disk
     return tables, "generated"
 
 
+def seed_stats(sess, tables, sf: float) -> None:
+    """Optimizer stats from a pickle cache (collection scans every column
+    — tens of seconds at SF10 through mmap; deterministic data makes the
+    cache exact)."""
+    import pickle
+
+    p = os.path.join(CACHE, f"stats_sf{sf:g}.pkl")
+    sm = sess.stats
+    if os.path.exists(p):
+        try:
+            with open(p, "rb") as f:
+                blob = pickle.load(f)
+            for name, ts in blob.items():
+                t = tables.get(name)
+                if t is not None:
+                    sm._cache[name] = (t, ts)
+            return
+        except Exception:
+            pass
+    blob = {}
+    for name in tables:
+        ts = sm.table_stats(name)
+        if ts is not None:
+            blob[name] = ts
+    try:
+        os.makedirs(CACHE, exist_ok=True)
+        tmp = p + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(blob, f)
+        os.replace(tmp, p)
+    except OSError:
+        pass
+
+
+def ensure_projection(tables, sf: float) -> float:
+    """lineitem sorted by l_shipdate via make_sorted_projection, with an
+    npz cache wrapper (the argsort costs ~20s at SF10, paid once per
+    machine). Returns seconds spent."""
+    from oceanbase_tpu.storage.sorted_projection import (
+        make_sorted_projection,
+        projection_name,
+    )
+
+    t0 = time.perf_counter()
+    li = tables["lineitem"]
+    keep = [f.name for f in li.schema.fields if f.name in SP_COLS]
+    d = os.path.join(CACHE, f"tpch_sf{sf:g}_sp.d")
+    if os.path.isdir(d):
+        pname = projection_name("lineitem", "l_shipdate")
+        from oceanbase_tpu.core.dtypes import Schema
+        from oceanbase_tpu.core.table import Table
+
+        tables[pname] = Table(
+            pname,
+            Schema(tuple(f for f in li.schema.fields if f.name in keep)),
+            {c: np.load(os.path.join(d, c + ".npy"), mmap_mode="r")
+             for c in keep},
+            {c: dd for c, dd in li.dicts.items() if c in keep},
+        )
+        li.sorted_projections = {"l_shipdate": pname}
+    else:
+        pname = make_sorted_projection(
+            tables, "lineitem", "l_shipdate", cols=keep
+        )
+        try:
+            os.makedirs(CACHE, exist_ok=True)
+            _write_npy_dir(d, tables[pname].data)
+        except OSError:
+            pass
+    return time.perf_counter() - t0
+
+
 # ---------------------------------------------------------------------------
-# CPU vectorized baselines (numpy; measured, not cited). q1/q6 are the
-# shared implementations in models/tpch/queries.py; q3/q14 add joins.
+# CPU vectorized baselines (numpy; measured, not cited) with a persistent
+# time+value cache: datagen is deterministic, so a baseline measured once
+# on this machine stays valid across runs.
 # ---------------------------------------------------------------------------
 
 D = lambda s: int(np.datetime64(s, "D").astype(int))
+
+_CPU_CACHE_PATH = os.path.join(CACHE, "cpu_base.json")
+
+
+def _cpu_cache():
+    try:
+        with open(_CPU_CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _cpu_cache_put(key, t, val):
+    c = _cpu_cache()
+    c[key] = {"t": t, "val": val}
+    try:
+        os.makedirs(CACHE, exist_ok=True)
+        tmp = _CPU_CACHE_PATH + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(c, f)
+        os.replace(tmp, _CPU_CACHE_PATH)
+    except OSError:
+        pass
 
 
 def q3_cpu(cust, orders, li):
     cut = D("1995-03-15")
     seg = cust.dicts["c_mktsegment"].encode_one("BUILDING", add=False)
-    ckeys = cust.data["c_custkey"][cust.data["c_mktsegment"] == seg]
-    om = (orders.data["o_orderdate"] < cut) & np.isin(
+    ckeys = cust.data["c_custkey"][np.asarray(cust.data["c_mktsegment"]) == seg]
+    om = (np.asarray(orders.data["o_orderdate"]) < cut) & np.isin(
         orders.data["o_custkey"], ckeys
     )
     okeys = orders.data["o_orderkey"][om]  # ascending (generator invariant)
     odate = orders.data["o_orderdate"][om]
     oprio = orders.data["o_shippriority"][om]
-    lm = li.data["l_shipdate"] > cut
+    lm = np.asarray(li.data["l_shipdate"]) > cut
     lok = li.data["l_orderkey"][lm]
     pos = np.searchsorted(okeys, lok)
     pos_c = np.minimum(pos, len(okeys) - 1)
@@ -128,14 +263,14 @@ def q3_cpu(cust, orders, li):
     order = np.lexsort((odate[nz], -sums[nz]))[:10]
     top = nz[order]
     return [
-        (int(okeys[i]), sums[i] / 1e4, int(odate[i]), int(oprio[i]))
+        [int(okeys[i]), sums[i] / 1e4, int(odate[i]), int(oprio[i])]
         for i in top
     ]
 
 
 def q14_cpu(part, li):
-    lm = (li.data["l_shipdate"] >= D("1995-09-01")) & (
-        li.data["l_shipdate"] < D("1995-10-01")
+    lm = (np.asarray(li.data["l_shipdate"]) >= D("1995-09-01")) & (
+        np.asarray(li.data["l_shipdate"]) < D("1995-10-01")
     )
     pk = li.data["l_partkey"][lm]
     rev = li.data["l_extendedprice"][lm].astype(np.int64) * (
@@ -143,7 +278,7 @@ def q14_cpu(part, li):
     )
     types = np.array(part.dicts["p_type"].values())
     promo_code = np.char.startswith(types, "PROMO")
-    is_promo = promo_code[part.data["p_type"]][pk - 1]  # p_partkey = 1..n
+    is_promo = promo_code[np.asarray(part.data["p_type"])][pk - 1]
     return float(100.0 * rev[is_promo].sum() / max(rev.sum(), 1))
 
 
@@ -156,8 +291,25 @@ def _best(f, reps):
     return min(ts), out
 
 
+def cpu_baseline(qname, sf, fn, reps):
+    """(best_seconds, value, source) with the persistent cache."""
+    key = f"{qname}@sf{sf:g}"
+    hit = _cpu_cache().get(key)
+    if hit is not None:
+        return float(hit["t"]), hit["val"], "cache"
+    t, val = _best(fn, reps)
+    try:
+        json.dumps(val)
+    except TypeError:
+        val = None  # q1 returns arrays; its check lives in the test suite
+    _cpu_cache_put(key, t, val)
+    return t, val, "measured"
+
+
 def check_result(qname, rs, cpu_val):
     """Per-query correctness cross-check vs the CPU baseline value."""
+    if cpu_val is None:
+        return True
     if qname == "q6":
         got = float(rs.columns["revenue"][0])
         return abs(got - cpu_val) <= 1e-6 * max(1.0, abs(cpu_val))
@@ -166,7 +318,7 @@ def check_result(qname, rs, cpu_val):
             (int(rs.columns["l_orderkey"][i]), float(rs.columns["revenue"][i]))
             for i in range(rs.nrows)
         ]
-        want3 = [(k, float(r)) for k, r, _d, _p in cpu_val]
+        want3 = [(int(k), float(r)) for k, r, _d, _p in cpu_val]
         return len(got3) == len(want3) and all(
             gk == wk and abs(gr - wr) < 1e-2
             for (gk, gr), (wk, wr) in zip(got3, want3)
@@ -176,13 +328,19 @@ def check_result(qname, rs, cpu_val):
     return True  # q1: full-table check is in tests/test_tpch_full.py
 
 
+# ---------------------------------------------------------------------------
+
+
 def main():
     budget = float(os.environ.get("BENCH_BUDGET_S", "270"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
+    stream_sf = float(os.environ.get("BENCH_STREAM_SF", "30"))
 
     import jax
 
-    # persistent XLA compile cache: repeat runs skip 20-40s per query
+    # persistent XLA compile cache (helps CPU/dev runs; the axon remote
+    # compile path ignores it, which is why the budget math assumes fresh
+    # compiles for every query)
     try:
         os.makedirs(os.path.join(CACHE, "xla"), exist_ok=True)
         jax.config.update(
@@ -193,13 +351,7 @@ def main():
     except Exception:
         pass
 
-    sf_env = os.environ.get("BENCH_SF")
-    if sf_env:
-        sf = float(sf_env)
-    elif os.path.exists(cache_path(10)) or budget >= 180:
-        sf = 10.0
-    else:
-        sf = 1.0
+    sf = float(os.environ.get("BENCH_SF", "10"))
     cpu_reps = 2 if sf <= 1 else 1
 
     from oceanbase_tpu.engine import Session
@@ -208,6 +360,7 @@ def main():
     t0 = time.perf_counter()
     tables, source = load_or_generate(sf)
     gen_s = time.perf_counter() - t0
+    sp_s = ensure_projection(tables, sf)
     li = tables["lineitem"]
     n = li.nrows
 
@@ -216,8 +369,10 @@ def main():
         "sf": sf,
         "rows": int(n),
         "datagen_s": round(gen_s, 1),
+        "projection_s": round(sp_s, 1),
         "tables_source": source,
         "budget_s": budget,
+        "sorted_projection": "lineitem(l_shipdate) [TPC-H 1.5.4 date index]",
     }
 
     from oceanbase_tpu.models.tpch.queries import q1_numpy_fast, q6_numpy
@@ -249,10 +404,11 @@ def main():
         })
 
     sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    t0 = time.perf_counter()
+    seed_stats(sess, tables, sf)
+    detail["stats_s"] = round(time.perf_counter() - t0, 1)
     tpu_t, cpu_t = {}, {}
     summary(tpu_t, cpu_t)  # tables line: a kill during q6 still parses
-    # reserve: the worst per-query wall cost seen so far (compile + CPU
-    # baseline dominate; with warm XLA/datagen caches this collapses)
     worst_q = 45.0
     for qname in ORDER:
         if elapsed() > budget - worst_q:
@@ -261,20 +417,20 @@ def main():
         q_start = elapsed()
         text = QUERIES[QID[qname]]
         try:
-            cpu_t[qname], cpu_val = _best(cpu_fns[qname], cpu_reps)
+            cpu_t[qname], cpu_val, src = cpu_baseline(
+                qname, sf, cpu_fns[qname], cpu_reps
+            )
             rs = sess.sql(text)  # compile + first run
             ok = check_result(qname, rs, cpu_val)
             e2e, _ = _best(lambda t=text: sess.sql(t), max(2, reps // 2))
             # device-path timing through the SAME cached executable the
             # session compiled (a separately prepared plan would re-trace
-            # and pay a second ~100s remote compile on the axon tunnel)
+            # and pay a second remote compile on the axon tunnel)
             entry, qp = sess.cached_entry(text)
             assert entry is not None, "plan cache miss on timed re-fetch"
             prepared = entry.prepared
             prepared.run(qparams=qp)  # warm
-            # amortized dispatch: K back-to-back executions, one sync —
-            # a single dispatch+fetch mostly measures host<->device
-            # round-trip latency, not the program
+            # amortized dispatch: K back-to-back executions, one sync
             K = 8
 
             def _run_k(p=prepared, q=qp):
@@ -288,8 +444,10 @@ def main():
             qd = {
                 "tpu_s": round(tpu_t[qname], 6),
                 "cpu_s": round(cpu_t[qname], 6),
+                "cpu_source": src,
                 "e2e_s": round(e2e, 6),
                 "speedup": round(cpu_t[qname] / tpu_t[qname], 3),
+                "vs_e2e": round(cpu_t[qname] / e2e, 3),
                 "rows_per_s": round(n / tpu_t[qname], 1),
                 "correct": bool(ok),
             }
@@ -299,6 +457,57 @@ def main():
             detail[f"{qname}_error"] = f"{type(e).__name__}: {e}"
         worst_q = max(worst_q, (elapsed() - q_start) * 1.1)
         summary(tpu_t, cpu_t)
+
+    # ---- out-of-core streamed section (SF >= 30 through the chunked
+    # executor with a reduced device budget) ---------------------------
+    stream_cached = os.path.isdir(cache_path(stream_sf)) or os.path.exists(
+        _legacy_npz(stream_sf)
+    )
+    if stream_sf > 0 and stream_cached and elapsed() < budget - 90:
+        try:
+            t0 = time.perf_counter()
+            tables_s, src_s = load_or_generate(stream_sf)
+            li_s = tables_s["lineitem"]
+            n_s = li_s.nrows
+            sess_s = Session(tables_s, unique_keys=UNIQUE_KEYS)
+            seed_stats(sess_s, tables_s, stream_sf)
+            # force real streaming: lineitem may NOT ride up whole
+            sess_s.executor.device_budget = 2 << 30
+            detail["stream_sf"] = stream_sf
+            detail["stream_rows"] = int(n_s)
+            detail["stream_tables_source"] = src_s
+            detail["stream_device_budget"] = 2 << 30
+            detail["streamed"] = True
+            for qname in ("q6", "q1"):
+                if elapsed() > budget - 45:
+                    detail[f"stream_{qname}_skipped"] = "budget"
+                    continue
+                text = QUERIES[QID[qname]]
+                fn = {"q6": lambda: q6_numpy(li_s),
+                      "q1": lambda: q1_numpy_fast(li_s)}[qname]
+                cpu_s, cpu_val, src = cpu_baseline(
+                    qname, stream_sf, fn, 1
+                )
+                t1 = time.perf_counter()
+                rs = sess_s.sql(text)  # compile + stream
+                first_s = time.perf_counter() - t1
+                ok = check_result(qname, rs, cpu_val)
+                t1 = time.perf_counter()
+                rs = sess_s.sql(text)  # warm plan: pure streaming cost
+                warm_s = time.perf_counter() - t1
+                detail[f"stream_{qname}_e2e_s"] = round(warm_s, 3)
+                detail[f"stream_{qname}_first_s"] = round(first_s, 3)
+                detail[f"stream_{qname}_cpu_s"] = round(cpu_s, 3)
+                detail[f"stream_{qname}_cpu_source"] = src
+                detail[f"stream_{qname}_vs_e2e"] = round(cpu_s / warm_s, 3)
+                detail[f"stream_{qname}_rows_per_s"] = round(n_s / warm_s, 1)
+                detail[f"stream_{qname}_correct"] = bool(ok)
+                summary(tpu_t, cpu_t)
+        except Exception as e:  # pragma: no cover
+            detail["stream_error"] = f"{type(e).__name__}: {e}"
+    elif stream_sf > 0 and not stream_cached:
+        detail["stream_skipped"] = "no cached tables (populate offline)"
+
     # final line re-emits with any budget-skip markers included
     summary(tpu_t, cpu_t)
 

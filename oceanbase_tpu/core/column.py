@@ -106,7 +106,8 @@ def make_batch(
     for f in schema.fields:
         a = np.asarray(data[f.name], dtype=f.dtype.storage_np)
         if cap > n:
-            a = np.concatenate([a, np.zeros(cap - n, dtype=a.dtype)])
+            a = np.concatenate(
+                [a, np.zeros((cap - n,) + a.shape[1:], dtype=a.dtype)])
         cols[f.name] = jnp.asarray(a)
         if f.dtype.nullable:
             v = (

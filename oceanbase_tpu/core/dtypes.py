@@ -45,6 +45,7 @@ class TypeKind(enum.Enum):
     DATE = "date"  # int32 days since epoch
     TIMESTAMP = "timestamp"  # int64 microseconds since epoch
     VARCHAR = "varchar"  # dict-encoded int32 codes
+    VECTOR = "vector"  # fixed-dim float32 rows; precision = dimension
 
 
 _INT_KINDS = {TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64}
@@ -111,6 +112,14 @@ class DataType:
     def varchar(nullable: bool = False) -> "DataType":
         return DataType(TypeKind.VARCHAR, nullable=nullable)
 
+    @staticmethod
+    def vector(dim: int) -> "DataType":
+        """Fixed-dimension embedding column: float32 rows of shape (dim,)
+        (reference: src/storage/vector_index — obvec stores float arrays;
+        here the whole column is one (n, dim) device matrix so distance
+        scoring is a matmul on the MXU)."""
+        return DataType(TypeKind.VECTOR, precision=dim)
+
     # ---- physical representation -------------------------------------
     @property
     def storage_np(self) -> np.dtype:
@@ -125,7 +134,7 @@ class DataType:
             return np.dtype(np.int32)
         if k in (TypeKind.INT64, TypeKind.TIMESTAMP):
             return np.dtype(np.int64)
-        if k is TypeKind.FLOAT32:
+        if k in (TypeKind.FLOAT32, TypeKind.VECTOR):
             return np.dtype(np.float32)
         if k is TypeKind.FLOAT64:
             return np.dtype(np.float64)

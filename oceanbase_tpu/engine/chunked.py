@@ -69,6 +69,8 @@ class NotStreamable(Exception):
 
 
 def scan_bytes(catalog, scan: Scan, needed_cols) -> int:
+    if scan.table == "$dual":
+        return 1
     t = catalog[scan.table]
     cols = needed_cols.get(scan.alias) or set(
         [t.schema.fields[0].name]
@@ -357,6 +359,11 @@ class _ChunkSourceExecutor(ChunkWindowMixin, Executor):
     """Executor whose streamed table reads one fixed-capacity chunk."""
 
     chunking_enabled = False
+    # chunk windows break the whole-table storage-order premise of the
+    # clustered-FK segment aggregation (fk_ranges index full-table rows)
+    # and of dynamic-slice range pruning (bounds index full-table rows)
+    clustered_agg_enabled = False
+    scan_slice_enabled = False
 
     def __init__(self, catalog, stream_table: str, chunk_rows: int, **kw):
         super().__init__(catalog, **kw)

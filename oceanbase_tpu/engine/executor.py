@@ -140,6 +140,15 @@ class PhysicalParams:
     # nid); overflow disables packing for that node and recompiles.
     pack_guard: dict[int, tuple] = field(default_factory=dict)
     groupby_nopack: set = field(default_factory=set)
+    # clustered-FK segment aggregation specs (nid -> ClusteredAggSpec),
+    # re-detected on every compile (deterministic from plan + catalog)
+    clustered_aggs: dict = field(default_factory=dict)
+    # range-pruned sorted-projection scans: nid -> _SliceSpec, with the
+    # static slice capacity in scan_cap (overflow-bumped like join caps)
+    scan_slice: dict = field(default_factory=dict)
+    scan_cap: dict[int, int] = field(default_factory=dict)
+    # ANN: TopN-over-vec_l2 nodes served by an IVF index (nid -> spec)
+    vector_topns: dict = field(default_factory=dict)
 
     def bump(self, overflows: dict[int, int]):
         for nid in overflows:
@@ -152,6 +161,65 @@ class PhysicalParams:
                 self.join_cap[nid] *= 4
             if nid in self.exchange_cap:
                 self.exchange_cap[nid] *= 4
+            if nid in self.scan_cap:
+                # the slice capacity was seeded from ONE representative
+                # parameter value; a wider runtime range is the normal
+                # plan-cache reuse case, so the retry must always
+                # resolve: drop back to the unsliced full scan (cap >=
+                # table rows disables slicing in the Scan emission)
+                self.scan_cap[nid] = 1 << 62
+
+
+class ClusteredPremiseInvalidated(Exception):
+    """A cached plan's clustered-FK premise no longer holds (the probe
+    table's data changed and its fk column is no longer monotone);
+    PreparedPlan.run recompiles, which re-detects and drops the spec."""
+
+
+@dataclass(frozen=True)
+class _SliceSpec:
+    """Range bounds of a sorted-projection scan: the scan reads only the
+    contiguous key range [max(lows), min(highs)) via device binary search
+    + dynamic_slice (engine/executor.py Scan emission). Bounds are
+    (Literal, searchsorted side) pairs so slotted literals keep the plan
+    reusable across parameter values."""
+
+    key: str                   # qualified sort-key column
+    lows: tuple = ()           # (E.Literal, 'left'|'right') lower bounds
+    highs: tuple = ()          # (E.Literal, 'left'|'right') upper bounds
+
+
+@dataclass(frozen=True)
+class VectorTopNSpec:
+    """ORDER BY vec_l2(col, q) LIMIT k over an IVF-indexed scan: probe =
+    centroid matmul + top-nprobe + contiguous-list candidate gather +
+    exact re-rank matmul + top-k (storage/vector_index.py)."""
+
+    table: str
+    column: str        # unqualified vector column
+    qual_col: str      # alias-qualified name in the scan batch
+    input_alias: str
+    nprobe: int        # static: probed lists
+    max_list: int      # static: per-list read window
+    nrows: int         # static: live rows of the table at compile
+    k: int
+    key: object        # the vec_l2 Func (resolved through the Project)
+    scan: object       # the Scan node to emit
+    proj: object       # Project between TopN and Scan (or None)
+
+
+@dataclass(frozen=True)
+class ClusteredAggSpec:
+    """One Aggregate-over-PK-FK-join collapsed into segment reductions
+    (see Executor._clustered_agg_spec)."""
+
+    ji: object        # the JoinOp replaced by per-build-row range sums
+    probe_table: str
+    fk_col: str       # clustered probe key (unqualified storage column)
+    fk_name: str      # qualified probe-side join key name
+    build_table: str
+    pk_col: str
+    input_alias: str  # inputs key carrying the (starts, ends) arrays
 
 
 def _number_nodes(plan: LogicalOp) -> dict[int, LogicalOp]:
@@ -246,6 +314,13 @@ def _dict_domain(batch: ColumnBatch, e: E.Expr) -> int | None:
 class Executor:
     # subclasses that manage their own placement (PX) disable chunking
     chunking_enabled = True
+    # clustered-FK segment aggregation requires whole-table inputs in
+    # storage order; sharded (PX) and chunk-streamed executors disable it
+    clustered_agg_enabled = True
+    # range-pruned slicing of sorted-projection scans needs whole-table
+    # device columns (shards/chunks would misindex); the projection SWAP
+    # itself is layout-only and stays on everywhere
+    scan_slice_enabled = True
 
     def __init__(self, catalog, unique_keys=None, default_rows_estimate=1 << 16,
                  stats=None, device_budget=None, chunk_rows=None):
@@ -264,6 +339,10 @@ class Executor:
         )
         self.chunk_rows = chunk_rows or DEFAULT_CHUNK_ROWS
         self._batch_cache: dict[tuple[str, tuple], ColumnBatch] = {}
+        # bumped by invalidate_table; derived device structures that span
+        # TWO tables (fk_ranges) revalidate against both versions, since
+        # the key-prefix delete in invalidate_table only covers one
+        self._table_version: dict[str, int] = {}
 
     # ---- input preparation -------------------------------------------
     def _collect_scans(self, plan: LogicalOp) -> list[Scan]:
@@ -329,10 +408,143 @@ class Executor:
 
     def invalidate_table(self, name: str) -> None:
         """Drop cached device batches of one table (its data changed)."""
+        self._table_version[name] = self._table_version.get(name, 0) + 1
         for key in [k for k in self._batch_cache if k[0] == name]:
             del self._batch_cache[key]
 
+    def fk_ranges(self, probe_table: str, fk_col: str,
+                  build_table: str, pk_col: str):
+        """Device (starts, ends) int32 arrays over build-table rows: build
+        row i joins exactly the probe rows [starts[i], ends[i]) — valid
+        because the probe's fk column is stored CLUSTERED (monotone
+        nondecreasing, checked by _monotone_col before any caller gets
+        here). Host-precomputed by binary search once per table version and
+        cached like device columns; this is the LSM analog of the
+        reference's ordered-index row ranges (an FK sstable scan range per
+        PK, cf. storage/access table scan ranges) and what lets a PK-FK
+        join + group-by collapse into segment reductions with no sort and
+        no per-probe-row gather."""
+        vp = self._table_version.get(probe_table, 0)
+        vb = self._table_version.get(build_table, 0)
+        key = (probe_table, ("#fkr", fk_col, build_table, pk_col))
+        hit = self._batch_cache.get(key)
+        if hit is not None and hit[0] == (vp, vb):
+            return hit[1]
+        # data changed since the spec was detected: the clustering premise
+        # must be re-proven, not assumed — a cached plan over a now
+        # unsorted fk would binary-search garbage and silently mis-group
+        if not self._monotone_col(probe_table, fk_col):
+            raise ClusteredPremiseInvalidated(
+                f"{probe_table}.{fk_col} is no longer monotone"
+            )
+        tp = self.catalog[probe_table]
+        tb = self.catalog[build_table]
+        fk = np.asarray(tp.data[fk_col])
+        pk = np.asarray(tb.data[pk_col])
+        lo = np.searchsorted(fk, pk, side="left").astype(np.int32)
+        hi = np.searchsorted(fk, pk, side="right").astype(np.int32)
+        cap = max(1024, -(-max(tb.nrows, 1) // 1024) * 1024)
+        if cap > len(lo):
+            pad = np.zeros(cap - len(lo), dtype=np.int32)
+            lo = np.concatenate([lo, pad])
+            hi = np.concatenate([hi, pad])
+        dev = (jnp.asarray(lo), jnp.asarray(hi))
+        self._batch_cache[key] = ((vp, vb), dev)
+        return dev
+
+    def input_batch(self, alias: str, table: str, cols: tuple):
+        """One jit input from its input_spec entry: a table ColumnBatch,
+        or a derived structure ('#fkr:' = clustered-FK join ranges,
+        '#ivf:' = IVF vector-index arrays)."""
+        if alias.startswith("#fkr:"):
+            return self.fk_ranges(*cols)
+        if alias.startswith("#ivf:"):
+            tname, col, max_list = cols
+            return self.ivf_device(tname, col, max_list)
+        return self.table_batch(table, cols)
+
+    def ivf_host(self, table: str, col: str):
+        """Built IvfIndex for (table, col), version-cached: DML bumps the
+        table version and the next use REBUILDS (index maintenance =
+        invalidate + lazy rebuild, same contract as sorted projections)."""
+        from ..storage.vector_index import build_ivf
+
+        t = self.catalog[table]
+        spec = getattr(t, "vector_indexes", {}).get(col)
+        if spec is None:
+            return None
+        v = self._table_version.get(table, 0)
+        key = (table, ("#ivfh", col))
+        hit = self._batch_cache.get(key)
+        if hit is not None and hit[0] == v:
+            return hit[1]
+        idx = build_ivf(np.asarray(t.data[col]), lists=spec.lists)
+        self._batch_cache[key] = (v, idx)
+        return idx
+
+    def ivf_device(self, table: str, col: str, expect_max_list: int):
+        """(centroids, perm, offsets, lengths) device arrays; raises the
+        premise-invalidated recompile signal when a rebuild changed the
+        static window shape the compiled program assumed."""
+        idx = self.ivf_host(table, col)
+        if idx is None or idx.max_list != expect_max_list:
+            raise ClusteredPremiseInvalidated(
+                f"vector index on {table}.{col} changed shape"
+            )
+        v = self._table_version.get(table, 0)
+        key = (table, ("#ivfd", col))
+        hit = self._batch_cache.get(key)
+        if hit is not None and hit[0] == v:
+            return hit[1]
+        dev = (
+            jnp.asarray(idx.centroids),
+            jnp.asarray(idx.perm),
+            jnp.asarray(idx.offsets),
+            jnp.asarray(idx.lengths),
+        )
+        self._batch_cache[key] = (v, dev)
+        return dev
+
+    # host-side monotonicity cache (id+weakref discipline: see
+    # _affine_cache below for why a bare id is not enough)
+    _monotone_cache: dict = {}
+
+    def _monotone_col(self, table: str, col: str) -> bool:
+        """True when the stored column array is monotone NONDECREASING —
+        i.e. the table is physically clustered by this column (LSM tables
+        laid out in key order; TPC-H lineitem by l_orderkey). Nullable
+        columns are excluded: NULL rows carry arbitrary storage values."""
+        try:
+            t = self.catalog[table]
+            arr = t.data[col]
+        except (KeyError, AttributeError):
+            return False
+        if col in getattr(t, "valid", {}):
+            return False
+        if not isinstance(arr, np.ndarray) or arr.ndim != 1 or len(arr) < 1:
+            return False
+        if not np.issubdtype(arr.dtype, np.integer):
+            return False
+        key = id(arr)
+        hit = Executor._monotone_cache.get(key)
+        if hit is not None and hit[0]() is arr:
+            return hit[1]
+        if len(Executor._monotone_cache) > 4096:
+            Executor._monotone_cache.clear()
+        out = bool(np.all(arr[1:] >= arr[:-1]))
+        Executor._monotone_cache[key] = (weakref.ref(arr), out)
+        return out
+
     def table_batch(self, name: str, cols: tuple[str, ...]) -> ColumnBatch:
+        if name == "$dual":  # FROM-less SELECT: one anonymous row
+            return ColumnBatch(
+                cols={"$one": jnp.zeros(1, jnp.int8)},
+                valid={},
+                sel=jnp.ones(1, jnp.bool_),
+                nrows=jnp.ones((), jnp.int64),
+                schema=Schema((Field("$one", DataType.int8()),)),
+                dicts={},
+            )
         is_private = getattr(self.catalog, "is_private", None)
         if is_private is not None and is_private(name):
             # tx-private view: never enters (or reads) the shared device
@@ -357,7 +569,8 @@ class Executor:
                 a = np.asarray(t.data[f.name], dtype=f.dtype.storage_np)
                 if cap > n:
                     a = np.concatenate(
-                        [a, np.zeros(cap - n, dtype=a.dtype)])
+                        [a, np.zeros((cap - n,) + a.shape[1:],
+                                     dtype=a.dtype)])
                 dev = jnp.asarray(a)
                 vdev = None
                 if f.dtype.nullable:
@@ -411,6 +624,8 @@ class Executor:
         layer's distribution-method choice)."""
         est_rows = self._est_rows
         if isinstance(op, Scan):
+            if op.table == "$dual":
+                return 1.0
             t = self.catalog[op.table]
             base = t.nrows or 1
             if op.pushed_filter is not None:
@@ -436,6 +651,14 @@ class Executor:
             if not op.left_keys:  # cross / scalar broadcast
                 return l if self._is_scalar_relation(op.right) else l * r
             if self._join_build_unique(op):
+                # each probe row matches at most one build row; the MATCH
+                # RATE is the filtered fraction of the build's key space
+                # (containment): est(right)/|build base|. Floored at 0.05
+                # — correlated filters make underestimates, and every
+                # overflow retry is a recompile
+                rb = self._build_base_rows(op.right)
+                if rb and rb > 0:
+                    return max(l * max(min(r / rb, 1.0), 0.05), 1.0)
                 return l
             # M:N equi-join: |L||R| / max(ndv(Lkeys), ndv(Rkeys)) — the
             # textbook containment estimate (ob_opt_selectivity analog)
@@ -535,7 +758,14 @@ class Executor:
         # the input capacity, so no table sizes (and no overflow retries)
         # are seeded for them
         for nid, op in nodes.items():
-            if isinstance(op, Aggregate) and len(op.group_keys) > 1:
+            if isinstance(op, Scan) and self.scan_slice_enabled:
+                ps = getattr(self, "_pending_slices", {}).get(id(op))
+                if ps is not None and nid not in params.scan_slice:
+                    params.scan_slice[nid], params.scan_cap[nid] = ps
+            if (
+                isinstance(op, Aggregate) and len(op.group_keys) > 1
+                and op.grouping_sets is None
+            ):
                 # multi-key sort group-bys pack into ONE int64 sort key
                 # when every key's domain is statically known: wide
                 # multi-operand sorts go superlinear past ~16M rows on
@@ -575,24 +805,14 @@ class Executor:
     # pinning superseded multi-MB columns until the 4096-entry clear.
     _affine_cache: dict[int, tuple["weakref.ref", tuple[int, int] | None]] = {}
 
-    def _affine_build_info(self, op: JoinOp) -> tuple[int, int] | None:
-        """(a0, stride) when the build side's single join-key column is an
-        AFFINE sequence in storage order (key[i] = a0 + stride*i) — true
-        for identifier columns of LSM tables laid out in key order with
-        regular keys (every TPC-H key column). Such joins skip sorting
-        entirely: the matching build row is (key - a0) / stride, verified
-        by one gather — a direct-address join (the TPU answer to the
-        reference's hash table; cf. dense dict decoders in
-        blocksstable/encoding). Filters/projections above the scan keep
-        the array layout (they only mask/rename), so the property holds
-        through them."""
-        if not op.left_keys or len(op.right_keys) != 1:
-            return None
-        e = op.right_keys[0]
-        node = op.right
-        name = e.name if isinstance(e, E.ColRef) else None
-        if name is None:
-            return None
+    def _resolve_layout_col(self, node: LogicalOp, name: str):
+        """(table, col) when output column `name` of `node` IS a base
+        Scan's stored array (same length, same order — only the sel mask
+        differs), seen through the layout-preserving ops: Filter, Project
+        renames, and the PROBE side of joins that keep the probe layout
+        (semi/anti always; inner via the merge/affine path, which emits
+        probe columns untouched and only gathers build columns). None
+        when the column is computed, gathered, or re-ordered."""
         while True:
             if isinstance(node, Filter):
                 node = node.child
@@ -602,6 +822,14 @@ class Executor:
                     return None
                 name = nxt.name
                 node = node.child
+            elif isinstance(node, JoinOp) and (
+                node.kind in ("semi", "anti")
+                or (node.kind == "inner" and self._merge_joinable(node))
+            ):
+                # a build-side column would gather (new layout), but then
+                # its alias only exists in the right subtree and the final
+                # Scan-alias check below fails — the walk stays honest
+                node = node.left
             else:
                 break
         if not isinstance(node, Scan) or "." not in name:
@@ -609,8 +837,35 @@ class Executor:
         alias, col = name.split(".", 1)
         if alias != node.alias:
             return None
+        return node.table, col
+
+    def _affine_build_info(self, op: JoinOp) -> tuple[int, int] | None:
+        """(a0, stride) when the build side's single join-key column is an
+        AFFINE sequence in storage order (key[i] = a0 + stride*i) — true
+        for identifier columns of LSM tables laid out in key order with
+        regular keys (every TPC-H key column). Such joins skip sorting
+        entirely: the matching build row is (key - a0) / stride, verified
+        by one gather — a direct-address join (the TPU answer to the
+        reference's hash table; cf. dense dict decoders in
+        blocksstable/encoding). Filters/projections/layout-preserving
+        joins above the scan keep the array layout (they only mask or
+        rename), so the property holds through them."""
+        if not op.left_keys or len(op.right_keys) != 1:
+            return None
+        e = op.right_keys[0]
+        if not isinstance(e, E.ColRef):
+            return None
+        hit = self._resolve_layout_col(op.right, e.name)
+        if hit is None:
+            return None
+        table, col = hit
+        if "#sp:" in table:
+            # routed projection scans may be DYNAMICALLY SLICED
+            # (params.scan_slice): affine candidates index full-table
+            # rows and would misindex the sliced batch
+            return None
         try:
-            arr = self.catalog[node.table].data[col]
+            arr = self.catalog[table].data[col]
         except (KeyError, AttributeError):
             return None
         if not isinstance(arr, np.ndarray) or arr.ndim != 1 or len(arr) < 2:
@@ -683,6 +938,21 @@ class Executor:
             prod *= nd
         return prod
 
+    def _build_base_rows(self, node: LogicalOp) -> float | None:
+        """UNFILTERED row count of the base relation a unique-build side
+        reads — the denominator of the join match-rate estimate. Walks
+        the same layout chain as _join_build_unique."""
+        while isinstance(node, (Filter, Project)):
+            node = node.child
+        if isinstance(node, JoinOp) and node.kind in ("inner", "semi", "anti"):
+            return self._build_base_rows(node.left)
+        if isinstance(node, Scan):
+            try:
+                return float(self.catalog[node.table].nrows or 1)
+            except KeyError:
+                return None
+        return None
+
     def _group_ndv(self, op: Aggregate) -> float | None:
         """Product of group-key NDVs (grouping cardinality upper bound)."""
         if self.stats is None or not op.group_keys:
@@ -716,7 +986,11 @@ class Executor:
         """True if the build (right) side's join keys cover a unique key of
         its source: a base table's declared unique key, an Aggregate's full
         group-key set, or a Distinct's full column set — seen through
-        Filter/Project (renames followed)."""
+        Filter/Project (renames followed) and through joins that cannot
+        duplicate probe rows (semi/anti, and inner joins whose own build
+        side is unique: each probe row matches at most one build row, so
+        output rows are a subset of the probe side's rows and a unique key
+        of the probe side stays unique)."""
         if self._is_scalar_relation(op.right):
             return True
         names = []
@@ -738,6 +1012,11 @@ class Executor:
                     nxt.append(ex.name)
                 names = nxt
                 node = node.child
+            elif isinstance(node, JoinOp) and (
+                node.kind in ("semi", "anti")
+                or (node.kind == "inner" and self._join_build_unique(node))
+            ):
+                node = node.left
             else:
                 break
         if isinstance(node, Aggregate):
@@ -747,12 +1026,470 @@ class Executor:
             cols = set(output_schema(node).names())
             return cols <= set(names)
         if isinstance(node, Scan):
-            uks = self.unique_keys.get(node.table, ())
+            # a routed sorted projection keeps the base table's rows (and
+            # so its unique keys) under the '#sp:' name
+            base = node.table.split("#sp:", 1)[0]
+            uks = tuple(self.unique_keys.get(node.table, ())) + tuple(
+                self.unique_keys.get(base, ())
+            )
             key_cols = {
                 n.split(".", 1)[1] for n in names if n.startswith(node.alias + ".")
             }
             return any(set(uk) <= key_cols for uk in uks)
         return False
+
+    # ---- sorted-projection scan routing -------------------------------
+    _RANGE_KINDS = (TypeKind.DATE, TypeKind.INT8, TypeKind.INT16,
+                    TypeKind.INT32, TypeKind.INT64)
+
+    def _route_projections(self, plan: LogicalOp) -> LogicalOp:
+        """Swap eligible Scans to sorted projections of their table (the
+        index-selection step: a selective range predicate on a projection's
+        sort key + covered columns). The swap alone is layout-only (same
+        rows, different order) and correct under every executor; the
+        contiguous-slice optimization rides separately via
+        params.scan_slice where scan_slice_enabled."""
+        self._pending_slices = {}
+        needed = self._needed_columns(plan)
+
+        def rec(op):
+            # identity-preserving: PX keys distribution decisions by plan
+            # node id, so untouched subtrees must come back AS-IS
+            if isinstance(op, Scan):
+                out = self._projection_choice(op, needed.get(op.alias, set()))
+                return out if out is not None else op
+            if isinstance(op, (JoinOp, SetOp)):
+                left, right = rec(op.left), rec(op.right)
+                if left is op.left and right is op.right:
+                    return op
+                return replace(op, left=left, right=right)
+            if hasattr(op, "child"):
+                child = rec(op.child)
+                return op if child is op.child else replace(op, child=child)
+            return op
+
+        return rec(plan)
+
+    def _projection_choice(self, scan: Scan, needed_cols: set):
+        if scan.pushed_filter is None:
+            return None
+        try:
+            t = self.catalog[scan.table]
+        except KeyError:
+            return None
+        projs = getattr(t, "sorted_projections", None)
+        if not projs:
+            return None
+        from ..expr.compile import bind_value
+
+        conj = self._conjuncts(scan.pushed_filter)
+        best = None
+        for key_col, pname in projs.items():
+            if key_col in t.dicts:
+                continue  # dict codes are not value-ordered
+            try:
+                kt = t.schema[key_col]
+            except Exception:
+                continue
+            if kt.kind not in self._RANGE_KINDS:
+                continue  # decimal scales / floats: sides would mis-round
+            qual = f"{scan.alias}.{key_col}"
+            lows, highs = [], []
+            for c in conj:
+                for kind, lit in _range_bounds(c, qual):
+                    if not (lit.value is not None
+                            and lit.dtype.kind in self._RANGE_KINDS):
+                        continue
+                    if kind in ("ge", "gt"):
+                        lows.append(
+                            (lit, "left" if kind == "ge" else "right"))
+                    elif kind in ("lt", "le"):
+                        highs.append(
+                            (lit, "left" if kind == "lt" else "right"))
+                    else:  # eq
+                        lows.append((lit, "left"))
+                        highs.append((lit, "right"))
+            if not lows and not highs:
+                continue
+            try:
+                pt = self.catalog[pname]
+            except KeyError:
+                continue
+            pcols = {f.name for f in pt.schema.fields}
+            if not needed_cols <= pcols:
+                continue
+            arr = pt.data[key_col]
+            n = len(arr)
+            if n < 2:
+                continue
+            # representative bounds (parameterized literals keep their
+            # planning-time value) -> exact count for the static capacity;
+            # a different runtime value overflows and bumps the capacity
+            lo_i = max(
+                (int(np.searchsorted(arr, bind_value(l.value, l.dtype), s))
+                 for l, s in lows), default=0,
+            )
+            hi_i = min(
+                (int(np.searchsorted(arr, bind_value(h.value, h.dtype), s))
+                 for h, s in highs), default=n,
+            )
+            cnt = max(hi_i - lo_i, 0)
+            if cnt > 0.25 * n:
+                continue  # not selective enough to beat the masked scan
+            if best is None or cnt < best[0]:
+                best = (cnt, pname, _SliceSpec(qual, tuple(lows), tuple(highs)))
+        if best is None:
+            return None
+        cnt, pname, spec = best
+        new_scan = replace(scan, table=pname)
+        cap = -(-int(cnt * 1.25 + 1024) // 1024) * 1024
+        self._pending_slices[id(new_scan)] = (spec, cap)
+        return new_scan
+
+    # ---- ANN vector top-n ---------------------------------------------
+    def _vector_topn_spec(self, op: TopN):
+        """Match ORDER BY vec_l2(col, q) [ASC] LIMIT k directly over an
+        un-filtered Scan of a table with an IVF index on `col` — the ANN
+        fast path (the reference's vector-index DAS iterator,
+        src/sql/das/iter). Index presence is the opt-in for approximate
+        results, like obvec; everything else brute-forces exactly
+        through the generic TopN (still a matmul + top-k)."""
+        if op.offset != 0 or len(op.keys) != 1:
+            return None
+        e, desc = op.keys[0]
+        if desc:
+            return None
+        node = op.child
+        proj = None
+        if isinstance(node, Project):
+            # the planner hoists ORDER BY exprs into the projection as
+            # $ordN; resolve the key ColRef back to its expression
+            proj = node
+            if isinstance(e, E.ColRef):
+                e = dict(node.exprs).get(e.name, e)
+            node = node.child
+        if not isinstance(e, E.Func) or e.name != "vec_l2":
+            return None
+        if not isinstance(node, Scan) or node.pushed_filter is not None:
+            return None
+        colref = e.args[0]
+        if not isinstance(colref, E.ColRef) or "." not in colref.name:
+            return None
+        alias, col = colref.name.split(".", 1)
+        if alias != node.alias:
+            return None
+        try:
+            t = self.catalog[node.table]
+        except KeyError:
+            return None
+        spec = getattr(t, "vector_indexes", {}).get(col)
+        if spec is None:
+            return None
+        idx = self.ivf_host(node.table, col)
+        if idx is None or idx.max_list == 0:
+            return None
+        nprobe = max(1, min(spec.nprobe, len(idx.lengths)))
+        return VectorTopNSpec(
+            table=node.table,
+            column=col,
+            qual_col=colref.name,
+            input_alias=f"#ivf:{node.table}.{col}",
+            nprobe=nprobe,
+            max_list=idx.max_list,
+            nrows=t.nrows,
+            k=op.n,
+            key=e,
+            scan=node,
+            proj=proj,
+        )
+
+    def _emit_vector_topn(self, op: TopN, nid, spec: VectorTopNSpec,
+                          inputs, emit, params):
+        from ..expr.compile import evaluate_vector_literal
+
+        # emit the SCAN, not the projection above it: the hoisted $ordN
+        # distance column would otherwise evaluate over every row,
+        # exactly the full matmul the index exists to avoid — the
+        # projection re-applies over the k winners below
+        child, ovf = emit(spec.scan, inputs)
+        cent, perm, offs, lens = inputs[spec.input_alias]
+        q = evaluate_vector_literal(spec.key.args[1])
+        # round 1: nearest lists by centroid distance (rank-invariant
+        # form drops ||q||^2 and ||x||^2-of-centroids keeps)
+        cdist = jnp.sum(cent * cent, axis=1) - 2.0 * (cent @ q)
+        _, probes = jax.lax.top_k(-cdist, spec.nprobe)
+        starts = offs[probes]
+        ll = lens[probes]
+        win = starts[:, None] + jnp.arange(spec.max_list, dtype=jnp.int32)
+        wvalid = (
+            jnp.arange(spec.max_list, dtype=jnp.int32)[None, :] < ll[:, None]
+        )
+        n = spec.nrows
+        rows = perm[jnp.clip(win, 0, max(n - 1, 0))].reshape(-1)
+        wv = wvalid.reshape(-1)
+        # round 2: exact re-rank of the candidate windows
+        xv = child.cols[spec.qual_col][rows]          # (C, d) row gather
+        dist = jnp.sum(xv * xv, axis=1) - 2.0 * (xv @ q)
+        live = wv & child.sel[rows]
+        dist = jnp.where(live, dist, jnp.inf)
+        k = min(spec.k, rows.shape[0])
+        neg, top_i = jax.lax.top_k(-dist, k)
+        win_rows = rows[top_i]
+        cols, valid, _ = gather_payload(child.cols, child.valid, win_rows)
+        sel = neg > -jnp.inf
+        out = ColumnBatch(
+            cols=cols,
+            valid=valid,
+            sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=child.schema,
+            dicts=child.dicts,
+        )
+        if spec.proj is not None:
+            out = self._project_batch(spec.proj, out)
+        return out, ovf
+
+    # ---- clustered-FK segment aggregation -----------------------------
+    def _clustered_agg_spec(self, op: Aggregate):
+        """Match Aggregate directly over an inner PK-FK join whose probe
+        (left) side is a Filter chain over a Scan stored CLUSTERED by the
+        single join key (monotone nondecreasing storage). The join +
+        group-by then collapse into segment reductions: per-aggregate
+        cumsums over the probe side in storage order, differenced at the
+        host-precomputed per-build-row ranges (fk_ranges) — no sort, no
+        hash table, no per-probe-row gather. The TPU redesign of the
+        reference's group-by pushdown + vectorized hash join pair
+        (rewrite/ob_transform_groupby_pushdown.cpp,
+        engine/join/hash_join/ob_hash_join_vec_op.h:402): on a TPU the
+        winning join is the one the storage layout already did.
+
+        Matched shape:
+        - group keys: exprs over the join key and/or build-side columns
+          (each group IS one build row — build-side keys are functionally
+          dependent on it because the build side is unique per key)
+        - aggregates: non-DISTINCT sum/count over probe-side exprs
+        - join: merge-joinable (unique build, single integer key both
+          sides with equal storage types), no residual
+        """
+        if not op.group_keys or op.grouping_sets is not None:
+            return None
+        ji = op.child
+        if (
+            not isinstance(ji, JoinOp)
+            or ji.kind != "inner"
+            or ji.residual is not None
+            or len(ji.left_keys) != 1
+            or not isinstance(ji.left_keys[0], E.ColRef)
+            or not isinstance(ji.right_keys[0], E.ColRef)
+        ):
+            return None
+        if not self._merge_joinable(ji):
+            return None
+        try:
+            lt = infer_type(ji.left_keys[0], output_schema(ji.left))
+            rt = infer_type(ji.right_keys[0], output_schema(ji.right))
+        except Exception:
+            return None
+        if lt.storage_np != rt.storage_np:
+            # the group-key output substitutes the build pk for the probe
+            # fk; a dtype mismatch would change the output column type
+            return None
+        node = ji.left
+        while isinstance(node, Filter):
+            node = node.child
+        if not isinstance(node, Scan):
+            return None
+        base = node
+        if "#sp:" in base.table:
+            # routed sorted-projection scans may be DYNAMICALLY SLICED
+            # (params.scan_slice): fk_ranges index full-table rows and
+            # would misindex the sliced batch — never combine the two
+            return None
+        fk_name = ji.left_keys[0].name
+        if "." not in fk_name:
+            return None
+        alias, fk_col = fk_name.split(".", 1)
+        if alias != base.alias or not self._monotone_col(base.table, fk_col):
+            return None
+        hit = self._resolve_layout_col(ji.right, ji.right_keys[0].name)
+        if hit is None:
+            return None
+        build_table, pk_col = hit
+        if "#sp:" in build_table:
+            return None  # same slicing hazard on the build side
+        build_names = set(output_schema(ji.right).names())
+        # groups must be 1:1 with build rows: some group key must BE the
+        # join key itself (injective by identity). Keys that are merely
+        # functions of the build side (group by customer attrs over an
+        # orders build, TPC-H Q10) make groups COARSER than build rows
+        # and need a second aggregation — generic path handles those.
+        if not any(
+            e == ji.left_keys[0] or e == ji.right_keys[0]
+            for _n, e in op.group_keys
+        ):
+            return None
+        for _name, e in op.group_keys:
+            if not set(E.referenced_columns(e)) <= (build_names | {fk_name}):
+                return None
+        probe_names = set(output_schema(ji.left).names())
+        for _name, fn, arg, distinct in op.aggs:
+            if distinct or fn not in ("sum", "count"):
+                return None
+            if arg is not None and not (
+                set(E.referenced_columns(arg)) <= probe_names
+            ):
+                return None
+        input_alias = (
+            f"#fkr:{base.table}.{fk_col}->{build_table}.{pk_col}"
+        )
+        return ClusteredAggSpec(
+            ji, base.table, fk_col, fk_name, build_table, pk_col,
+            input_alias,
+        )
+
+    def _emit_grouping_sets(self, op: Aggregate, nid, inputs, emit, params):
+        """ROLLUP/CUBE/GROUPING SETS: aggregate once per set and stack
+        the results, NULL-filling keys absent from a set — the engine's
+        EXPAND (reference: the EXPAND phy operator replicates each input
+        row per grouping set and NULL-masks; here the replication
+        happens at the AGGREGATE level instead, which aggregates G
+        smaller problems rather than one G-times-larger sort and lets
+        each set reuse the engine's direct/packed/sort group-by routes).
+        XLA CSE collapses the G re-traced child subtrees."""
+        out_schema = _agg_schema(op, output_schema(op.child))
+        parts = []
+        ovf_all: dict = {}
+        for si, idxs in enumerate(op.grouping_sets):
+            sub = Aggregate(
+                op.child,
+                tuple(op.group_keys[i] for i in idxs),
+                op.aggs,
+            )
+            # pseudo node id: nothing seeded, so sub-aggregates take the
+            # parameter-free group-by routes (direct or unpacked sort)
+            pseudo = -(1_000_000 + nid * 64 + si)
+            b, ovf = self._emit_aggregate(sub, pseudo, inputs, emit, params)
+            ovf_all.update(ovf)
+            parts.append((idxs, b))
+        cols: dict[str, list] = {n: [] for n in out_schema.names()}
+        valid: dict[str, list] = {}
+        sels = []
+        key_names = [n for n, _e in op.group_keys]
+        for idxs, b in parts:
+            cap = b.capacity
+            present = {key_names[i] for i in idxs}
+            for f in out_schema.fields:
+                n = f.name
+                if n in present or n not in key_names:
+                    cols[n].append(
+                        b.cols[n].astype(f.dtype.storage_np))
+                    v = b.valid.get(n)
+                    if f.dtype.nullable:
+                        valid.setdefault(n, []).append(
+                            v if v is not None
+                            else jnp.ones(cap, jnp.bool_)
+                        )
+                else:  # key absent from this set: NULL
+                    cols[n].append(
+                        jnp.zeros(cap, dtype=f.dtype.storage_np))
+                    valid.setdefault(n, []).append(
+                        jnp.zeros(cap, jnp.bool_))
+            sels.append(b.sel)
+        out = ColumnBatch(
+            cols={n: jnp.concatenate(v) for n, v in cols.items()},
+            valid={n: jnp.concatenate(v) for n, v in valid.items()},
+            sel=jnp.concatenate(sels),
+            nrows=sum(
+                (jnp.sum(s, dtype=jnp.int64) for s in sels),
+                jnp.zeros((), jnp.int64),
+            ),
+            schema=out_schema,
+            dicts={
+                n: d
+                for _idxs, b in parts
+                for n, d in b.dicts.items()
+            },
+        )
+        return out, ovf_all
+
+    def _emit_clustered_agg(self, op: Aggregate, nid, spec: ClusteredAggSpec,
+                            inputs, emit, params):
+        """Emit the matched Aggregate-over-join as segment reductions.
+
+        Probe side (storage order, filters as sel): one cumsum per
+        aggregate plus a live-row cumsum; build side: the group table —
+        each live build row with >= 1 joined live probe row becomes a
+        group, its aggregates the cumsum differences at [start, end).
+        Exact (no hashing, no capacities, no overflow): the ranges are
+        host-precomputed from the clustered key, and the count/sum
+        semantics match the generic paths (NULL args skipped via
+        validity; sum over an empty/all-NULL group yields 0 like
+        sort_groupby's masked segmented cumsum)."""
+        from ..ops.gather import gather_rows
+        from ..sql.planner import _substitute
+
+        ji = spec.ji
+        L, lovf = emit(ji.left, inputs)
+        R, rovf = emit(ji.right, inputs)
+        ovf = {**lovf, **rovf}
+        starts, ends = inputs[spec.input_alias]
+        base_mask = L.sel
+        running: dict = {"#cnt": jnp.cumsum(base_mask.astype(jnp.int64))}
+        for i, (_name, fn, arg, _d) in enumerate(op.aggs):
+            if arg is None:
+                continue  # count(*) counts joined live rows == "#cnt"
+            v, vv = evaluate(arg, L)
+            am = base_mask if vv is None else base_mask & vv
+            if fn == "count":
+                running[i] = jnp.cumsum(am.astype(jnp.int64))
+            else:
+                acc = (
+                    jnp.int64
+                    if jnp.issubdtype(v.dtype, jnp.integer)
+                    else v.dtype
+                )
+                running[i] = jnp.cumsum(jnp.where(am, v, 0).astype(acc))
+        cap = L.capacity
+        # ONE packed row-gather per bound materializes every aggregate's
+        # running value (ops/gather.py); `upto(x) = c[x-1] if x>0 else 0`
+        at_hi = gather_rows(running, jnp.clip(ends - 1, 0, cap - 1))
+        at_lo = gather_rows(running, jnp.clip(starts - 1, 0, cap - 1))
+
+        def seg(k):
+            h = jnp.where(ends > 0, at_hi[k], 0)
+            lo = jnp.where(starts > 0, at_lo[k], 0)
+            return h - lo
+
+        cnt = seg("#cnt")
+        sel = R.sel & (cnt > 0)
+        # group keys evaluate on the build side; the probe fk substitutes
+        # to the build pk (equal on every surviving group by definition)
+        sub = {ji.left_keys[0]: ji.right_keys[0]}
+        cols, valid, dicts = {}, {}, {}
+        for name, e in op.group_keys:
+            e2 = _substitute(e, sub)
+            v, vv = evaluate(e2, R)
+            cols[name] = v
+            if vv is not None:
+                valid[name] = vv
+            if isinstance(e2, E.ColRef) and e2.name in R.dicts:
+                dicts[name] = R.dicts[e2.name]
+        for i, (name, fn, arg, _d) in enumerate(op.aggs):
+            cols[name] = cnt if arg is None else seg(i)
+        out_schema = _agg_schema(op, output_schema(op.child))
+        out = ColumnBatch(
+            cols=cols,
+            valid=valid,
+            sel=sel,
+            nrows=jnp.sum(sel, dtype=jnp.int64),
+            schema=out_schema,
+            dicts=dicts,
+        )
+        # NOTE: compacting this output before the downstream TopN was
+        # tried and measured SLOWER on chip (the sort-based compaction
+        # costs a full extra build-capacity pass, more than the TopN
+        # saves) — keep the full-capacity batch
+        return out, ovf
 
     # ---- tracing ------------------------------------------------------
     def compile(self, plan: LogicalOp, params: PhysicalParams):
@@ -765,11 +1502,46 @@ class Executor:
         for s in scans:
             cols = needed.get(s.alias, set())
             if not cols:
-                cols = {self.catalog[s.table].schema.fields[0].name}
+                cols = (
+                    {"$one"} if s.table == "$dual"
+                    else {self.catalog[s.table].schema.fields[0].name}
+                )
             input_spec.append((s.alias, s.table, tuple(sorted(cols))))
+
+        # clustered-FK aggregates + ANN top-n: re-detect every compile
+        # (deterministic from plan + catalog) and feed the precomputed
+        # derived structures as inputs
+        params.clustered_aggs.clear()
+        params.vector_topns.clear()
+        if self.clustered_agg_enabled:
+            for nid2, op2 in nodes.items():
+                if isinstance(op2, TopN):
+                    vspec = self._vector_topn_spec(op2)
+                    if vspec is not None:
+                        params.vector_topns[nid2] = vspec
+                        if all(a != vspec.input_alias
+                               for a, _t, _c in input_spec):
+                            input_spec.append((
+                                vspec.input_alias,
+                                vspec.table,
+                                (vspec.table, vspec.column, vspec.max_list),
+                            ))
+                if not isinstance(op2, Aggregate):
+                    continue
+                spec = self._clustered_agg_spec(op2)
+                if spec is not None:
+                    params.clustered_aggs[nid2] = spec
+                    if all(a != spec.input_alias for a, _t, _c in input_spec):
+                        input_spec.append((
+                            spec.input_alias,
+                            spec.probe_table,
+                            (spec.probe_table, spec.fk_col,
+                             spec.build_table, spec.pk_col),
+                        ))
 
         overflow_nodes: list[int] = sorted(
             set(params.groupby_size) | set(params.join_cap)
+            | set(params.scan_cap)
             | {
                 PACK_GUARD_BASE + nid
                 for nid in params.pack_guard
@@ -819,9 +1591,17 @@ class Executor:
                 schema=qschema,
                 dicts={f"{op.alias}.{n}": d for n, d in b.dicts.items()},
             )
+            ovf = {}
+            sl = params.scan_slice.get(nid)
+            if sl is not None and sl.key in qb.cols:
+                cap = params.scan_cap[nid]
+                n = self.catalog[op.table].nrows
+                if cap < n:
+                    qb, over = _slice_sorted_scan(qb, sl, cap, n)
+                    ovf[nid] = over
             if op.pushed_filter is not None:
                 qb = qb.with_sel(compile_predicate(op.pushed_filter, qb))
-            return qb, {}
+            return qb, ovf
 
         if isinstance(op, Filter):
             child, ovf = emit(op.child, inputs)
@@ -829,33 +1609,7 @@ class Executor:
 
         if isinstance(op, Project):
             child, ovf = emit(op.child, inputs)
-            cols, valid, dicts, fields = {}, {}, {}, []
-            for name, e in op.exprs:
-                derived = derive_dict_column(e, child)
-                if derived is not None:
-                    # string transform (substr): new dict column
-                    v, vv, d2 = derived
-                    dicts[name] = d2
-                else:
-                    v, vv = evaluate(e, child)
-                cols[name] = v
-                if vv is not None:
-                    valid[name] = vv
-                t = infer_type(e, child.schema)
-                fields.append(Field(name, t))
-                if isinstance(e, E.ColRef) and e.name in child.dicts:
-                    dicts[name] = child.dicts[e.name]
-            return (
-                ColumnBatch(
-                    cols=cols,
-                    valid=valid,
-                    sel=child.sel,
-                    nrows=child.nrows,
-                    schema=Schema(tuple(fields)),
-                    dicts=dicts,
-                ),
-                ovf,
-            )
+            return self._project_batch(op, child), ovf
 
         if isinstance(op, JoinOp):
             return self._emit_join(op, nid, inputs, emit, params)
@@ -894,6 +1648,11 @@ class Executor:
             return child.with_sel(keep), ovf
 
         if isinstance(op, TopN):
+            vspec = params.vector_topns.get(nid)
+            if vspec is not None and vspec.input_alias in inputs:
+                return self._emit_vector_topn(
+                    op, nid, vspec, inputs, emit, params
+                )
             child, ovf = emit(op.child, inputs)
             return (
                 self._topn_batch(child, op.keys, op.n, op.offset),
@@ -907,6 +1666,36 @@ class Executor:
             return self._emit_window(op, nid, inputs, emit, params)
 
         raise NotImplementedError(type(op))
+
+    def _project_batch(self, op: Project, child: ColumnBatch) -> ColumnBatch:
+        cols, valid, dicts, fields = {}, {}, {}, []
+        for name, e in op.exprs:
+            derived = derive_dict_column(e, child)
+            if derived is not None:
+                # string transform (substr): new dict column
+                v, vv, d2 = derived
+                dicts[name] = d2
+            else:
+                v, vv = evaluate(e, child)
+            if getattr(v, "ndim", 1) == 0:
+                # all-literal expression: broadcast the scalar to the
+                # batch (FROM-less SELECT constants)
+                v = jnp.broadcast_to(v, (child.capacity,))
+            cols[name] = v
+            if vv is not None:
+                valid[name] = vv
+            t = infer_type(e, child.schema)
+            fields.append(Field(name, t))
+            if isinstance(e, E.ColRef) and e.name in child.dicts:
+                dicts[name] = child.dicts[e.name]
+        return ColumnBatch(
+            cols=cols,
+            valid=valid,
+            sel=child.sel,
+            nrows=child.nrows,
+            schema=Schema(tuple(fields)),
+            dicts=dicts,
+        )
 
     def _topn_batch(self, child: ColumnBatch, keys, n: int, offset: int,
                     apply_offset: bool = True) -> ColumnBatch:
@@ -1490,17 +2279,64 @@ class Executor:
                     lo_b = None if lo_b is None else lo_b * kt.decimal_factor
                     hi_b = None if hi_b is None else hi_b * kt.decimal_factor
                 if odesc[0]:
-                    kk = -kk
+                    # ~k = -k - 1: order-reversing like negation but with
+                    # no int64-min overflow; the uniform -1 shift cancels
+                    # in every key-vs-target comparison
+                    kk = ~kk
                 live_k = jnp.where(ssel, kk, 0)
                 kmin = jnp.min(jnp.where(ssel, kk, jnp.iinfo(jnp.int64).max))
                 kmax = jnp.max(jnp.where(ssel, kk, jnp.iinfo(jnp.int64).min))
                 span = jnp.maximum(kmax - kmin + 1, 1)
                 seg_rank = jnp.cumsum(new_seg.astype(jnp.int64)) - 1
+                nseg_total = jnp.maximum(seg_rank[-1] + 1, 1)
+                # (rank, key) packs into one int64 only while
+                # nseg * span < 2^62; wide-domain keys fall back to an
+                # exact per-segment binary search (33 gather rounds)
+                # chosen at RUNTIME by lax.cond — wrong frames are not an
+                # acceptable failure mode for silent wide domains
+                pack_ok = span <= (1 << 62) // nseg_total
+                span_c = jnp.minimum(span, (1 << 62) // nseg_total)
                 packed = jnp.where(
                     ssel,
-                    seg_rank * span + (live_k - kmin),
+                    seg_rank * span_c + jnp.clip(live_k - kmin, 0, span_c),
                     jnp.iinfo(jnp.int64).max,
                 )
+
+                def _lex_bound(target, right):
+                    """Insertion point of per-row `target` within the
+                    row's own [seg_start, seg_end] run of the
+                    segment-ascending key array — exact for any key
+                    domain, ~log2(n) element-gather rounds."""
+                    lo_ = seg_start.astype(jnp.int64)
+                    hi_ = seg_end.astype(jnp.int64) + 1
+
+                    def body(_i, lh):
+                        l_, h_ = lh
+                        mid = (l_ + h_) >> 1
+                        kv = sok[0].astype(jnp.int64)[
+                            jnp.clip(mid, 0, n - 1)
+                        ]
+                        if odesc[0]:
+                            kv = ~kv
+                        go = (kv <= target) if right else (kv < target)
+                        act = l_ < h_
+                        return (
+                            jnp.where(act & go, mid + 1, l_),
+                            jnp.where(act & ~go, mid, h_),
+                        )
+
+                    l_, _h = jax.lax.fori_loop(0, 34, body, (lo_, hi_))
+                    return l_
+
+                def _sat_add(v, off):
+                    # saturating v + off: a wrapped target would flip the
+                    # comparison direction; saturation costs at most the
+                    # single boundary value int64 min/max
+                    t = v + off
+                    if off >= 0:
+                        return jnp.where(
+                            t < v, jnp.iinfo(jnp.int64).max, t)
+                    return jnp.where(t > v, jnp.iinfo(jnp.int64).min, t)
 
                 def bound_at(off, side):
                     # out-of-domain targets must yield EMPTY frames, not
@@ -1508,17 +2344,35 @@ class Executor:
                     # segment's keys resolves past its end (rel=span ->
                     # next segment's base -> lo > hi), a frame-end below
                     # resolves before its start (rel=-1 -> hi < lo)
+                    off = max(min(off, (1 << 63) - 1), -(1 << 63))
                     if side == "lo":
-                        rel = jnp.clip(live_k + off - kmin, 0, span)
-                        target = seg_rank * span + rel
+                        def packed_fn(_):
+                            rel = jnp.clip(
+                                _sat_add(live_k - kmin, off), 0, span_c)
+                            target = seg_rank * span_c + rel
+                            return jnp.searchsorted(
+                                packed, target, side="left", method="sort"
+                            ).astype(jnp.int64)
+
+                        return jax.lax.cond(
+                            pack_ok, packed_fn,
+                            lambda _: _lex_bound(_sat_add(live_k, off), False),
+                            0,
+                        )
+
+                    def packed_fn(_):
+                        rel = jnp.clip(
+                            _sat_add(live_k - kmin, off), -1, span_c - 1)
+                        target = seg_rank * span_c + rel
                         return jnp.searchsorted(
-                            packed, target, side="left", method="sort"
-                        ).astype(jnp.int64)
-                    rel = jnp.clip(live_k + off - kmin, -1, span - 1)
-                    target = seg_rank * span + rel
-                    return jnp.searchsorted(
-                        packed, target, side="right", method="sort"
-                    ).astype(jnp.int64) - 1
+                            packed, target, side="right", method="sort"
+                        ).astype(jnp.int64) - 1
+
+                    return jax.lax.cond(
+                        pack_ok, packed_fn,
+                        lambda _: _lex_bound(_sat_add(live_k, off), True) - 1,
+                        0,
+                    )
 
                 if lo is None:
                     lo = bound_at(lo_b, "lo")
@@ -1733,6 +2587,13 @@ class Executor:
 
     # ---- aggregate emission --------------------------------------------
     def _emit_aggregate(self, op: Aggregate, nid, inputs, emit, params):
+        if op.grouping_sets is not None:
+            return self._emit_grouping_sets(op, nid, inputs, emit, params)
+        spec = params.clustered_aggs.get(nid)
+        if spec is not None and spec.input_alias in inputs:
+            return self._emit_clustered_agg(
+                op, nid, spec, inputs, emit, params
+            )
         child, ovf = emit(op.child, inputs)
         key_vals = []
         domains = []
@@ -1883,6 +2744,7 @@ class Executor:
         (the expensive artifact — this is what the plan cache stores).
         Inputs beyond the device budget return a ChunkedPreparedPlan that
         streams the biggest table through the program (engine/chunked.py)."""
+        plan = self._route_projections(plan)
         if self.chunking_enabled:
             from .chunked import (
                 ChunkedPreparedPlan,
@@ -1921,14 +2783,28 @@ class PreparedPlan:
         self.overflow_nodes = overflow_nodes
         self.retries = 0  # lifetime overflow-recompile count (plan monitor)
 
+    def _inputs(self):
+        try:
+            return {
+                alias: self.executor.input_batch(alias, table, cols)
+                for alias, table, cols in self.input_spec
+            }
+        except ClusteredPremiseInvalidated:
+            # the probe's clustering dissolved under a cached plan:
+            # recompile (spec re-detection drops the fast path) and
+            # assemble again
+            self.jitted, self.input_spec, self.overflow_nodes = (
+                self.executor.compile(self.plan, self.params)
+            )
+            return {
+                alias: self.executor.input_batch(alias, table, cols)
+                for alias, table, cols in self.input_spec
+            }
+
     def run_nocheck(self, qparams: tuple = ()):
         """Dispatch one execution WITHOUT the overflow host sync — for
         benchmarking/pipelining after a checked run validated capacities."""
-        inputs = {
-            alias: self.executor.table_batch(table, cols)
-            for alias, table, cols in self.input_spec
-        }
-        out, _ovf = self.jitted(inputs, qparams)
+        out, _ovf = self.jitted(self._inputs(), qparams)
         return out
 
     def run(self, max_retries: int = 3, qparams: tuple = ()):
@@ -1936,10 +2812,7 @@ class PreparedPlan:
 
         for attempt in range(max_retries + 1):
             checkpoint()  # between overflow retries (and before the first run)
-            inputs = {
-                alias: self.executor.table_batch(table, cols)
-                for alias, table, cols in self.input_spec
-            }
+            inputs = self._inputs()
             out, ovf_vec = self.jitted(inputs, qparams)
             overflows = {
                 nid: int(v)
@@ -1958,6 +2831,81 @@ class PreparedPlan:
                 self.executor.compile(self.plan, self.params)
             )
         raise AssertionError
+
+
+def _range_bounds(c: E.Expr, qual: str) -> list:
+    """Classify one conjunct as bounds on column `qual`: a list of
+    ('gt'|'ge'|'lt'|'le'|'eq', Literal) pairs (empty = not a bound).
+    Handles both operand orders and non-negated BETWEEN."""
+    if isinstance(c, E.Between) and not c.negated:
+        if (
+            isinstance(c.arg, E.ColRef) and c.arg.name == qual
+            and isinstance(c.low, E.Literal)
+            and isinstance(c.high, E.Literal)
+        ):
+            return [("ge", c.low), ("le", c.high)]
+        return []
+    if not isinstance(c, E.Compare):
+        return []
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    op, lhs, rhs = c.op, c.left, c.right
+    if isinstance(rhs, E.ColRef) and isinstance(lhs, E.Literal):
+        op, lhs, rhs = flip.get(op), rhs, lhs
+    if not (
+        isinstance(lhs, E.ColRef) and lhs.name == qual
+        and isinstance(rhs, E.Literal) and op in flip
+    ):
+        return []
+    kind = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge", "=": "eq"}[op]
+    return [(kind, rhs)]
+
+
+def _slice_sorted_scan(qb: ColumnBatch, sl: _SliceSpec, cap: int, n: int):
+    """Read only the qualifying key range of a sorted-projection scan.
+
+    Device binary search finds [lo, hi) from the (possibly parameterized)
+    bounds, one dynamic_slice per column reads `cap` rows from lo, and
+    rows outside [lo, hi) mask off. Returns (sliced batch, overflow =
+    max(hi-lo-cap, 0)) — a runtime range wider than the static capacity
+    rides the usual overflow-retry recompile. The TPU redesign of the
+    reference's index range scan (ob_das_scan_op.h): the 'index' is the
+    projection's physical order, the 'scan range' a device slice."""
+    from ..expr.compile import literal_scalar
+
+    kcol = jax.lax.slice_in_dim(qb.cols[sl.key], 0, n)  # drop capacity pad
+    lo = jnp.zeros((), jnp.int64)
+    hi = jnp.full((), n, jnp.int64)
+    for lit, side in sl.lows:
+        v = literal_scalar(lit).astype(kcol.dtype)
+        lo = jnp.maximum(
+            lo, jnp.searchsorted(kcol, v, side=side).astype(jnp.int64)
+        )
+    for lit, side in sl.highs:
+        v = literal_scalar(lit).astype(kcol.dtype)
+        hi = jnp.minimum(
+            hi, jnp.searchsorted(kcol, v, side=side).astype(jnp.int64)
+        )
+    hi = jnp.maximum(hi, lo)
+    cap2 = qb.capacity
+    start = jnp.clip(lo, 0, cap2 - cap)
+    gidx = start + jnp.arange(cap, dtype=jnp.int64)
+    in_range = (gidx >= lo) & (gidx < hi)
+
+    def dsl(c):
+        return jax.lax.dynamic_slice_in_dim(c, start, cap)
+
+    cols = {k: dsl(c) for k, c in qb.cols.items()}
+    valid = {k: dsl(c) for k, c in qb.valid.items()}
+    sel = dsl(qb.sel) & in_range
+    out = ColumnBatch(
+        cols=cols,
+        valid=valid,
+        sel=sel,
+        nrows=jnp.sum(sel, dtype=jnp.int64),
+        schema=qb.schema,
+        dicts=qb.dicts,
+    )
+    return out, jnp.maximum((hi - lo) - cap, 0)
 
 
 def _affine_candidates(probe_key, aff, nb):
@@ -2032,8 +2980,12 @@ def _join_schema(ls: Schema, rs: Schema) -> Schema:
 
 def _agg_schema(op: Aggregate, child_schema: Schema) -> Schema:
     fields = []
-    for name, e in op.group_keys:
-        fields.append(Field(name, infer_type(e, child_schema)))
+    gs = op.grouping_sets
+    for i, (name, e) in enumerate(op.group_keys):
+        t = infer_type(e, child_schema)
+        if gs is not None and any(i not in s for s in gs):
+            t = replace(t, nullable=True)  # NULL-filled in coarser sets
+        fields.append(Field(name, t))
     for name, fn, arg, _ in op.aggs:
         if fn == "count":
             fields.append(Field(name, DataType.int64()))

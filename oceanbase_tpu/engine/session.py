@@ -117,6 +117,13 @@ class Session:
         use_cache=False bypasses the plan cache entirely (virtual-table
         statements: their per-materialization dictionaries make entries
         never reusable, and caching them would evict user plans)."""
+        if getattr(ast, "ctes", None):
+            from .recursive import recursive_cte_of, run_recursive
+
+            if recursive_cte_of(ast) is not None:
+                out_batch, names = run_recursive(self, ast)
+                host = batch_to_host(out_batch)
+                return ResultSet(tuple(names), {n: host[n] for n in names})
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
         key = self._cache_key(norm_key, pz)

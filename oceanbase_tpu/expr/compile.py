@@ -78,11 +78,35 @@ def set_params(params: tuple | None):
     return prev
 
 
+def literal_scalar(e):
+    """Traced storage-domain value of a Literal (slotted literals read
+    the active parameter tuple so one executable serves every value) —
+    for kernels that consume a literal directly (range-scan bounds, ANN
+    query vectors) rather than as a broadcast column."""
+    if e.slot is not None and _ACTIVE_PARAMS is not None:
+        return _ACTIVE_PARAMS[e.slot]
+    return jnp.asarray(bind_value(e.value, e.dtype))
+
+
+# VECTOR literals resolve identically (the 'scalar' is a (d,) array)
+evaluate_vector_literal = literal_scalar
+
+
 def bind_value(value, dtype: DataType) -> np.generic:
     """Convert a python literal to its physical storage scalar (host side).
 
     Mirrors _literal_as so a bound parameter lands in exactly the domain the
     trace assumed: decimals as scaled ints, dates as int32 days."""
+    if dtype.kind is TypeKind.VECTOR:
+        if isinstance(value, str):
+            value = [float(x) for x in value.strip("[] ").split(",")]
+        a = np.asarray(value, dtype=np.float32)
+        if a.shape != (dtype.precision,):
+            raise ValueError(
+                f"vector literal dim {a.shape} != column dim "
+                f"({dtype.precision},)"
+            )
+        return a
     if dtype.kind is TypeKind.DATE:
         if isinstance(value, str):
             value = _parse_date(value)
@@ -137,6 +161,8 @@ def infer_type(e: Expr, schema: Schema) -> DataType:
                 t = common_numeric_type(t, bt)
         return t
     if isinstance(e, Func):
+        if e.name == "vec_l2":
+            return DataType.float32()
         if e.name in ("extract_year", "extract_month", "extract_day"):
             return DataType.int32()
         if e.name in ("like", "prefix", "contains"):
@@ -662,6 +688,17 @@ def _eval_func(e: Func, batch: ColumnBatch):
         codes, valid = evaluate(col_expr, batch)
         return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
 
+    if e.name == "vec_l2":
+        # squared L2 distance of a VECTOR column to a query vector, in
+        # matmul form (||x||^2 - 2 x.q + ||q||^2): the n*d work lands on
+        # the MXU instead of a VPU subtract-square sweep. Used by both
+        # the brute-force exact path (ORDER BY vec_l2 ... LIMIT k = plain
+        # TopN) and the IVF candidate re-ranking.
+        xv, valid = evaluate(e.args[0], batch)
+        q = evaluate_vector_literal(e.args[1])
+        xq = xv @ q
+        xn = jnp.sum(xv * xv, axis=1)
+        return xn - 2.0 * xq + jnp.sum(q * q), valid
     if e.name == "abs":
         v, valid = evaluate(e.args[0], batch)
         return jnp.abs(v), valid

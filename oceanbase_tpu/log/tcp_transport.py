@@ -41,11 +41,18 @@ class TcpBus:
 
     def __init__(self, listen_port: int, route: dict[int, tuple[str, int]],
                  local_nodes: set[int] | None = None,
-                 auth_token: bytes = b""):
+                 auth_token: bytes = b"",
+                 tls: tuple | None = None):
         self.listen_port = listen_port
         self.route = route
         self.local_nodes = set(local_nodes or ())
         self.auth_token = auth_token
+        # (server ssl.SSLContext, client ssl.SSLContext) — mutual-TLS
+        # upgrade of every bus connection (share/tls.py; the reference's
+        # ussl-hook interception point). None = plaintext (tests, single
+        # host). With TLS on, the HELLO token is no longer observable on
+        # the wire, closing its replay window.
+        self.tls = tls
         self._handlers: dict[int, object] = {}
         self._conns: dict[tuple[str, int], socket.socket] = {}
         self._t0 = time.monotonic()
@@ -91,6 +98,8 @@ class TcpBus:
                 if conn is None:
                     conn = socket.create_connection(addr, timeout=1.0)
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    if self.tls is not None:
+                        conn = self.tls[1].wrap_socket(conn)
                     # authenticate the connection before the first message
                     conn.sendall(
                         self._frame(KIND_HELLO, 0, self.auth_token)
@@ -135,6 +144,17 @@ class TcpBus:
         self._threads.append(t)
 
     def _reader(self, conn: socket.socket) -> None:
+        if self.tls is not None:
+            try:
+                conn.settimeout(5.0)
+                conn = self.tls[0].wrap_socket(conn, server_side=True)
+            except (OSError, ValueError):
+                self.rejected_frames += 1
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         conn.settimeout(0.5)
         buf = b""
         authed = not self.auth_token

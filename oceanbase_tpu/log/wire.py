@@ -159,10 +159,13 @@ register(7, _Pong, "<d", ("t",))
 
 # distributed deadlock probes ------------------------------------------------
 
-from ..share.deadlock import LockProbe  # noqa: E402
+from ..share.deadlock import AbortGrant, ConfirmRequest, LockProbe  # noqa: E402
 
-register(8, LockProbe, "<qqqB",
-         ("initiator", "holder", "max_seen", "hops"))
+register(8, LockProbe, "<qqqBq",
+         ("initiator", "holder", "max_seen", "hops", "init_token"))
+register(9, ConfirmRequest, "<qqqi",
+         ("initiator", "victim", "init_token", "victim_node"))
+register(10, AbortGrant, "<qq", ("initiator", "victim"))
 
 
 # ---- top level -------------------------------------------------------------

@@ -18,6 +18,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 SHARD_AXIS = "shard"
 
 
+# Multi-process runtimes (the DCN half of SURVEY §2.7's architectural
+# translation: ICI within a slice = one process's devices, DCN across
+# slices = jax.distributed's cross-process collectives — gloo on CPU,
+# real DCN transport on TPU pods): call jax.distributed.initialize
+# BEFORE importing anything from this package (package imports build jnp
+# constants, which locks the backend) — after that jax.devices() is the
+# GLOBAL list and the same shard_map PX programs run SPMD across
+# processes, exactly like the reference's SQC dispatch spans observers
+# (sql/engine/px/ob_px_rpc_processor.h:28). See
+# tests/test_px_multiproc.py and __graft_entry__._mp_px_worker.
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()

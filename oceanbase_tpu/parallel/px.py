@@ -124,6 +124,11 @@ class PxExecutor(Executor):
     # table dispatches as one shard_map program over the mesh; partials
     # merge on the (small) single-chip merge plan exactly as single-chip
     chunking_enabled = True
+    # shard inputs are row slices — full-table fk_ranges would misindex
+    # (PX compile never seeds clustered_aggs either; this is the belt)
+    clustered_agg_enabled = False
+    # likewise: dynamic-slice range pruning indexes whole-table columns
+    scan_slice_enabled = False
 
     def make_chunk_source(self, stream_table: str, chunk_rows: int):
         # per-shard granularity: the chunk capacity must shard evenly

@@ -45,7 +45,13 @@ from ..storage import OP_DELETE, OP_PUT
 
 
 class SqlError(Exception):
-    pass
+    """Statement-level error; `code` is the MySQL-compatible error code
+    the wire front door puts in the ERR packet (1064 generic syntax,
+    1142 table access denied, 1227 privilege required, 1396 user-admin)."""
+
+    def __init__(self, msg: str, code: int = 1064):
+        super().__init__(msg)
+        self.code = code
 
 
 @dataclass
@@ -248,6 +254,7 @@ class Database:
             # record observation is multiplexed across tenants (each
             # ignores tablets it does not own)
             self.cluster.record_observers.append(self._on_applied_record)
+            restored_meta = None
         else:
             node_meta = self._load_node_meta() if data_dir is not None else None
             if node_meta is not None:
@@ -265,6 +272,20 @@ class Database:
                 for rep in group.values():
                     rep.on_record = self._on_applied_record
             self.cluster.finalize()
+            restored_meta = node_meta
+        # user accounts + grants (src/sql/privilege_check analog); restored
+        # from node meta alongside the schema so grants survive restart
+        from ..share.privilege import PrivilegeManager
+
+        self.privileges = PrivilegeManager.from_meta(
+            restored_meta.get("privileges") if restored_meta else None
+        )
+        # vector index registrations: table -> col -> (lists, nprobe);
+        # re-applied to every fresh snapshot Table (the built artifact
+        # version-caches in the executor — DML = invalidate + lazy rebuild)
+        self._vector_specs: dict[str, dict[str, tuple[int, int]]] = (
+            restored_meta.get("vector_specs", {}) if restored_meta else {}
+        )
         # worker pool quota (ObTenant worker queues): bounds concurrent
         # statements of this tenant
         self._worker_sem = (
@@ -442,6 +463,8 @@ class Database:
             "n_ls": len(self.cluster.ls_groups),
             "tables": dict(self.tables),
             "next_tablet_id": self.rootservice.next_tablet_id,
+            "privileges": self.privileges.to_meta(),
+            "vector_specs": dict(self._vector_specs),
         }
         from ..share.fsutil import atomic_write
 
@@ -681,6 +704,40 @@ class Database:
             self._save_node_meta()
 
     # ----------------------------------------------------------- indexes
+    def create_vector_index(self, st: A.CreateVectorIndex) -> None:
+        """IVF-flat ANN index registration (storage/vector_index.py);
+        the artifact builds lazily per table version, so DML maintenance
+        is the usual invalidate + rebuild contract."""
+        from ..core.dtypes import TypeKind
+        from ..storage.vector_index import register_vector_index
+
+        ti = self.tables.get(st.table)
+        if ti is None:
+            raise SqlError(f"no such table {st.table}")
+        try:
+            ct = ti.schema[st.column]
+        except Exception:
+            raise SqlError(f"no such column {st.column}") from None
+        if ct.kind is not TypeKind.VECTOR:
+            raise SqlError(f"{st.column} is not a VECTOR column")
+        self._vector_specs.setdefault(st.table, {})[st.column] = (
+            st.lists, st.nprobe)
+        t = self.catalog.get(st.table)
+        if t is not None:
+            register_vector_index(
+                self.catalog, st.table, st.column, st.lists, st.nprobe)
+        self._save_node_meta()
+
+    def drop_vector_index(self, st: A.DropVectorIndex) -> None:
+        from ..storage.vector_index import drop_vector_index
+
+        specs = self._vector_specs.get(st.table, {})
+        specs.pop(st.column, None)
+        t = self.catalog.get(st.table)
+        if t is not None:
+            drop_vector_index(self.catalog, st.table, st.column)
+        self._save_node_meta()
+
     def create_index(self, st: A.CreateIndex) -> None:
         """Online-ish index build (src/storage/ddl direct-insert analog):
 
@@ -880,7 +937,30 @@ class Database:
                 # never the shared committed entry other sessions read
                 tx.views[name] = t
             else:
+                # the replaced Table object carries no sorted_projections
+                # registration, so routing stops by construction; delete
+                # the orphaned projection tables, their device batches,
+                # and every cached plan (a cached plan routed to the
+                # dropped projection would KeyError — or worse, a
+                # re-materialized namesake would serve stale device
+                # columns)
+                old = self.catalog.get(name)
+                projs = getattr(old, "sorted_projections", None)
+                if projs:
+                    from ..storage.sorted_projection import drop_projections
+
+                    for pname in projs.values():
+                        self.engine.executor.invalidate_table(pname)
+                    drop_projections(self.catalog, name)
+                    self.plan_cache.flush()
                 self.catalog[name] = t
+                vspecs = self._vector_specs.get(name)
+                if vspecs:
+                    from ..storage.vector_index import register_vector_index
+
+                    for col, (lists, nprobe) in vspecs.items():
+                        register_vector_index(
+                            self.catalog, name, col, lists, nprobe)
                 self.engine.executor.invalidate_table(name)
                 ti.cached_data_version = ti.data_version
                 self._enforce_memory(keep=name)
@@ -935,8 +1015,8 @@ class Database:
         self.interrupts[0].interrupt(iid, reason)
 
     # ------------------------------------------------------------ session
-    def session(self) -> "DbSession":
-        return DbSession(self)
+    def session(self, user: str = "root") -> "DbSession":
+        return DbSession(self, user=user)
 
 
 class _OpenTx:
@@ -977,8 +1057,9 @@ class _OpenTx:
 class DbSession:
     """One client session: statement dispatch + transaction state."""
 
-    def __init__(self, db: Database):
+    def __init__(self, db: Database, user: str = "root"):
         self.db = db
+        self.user = user
         self._tx: _OpenTx | None = None
         self.session_id = next(db._session_ids)
         self._last_stmt_type = ""
@@ -1048,10 +1129,93 @@ class DbSession:
                     )
         return rs
 
+    @staticmethod
+    def _referenced_tables(node) -> set:
+        """Every base-table name the statement reads: TableRef names
+        anywhere in the AST (FROM lists, joins, subqueries inside
+        predicates, INSERT..SELECT sources) MINUS names declared as CTEs
+        — a CTE reference is statement-local, not a catalog object."""
+        import dataclasses
+
+        from ..engine.recursive import _table_refs
+
+        refs = _table_refs(node)
+
+        def cte_names(n, out):
+            for name, _b in getattr(n, "ctes", ()) or ():
+                out.add(name)
+            if dataclasses.is_dataclass(n) and not isinstance(n, type):
+                for f in dataclasses.fields(n):
+                    cte_names(getattr(n, f.name), out)
+            elif isinstance(n, (tuple, list)):
+                for x in n:
+                    cte_names(x, out)
+            return out
+
+        return refs - cte_names(node, set())
+
+    def _check_privs(self, stmt) -> None:
+        """Resolve-time privilege enforcement (the reference checks in
+        sql/privilege_check before optimization; same point here: after
+        parse, before any plan executes)."""
+        from ..share.privilege import AccessDenied
+
+        if self.user == "root":
+            return  # superuser: skip the AST walk on the hot path
+        pm = self.db.privileges
+        try:
+            if isinstance(stmt, (A.Select, A.SetSelect)):
+                pm.check(self.user, "select", self._referenced_tables(stmt))
+            elif isinstance(stmt, (A.Insert, A.Update, A.Delete)):
+                priv = type(stmt).__name__.lower()
+                target = stmt.table
+                pm.check(self.user, priv, {target})
+                others = self._referenced_tables(stmt) - {target}
+                if others:
+                    pm.check(self.user, "select", others)
+            elif isinstance(stmt, A.CreateTable):
+                pm.check(self.user, "create", {stmt.name})
+            elif isinstance(stmt, A.DropTable):
+                pm.check(self.user, "drop", {stmt.name})
+            elif isinstance(stmt, (A.CreateIndex, A.DropIndex,
+                                   A.CreateVectorIndex, A.DropVectorIndex)):
+                pm.check(self.user, "index", {stmt.table})
+            elif isinstance(stmt, (A.AlterSystemSet, A.KillQuery)):
+                if self.user != "root":
+                    raise AccessDenied(
+                        f"'{self.user}' lacks SUPER", 1227)
+        except AccessDenied as e:
+            raise SqlError(str(e), code=e.code) from None
+
+    def _dcl(self, stmt) -> ResultSet:
+        from ..share.privilege import AccessDenied
+
+        if self.user != "root":
+            raise SqlError(
+                f"'{self.user}' may not administer users/grants", code=1227
+            )
+        pm = self.db.privileges
+        try:
+            if isinstance(stmt, A.CreateUser):
+                pm.create_user(stmt.name, stmt.password)
+            elif isinstance(stmt, A.DropUser):
+                pm.drop_user(stmt.name)
+            elif isinstance(stmt, A.Grant):
+                pm.grant(stmt.user, stmt.obj, stmt.privs)
+            elif isinstance(stmt, A.Revoke):
+                pm.revoke(stmt.user, stmt.obj, stmt.privs)
+        except AccessDenied as e:
+            raise SqlError(str(e), code=e.code) from None
+        self.db._save_node_meta()  # grants survive restart like schema
+        return ResultSet((), {})
+
     def _dispatch(self, text: str) -> ResultSet:
         stmt = P.parse_statement(text)
         self._last_stmt_type = type(stmt).__name__
-        if isinstance(stmt, A.Select):
+        self._check_privs(stmt)
+        if isinstance(stmt, (A.CreateUser, A.DropUser, A.Grant, A.Revoke)):
+            return self._dcl(stmt)
+        if isinstance(stmt, (A.Select, A.SetSelect)):
             return self._select(stmt, P.normalize_for_cache(text)[0])
         if isinstance(stmt, A.CreateTable):
             self.db.create_table(stmt)
@@ -1064,6 +1228,12 @@ class DbSession:
             return ResultSet((), {})
         if isinstance(stmt, A.DropIndex):
             self.db.drop_index(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.CreateVectorIndex):
+            self.db.create_vector_index(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.DropVectorIndex):
+            self.db.drop_vector_index(stmt)
             return ResultSet((), {})
         if isinstance(stmt, A.Begin):
             if self._tx is not None:
@@ -1695,6 +1865,12 @@ def _coerce(v, dt: DataType, d: Dictionary | None, col: str):
         return iv
     if dt.is_float:
         return float(v)
+    if dt.kind is TypeKind.VECTOR:
+        # '[f, f, ...]' literal -> (d,) float32 tuple (hashable so the
+        # MVCC row path treats it like any other cell value)
+        from ..expr.compile import bind_value
+
+        return tuple(float(x) for x in bind_value(v, dt))
     raise SqlError(f"unsupported column type {dt} for DML")
 
 

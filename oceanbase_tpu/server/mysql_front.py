@@ -36,6 +36,7 @@ from .database import Database, SqlError
 
 CLIENT_PROTOCOL_41 = 0x0200
 CLIENT_CONNECT_WITH_DB = 0x0008
+CLIENT_SSL = 0x0800
 CLIENT_SECURE_CONNECTION = 0x8000
 
 MYSQL_TYPE_LONGLONG = 8
@@ -158,9 +159,14 @@ class MySqlFrontend:
     mysql_native_password scramble against the salt."""
 
     def __init__(self, db: Database, host: str = "127.0.0.1", port: int = 0,
-                 users: dict[str, str] | None = None):
+                 users: dict[str, str] | None = None,
+                 ssl_context=None):
         self.db = db
         self.users = users
+        # ssl.SSLContext (share/tls.server_context): advertise CLIENT_SSL
+        # and upgrade the connection on an SSLRequest packet, per the
+        # MySQL protocol's mid-handshake TLS negotiation
+        self.ssl_context = ssl_context
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -188,7 +194,6 @@ class MySqlFrontend:
     # ---------------------------------------------------------- protocol
     def _serve(self, sock: socket.socket) -> None:
         conn = _Conn(sock)
-        sess = self.db.session()
         # id -> [pieces, nparams, last-bound param types] (drivers send
         # types only on the FIRST execute; new_params_bound=0 reuses them)
         stmts: dict[int, list] = {}
@@ -196,10 +201,24 @@ class MySqlFrontend:
         try:
             salt = self._greet(conn)
             login = conn.read_packet()
-            if not self._check_login(login, salt):
+            if self.ssl_context is not None and len(login) < 36 and (
+                len(login) >= 4
+                and int.from_bytes(login[:4], "little") & CLIENT_SSL
+            ):
+                # SSLRequest (caps+maxpacket+charset+23 filler, no user):
+                # upgrade the socket, then read the real login over TLS.
+                # The packet sequence number continues across the upgrade.
+                conn.sock = self.ssl_context.wrap_socket(
+                    conn.sock, server_side=True
+                )
+                sock = conn.sock  # the finally-close must close the TLS fd
+                login = conn.read_packet()
+            user = self._check_login(login, salt)
+            if user is None:
                 conn.send_packet(
                     _err_packet(1045, "Access denied (bad credentials)"))
                 return
+            sess = self.db.session(user=user)
             conn.send_packet(_ok_packet())
             while True:
                 conn.reset_seq()
@@ -235,9 +254,15 @@ class MySqlFrontend:
             except OSError:
                 pass
 
-    def _check_login(self, login: bytes, salt: bytes) -> bool:
-        if self.users is None:
-            return True  # open door (in-process harness mode)
+    def _check_login(self, login: bytes, salt: bytes) -> str | None:
+        """Verified login user name, or None. With no explicit `users`
+        map, accounts come from the database's privilege manager (root
+        with empty password exists from bootstrap), so CREATE USER /
+        GRANT govern the front door too."""
+        users = self.users
+        if users is None:
+            pm = getattr(self.db, "privileges", None)
+            users = pm.authenticate_db() if pm is not None else None
         try:
             # HandshakeResponse41: caps u32, max packet u32, charset u8,
             # 23 reserved, user\0, lenenc auth response
@@ -249,17 +274,26 @@ class MySqlFrontend:
             off += 1
             auth = login[off:off + alen]
         except (ValueError, IndexError):
-            return False
-        if user not in self.users:
-            return False
-        want = native_password_scramble(self.users[user], salt)
-        return auth == want
+            return None
+        if users is None:
+            return user or "root"  # open door (no privilege manager)
+        if user not in users:
+            return None
+        want = native_password_scramble(users[user], salt)
+        import hmac
+
+        # constant-time: the 20-byte digest compare must not leak a
+        # prefix-length timing side channel (the TcpBus HELLO path
+        # already uses compare_digest)
+        return user if hmac.compare_digest(auth, want) else None
 
     def _greet(self, conn: _Conn) -> bytes:
         caps = (
             CLIENT_PROTOCOL_41 | CLIENT_CONNECT_WITH_DB
             | CLIENT_SECURE_CONNECTION
         )
+        if self.ssl_context is not None:
+            caps |= CLIENT_SSL
         import os
 
         salt = bytes(
@@ -285,7 +319,8 @@ class MySqlFrontend:
         try:
             rs = sess.sql(sql)
         except Exception as e:  # SqlError, parse errors, resolver errors
-            conn.send_packet(_err_packet(1064, f"{type(e).__name__}: {e}"))
+            conn.send_packet(_err_packet(
+                getattr(e, "code", 1064), f"{type(e).__name__}: {e}"))
             return
         if not rs.names:
             conn.send_packet(_ok_packet(affected=rs.affected))
@@ -302,23 +337,41 @@ class MySqlFrontend:
     # ------------------------------------------------- prepared statements
     @staticmethod
     def _split_placeholders(sql: str) -> list[str]:
-        """SQL split at '?' placeholders outside string literals."""
-        pieces, cur, in_str = [], [], False
-        i = 0
-        while i < len(sql):
+        """SQL split at '?' placeholders outside quoted regions ('...',
+        "...", `...`) and comments (-- to EOL, /* */) — a '?' inside any
+        of those is literal text, and miscounting here shifts every
+        later COM_STMT_EXECUTE substitution by one."""
+        pieces, cur = [], []
+        quote = None  # "'", '"' or '`' while inside that quoted region
+        i, n = 0, len(sql)
+        while i < n:
             ch = sql[i]
-            if in_str:
+            if quote is not None:
                 cur.append(ch)
-                if ch == "'":
-                    # '' escape stays inside the literal
-                    if i + 1 < len(sql) and sql[i + 1] == "'":
-                        cur.append("'")
+                if ch == quote:
+                    # doubled-quote escape stays inside the region
+                    if i + 1 < n and sql[i + 1] == quote:
+                        cur.append(quote)
                         i += 1
                     else:
-                        in_str = False
-            elif ch == "'":
-                in_str = True
+                        quote = None
+            elif ch in ("'", '"', "`"):
+                quote = ch
                 cur.append(ch)
+            elif ch == "-" and i + 1 < n and sql[i + 1] == "-" and (
+                i + 2 >= n or sql[i + 2] in " \t\n"
+            ):
+                # MySQL comment syntax: '--' must be followed by
+                # whitespace (or EOL) — `x=x--1` is double negation
+                j = sql.find("\n", i)
+                j = n if j < 0 else j
+                cur.append(sql[i:j])
+                i = j - 1
+            elif ch == "/" and i + 1 < n and sql[i + 1] == "*":
+                j = sql.find("*/", i + 2)
+                j = n if j < 0 else j + 2
+                cur.append(sql[i:j])
+                i = j - 1
             elif ch == "?":
                 pieces.append("".join(cur))
                 cur = []
@@ -456,7 +509,8 @@ class MySqlFrontend:
         try:
             rs = sess.sql(sql)
         except Exception as e:
-            conn.send_packet(_err_packet(1064, f"{type(e).__name__}: {e}"))
+            conn.send_packet(_err_packet(
+                getattr(e, "code", 1064), f"{type(e).__name__}: {e}"))
             return
         if not rs.names:
             conn.send_packet(_ok_packet(affected=rs.affected))

@@ -42,6 +42,26 @@ class LockProbe:
     holder: int     # tx id being chased
     max_seen: int   # largest tx id on the chase path (victim arbitration)
     hops: int
+    init_token: int = 0  # initiator's wait-instance token at origination
+
+
+@dataclass(frozen=True)
+class ConfirmRequest:
+    """Cycle closed at `victim`'s host; asks the initiator's host to
+    verify the originating wait still exists (same token) before the
+    abort — the CMH phantom-cycle guard: a wait edge released mid-chase
+    must not let a stale probe kill a live transaction."""
+
+    initiator: int
+    victim: int
+    init_token: int
+    victim_node: int
+
+
+@dataclass(frozen=True)
+class AbortGrant:
+    initiator: int
+    victim: int
 
 
 class DeadlockService:
@@ -69,14 +89,21 @@ class DeadlockService:
             )
 
     def _chase(self, initiator: int, holder: int, max_seen: int,
-               hops: int) -> None:
+               hops: int, init_token: int) -> None:
         """Follow `holder`'s local wait edges; close the cycle or forward.
 
         max_seen accumulates the largest tx id along the chase; a probe
         that closes a cycle aborts its holder ONLY when the holder is
         that maximum — so among the N probes circulating one N-cycle,
         exactly the one whose path ends at the max-id member kills it
-        (one victim per cycle, the youngest-tx policy)."""
+        (one victim per cycle, the youngest-tx policy).
+
+        Phantom-cycle guard: the closing edge is freshly read here, but
+        the ORIGINATING wait may have dissolved mid-chase (lock granted,
+        tx re-blocked elsewhere) — so the abort only fires after the
+        initiator's host confirms the same wait instance (init_token)
+        still stands. Local initiators check inline; remote ones go
+        through a ConfirmRequest/AbortGrant round-trip."""
         if hops > self.max_hops:
             return
         max_seen = max(max_seen, holder)
@@ -86,27 +113,54 @@ class DeadlockService:
                 # cycle: the closing edge is holder -> initiator
                 self.cycles_found += 1
                 if holder >= max_seen:
-                    self.lock_mgr.abort(holder)
+                    self._confirm_then_abort(initiator, holder, init_token)
                 continue
             if self.lock_mgr.hosts_wait(t):
-                self._chase(initiator, t, max_seen, hops + 1)
+                self._chase(initiator, t, max_seen, hops + 1, init_token)
             else:
                 self._broadcast(
-                    LockProbe(initiator, t, max_seen, hops + 1))
+                    LockProbe(initiator, t, max_seen, hops + 1, init_token))
+
+    def _confirm_then_abort(self, initiator: int, victim: int,
+                            init_token: int) -> None:
+        if self.lock_mgr.hosts_wait(initiator):
+            if self.lock_mgr.wait_token(initiator) == init_token:
+                self.lock_mgr.abort(victim)
+            return
+        self._broadcast(ConfirmRequest(
+            initiator, victim, init_token, self.node_id))
 
     def _on_message(self, src: int, msg) -> None:
         if isinstance(msg, LockProbe) and self.lock_mgr.hosts_wait(msg.holder):
-            self._chase(msg.initiator, msg.holder, msg.max_seen, msg.hops)
+            self._chase(msg.initiator, msg.holder, msg.max_seen, msg.hops,
+                        msg.init_token)
+        elif isinstance(msg, ConfirmRequest):
+            if (self.lock_mgr.hosts_wait(msg.initiator)
+                    and self.lock_mgr.wait_token(msg.initiator)
+                    == msg.init_token):
+                self.bus.send(
+                    DEADLOCK_EP + self.node_id,
+                    DEADLOCK_EP + msg.victim_node,
+                    AbortGrant(msg.initiator, msg.victim),
+                )
+        elif isinstance(msg, AbortGrant):
+            # revalidate the closing edge before the kill: the victim
+            # must still be waiting on the initiator
+            if msg.initiator in self.lock_mgr.wait_edges_of(msg.victim):
+                self.lock_mgr.abort(msg.victim)
 
     # ----------------------------------------------------------- driving
     def scan_once(self) -> None:
         """Originate probes for every local waiter (one detection round)."""
         for tx, holders in self.lock_mgr.waiting_snapshot().items():
+            tok = self.lock_mgr.wait_token(tx)
+            if tok is None:
+                continue  # wait dissolved between snapshot and here
             for h in holders:
                 if self.lock_mgr.hosts_wait(h):
-                    self._chase(tx, h, tx, 1)
+                    self._chase(tx, h, tx, 1, tok)
                 else:
-                    self._broadcast(LockProbe(tx, h, tx, 1))
+                    self._broadcast(LockProbe(tx, h, tx, 1, tok))
 
     def start(self) -> None:
         def loop():

@@ -1,0 +1,48 @@
+"""TLS contexts for the cluster bus and the MySQL front door.
+
+Reference surface: deps/ussl-hook — the reference intercepts cluster
+sockets and upgrades them to OpenSSL with cluster certificates; intra-
+cluster auth there is certificate-based with optional mTLS. The rebuild
+keeps the same trust model on Python's ssl module:
+
+- the CLUSTER bus (log/tcp_transport.TcpBus) uses MUTUAL TLS: both sides
+  present the cluster certificate and verify against the cluster CA, so
+  a network position alone cannot join the replication plane (the HELLO
+  token then authenticates at the frame layer — defense in depth, and
+  no longer replayable off the wire);
+- the MySQL front door uses standard server-side TLS negotiated via the
+  protocol's CLIENT_SSL capability + SSLRequest packet.
+
+Hostname checks are disabled by design: cluster certs identify the
+CLUSTER (one cert, many nodes), not individual hosts — exactly the
+reference's deployment shape.
+"""
+
+from __future__ import annotations
+
+import ssl
+
+
+def server_context(certfile: str, keyfile: str,
+                   cafile: str | None = None) -> ssl.SSLContext:
+    """Server-side context; with `cafile`, peers MUST present a cert
+    signed by it (mutual TLS — the cluster-bus mode)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    if cafile:
+        ctx.load_verify_locations(cafile)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_context(cafile: str, certfile: str | None = None,
+                   keyfile: str | None = None) -> ssl.SSLContext:
+    """Client-side context verifying the server against the cluster CA;
+    pass certfile/keyfile for mutual TLS (cluster-bus mode)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(cafile)
+    ctx.check_hostname = False  # cluster certs, not per-host certs
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    if certfile:
+        ctx.load_cert_chain(certfile, keyfile)
+    return ctx

@@ -205,6 +205,11 @@ class Select(Node):
     offset: int | None = None
     distinct: bool = False
     ctes: tuple[tuple[str, "Select"], ...] = ()  # WITH name AS (...)
+    # grouping sets: index tuples into group_by (ROLLUP/CUBE/GROUPING
+    # SETS expansion); None = plain GROUP BY
+    group_sets: tuple[tuple[int, ...], ...] | None = None
+    # names of ctes that are WITH RECURSIVE (subset of ctes keys)
+    recursive_ctes: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -224,6 +229,7 @@ class SetSelect(Node):
     limit: int | None = None
     offset: int | None = None
     ctes: tuple[tuple[str, "Select"], ...] = ()
+    recursive_ctes: tuple[str, ...] = ()
 
 
 # ---- statements (DDL / DML / tx control) ----------------------------------
@@ -319,6 +325,55 @@ class LockTable(Node):
 
     name: str
     exclusive: bool
+
+
+@dataclass(frozen=True)
+class CreateVectorIndex(Node):
+    """CREATE VECTOR INDEX name ON table (column) [WITH (lists=N,
+    nprobe=M)] — IVF-flat ANN index (storage/vector_index.py)."""
+
+    name: str
+    table: str
+    column: str
+    lists: int = 0
+    nprobe: int = 8
+
+
+@dataclass(frozen=True)
+class DropVectorIndex(Node):
+    name: str
+    table: str
+    column: str
+
+
+@dataclass(frozen=True)
+class CreateUser(Node):
+    """CREATE USER name [IDENTIFIED BY 'password']."""
+
+    name: str
+    password: str = ""
+
+
+@dataclass(frozen=True)
+class DropUser(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class Grant(Node):
+    """GRANT priv[, priv] ON table|* TO user. Privileges lowercase;
+    'all' expands server-side."""
+
+    privs: tuple[str, ...]
+    obj: str
+    user: str
+
+
+@dataclass(frozen=True)
+class Revoke(Node):
+    privs: tuple[str, ...]
+    obj: str
+    user: str
 
 
 @dataclass(frozen=True)

@@ -66,6 +66,10 @@ class Aggregate(LogicalOp):
     group_keys: tuple[tuple[str, E.Expr], ...]  # (name, expr)
     aggs: tuple[tuple[str, str, E.Expr | None, bool], ...]
     # (output name, op in sum/count/min/max, input expr, distinct)
+    # ROLLUP/CUBE/GROUPING SETS: index tuples into group_keys; the
+    # executor aggregates once per set and NULL-fills absent keys
+    # (the reference's EXPAND operator, ob_phy_operator_type.h)
+    grouping_sets: tuple[tuple[int, ...], ...] | None = None
 
 
 @dataclass
@@ -402,6 +406,8 @@ class Resolver:
                 if not allow_agg:
                     raise ResolveError(f"aggregate {node.name} not allowed here")
                 return self._agg_call(node)
+            if node.name == "vec_l2":
+                return self._vec_l2_call(node, allow_agg)
             raise ResolveError(f"unknown function {node.name}")
         if isinstance(node, (A.ScalarSubquery, A.ExistsOp)):
             raise ResolveError("subquery handled by planner")
@@ -460,10 +466,11 @@ class Resolver:
             return E.and_(l, r) if op == "and" else E.or_(l, r)
         if op in ("=", "!=", "<>", "<", "<=", ">", ">="):
             if self._is_null_comparison(node):
-                # any comparison against NULL is SQL NULL, which a WHERE
-                # treats as not-satisfied: fold to constant false (use
-                # IS NULL for null tests)
-                return E.Compare("=", E.lit(0), E.lit(1))
+                # any comparison against NULL is SQL NULL: a typed NULL
+                # literal keeps BOTH contexts honest — compile_predicate
+                # rejects NULL rows in WHERE position, and a select-list
+                # `(k = null) as b` projects NULL, not false
+                return E.Literal(None, DataType.bool_(nullable=True))
             return E.Compare(
                 op,
                 self.expr(node.left, allow_agg),
@@ -479,6 +486,34 @@ class Resolver:
         return E.BinaryOp(
             op, self.expr(node.left, allow_agg), self.expr(node.right, allow_agg)
         )
+
+    def _vec_l2_call(self, node: A.FuncCall, allow_agg) -> E.Expr:
+        """vec_l2(vector_col, query): squared L2 distance. The query
+        vector (a '[f, f, ...]' string literal) types as VECTOR(d) from
+        the column so it can parameterize — one compiled plan serves
+        every query vector (reference: obvec distance exprs over the
+        vector index, src/storage/vector_index)."""
+        if len(node.args) != 2:
+            raise ResolveError("vec_l2(column, query_vector) takes 2 args")
+        from ..core.dtypes import TypeKind
+
+        col = self.expr(node.args[0], allow_agg)
+        ct = None
+        if isinstance(col, E.ColRef):
+            for _alias, sc in self.scopes:
+                try:
+                    ct = sc[col.name]
+                    break
+                except Exception:
+                    continue
+        if ct is None or ct.kind is not TypeKind.VECTOR:
+            raise ResolveError("vec_l2 first argument must be a VECTOR column")
+        q = self.expr(node.args[1], allow_agg)
+        if not isinstance(q, E.Literal):
+            raise ResolveError("vec_l2 second argument must be a literal")
+        return E.Func("vec_l2", (col, E.Literal(
+            q.value, DataType(TypeKind.VECTOR, precision=ct.precision)
+        )))
 
     def _agg_call(self, node: A.FuncCall) -> E.Expr:
         fn = node.name
@@ -637,6 +672,11 @@ def _parse_type(tn: str) -> DataType:
     tn = tn.lower()
     if tn.endswith("?"):  # DataType.__str__ nullable marker round-trip
         return _parse_type(tn[:-1]).with_nullable(True)
+    if tn.startswith("vector"):
+        if "(" not in tn:
+            raise ResolveError("VECTOR needs a dimension: vector(d)")
+        d = int(tn[tn.index("(") + 1:tn.index(")")])
+        return DataType.vector(d)
     if tn.startswith("decimal") or tn.startswith("numeric"):
         if "(" in tn:
             inner = tn[tn.index("(") + 1 : tn.index(")")]

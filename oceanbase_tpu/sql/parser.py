@@ -40,6 +40,11 @@ KEYWORDS = {
     "values", "update", "set", "delete", "begin", "start", "transaction",
     "commit", "rollback", "alter", "system", "show", "parameters", "tables",
     "lock", "mode", "share", "exclusive", "unique", "index", "kill", "query", "partitions",
+    # DCL
+    "grant", "revoke", "to", "user", "identified", "privileges",
+    # grouping sets
+    "rollup", "cube", "grouping", "sets",
+    "recursive",
 }
 
 
@@ -142,6 +147,8 @@ class Parser:
             "show": self._show,
             "lock": self._lock,
             "kill": self._kill,
+            "grant": self._grant,
+            "revoke": self._revoke,
         }
         h = handlers.get(t.value) if t.kind == "kw" else None
         if h is None:
@@ -204,8 +211,67 @@ class Parser:
             self.expect("transaction")
         return A.Begin()
 
+    def _privlist(self) -> tuple[str, ...]:
+        privs = [self.next().value.lower()]
+        if privs[0] == "all":
+            self.accept("privileges")
+        while self.accept(","):
+            privs.append(self.next().value.lower())
+        return tuple(privs)
+
+    def _grant(self) -> "A.Grant":
+        self.expect("grant")
+        privs = self._privlist()
+        self.expect("on")
+        obj = "*" if self.accept("*") else self.next().value
+        self.expect("to")
+        return A.Grant(privs, obj, self.next().value)
+
+    def _revoke(self) -> "A.Revoke":
+        self.expect("revoke")
+        privs = self._privlist()
+        self.expect("on")
+        obj = "*" if self.accept("*") else self.next().value
+        self.expect("from")
+        return A.Revoke(privs, obj, self.next().value)
+
     def _create(self) -> "A.CreateTable | A.CreateIndex":
         self.expect("create")
+        if self.peek().value == "vector" and self.peek(1).value == "index":
+            self.next()
+            self.next()
+            name = self.next().value
+            self.expect("on")
+            table = self.next().value
+            self.expect("(")
+            column = self.next().value
+            self.expect(")")
+            lists, nprobe = 0, 8
+            if self.peek().value == "with":
+                self.next()
+                self.expect("(")
+                while True:
+                    k = self.next().value
+                    self.expect("=")
+                    v = int(self.next().value)
+                    if k == "lists":
+                        lists = v
+                    elif k == "nprobe":
+                        nprobe = v
+                    else:
+                        raise SyntaxError(f"unknown vector index option {k}")
+                    if not self.accept(","):
+                        break
+                self.expect(")")
+            return A.CreateVectorIndex(name, table, column, lists, nprobe)
+        if self.accept("user"):
+            name = self.next().value
+            pw = ""
+            if self.accept("identified"):
+                self.expect("by")
+                t = self.next()
+                pw = t.value
+            return A.CreateUser(name, pw)
         unique = self.accept("unique")
         if self.accept("index"):
             if_not_exists = False
@@ -279,6 +345,18 @@ class Parser:
 
     def _drop(self) -> "A.DropTable | A.DropIndex":
         self.expect("drop")
+        if self.peek().value == "vector" and self.peek(1).value == "index":
+            self.next()
+            self.next()
+            name = self.next().value
+            self.expect("on")
+            table = self.next().value
+            self.expect("(")
+            column = self.next().value
+            self.expect(")")
+            return A.DropVectorIndex(name, table, column)
+        if self.accept("user"):
+            return A.DropUser(self.next().value)
         if self.accept("index"):
             if_exists = False
             if self.accept("if"):
@@ -344,29 +422,35 @@ class Parser:
 
     def parse(self) -> "A.Select | A.SetSelect":
         ctes = []
+        recursive = False
         if self.accept("with"):
+            recursive = self.accept("recursive")
             while True:
                 name = self.next().value
                 self.expect("as")
                 self.expect("(")
-                ctes.append((name, self.select()))
+                # recursive bodies are base UNION [ALL] step: full
+                # query expressions, not bare SELECTs
+                ctes.append((name, self.query_expr()))
                 self.expect(")")
                 if not self.accept(","):
                     break
         s = self.query_expr()
         if ctes:
+            rec_names = tuple(n for n, _ in ctes) if recursive else ()
             if isinstance(s, A.SetSelect):
                 s = A.SetSelect(
                     kind=s.kind, all=s.all, left=s.left, right=s.right,
                     order_by=s.order_by, limit=s.limit, offset=s.offset,
-                    ctes=tuple(ctes),
+                    ctes=tuple(ctes), recursive_ctes=rec_names,
                 )
             else:
                 s = A.Select(
                     items=s.items, from_=s.from_, where=s.where,
                     group_by=s.group_by, having=s.having, order_by=s.order_by,
                     limit=s.limit, offset=s.offset, distinct=s.distinct,
-                    ctes=tuple(ctes),
+                    ctes=tuple(ctes), recursive_ctes=rec_names,
+                    group_sets=s.group_sets,
                 )
         self.accept(";")
         if self.peek().kind != "eof":
@@ -481,11 +565,60 @@ class Parser:
                 from_.append(self.table_expr())
         where = self.expr() if self.accept("where") else None
         group_by = ()
+        group_sets = None
         if self.accept("group"):
             self.expect("by")
-            group_by = [self.expr()]
-            while self.accept(","):
-                group_by.append(self.expr())
+            if self.peek().kind == "kw" and self.peek().value in (
+                "rollup", "cube"
+            ):
+                kind = self.next().value
+                self.expect("(")
+                group_by = [self.expr()]
+                while self.accept(","):
+                    group_by.append(self.expr())
+                self.expect(")")
+                k = len(group_by)
+                if kind == "rollup":
+                    group_sets = tuple(
+                        tuple(range(k - i)) for i in range(k + 1)
+                    )
+                else:  # cube: all subsets, largest first
+                    group_sets = tuple(sorted(
+                        (tuple(i for i in range(k) if m & (1 << i))
+                         for m in range(1 << k)),
+                        key=lambda s: (-len(s), s),
+                    ))
+            elif self.peek().kind == "kw" and self.peek().value == "grouping":
+                self.next()
+                self.expect("sets")
+                self.expect("(")
+                sets_ast: list[list] = []
+                while True:
+                    self.expect("(")
+                    one: list = []
+                    if not self.accept(")"):
+                        one.append(self.expr())
+                        while self.accept(","):
+                            one.append(self.expr())
+                        self.expect(")")
+                    sets_ast.append(one)
+                    if not self.accept(","):
+                        break
+                self.expect(")")
+                group_by = []
+                sets_idx = []
+                for one in sets_ast:
+                    idxs = []
+                    for e in one:
+                        if e not in group_by:
+                            group_by.append(e)
+                        idxs.append(group_by.index(e))
+                    sets_idx.append(tuple(idxs))
+                group_sets = tuple(sets_idx)
+            else:
+                group_by = [self.expr()]
+                while self.accept(","):
+                    group_by.append(self.expr())
         having = self.expr() if self.accept("having") else None
         order_by = []
         if self.accept("order"):
@@ -508,6 +641,7 @@ class Parser:
             limit=limit,
             offset=offset,
             distinct=distinct,
+            group_sets=group_sets,
         )
 
     def select_item(self) -> A.SelectItem:

@@ -56,6 +56,7 @@ _PARAM_KINDS = {
     TypeKind.FLOAT64,
     TypeKind.DECIMAL,
     TypeKind.DATE,
+    TypeKind.VECTOR,
 }
 
 
@@ -122,6 +123,14 @@ class _Paramizer:
                 e.negated,
             )
         if isinstance(e, E.Func):
+            if e.name == "vec_l2":
+                # the QUERY VECTOR parameterizes (one executable per
+                # column serves every query point — the ANN qps story);
+                # the column ref stays structural
+                return E.Func(e.name, (
+                    self.expr(e.args[0], True),
+                    self.expr(e.args[1], False),
+                ))
             # function args (LIKE patterns, substr bounds) drive host-side
             # dictionary transforms during tracing: never parameterize
             return E.Func(e.name, tuple(self.expr(a, True) for a in e.args))
@@ -149,6 +158,9 @@ class _Paramizer:
                 residual=self.expr(op.residual),
             )
         if isinstance(op, Aggregate):
+            if op.grouping_sets is not None:
+                # set structure shapes the physical program: structural
+                self.baked.append(("gsets", op.grouping_sets))
             return dc_replace(
                 op,
                 child=self.plan(op.child),

@@ -20,7 +20,7 @@ join as relations whose outputs are renamed into the block's namespace.
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from ..core.dtypes import Schema
 from ..expr import ir as E
@@ -206,6 +206,8 @@ class Planner:
 
     # -- cardinality estimates (stats-backed with heuristic fallback) --
     def _scan_rows(self, scan: Scan) -> float:
+        if scan.table == "$dual":
+            return 1.0
         t = self.catalog[scan.table]
         base = t.nrows or 1
         if scan.pushed_filter is not None:
@@ -220,23 +222,22 @@ class Planner:
     def _rel_rows(self, rel: Relation) -> float:
         if rel.is_scan:
             return self._scan_rows(rel.scan)
+        return self._est_op(rel.plan)
 
-        def est(op) -> float:
-            if isinstance(op, Scan):
-                return self._scan_rows(op)
-            if isinstance(op, Filter):
-                return max(est(op.child) * 0.5, 1.0)
-            if isinstance(op, Aggregate):
-                return max(est(op.child) * 0.1, 1.0)
-            if isinstance(op, JoinOp):
-                return max(est(op.left), est(op.right))
-            if isinstance(op, (Project, Sort, Distinct)):
-                return est(op.child)
-            if isinstance(op, Limit):
-                return float(op.n)
-            return 1e4
-
-        return est(rel.plan)
+    def _est_op(self, op) -> float:
+        if isinstance(op, Scan):
+            return self._scan_rows(op)
+        if isinstance(op, Filter):
+            return max(self._est_op(op.child) * 0.5, 1.0)
+        if isinstance(op, Aggregate):
+            return max(self._est_op(op.child) * 0.1, 1.0)
+        if isinstance(op, JoinOp):
+            return max(self._est_op(op.left), self._est_op(op.right))
+        if isinstance(op, (Project, Sort, Distinct)):
+            return self._est_op(op.child)
+        if isinstance(op, Limit):
+            return float(op.n)
+        return 1e4
 
     # ================================================================ API
     def plan(self, sel: "A.Select | A.SetSelect", outer: Resolver | None = None) -> PlannedQuery:
@@ -482,7 +483,10 @@ class Planner:
                     agg_order_keys.append(
                         (E.ColRef(matched[0]) if matched else oe, oi.descending)
                     )
-            plan, agg_out_sub = self._build_aggregate(plan, key_exprs, r.agg_exprs)
+            plan, agg_out_sub = self._build_aggregate(
+                plan, key_exprs, r.agg_exprs,
+                group_sets=getattr(sel, "group_sets", None),
+            )
             out_items = [(n, _substitute(e, agg_out_sub)) for n, e in out_items]
             for kind, sub_plan, lkeys, rkeys, resid in scalar_join_after_agg:
                 plan = JoinOp(kind, plan, sub_plan, tuple(lkeys), tuple(rkeys), resid)
@@ -590,10 +594,16 @@ class Planner:
         return plan, r, out_items, visible
 
     # ------------------------------------------------- aggregate helper
-    def _build_aggregate(self, plan, key_exprs, agg_exprs):
+    def _build_aggregate(self, plan, key_exprs, agg_exprs, group_sets=None):
         """Build the Aggregate node; expands DISTINCT aggregates into a
         pre-dedup (Distinct over keys+arg) + plain aggregate."""
         distinct_aggs = [a for a in agg_exprs if a[3]]
+        if group_sets is not None:
+            # ROLLUP/CUBE/GROUPING SETS: one EXPAND-style Aggregate
+            # (executor replicates per set and NULL-masks missing keys)
+            plan = Aggregate(plan, tuple(key_exprs), tuple(agg_exprs),
+                             grouping_sets=tuple(group_sets))
+            return plan, {e: E.ColRef(n) for n, e in key_exprs}
         if len(distinct_aggs) == 1 and len(agg_exprs) == 1 \
                 and distinct_aggs[0][1] == "count":
             # lone COUNT(DISTINCT): pre-dedup (Distinct over keys+arg) +
@@ -611,7 +621,8 @@ class Planner:
             return plan, sub
         # mixed / multiple / non-count DISTINCT aggregates flow through:
         # the executor masks each distinct agg to first occurrences
-        plan = Aggregate(plan, tuple(key_exprs), tuple(agg_exprs))
+        plan = Aggregate(plan, tuple(key_exprs), tuple(agg_exprs),
+                         grouping_sets=group_sets)
         sub = {e: E.ColRef(n) for n, e in key_exprs}
         return plan, sub
 
@@ -929,7 +940,17 @@ class Planner:
         residual: list[E.Expr],
     ) -> LogicalOp:
         if not relations:
-            raise ResolveError("SELECT without FROM is not supported")
+            # FROM-less SELECT: a one-row dual relation (MySQL's implicit
+            # DUAL); the executor serves '$dual' without a catalog entry
+            from ..core.dtypes import DataType, Field as F, Schema as S
+
+            plan = Scan(
+                "$dual", "$dual",
+                S((F("$dual.$one", DataType.int8()),)),
+            )
+            for c in residual:
+                plan = Filter(plan, c)
+            return plan
         if len(relations) == 1:
             plan = relations[0].plan
             for c in residual:
@@ -983,10 +1004,60 @@ class Planner:
                 tuple(rkeys),
             )
             joined.add(alias)
+        plan = self._rotate_right_deep(plan)
         leftover = [E.Compare("=", l, r_) for l, r_ in pending_equi] + residual
         for c in leftover:
             plan = Filter(plan, c)
         return plan
+
+    def _rotate_right_deep(self, op) -> LogicalOp:
+        """Rotate J2(J1(A, B), C) into J1(A, J2'(B, C)) when J2's join
+        condition only touches B — join associativity, applied whenever A
+        is the bigger side. Keeps the large probe relation A as the single
+        probe spine so every join above it stays layout-preserving and
+        the engine's direct-address / clustered-FK paths apply (the
+        reference reaches the same shapes through bushy-tree costing in
+        sql/optimizer/ob_join_order.cpp; here the right-deep shape is the
+        one whose joins all ride gathers instead of sorts)."""
+        if not isinstance(op, JoinOp):
+            if hasattr(op, "child"):
+                return replace(op, child=self._rotate_right_deep(op.child))
+            return op
+        op = replace(
+            op,
+            left=self._rotate_right_deep(op.left),
+            right=self._rotate_right_deep(op.right),
+        )
+        while True:
+            j1 = op.left
+            if not (
+                op.kind in ("inner", "semi", "anti")
+                and op.left_keys
+                and isinstance(j1, JoinOp)
+                and j1.kind == "inner"
+                and j1.left_keys
+            ):
+                break
+            a_names = set(output_schema(j1.left).names())
+            b_names = set(output_schema(j1.right).names())
+            refs: set[str] = set()
+            for e in op.left_keys:
+                refs |= set(E.referenced_columns(e))
+            res_refs = (
+                set(E.referenced_columns(op.residual))
+                if op.residual is not None
+                else set()
+            )
+            if not (refs <= b_names and not (res_refs & a_names)):
+                break
+            if self._est_op(j1.left) <= self._est_op(j1.right):
+                break
+            inner = JoinOp(
+                op.kind, j1.right, op.right,
+                op.left_keys, op.right_keys, op.residual,
+            )
+            op = replace(j1, right=self._rotate_right_deep(inner))
+        return op
 
 
 def _rename_cols(e: E.Expr, mapping: dict[str, str]) -> E.Expr:

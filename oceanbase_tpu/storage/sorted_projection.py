@@ -1,0 +1,70 @@
+"""Sorted projections: a covering secondary index materialized columnar.
+
+Reference surface: ObTableSchema index tables + the ordered index-back
+scan path (src/sql/das/ob_das_scan_op.h, storage index sstables laid out
+in index-key order). The reference answers a selective range predicate by
+walking an ordered index and looking rows back; the TPU redesign
+materializes the index WITH its included columns as a second
+column-ordered table (no row-ids, no look-back gathers) so a range
+predicate becomes a contiguous device slice — the scan reads exactly the
+qualifying rows instead of masking a full-table pass. TPC-H-legal for
+date columns (clause 1.5.4 allows indexes on date attributes); the bench
+builds one on lineitem.l_shipdate.
+
+DML on the base table drops its projections (Database.invalidate path):
+they are rebuilt on demand, the same contract as the device batch cache.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import Schema
+from ..core.table import Table
+
+
+def projection_name(table: str, key_col: str) -> str:
+    return f"{table}#sp:{key_col}"
+
+
+def make_sorted_projection(
+    catalog, table: str, key_col: str, cols: list[str] | None = None
+) -> str:
+    """Materialize `table` re-ordered by `key_col` (stable) into the
+    catalog under projection_name(); registers it on the base Table's
+    `sorted_projections` map, which the executor's scan router consults.
+    `cols` limits the covered columns (default: all)."""
+    t = catalog[table]
+    names = [f.name for f in t.schema.fields]
+    keep = list(cols) if cols is not None else list(names)
+    if key_col not in keep:
+        keep.append(key_col)
+    keep = [n for n in names if n in keep]  # schema order
+    order = np.argsort(t.data[key_col], kind="stable")
+    data = {c: np.ascontiguousarray(t.data[c][order]) for c in keep}
+    valid = {c: np.ascontiguousarray(t.valid[c][order])
+             for c in t.valid if c in keep}
+    sub_schema = Schema(tuple(f for f in t.schema.fields if f.name in keep))
+    pname = projection_name(table, key_col)
+    catalog[pname] = Table(
+        pname, sub_schema, data,
+        {c: d for c, d in t.dicts.items() if c in keep}, valid,
+    )
+    t.sorted_projections = {
+        **getattr(t, "sorted_projections", {}), key_col: pname
+    }
+    return pname
+
+
+def drop_projections(catalog, table: str) -> None:
+    """Remove every sorted projection of `table` (base data changed)."""
+    t = catalog[table]
+    projs = getattr(t, "sorted_projections", None)
+    if not projs:
+        return
+    for pname in projs.values():
+        try:
+            del catalog[pname]
+        except (KeyError, TypeError):
+            pass
+    t.sorted_projections = {}

@@ -55,6 +55,10 @@ class LockManager:
     # txs killed by the distributed detector (share/deadlock): surfaced as
     # DeadlockDetected on the victim's next lock() retry
     _aborted: set[int] = field(default_factory=set)
+    # tx -> wait instance counter, bumped on each (re)registered wait:
+    # lets the distributed detector drop probes from superseded waits
+    # (the classic CMH phantom-cycle hazard)
+    _wait_seq: dict[int, int] = field(default_factory=dict)
     deadlocks: int = 0
 
     @staticmethod
@@ -102,6 +106,15 @@ class LockManager:
         with self._lock:
             return tx_id in self._waiting
 
+    def wait_token(self, tx_id: int) -> int | None:
+        """Current wait-instance token of a waiting tx (None = not
+        waiting). A probe stamped with an older token chased a wait
+        that no longer exists and must not abort anyone."""
+        with self._lock:
+            if tx_id not in self._waiting:
+                return None
+            return self._wait_seq.get(tx_id, 0)
+
     def abort(self, tx_id: int) -> None:
         """Mark a tx as a deadlock victim (distributed detector verdict);
         its next lock() retry raises DeadlockDetected."""
@@ -128,6 +141,8 @@ class LockManager:
                 holders.setdefault(tx_id, set()).add(mode)
                 self._waiting.pop(tx_id, None)
                 return
+            if self._waiting.get(tx_id) != (lock_id, mode):
+                self._wait_seq[tx_id] = self._wait_seq.get(tx_id, 0) + 1
             self._waiting[tx_id] = (lock_id, mode)
             if self._would_deadlock(tx_id):
                 self.deadlocks += 1

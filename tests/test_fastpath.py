@@ -1,0 +1,293 @@
+"""Clustered-FK segment aggregation, sorted-projection range scans, and
+affine-through-join propagation (round 4 join fast paths).
+
+Strategy mirrors the engine's own discipline elsewhere: every fast path
+must produce bit-identical results to the generic path it replaces, on
+data with the awkward cases present (unmatched keys on both sides, NULL
+aggregate inputs, empty groups, duplicate fk runs, parameter values that
+overflow the seeded capacity)."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core.dtypes import DataType, Field, Schema, TypeKind
+from oceanbase_tpu.core.table import Table
+from oceanbase_tpu.engine import Session
+from oceanbase_tpu.engine.executor import Executor
+from oceanbase_tpu.storage.sorted_projection import (
+    drop_projections,
+    make_sorted_projection,
+)
+
+I64 = DataType(TypeKind.INT64)
+I32 = DataType(TypeKind.INT32)
+F64 = DataType(TypeKind.FLOAT64)
+I64N = DataType(TypeKind.INT64, nullable=True)
+
+
+def _tables(seed=7, nprobe=5000, nbuild=400):
+    rng = np.random.default_rng(seed)
+    # clustered fk: sorted, with runs, referencing ~half the build keys,
+    # plus some fk values that exist in no build row
+    fk = np.sort(rng.integers(0, nbuild * 2, nprobe)).astype(np.int64)
+    val = rng.integers(-50, 50, nprobe).astype(np.int64)
+    val_null = rng.random(nprobe) < 0.15
+    flt = rng.integers(0, 10, nprobe).astype(np.int32)
+    probe = Table(
+        "probe",
+        Schema((
+            Field("fk", I64),
+            Field("val", I64N),
+            Field("flt", I32),
+        )),
+        {"fk": fk, "val": val, "flt": flt},
+        valid={"val": ~val_null},
+    )
+    pk = rng.permutation(nbuild * 2)[:nbuild].astype(np.int64)
+    battr = rng.integers(0, 5, nbuild).astype(np.int32)
+    build = Table(
+        "build",
+        Schema((Field("pk", I64), Field("battr", I32))),
+        {"pk": pk, "battr": battr},
+    )
+    return {"probe": probe, "build": build}
+
+
+Q_CLUSTERED = """
+select fk, battr, sum(val) as s, count(val) as c, count(*) as n
+from probe, build
+where fk = pk and flt < 7 and battr <> 3
+group by fk, battr
+order by fk
+"""
+
+
+def _run(catalog, q, clustered: bool):
+    sess = Session(catalog, unique_keys={"build": (("pk",),)})
+    prev = Executor.clustered_agg_enabled
+    Executor.clustered_agg_enabled = clustered
+    try:
+        rs = sess.sql(q)
+    finally:
+        Executor.clustered_agg_enabled = prev
+    # the fast path must actually have fired (or not)
+    entry, _ = sess.cached_entry(q)
+    specs = entry.prepared.params.clustered_aggs
+    assert bool(specs) == clustered
+    return rs.rows()
+
+
+def test_clustered_agg_matches_generic():
+    got = _run(_tables(), Q_CLUSTERED, clustered=True)
+    want = _run(_tables(), Q_CLUSTERED, clustered=False)
+    assert len(got) == len(want) and len(got) > 5
+    assert got == want
+
+
+def test_clustered_agg_declines_unclustered_fk():
+    cat = _tables()
+    # shuffle the fk column: monotonicity gone -> generic path
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(cat["probe"].data["fk"]))
+    for c in ("fk", "val", "flt"):
+        cat["probe"].data[c] = cat["probe"].data[c][order]
+    cat["probe"].valid["val"] = cat["probe"].valid["val"][order]
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    rs = sess.sql(Q_CLUSTERED)
+    entry, _ = sess.cached_entry(Q_CLUSTERED)
+    assert not entry.prepared.params.clustered_aggs
+    want = _run(_tables(), Q_CLUSTERED, clustered=False)
+    # same multiset of rows modulo fk order (ordered by fk both ways)
+    assert rs.rows() == want
+
+
+def test_clustered_agg_declines_coarser_groups():
+    """Group keys that don't pin the join key (TPC-H Q10 shape) must NOT
+    ride the per-build-row path."""
+    cat = _tables()
+    q = """
+    select battr, sum(val) as s from probe, build
+    where fk = pk group by battr order by battr
+    """
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    rs = sess.sql(q)
+    entry, _ = sess.cached_entry(q)
+    assert not entry.prepared.params.clustered_aggs
+    # numpy oracle
+    p, b = cat["probe"], cat["build"]
+    pos = {int(k): i for i, k in enumerate(b.data["pk"])}
+    s = {}
+    for i in range(p.nrows):
+        j = pos.get(int(p.data["fk"][i]))
+        if j is None or not p.valid["val"][i]:
+            continue
+        a = int(b.data["battr"][j])
+        s[a] = s.get(a, 0) + int(p.data["val"][i])
+    want = [(a, s[a]) for a in sorted(s)]
+    assert [(int(a), int(v)) for a, v in rs.rows()] == want
+
+
+def test_sorted_projection_slice_and_params():
+    cat = _tables(nprobe=20000)
+    make_sorted_projection(cat, "probe", "fk")
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    q = "select sum(val) as s, count(*) as n from probe where fk >= 100 and fk < 140"
+    rs = sess.sql(q)
+    entry, _ = sess.cached_entry(q)
+    assert entry.prepared.params.scan_cap, "slice did not engage"
+    p = cat["probe"]
+    m = (p.data["fk"] >= 100) & (p.data["fk"] < 140) & p.valid["val"]
+    assert int(rs.columns["s"][0]) == int(p.data["val"][m].sum())
+    # same plan, range wide enough to overflow the seeded capacity
+    q2 = "select sum(val) as s, count(*) as n from probe where fk >= 0 and fk < 600"
+    rs2 = sess.sql(q2)
+    assert rs2.plan_cache_hit
+    m2 = (p.data["fk"] >= 0) & (p.data["fk"] < 600) & p.valid["val"]
+    assert int(rs2.columns["s"][0]) == int(p.data["val"][m2].sum())
+    assert entry.prepared.retries >= 1
+
+
+def test_projection_not_routed_when_unselective():
+    cat = _tables(nprobe=20000)
+    make_sorted_projection(cat, "probe", "fk")
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    q = "select count(*) as n from probe where fk >= 1"  # ~all rows
+    rs = sess.sql(q)
+    entry, _ = sess.cached_entry(q)
+    assert not entry.prepared.params.scan_cap
+    assert int(rs.columns["n"][0]) == int((cat["probe"].data["fk"] >= 1).sum())
+
+
+def test_drop_projections():
+    cat = _tables()
+    pname = make_sorted_projection(cat, "probe", "fk")
+    assert pname in cat
+    drop_projections(cat, "probe")
+    assert pname not in cat
+    assert not cat["probe"].sorted_projections
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    q = "select count(*) as n from probe where fk >= 100 and fk < 140"
+    rs = sess.sql(q)
+    entry, _ = sess.cached_entry(q)
+    assert not entry.prepared.params.scan_cap  # no projection, no slice
+
+
+def test_clustered_never_combines_with_sliced_projection():
+    """A projection sorted by the clustered fk makes BOTH fast paths
+    eligible; combining them misindexes fk_ranges against the sliced
+    batch (review finding r4). Exactly one may fire, and results must
+    stay correct."""
+    cat = _tables()
+    make_sorted_projection(cat, "probe", "fk")
+    q = """
+    select fk, battr, sum(val) as s from probe, build
+    where fk = pk and fk >= 100 and fk < 140 and flt < 7
+    group by fk, battr order by fk
+    """
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    rs = sess.sql(q)
+    entry, _ = sess.cached_entry(q)
+    p = entry.prepared.params
+    assert not (p.clustered_aggs and p.scan_cap), "both fast paths fired"
+    # oracle
+    cat2 = _tables()
+    pr, b = cat2["probe"], cat2["build"]
+    pos = {int(k): i for i, k in enumerate(b.data["pk"])}
+    agg = {}
+    for i in range(pr.nrows):
+        fk = int(pr.data["fk"][i])
+        if not (100 <= fk < 140) or pr.data["flt"][i] >= 7:
+            continue
+        j = pos.get(fk)
+        if j is None:
+            continue
+        k = (fk, int(b.data["battr"][j]))
+        agg.setdefault(k, 0)
+        if pr.valid["val"][i]:
+            agg[k] += int(pr.data["val"][i])
+    want = [(fk, a, agg[(fk, a)]) for fk, a in sorted(agg)]
+    assert [(int(x), int(y), int(z)) for x, y, z in rs.rows()] == want
+
+
+def test_clustered_premise_revalidated_after_dml():
+    """In-place data change that breaks the fk clustering must NOT let a
+    cached clustered plan mis-group (review finding r4): the premise is
+    re-proven when versions bump, and the plan recompiles generic."""
+    cat = _tables()
+    sess = Session(cat, unique_keys={"build": (("pk",),)})
+    rs1 = sess.sql(Q_CLUSTERED)
+    entry, _ = sess.cached_entry(Q_CLUSTERED)
+    assert entry.prepared.params.clustered_aggs
+    # permute the probe rows in place: same multiset, clustering gone
+    rng = np.random.default_rng(3)
+    order = rng.permutation(cat["probe"].nrows)
+    p = cat["probe"]
+    p.data = {c: p.data[c][order] for c in p.data}
+    p.valid = {c: p.valid[c][order] for c in p.valid}
+    sess.executor.invalidate_table("probe")
+    rs2 = sess.sql(Q_CLUSTERED)
+    # grouped sums are permutation-invariant: identical rows expected
+    assert rs2.rows() == rs1.rows()
+
+
+def test_affine_through_join():
+    """Build side that is itself a merge-joinable join output keeps the
+    affine direct-address property of its probe-side key column."""
+    n = 2000
+    a = Table(
+        "a", Schema((Field("ak", I64), Field("av", I64))),
+        {"ak": np.arange(1, n + 1, dtype=np.int64) * 3,
+         "av": np.arange(n, dtype=np.int64)},
+    )
+    b = Table(
+        "b", Schema((Field("bk", I64), Field("bv", I64))),
+        {"bk": np.arange(1, n + 1, dtype=np.int64),
+         "bv": np.arange(n, dtype=np.int64) * 7},
+    )
+    big = Table(
+        "big", Schema((Field("gk", I64), Field("gv", I64))),
+        {"gk": (np.arange(4 * n, dtype=np.int64) % (2 * n)) * 3,
+         "gv": np.arange(4 * n, dtype=np.int64)},
+    )
+    cat = {"a": a, "b": b, "big": big}
+    uk = {"a": (("ak",),), "b": (("bk",),)}
+    q = """
+    select sum(gv) as s, sum(bv) as t from big, a, b
+    where gk = ak and av + 1 = bk
+    """
+    sess = Session(cat, unique_keys=uk)
+    rs = sess.sql(q)
+    # oracle
+    amap = {int(k): int(v) for k, v in zip(a.data["ak"], a.data["av"])}
+    bmap = {int(k): int(v) for k, v in zip(b.data["bk"], b.data["bv"])}
+    s = t = 0
+    for gk, gv in zip(big.data["gk"], big.data["gv"]):
+        av = amap.get(int(gk))
+        if av is None:
+            continue
+        bv = bmap.get(av + 1)
+        if bv is None:
+            continue
+        s += int(gv)
+        t += bv
+    assert int(rs.columns["s"][0]) == s
+    assert int(rs.columns["t"][0]) == t
+    # the planner rotated and the executor resolved the (a join b) build
+    # side's ak column through the join to the affine base column
+    entry, _ = sess.cached_entry(q)
+    from oceanbase_tpu.sql.logical import JoinOp
+
+    def find_joins(op, out):
+        for c in (getattr(op, "child", None), getattr(op, "left", None),
+                  getattr(op, "right", None)):
+            if c is not None:
+                find_joins(c, out)
+        if isinstance(op, JoinOp):
+            out.append(op)
+        return out
+
+    joins = find_joins(entry.prepared.plan, [])
+    ex = sess.executor
+    outer = [j for j in joins if j.left_keys
+             and j.left_keys[0].name == "big.gk"]
+    assert outer and ex._affine_build_info(outer[0]) == (3, 3)

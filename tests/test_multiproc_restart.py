@@ -86,10 +86,34 @@ class _Zones:
         self.procs[z] = p
 
     def ask(self, z, cmd, arg=None, timeout=5.0):
+        # drain any stale reply a previously timed-out ask left behind —
+        # otherwise a retry reads the old answer for the new question
+        while self.pipes[z].poll(0):
+            self.pipes[z].recv()
         self.pipes[z].send((cmd, arg))
         if self.pipes[z].poll(timeout):
             return self.pipes[z].recv()
         raise TimeoutError(f"zone {z} no reply to {cmd}")
+
+    def submit_retry(self, lead, payload, exclude=(), budget=30.0):
+        """Commit one payload against whoever currently leads, under a
+        WALL-CLOCK budget rather than a single fixed deadline: on a
+        loaded machine an election or a slow majority ack is load
+        sensitivity, not a consensus bug (round-3 verdict, weak #3)."""
+        deadline = time.time() + budget
+        while time.time() < deadline:
+            try:
+                lsn = self.ask(lead, "submit", payload, timeout=5.0)
+            except TimeoutError:
+                lsn = None
+            if lsn is not None:
+                return lead
+            try:
+                lead = self.wait_leader(exclude=exclude, timeout=10.0)
+            except TimeoutError:
+                pass
+            time.sleep(0.1)
+        raise TimeoutError(f"submit {payload!r} uncommitted in {budget}s")
 
     def kill9(self, z):
         os.kill(self.procs[z].pid, signal.SIGKILL)
@@ -134,7 +158,7 @@ def test_kill9_rejoin_and_cold_restart(tmp_path):
         # phase 1: commit 30 entries with all zones alive
         for i in range(30):
             p = f"pre-{i}".encode()
-            assert zones.ask(lead, "submit", p) is not None
+            lead = zones.submit_retry(lead, p)
             all_payloads.append(p)
 
         # let the victim replicate some of it, then SIGKILL it mid-stream
@@ -148,11 +172,7 @@ def test_kill9_rejoin_and_cold_restart(tmp_path):
         # phase 2: keep committing on the surviving majority
         for i in range(30):
             p = f"mid-{i}".encode()
-            lsn = zones.ask(lead, "submit", p)
-            if lsn is None:  # leadership may have wobbled; re-find
-                lead = zones.wait_leader(exclude=(victim,))
-                lsn = zones.ask(lead, "submit", p)
-            assert lsn is not None
+            lead = zones.submit_retry(lead, p, exclude=(victim,))
             all_payloads.append(p)
 
         # phase 3: restart the victim FROM ITS DISK; it must catch up
@@ -185,6 +205,6 @@ def test_kill9_rejoin_and_cold_restart(tmp_path):
             time.sleep(0.05)
         assert got[: len(all_payloads)] == all_payloads
         # and the reborn cluster accepts new writes
-        assert zones2.ask(lead, "submit", b"post-restart") is not None
+        zones2.submit_retry(lead, b"post-restart")
     finally:
         zones2.stop_all()

@@ -1,0 +1,152 @@
+"""GRANT/REVOKE + resolve-time privilege checks (src/sql/privilege_check
+analog): denial carries MySQL error codes, grants persist across restart,
+and the wire front door authenticates against the same account table."""
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+
+@pytest.fixture()
+def db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table t (a int primary key, b int)")
+    s.sql("insert into t values (1, 10), (2, 20)")
+    s.sql("create table u (a int primary key)")
+    yield d
+    d.close()
+
+
+def code_of(excinfo):
+    return excinfo.value.code
+
+
+def test_denied_then_granted_select(db):
+    root = db.session()
+    root.sql("create user alice identified by 'pw'")
+    alice = db.session(user="alice")
+    with pytest.raises(SqlError) as e:
+        alice.sql("select * from t")
+    assert code_of(e) == 1142
+    root.sql("grant select on t to alice")
+    assert alice.sql("select sum(b) as s from t").columns["s"][0] == 30
+    # table-scoped: u stays denied
+    with pytest.raises(SqlError) as e:
+        alice.sql("select * from u")
+    assert code_of(e) == 1142
+
+
+def test_dml_privs_separate(db):
+    root = db.session()
+    root.sql("create user bob")
+    root.sql("grant select on t to bob")
+    bob = db.session(user="bob")
+    with pytest.raises(SqlError) as e:
+        bob.sql("insert into t values (3, 30)")
+    assert code_of(e) == 1142
+    root.sql("grant insert, update, delete on t to bob")
+    assert bob.sql("insert into t values (3, 30)").affected == 1
+    assert bob.sql("update t set b = 31 where a = 3").affected == 1
+    assert bob.sql("delete from t where a = 3").affected == 1
+
+
+def test_cte_names_are_not_tables(db):
+    """A CTE reference is statement-local: grants on the UNDERLYING
+    tables suffice (review finding r4)."""
+    root = db.session()
+    root.sql("create user hana")
+    root.sql("grant select on t to hana")
+    hana = db.session(user="hana")
+    rs = hana.sql(
+        "with x as (select a, b from t) select sum(b) as s from x"
+    )
+    assert int(rs.columns["s"][0]) == 30
+    # but the tables INSIDE the cte are still checked
+    with pytest.raises(SqlError) as e:
+        hana.sql("with x as (select a from u) select * from x")
+    assert code_of(e) == 1142
+
+
+def test_subquery_tables_checked(db):
+    root = db.session()
+    root.sql("create user carol")
+    root.sql("grant select on t to carol")
+    carol = db.session(user="carol")
+    with pytest.raises(SqlError) as e:
+        carol.sql("select * from t where a in (select a from u)")
+    assert code_of(e) == 1142
+
+
+def test_revoke_and_global_grant(db):
+    root = db.session()
+    root.sql("create user dave")
+    root.sql("grant all on * to dave")
+    dave = db.session(user="dave")
+    assert dave.sql("select count(*) as n from u").nrows == 1
+    dave.sql("create table w (x int primary key)")
+    root.sql("revoke all on * from dave")
+    with pytest.raises(SqlError) as e:
+        dave.sql("select * from t")
+    assert code_of(e) == 1142
+
+
+def test_only_root_administers(db):
+    root = db.session()
+    root.sql("create user eve")
+    eve = db.session(user="eve")
+    with pytest.raises(SqlError) as e:
+        eve.sql("grant select on t to eve")
+    assert code_of(e) == 1227
+    with pytest.raises(SqlError) as e:
+        eve.sql("alter system set plan_cache_capacity = 64")
+    assert code_of(e) == 1227
+    with pytest.raises(SqlError) as e:
+        root.sql("drop user root")
+    assert code_of(e) == 1396
+
+
+def test_grants_survive_restart(tmp_path):
+    data = str(tmp_path / "d")
+    db = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
+    s = db.session()
+    s.sql("create table t (a int primary key, b int)")
+    s.sql("insert into t values (1, 5)")
+    s.sql("create user frank identified by 'fpw'")
+    s.sql("grant select on t to frank")
+    db.checkpoint()
+    db.close()
+
+    db2 = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
+    try:
+        assert db2.privileges.users.get("frank") == "fpw"
+        frank = db2.session(user="frank")
+        assert frank.sql("select sum(b) as s from t").columns["s"][0] == 5
+        with pytest.raises(SqlError):
+            frank.sql("insert into t values (2, 6)")
+    finally:
+        db2.close()
+
+
+def test_front_door_authenticates_created_user(db):
+    """CREATE USER + GRANT govern the wire protocol too: bad password is
+    1045, denied table is 1142 over the wire."""
+    from oceanbase_tpu.server.mysql_front import MySqlFrontend
+
+    from test_mysql_front import MiniMySqlClient
+
+    root = db.session()
+    root.sql("create user grace identified by 'gpw'")
+    root.sql("grant select on t to grace")
+    front = MySqlFrontend(db).start()
+    try:
+        with pytest.raises(PermissionError):
+            MiniMySqlClient(front.port, user="grace", password="wrong")
+        c = MiniMySqlClient(front.port, user="grace", password="gpw")
+        names, rows = c.query("select sum(b) as s from t")
+        assert rows == [("30",)]
+        with pytest.raises(RuntimeError) as e:
+            c.query("select * from u")
+        assert "1142" in str(e.value)
+    finally:
+        front.stop()

@@ -1,0 +1,204 @@
+"""TLS on the cluster bus and the MySQL front door (ussl-hook analog).
+
+Certificates are generated per-test-session with the openssl CLI: one
+cluster CA signing one shared cluster cert — the reference's trust shape
+(certs identify the cluster, not hosts)."""
+
+import socket
+import ssl
+import subprocess
+import threading
+import time
+
+import pytest
+
+from oceanbase_tpu.log.tcp_transport import TcpBus
+from oceanbase_tpu.share.tls import client_context, server_context
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    key, csr, crt = d / "node.key", d / "node.csr", d / "node.crt"
+    run = lambda *a: subprocess.run(a, check=True, capture_output=True)
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", "/CN=oceanbase-tpu-test-ca")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(key), "-out", str(csr),
+        "-subj", "/CN=oceanbase-tpu-cluster")
+    run("openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+        "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(crt),
+        "-days", "1")
+    return {"ca": str(ca_crt), "crt": str(crt), "key": str(key)}
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _bus_pair(certs, token_a=b"tok", token_b=b"tok", b_tls=True):
+    p1, p2 = _free_ports(2)
+    tls_pair = lambda: (
+        server_context(certs["crt"], certs["key"], cafile=certs["ca"]),
+        client_context(certs["ca"], certs["crt"], certs["key"]),
+    )
+    a = TcpBus(p1, {2: ("127.0.0.1", p2)}, {1}, auth_token=token_a,
+               tls=tls_pair())
+    b = TcpBus(p2, {1: ("127.0.0.1", p1)}, {2}, auth_token=token_b,
+               tls=tls_pair() if b_tls else None)
+    a.start()
+    b.start()
+    return a, b
+
+
+def test_bus_roundtrip_over_tls(certs):
+    from oceanbase_tpu.share.deadlock import LockProbe
+
+    a, b = _bus_pair(certs)
+    got = []
+    b.register(2, lambda src, msg: got.append((src, msg)))
+    a.register(1, lambda src, msg: None)
+    try:
+        probe = LockProbe(7, 8, 9, 1, 42)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            a.send(1, 2, probe)
+            time.sleep(0.05)
+        assert got and got[0] == (1, probe)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_bus_rejects_non_tls_peer(certs):
+    """A plaintext client against a TLS listener must be rejected, not
+    interpreted as frames."""
+    from oceanbase_tpu.share.deadlock import LockProbe
+
+    a, b = _bus_pair(certs)
+    got = []
+    b.register(2, lambda src, msg: got.append(msg))
+    try:
+        # plaintext bus dialing the TLS listener: its frames are TLS
+        # garbage to the server handshake
+        p_plain = _free_ports(1)[0]
+        plain = TcpBus(p_plain, {2: ("127.0.0.1", b.listen_port)}, {3},
+                       auth_token=b"tok")
+        plain.start()
+        for _ in range(5):
+            plain.send(3, 2, LockProbe(1, 2, 3, 1, 0))
+            time.sleep(0.05)
+        time.sleep(0.3)
+        assert not got
+        plain.stop()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_bus_rejects_unverified_cert(certs, tmp_path):
+    """mTLS: a client with a self-signed (non-cluster-CA) cert fails the
+    server's verification."""
+    run = lambda *a: subprocess.run(a, check=True, capture_output=True)
+    rogue_key, rogue_crt = tmp_path / "r.key", tmp_path / "r.crt"
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(rogue_key), "-out", str(rogue_crt), "-days", "1",
+        "-subj", "/CN=rogue")
+    p1 = _free_ports(1)[0]
+    srv = TcpBus(p1, {}, {1}, auth_token=b"tok", tls=(
+        server_context(certs["crt"], certs["key"], cafile=certs["ca"]),
+        client_context(certs["ca"], certs["crt"], certs["key"]),
+    ))
+    got = []
+    srv.register(1, lambda src, msg: got.append(msg))
+    srv.start()
+    try:
+        rogue_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        rogue_ctx.check_hostname = False
+        rogue_ctx.verify_mode = ssl.CERT_NONE
+        rogue_ctx.load_cert_chain(str(rogue_crt), str(rogue_key))
+        raw = socket.create_connection(("127.0.0.1", p1), timeout=2)
+        with pytest.raises(ssl.SSLError):
+            s = rogue_ctx.wrap_socket(raw)
+            # server aborts during/after handshake on cert verify
+            s.sendall(b"x" * 64)
+            for _ in range(10):
+                s.sendall(b"x" * 64)
+                time.sleep(0.05)
+        assert not got
+    finally:
+        srv.stop()
+
+
+def test_mysql_front_tls(certs):
+    """Full MySQL login + query over protocol-negotiated TLS: greeting in
+    plaintext, SSLRequest, handshake upgrade, login + COM_QUERY over the
+    encrypted channel (what every stock client does with ssl-mode on)."""
+    import struct
+
+    from oceanbase_tpu.server.database import Database
+    from oceanbase_tpu.server.mysql_front import MySqlFrontend
+
+    from test_mysql_front import MiniMySqlClient
+
+    class TlsClient(MiniMySqlClient):
+        def __init__(self, port, user, password, cafile):
+            self.sock = socket.create_connection(
+                ("127.0.0.1", port), timeout=10)
+            self.seq = 0
+            greeting = self._read()
+            nul = greeting.index(b"\x00", 1)
+            p = nul + 1 + 4
+            salt = greeting[p:p + 8]
+            caps_lo = int.from_bytes(
+                greeting[p + 8 + 1:p + 8 + 3], "little")
+            assert caps_lo & 0x0800, "server did not advertise CLIENT_SSL"
+            p += 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+            salt += greeting[p:greeting.index(b"\x00", p)]
+            caps = 0x0200 | 0x8000 | 0x0800
+            # SSLRequest: caps/maxpacket/charset only, then upgrade
+            self._send(struct.pack("<IIB23x", caps, 1 << 24, 33))
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.load_verify_locations(cafile)
+            ctx.check_hostname = False
+            self.sock = ctx.wrap_socket(self.sock)
+            from oceanbase_tpu.server.mysql_front import (
+                native_password_scramble,
+            )
+
+            auth = native_password_scramble(password, salt[:20])
+            self._send(
+                struct.pack("<IIB23x", caps, 1 << 24, 33)
+                + user.encode() + b"\x00"
+                + bytes([len(auth)]) + auth
+            )
+            ok = self._read()
+            assert ok[0] == 0x00, ok
+
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table t (a int primary key, b int)")
+    s.sql("insert into t values (1, 10), (2, 20)")
+    front = MySqlFrontend(
+        db, users={"root": "secret"},
+        ssl_context=server_context(certs["crt"], certs["key"]),
+    ).start()
+    try:
+        c = TlsClient(front.port, "root", "secret", certs["ca"])
+        names, rows = c.query("select sum(b) as s from t")
+        assert names == ["s"] and rows == [("30",)]
+        # and the socket really is TLS
+        assert isinstance(c.sock, ssl.SSLSocket)
+    finally:
+        front.stop()
+        db.close()

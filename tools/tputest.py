@@ -22,6 +22,13 @@ SUITES = [
     ("ops_kernels", ["tests/test_ops.py"]),
     ("sql_smoke", ["tests/test_sql.py"]),
     ("tpch_smoke", ["tests/test_tpch.py"]),
+    # r4 (VERDICT weak #9: widen the on-chip surface): the full 22-query
+    # sqlite-oracle suite at tiny SF, the r4 fast paths (clustered agg,
+    # sorted projections, affine-through-join), ANN, recursive/rollup
+    ("tpch_oracle_full", ["tests/test_tpch_full.py"]),
+    ("fastpaths", ["tests/test_fastpath.py"]),
+    ("vector_ann", ["tests/test_vector_index.py"]),
+    ("recursive_rollup", ["tests/test_recursive_rollup.py"]),
 ]
 
 
