@@ -80,6 +80,41 @@ class ColumnBatch:
         )
 
 
+def narrow_tier(amin: int, amax: int, itemsize: int):
+    """Smallest unsigned dtype that holds [0, amax - amin], if narrower
+    than the storage width (the shared frame-of-reference tier rule for
+    wire-narrowed uploads)."""
+    span = amax - amin
+    for nt in (np.uint8, np.uint16, np.uint32):
+        if span <= np.iinfo(nt).max and np.dtype(nt).itemsize < itemsize:
+            return np.dtype(nt)
+    return None
+
+
+def narrowed_upload(a: np.ndarray):
+    """Host->device transfer with the wire cost of the VALUE RANGE, not
+    the storage width: integer columns ship frame-of-reference narrowed
+    (a - min, downcast to the smallest unsigned dtype that fits the
+    span) and decode on device with one cast + one add.
+
+    The network-attached chip moves ~12-30 MB/s host->device (measured
+    r4), so wire bytes bound both first-touch table residency and every
+    out-of-core streamed chunk; TPC-H's int64-stored decimals/dates
+    narrow 2-8x. The device-side cache still holds the full-width
+    column — this is a transport encoding, the device-resident analog
+    of the reference's FOR-encoded micro-blocks decoded by SIMD readers
+    (blocksstable/encoding/ob_dict_decoder_simd.cpp)."""
+    if a.dtype.kind not in "iu" or a.ndim != 1 or len(a) == 0:
+        return jnp.asarray(a)
+    amin = int(a.min())
+    nt = narrow_tier(amin, int(a.max()), a.dtype.itemsize)
+    if nt is None:
+        return jnp.asarray(a)
+    narrow = (a - amin).astype(nt)
+    return (jnp.asarray(narrow).astype(a.dtype)
+            + np.asarray(amin, dtype=a.dtype))
+
+
 def make_batch(
     data: dict[str, np.ndarray],
     schema: Schema,
@@ -108,7 +143,7 @@ def make_batch(
         if cap > n:
             a = np.concatenate(
                 [a, np.zeros((cap - n,) + a.shape[1:], dtype=a.dtype)])
-        cols[f.name] = jnp.asarray(a)
+        cols[f.name] = narrowed_upload(a)
         if f.dtype.nullable:
             v = (
                 np.asarray(valid[f.name], dtype=np.bool_)

@@ -571,7 +571,9 @@ class Executor:
                     a = np.concatenate(
                         [a, np.zeros((cap - n,) + a.shape[1:],
                                      dtype=a.dtype)])
-                dev = jnp.asarray(a)
+                from ..core.column import narrowed_upload
+
+                dev = narrowed_upload(a)
                 vdev = None
                 if f.dtype.nullable:
                     v = (
