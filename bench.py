@@ -332,7 +332,7 @@ def check_result(qname, rs, cpu_val):
 
 
 def main():
-    budget = float(os.environ.get("BENCH_BUDGET_S", "270"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "330"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
     stream_sf = float(os.environ.get("BENCH_STREAM_SF", "30"))
 

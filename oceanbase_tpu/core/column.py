@@ -80,6 +80,34 @@ class ColumnBatch:
         )
 
 
+def batch_rows_storage(batch, names) -> dict:
+    """Live rows of a device batch in STORAGE domain (no decimal/date
+    decoding — callers materializing Tables need exact round-trips)."""
+    sel = np.asarray(batch.sel)
+    return {n: np.ascontiguousarray(np.asarray(batch.cols[n])[sel])
+            for n in names}
+
+
+def batch_valid_storage(batch, names) -> dict:
+    """Live-row validity masks (only for columns that HAVE one) — the
+    NULL half of an exact materialization; dropping it would turn NULLs
+    into storage sentinel values."""
+    sel = np.asarray(batch.sel)
+    return {
+        n: np.ascontiguousarray(np.asarray(batch.valid[n])[sel])
+        for n in names if n in batch.valid
+    }
+
+
+def renamed_storage_schema(schema_src, names) -> "Schema":
+    """Schema of a materialized result: output names zipped positionally
+    onto the planned output schema's field types."""
+    return Schema(tuple(
+        Field(n, schema_src[sn])
+        for n, sn in zip(names, schema_src.names())
+    ))
+
+
 def narrow_tier(amin: int, amax: int, itemsize: int):
     """Smallest unsigned dtype that holds [0, amax - amin], if narrower
     than the storage width (the shared frame-of-reference tier rule for
@@ -91,7 +119,7 @@ def narrow_tier(amin: int, amax: int, itemsize: int):
     return None
 
 
-def narrowed_upload(a: np.ndarray):
+def narrowed_upload(a: np.ndarray, cap: int | None = None):
     """Host->device transfer with the wire cost of the VALUE RANGE, not
     the storage width: integer columns ship frame-of-reference narrowed
     (a - min, downcast to the smallest unsigned dtype that fits the
@@ -104,13 +132,25 @@ def narrowed_upload(a: np.ndarray):
     column — this is a transport encoding, the device-resident analog
     of the reference's FOR-encoded micro-blocks decoded by SIMD readers
     (blocksstable/encoding/ob_dict_decoder_simd.cpp)."""
+    def pad(arr, fill=0):
+        if cap is None or cap <= len(arr):
+            return arr
+        return np.concatenate([
+            arr,
+            np.full((cap - len(arr),) + arr.shape[1:], fill,
+                    dtype=arr.dtype),
+        ])
+
     if a.dtype.kind not in "iu" or a.ndim != 1 or len(a) == 0:
-        return jnp.asarray(a)
+        return jnp.asarray(pad(a))
+    # frame from the UNPADDED values: zero-padding an all-positive column
+    # (dates, keys, scaled decimals) would drag the frame base to 0 and
+    # forfeit most of the narrowing; dead pad rows carry amin instead
     amin = int(a.min())
     nt = narrow_tier(amin, int(a.max()), a.dtype.itemsize)
     if nt is None:
-        return jnp.asarray(a)
-    narrow = (a - amin).astype(nt)
+        return jnp.asarray(pad(a))
+    narrow = pad((a - amin).astype(nt))
     return (jnp.asarray(narrow).astype(a.dtype)
             + np.asarray(amin, dtype=a.dtype))
 
@@ -140,10 +180,7 @@ def make_batch(
     vmap_: dict[str, jnp.ndarray] = {}
     for f in schema.fields:
         a = np.asarray(data[f.name], dtype=f.dtype.storage_np)
-        if cap > n:
-            a = np.concatenate(
-                [a, np.zeros((cap - n,) + a.shape[1:], dtype=a.dtype)])
-        cols[f.name] = narrowed_upload(a)
+        cols[f.name] = narrowed_upload(a, cap)
         if f.dtype.nullable:
             v = (
                 np.asarray(valid[f.name], dtype=np.bool_)

@@ -566,14 +566,10 @@ class Executor:
             key = (name, f.name)
             hit = self._batch_cache.get(key)
             if hit is None:
-                a = np.asarray(t.data[f.name], dtype=f.dtype.storage_np)
-                if cap > n:
-                    a = np.concatenate(
-                        [a, np.zeros((cap - n,) + a.shape[1:],
-                                     dtype=a.dtype)])
                 from ..core.column import narrowed_upload
 
-                dev = narrowed_upload(a)
+                a = np.asarray(t.data[f.name], dtype=f.dtype.storage_np)
+                dev = narrowed_upload(a, cap)
                 vdev = None
                 if f.dtype.nullable:
                     v = (

@@ -82,12 +82,7 @@ def _rename_table(node, old: str, new: str):
     return node
 
 
-def _batch_rows_storage(batch, names):
-    """Live rows in STORAGE domain (no decimal/date decoding — the temp
-    table must round-trip exactly)."""
-    sel = np.asarray(batch.sel)
-    return {n: np.ascontiguousarray(np.asarray(batch.cols[n])[sel])
-            for n in names}
+from ..core.column import batch_rows_storage as _batch_rows_storage  # noqa: E402
 
 
 def run_recursive(session, ast):
@@ -123,11 +118,9 @@ def run_recursive(session, ast):
     names = list(planned.output_names)
     acc = _batch_rows_storage(out_batch, names)
     dicts = {n: out_batch.dicts[n] for n in names if n in out_batch.dicts}
-    from ..core.dtypes import Field, Schema
+    from ..core.column import renamed_storage_schema
 
-    tmp_schema = Schema(tuple(
-        Field(n, schema_src[n2]) for n, n2 in zip(names, schema_src.names())
-    ))
+    tmp_schema = renamed_storage_schema(schema_src, names)
 
     seen = None
     if dedup:

@@ -74,6 +74,51 @@ class Session:
         # hook: server/diag.PlanMonitor (per-plan compile/exec stats)
         self.plan_monitor = plan_monitor
 
+    def materialize(self, text: str, name: str) -> Table:
+        """Run a SELECT and materialize its result as a storage-domain
+        Table (exact round-trip: decimals stay scaled ints, dates stay
+        day numbers, NULLs keep their validity masks) — the engine half
+        of materialized views."""
+        from ..core.column import (
+            batch_rows_storage,
+            batch_valid_storage,
+            renamed_storage_schema,
+        )
+        from ..sql.logical import output_schema
+        from .recursive import recursive_cte_of, run_recursive
+
+        ast = P.parse(text)
+        if getattr(ast, "ctes", None) and recursive_cte_of(ast) is not None:
+            batch, out_names = run_recursive(self, ast)
+            names = list(out_names)
+            schema_src = batch.schema
+        else:
+            planned = self.planner.plan(ast)
+            schema_src = output_schema(planned.plan)
+            batch = self.executor.execute(planned.plan)
+            names = list(planned.output_names)
+        valid = batch_valid_storage(batch, names)
+        schema = renamed_storage_schema(schema_src, names)
+        if valid:
+            # a validity mask forces the field nullable, or make_batch
+            # would drop the mask on the next read
+            from dataclasses import replace as _rp
+
+            from ..core.dtypes import Field as _F, Schema as _S
+
+            schema = _S(tuple(
+                _F(f.name, _rp(f.dtype, nullable=True))
+                if f.name in valid else f
+                for f in schema.fields
+            ))
+        return Table(
+            name,
+            schema,
+            batch_rows_storage(batch, names),
+            {n: batch.dicts[n] for n in names if n in batch.dicts},
+            valid,
+        )
+
     def sql(self, text: str) -> ResultSet:
         norm_key, _ = P.normalize_for_cache(text)
         # parse + logical plan always run (host-cheap, the fast-parser

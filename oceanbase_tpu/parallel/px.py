@@ -40,6 +40,7 @@ device holds all rows). Placement rules:
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import replace
 
 import jax
@@ -93,28 +94,55 @@ class PxAdmission:
     """Cluster-wide DOP quota (ObPxAdmission / ObPxTargetMgr analog).
 
     acquire() grants up to `dop` workers, degrading to whatever quota
-    remains (minimum 1, like the reference's min-DOP admission); release()
-    returns them. A query that cannot get even one worker raises."""
+    remains (minimum 1, like the reference's min-DOP admission). When
+    nothing is free the caller QUEUES (FIFO, condition-variable wait)
+    up to `queue_timeout_s` — the reference's admission behavior
+    (ob_px_admission.h waits on the target manager rather than failing
+    a concurrent burst); only a timeout raises."""
 
-    def __init__(self, target: int):
+    def __init__(self, target: int, queue_timeout_s: float = 10.0):
         self.target = target
+        self.queue_timeout_s = queue_timeout_s
         self._used = 0
         self._lock = threading.Lock()
+        self._free_cv = threading.Condition(self._lock)
+        self._waiters = 0
+        self.queued_total = 0  # observability: how often a burst queued
 
-    def acquire(self, dop: int) -> int:
-        with self._lock:
-            free = self.target - self._used
-            if free <= 0:
-                raise RuntimeError(
-                    f"PX admission: no quota ({self._used}/{self.target} in use)"
-                )
-            granted = min(dop, free)
+    def acquire(self, dop: int, timeout: float | None = None) -> int:
+        deadline = time.monotonic() + (
+            self.queue_timeout_s if timeout is None else timeout
+        )
+        with self._free_cv:
+            first = True
+            while self.target - self._used <= 0:
+                if first:
+                    self.queued_total += 1
+                    self._waiters += 1
+                    first = False
+                remain = deadline - time.monotonic()
+                timed_out = remain <= 0 or not self._free_cv.wait(remain)
+                # a release can land between the wait timing out and the
+                # lock reacquisition: re-check the predicate before
+                # failing a query that would now be admissible
+                if timed_out and self.target - self._used <= 0:
+                    if not first:
+                        self._waiters -= 1
+                    raise RuntimeError(
+                        f"PX admission: queue timeout "
+                        f"({self._used}/{self.target} in use, "
+                        f"{self._waiters} queued)"
+                    )
+            if not first:
+                self._waiters -= 1
+            granted = min(dop, self.target - self._used)
             self._used += granted
             return granted
 
     def release(self, granted: int) -> None:
-        with self._lock:
+        with self._free_cv:
             self._used = max(0, self._used - granted)
+            self._free_cv.notify_all()
 
 
 class PxExecutor(Executor):

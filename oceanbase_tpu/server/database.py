@@ -286,6 +286,16 @@ class Database:
         self._vector_specs: dict[str, dict[str, tuple[int, int]]] = (
             restored_meta.get("vector_specs", {}) if restored_meta else {}
         )
+        # external tables (plugin loaders): name -> (format, location);
+        # re-materialized from their files once the catalog exists below
+        self._external_specs: dict[str, tuple[str, str]] = (
+            restored_meta.get("external_specs", {}) if restored_meta else {}
+        )
+        # materialized views: name -> defining SELECT text; re-run at
+        # boot once base-table snapshots restore
+        self._mview_specs: dict[str, str] = (
+            restored_meta.get("mview_specs", {}) if restored_meta else {}
+        )
         # worker pool quota (ObTenant worker queues): bounds concurrent
         # statements of this tenant
         self._worker_sem = (
@@ -321,6 +331,18 @@ class Database:
                     f.name: np.zeros(0, f.dtype.storage_np)
                     for f in ti.schema.fields
                 })
+        # re-materialize registered external tables from their files.
+        # A load failure (missing mount, transient IO) keeps the
+        # REGISTRATION — queries error "unknown table" until the file is
+        # back and the next boot (or re-create) materializes it; silently
+        # dropping the spec would persist the loss at the next meta save
+        for _ename, (_efmt, _eloc) in list(self._external_specs.items()):
+            try:
+                from ..plugin import load_external
+
+                self.catalog[_ename] = load_external(_ename, _efmt, _eloc)
+            except Exception:
+                pass
         self.plan_cache = PlanCache(capacity=self.config["plan_cache_capacity"])
         self.config.on_change(
             "plan_cache_capacity",
@@ -392,6 +414,14 @@ class Database:
             plan_monitor=self.plan_monitor,
         )
         self._ddl_lock = threading.RLock()
+        # re-materialize restored mviews against the recovered base data
+        # (failures keep the registration: REFRESH can retry once the
+        # base objects are available again)
+        for _mname, _msql in list(self._mview_specs.items()):
+            try:
+                self._materialize_mview(_mname, _msql)
+            except Exception:
+                pass
 
     @property
     def tables(self):
@@ -465,6 +495,8 @@ class Database:
             "next_tablet_id": self.rootservice.next_tablet_id,
             "privileges": self.privileges.to_meta(),
             "vector_specs": dict(self._vector_specs),
+            "external_specs": dict(self._external_specs),
+            "mview_specs": dict(self._mview_specs),
         }
         from ..share.fsutil import atomic_write
 
@@ -701,6 +733,65 @@ class Database:
             self._unique_keys.pop(stmt.name, None)
             self._ti_by_tablet = None
             self.engine.executor.invalidate_table(stmt.name)
+            self._save_node_meta()
+
+    # -------------------------------------------------- materialized views
+    def create_mview(self, st: A.CreateMaterializedView) -> None:
+        """Full-refresh materialized view (src/storage/mview analog at
+        this engine's scale: definition text in meta like the reference's
+        schema-service mview definitions; REFRESH re-plans and
+        re-materializes against current data)."""
+        with self._ddl_lock:
+            if st.name in self.tables or st.name in self.catalog:
+                raise SqlError(f"table {st.name} already exists")
+            self._materialize_mview(st.name, st.query_sql)
+            self._mview_specs[st.name] = st.query_sql
+            self._save_node_meta()
+
+    def _materialize_mview(self, name: str, sql_text: str) -> None:
+        from ..sql import parser as P2
+
+        # base-table snapshots must be current before the defining query
+        # runs (the same refresh every SELECT path does)
+        self.refresh_catalog(
+            _tables_in_ast(P2.parse(sql_text)), tx=None)
+        self.catalog[name] = self.engine.materialize(sql_text, name)
+        self.engine.executor.invalidate_table(name)
+
+    def refresh_mview(self, name: str) -> None:
+        with self._ddl_lock:
+            sql_text = self._mview_specs.get(name)
+            if sql_text is None:
+                raise SqlError(f"no materialized view {name}")
+            self._materialize_mview(name, sql_text)
+
+    def drop_mview(self, name: str) -> None:
+        with self._ddl_lock:
+            if self._mview_specs.pop(name, None) is None:
+                raise SqlError(f"no materialized view {name}")
+            self.catalog.pop(name, None)
+            self.engine.executor.invalidate_table(name)
+            self._save_node_meta()
+
+    def create_external_table(self, st: A.CreateExternalTable) -> None:
+        """External table via the plugin loader registry (src/plugin's
+        ob_external_arrow_data_loader analog): the file materializes as
+        a columnar catalog Table readable by every query path; DML is
+        rejected (the table is not LSM-backed), matching the reference's
+        read-only external tables."""
+        from ..plugin import ExternalFormatError, load_external
+
+        with self._ddl_lock:
+            if st.name in self.tables or st.name in self.catalog:
+                raise SqlError(f"table {st.name} already exists")
+            try:
+                t = load_external(st.name, st.format, st.location)
+            except ExternalFormatError as e:
+                raise SqlError(str(e)) from None
+            except OSError as e:
+                raise SqlError(f"cannot read {st.location}: {e}") from None
+            self.catalog[st.name] = t
+            self._external_specs[st.name] = (st.format, st.location)
             self._save_node_meta()
 
     # ----------------------------------------------------------- indexes
@@ -1173,8 +1264,20 @@ class DbSession:
                 others = self._referenced_tables(stmt) - {target}
                 if others:
                     pm.check(self.user, "select", others)
-            elif isinstance(stmt, A.CreateTable):
+            elif isinstance(stmt, (A.CreateTable, A.CreateExternalTable)):
                 pm.check(self.user, "create", {stmt.name})
+            elif isinstance(stmt, A.CreateMaterializedView):
+                pm.check(self.user, "create", {stmt.name})
+                pm.check(self.user, "select", self._referenced_tables(
+                    P.parse(stmt.query_sql)))
+            elif isinstance(stmt, A.RefreshMaterializedView):
+                pm.check(self.user, "create", {stmt.name})
+                spec = self.db._mview_specs.get(stmt.name)
+                if spec is not None:
+                    pm.check(self.user, "select",
+                             self._referenced_tables(P.parse(spec)))
+            elif isinstance(stmt, A.DropMaterializedView):
+                pm.check(self.user, "drop", {stmt.name})
             elif isinstance(stmt, A.DropTable):
                 pm.check(self.user, "drop", {stmt.name})
             elif isinstance(stmt, (A.CreateIndex, A.DropIndex,
@@ -1228,6 +1331,18 @@ class DbSession:
             return ResultSet((), {})
         if isinstance(stmt, A.DropIndex):
             self.db.drop_index(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.CreateExternalTable):
+            self.db.create_external_table(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.CreateMaterializedView):
+            self.db.create_mview(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.DropMaterializedView):
+            self.db.drop_mview(stmt.name)
+            return ResultSet((), {})
+        if isinstance(stmt, A.RefreshMaterializedView):
+            self.db.refresh_mview(stmt.name)
             return ResultSet((), {})
         if isinstance(stmt, A.CreateVectorIndex):
             self.db.create_vector_index(stmt)

@@ -126,6 +126,124 @@ def _ls(db) -> Table:
     ])
 
 
+def _processlist(db) -> Table:
+    rows = sorted(db._active_stmts.items())
+    return _t("__all_virtual_processlist", [
+        ("session_id", DataType.int64(), [sid for sid, _ in rows]),
+        ("stmt_tag", DataType.varchar(),
+         [":".join(map(str, iid)) for _, iid in rows]),
+        ("tenant", DataType.varchar(), [db.tenant_name for _ in rows]),
+    ])
+
+
+def _tablets(db) -> Table:
+    rows = []
+    for name in sorted(db.tables):
+        ti = db.tables[name]
+        for ls_id, tablet_id in ti.all_partitions():
+            rows.append((tablet_id, name, ls_id))
+    return _t("__all_virtual_tablet", [
+        ("tablet_id", DataType.int64(), [r[0] for r in rows]),
+        ("table_name", DataType.varchar(), [r[1] for r in rows]),
+        ("ls_id", DataType.int64(), [r[2] for r in rows]),
+    ])
+
+
+def _users(db) -> Table:
+    pm = db.privileges
+    names = sorted(pm.users)
+    return _t("__all_virtual_user", [
+        ("user_name", DataType.varchar(), names),
+        ("grant_count", DataType.int64(),
+         [sum(len(p) for p in pm.grants.get(u, {}).values())
+          for u in names]),
+        ("is_root", DataType.int32(), [int(u == "root") for u in names]),
+    ])
+
+
+def _privileges(db) -> Table:
+    pm = db.privileges
+    rows = [
+        (u, obj, priv)
+        for u in sorted(pm.grants)
+        for obj in sorted(pm.grants[u])
+        for priv in sorted(pm.grants[u][obj])
+    ]
+    return _t("__all_virtual_privilege", [
+        ("user_name", DataType.varchar(), [r[0] for r in rows]),
+        ("object", DataType.varchar(), [r[1] for r in rows]),
+        ("privilege", DataType.varchar(), [r[2] for r in rows]),
+    ])
+
+
+def _deadlock_stat(db) -> Table:
+    lm = db.lock_mgr
+    waits = lm.waiting_snapshot()
+    return _t("__all_virtual_deadlock_stat", [
+        ("deadlocks_resolved", DataType.int64(), [lm.deadlocks]),
+        ("waiting_txs", DataType.int64(), [len(waits)]),
+        ("wait_edges", DataType.int64(),
+         [sum(len(v) for v in waits.values())]),
+    ])
+
+
+def _memory(db) -> Table:
+    names = sorted(db.tables)
+    sizes = []
+    for n in names:
+        t = db.catalog.get(n)
+        sizes.append(
+            sum(getattr(a, "nbytes", 0) for a in t.data.values())
+            if t is not None else 0
+        )
+    return _t("__all_virtual_memory", [
+        ("table_name", DataType.varchar(), names),
+        ("resident_bytes", DataType.int64(), sizes),
+    ])
+
+
+def _indexes(db) -> Table:
+    rows = []
+    for name in sorted(db.tables):
+        ti = db.tables[name]
+        idxs = getattr(ti, "indexes", None) or {}
+        if isinstance(idxs, dict):
+            idxs = idxs.values()
+        for ix in idxs:
+            rows.append((ix.name, name, ",".join(ix.cols),
+                         int(ix.unique)))
+    for tname, specs in sorted(db._vector_specs.items()):
+        for col, (lists, nprobe) in sorted(specs.items()):
+            rows.append((f"ivf:{col}", tname, col, 0))
+    return _t("__all_virtual_index", [
+        ("index_name", DataType.varchar(), [r[0] for r in rows]),
+        ("table_name", DataType.varchar(), [r[1] for r in rows]),
+        ("columns", DataType.varchar(), [r[2] for r in rows]),
+        ("is_unique", DataType.int32(), [r[3] for r in rows]),
+    ])
+
+
+def _external_tables(db) -> Table:
+    rows = sorted(db._external_specs.items())
+    return _t("__all_virtual_external_table", [
+        ("table_name", DataType.varchar(), [n for n, _ in rows]),
+        ("format", DataType.varchar(), [f for _, (f, _p) in rows]),
+        ("location", DataType.varchar(), [p for _, (_f, p) in rows]),
+    ])
+
+
+def _server_stat(db) -> Table:
+    n_repl = sum(len(g) for g in db.cluster.ls_groups.values())
+    return _t("__all_virtual_server_stat", [
+        ("tenant", DataType.varchar(), [db.tenant_name]),
+        ("nodes", DataType.int64(), [db.cluster.n_nodes]),
+        ("ls_groups", DataType.int64(), [len(db.cluster.ls_groups)]),
+        ("replicas", DataType.int64(), [n_repl]),
+        ("tables", DataType.int64(), [len(db.tables)]),
+        ("active_statements", DataType.int64(), [len(db._active_stmts)]),
+    ])
+
+
 PROVIDERS = {
     "__all_virtual_parameters": _parameters,
     "__all_virtual_table": _tables,
@@ -135,4 +253,13 @@ PROVIDERS = {
     "__all_virtual_ash": _ash,
     "__all_virtual_trace_span": _trace,
     "__all_virtual_ls": _ls,
+    "__all_virtual_processlist": _processlist,
+    "__all_virtual_tablet": _tablets,
+    "__all_virtual_user": _users,
+    "__all_virtual_privilege": _privileges,
+    "__all_virtual_deadlock_stat": _deadlock_stat,
+    "__all_virtual_memory": _memory,
+    "__all_virtual_index": _indexes,
+    "__all_virtual_external_table": _external_tables,
+    "__all_virtual_server_stat": _server_stat,
 }

@@ -328,6 +328,36 @@ class LockTable(Node):
 
 
 @dataclass(frozen=True)
+class CreateMaterializedView(Node):
+    """CREATE MATERIALIZED VIEW name AS <select text> — materialized at
+    creation; REFRESH re-runs the defining query (full refresh, the
+    mview core; reference: src/storage/mview)."""
+
+    name: str
+    query_sql: str
+
+
+@dataclass(frozen=True)
+class DropMaterializedView(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class RefreshMaterializedView(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class CreateExternalTable(Node):
+    """CREATE EXTERNAL TABLE name USING format LOCATION 'path' — schema
+    inferred from the file via the plugin loader registry."""
+
+    name: str
+    format: str
+    location: str
+
+
+@dataclass(frozen=True)
 class CreateVectorIndex(Node):
     """CREATE VECTOR INDEX name ON table (column) [WITH (lists=N,
     nprobe=M)] — IVF-flat ANN index (storage/vector_index.py)."""

@@ -45,6 +45,8 @@ KEYWORDS = {
     # grouping sets
     "rollup", "cube", "grouping", "sets",
     "recursive",
+    # materialized views
+    "refresh", "materialized", "view",
 }
 
 
@@ -149,6 +151,7 @@ class Parser:
             "kill": self._kill,
             "grant": self._grant,
             "revoke": self._revoke,
+            "refresh": self._refresh,
         }
         h = handlers.get(t.value) if t.kind == "kw" else None
         if h is None:
@@ -237,6 +240,32 @@ class Parser:
 
     def _create(self) -> "A.CreateTable | A.CreateIndex":
         self.expect("create")
+        if self.peek().value == "materialized":
+            self.next()
+            if self.next().value != "view":
+                raise SyntaxError("expected MATERIALIZED VIEW")
+            name = self.next().value
+            t = self.expect("as")
+            # the defining query is kept as TEXT (re-planned per refresh
+            # against the current schema, like the reference's mview
+            # definitions in the schema service); consume to EOF
+            self.i = len(self.toks) - 1
+            return A.CreateMaterializedView(
+                name, self.sql[t.pos + 2:].strip().rstrip(";")
+            )
+        if self.peek().value == "external":
+            self.next()
+            self.expect("table")
+            name = self.next().value
+            if self.next().value != "using":
+                raise SyntaxError("expected USING <format>")
+            fmt = self.next().value
+            if self.next().value != "location":
+                raise SyntaxError("expected LOCATION '<path>'")
+            t = self.next()
+            if t.kind != "str":
+                raise SyntaxError("LOCATION needs a quoted path")
+            return A.CreateExternalTable(name, fmt, t.value)
         if self.peek().value == "vector" and self.peek(1).value == "index":
             self.next()
             self.next()
@@ -343,8 +372,21 @@ class Parser:
             name, tuple(cols), pk, if_not_exists, part_col, n_parts
         )
 
+    def _refresh(self) -> "A.RefreshMaterializedView":
+        self.expect("refresh")
+        if self.next().value != "materialized":
+            raise SyntaxError("expected REFRESH MATERIALIZED VIEW")
+        if self.next().value != "view":
+            raise SyntaxError("expected REFRESH MATERIALIZED VIEW")
+        return A.RefreshMaterializedView(self.next().value)
+
     def _drop(self) -> "A.DropTable | A.DropIndex":
         self.expect("drop")
+        if self.peek().value == "materialized":
+            self.next()
+            if self.next().value != "view":
+                raise SyntaxError("expected MATERIALIZED VIEW")
+            return A.DropMaterializedView(self.next().value)
         if self.peek().value == "vector" and self.peek(1).value == "index":
             self.next()
             self.next()

@@ -246,7 +246,72 @@ class Planner:
         if isinstance(sel, A.SetSelect):
             return self._plan_setop(sel, outer)
         plan, r, out_items, visible = self._plan_block(sel, outer)
+        plan = self._simplify_outer_joins(plan)
         return PlannedQuery(plan, visible)
+
+    def _simplify_outer_joins(self, op, null_rejected: frozenset = frozenset()):
+        """Outer-join elimination (ob_transform_simplify's outer->inner
+        rule): a LEFT join under a NULL-REJECTING predicate on its right
+        side cannot produce surviving null-extended rows, so it is an
+        inner join — which unlocks the engine's merge/affine fast paths
+        and the right-deep rotation that left joins block.
+
+        `null_rejected` carries columns that some ancestor filter
+        rejects NULLs on (comparisons, BETWEEN, IN: all yield NULL/false
+        for NULL inputs, and compile_predicate drops those rows)."""
+        if isinstance(op, Filter):
+            nr = set(null_rejected)
+            for c in split_conjuncts(op.pred):
+                nr |= _null_rejecting_cols(c)
+            child = self._simplify_outer_joins(op.child, frozenset(nr))
+            return op if child is op.child else replace(op, child=child)
+        if isinstance(op, JoinOp):
+            kind = op.kind
+            if kind in ("left", "full"):
+                rej_r = any(n in null_rejected
+                            for n in output_schema(op.right).names())
+                rej_l = kind == "full" and any(
+                    n in null_rejected
+                    for n in output_schema(op.left).names())
+                if kind == "full":
+                    if rej_l and rej_r:
+                        kind = "inner"
+                    elif rej_r:
+                        kind = "left"
+                    # rej_l alone would be a RIGHT join (the resolver
+                    # mirrors those away; not representable here): keep
+                elif rej_r:
+                    kind = "inner"
+            # predicates keep rejecting through the preserved (probe)
+            # side; the null-extended sides reset
+            left = self._simplify_outer_joins(
+                op.left,
+                null_rejected if kind in ("inner", "left", "semi", "anti")
+                else frozenset(),
+            )
+            right = self._simplify_outer_joins(op.right, frozenset())
+            if kind == op.kind and left is op.left and right is op.right:
+                return op
+            return replace(op, kind=kind, left=left, right=right)
+        if isinstance(op, (Project, Sort, Distinct, Limit, TopN)):
+            # only Sort/Distinct are sound pass-throughs: a Limit/TopN
+            # below the filter SAMPLES rows, and converting a join under
+            # it changes which rows the sample draws from; Project
+            # renames would need mapping through
+            passes = isinstance(op, (Sort, Distinct))
+            child = self._simplify_outer_joins(
+                op.child, null_rejected if passes else frozenset())
+            return op if child is op.child else replace(op, child=child)
+        if hasattr(op, "child"):
+            child = self._simplify_outer_joins(op.child, frozenset())
+            return op if child is op.child else replace(op, child=child)
+        if isinstance(op, SetOp):
+            left = self._simplify_outer_joins(op.left, frozenset())
+            right = self._simplify_outer_joins(op.right, frozenset())
+            if left is op.left and right is op.right:
+                return op
+            return replace(op, left=left, right=right)
+        return op
 
     def _plan_setop(self, node: A.SetSelect, outer: Resolver | None) -> PlannedQuery:
         lq = self.plan(node.left, outer)
@@ -1058,6 +1123,25 @@ class Planner:
             )
             op = replace(j1, right=self._rotate_right_deep(inner))
         return op
+
+
+def _null_rejecting_cols(c: E.Expr) -> set[str]:
+    """Columns a conjunct provably rejects NULLs on: comparisons,
+    BETWEEN and IN yield NULL for NULL inputs (rows dropped by
+    compile_predicate); IS NULL / OR / NOT are NOT null-rejecting."""
+    if isinstance(c, E.Compare):
+        out = set()
+        for side in (c.left, c.right):
+            if isinstance(side, E.ColRef):
+                out.add(side.name)
+        return out
+    if isinstance(c, E.Between) and not c.negated:
+        return {c.arg.name} if isinstance(c.arg, E.ColRef) else set()
+    if isinstance(c, E.InList) and not c.negated:
+        return {c.arg.name} if isinstance(c.arg, E.ColRef) else set()
+    if isinstance(c, E.IsNull) and c.negated:  # IS NOT NULL
+        return {c.arg.name} if isinstance(c.arg, E.ColRef) else set()
+    return set()
 
 
 def _rename_cols(e: E.Expr, mapping: dict[str, str]) -> E.Expr:

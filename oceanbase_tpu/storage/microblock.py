@@ -29,8 +29,15 @@ import numpy as np
 from . import encoding as enc
 
 MAGIC = 0x0B5EB10C
+# compressed-wrapper frame: u32 magic | u32 raw_len | deflate payload —
+# the reference wraps ENCODED micro blocks in a general-purpose block
+# compressor (lz4/zstd/snappy, deps/oblib/src/lib/compress); this image
+# ships zlib, and the wrapper composes with (never replaces) the
+# lightweight per-column encodings, exactly like the reference
+MAGIC_COMPRESSED = 0x0B5EB10D
 VERSION = 1
 _HEADER = struct.Struct("<IHHII")
+_CHEADER = struct.Struct("<II")
 _COLDESC = struct.Struct("<BBBBqIIII")
 
 # dtype codes on the wire
@@ -57,7 +64,8 @@ class ColumnZone:
 
 
 def write_block(
-    columns: list[np.ndarray], valids: list[np.ndarray | None]
+    columns: list[np.ndarray], valids: list[np.ndarray | None],
+    compress: bool = True,
 ) -> tuple[bytes, list[ColumnZone]]:
     """Encode one micro block; returns (bytes, per-column zone maps)."""
     nrows = len(columns[0]) if columns else 0
@@ -113,7 +121,19 @@ def write_block(
     for s in streams:
         out += s
     out += struct.pack("<I", enc.crc32(bytes(out)))
-    return bytes(out), zones
+    raw = bytes(out)
+    if compress:
+        import zlib
+
+        packed = zlib.compress(raw, 1)
+        # only keep the wrapper when it actually saves space (already-
+        # tight encodings often don't deflate further)
+        if len(packed) + _CHEADER.size < int(len(raw) * 0.9):
+            return (
+                _CHEADER.pack(MAGIC_COMPRESSED, len(raw)) + packed,
+                zones,
+            )
+    return raw, zones
 
 
 @dataclass
@@ -129,6 +149,17 @@ class BlockReader:
     @staticmethod
     def open(buf: bytes | memoryview, verify: bool = True) -> "BlockReader":
         mv = memoryview(buf)
+        magic2, raw_len = _CHEADER.unpack_from(mv, 0)
+        if magic2 == MAGIC_COMPRESSED:
+            import zlib
+
+            try:
+                raw = zlib.decompress(bytes(mv[_CHEADER.size:]))
+            except zlib.error as e:  # corruption surfaces uniformly
+                raise ValueError(f"micro-block decompress failed: {e}")
+            if len(raw) != raw_len:
+                raise ValueError("micro-block decompressed length mismatch")
+            mv = memoryview(raw)
         magic, version, ncols, nrows, _ = _HEADER.unpack_from(mv, 0)
         if magic != MAGIC:
             raise ValueError(f"bad micro-block magic 0x{magic:08X}")

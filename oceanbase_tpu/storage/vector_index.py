@@ -47,6 +47,29 @@ class IvfIndex:
     max_list: int           # static per-list read window
 
 
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _kmeans_assign(xd, cd):
+    # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2; argmin drops ||x||^2
+    d2 = -2.0 * (xd @ cd.T) + jnp.sum(cd * cd, axis=1)[None, :]
+    return jnp.argmin(d2, axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _kmeans_update(xd, a_dev, L):
+    # segment means on device: one scatter-add per iteration beats a
+    # host np.add.at sweep by orders of magnitude at 1M x 128
+    sums = jax.ops.segment_sum(xd, a_dev, num_segments=L)
+    cnt = jax.ops.segment_sum(
+        jnp.ones(xd.shape[0], jnp.float32), a_dev, num_segments=L)
+    return sums, cnt
+
+
 def _auto_lists(n: int) -> int:
     L = 1
     while L * L < n:
@@ -59,8 +82,6 @@ def build_ivf(x: np.ndarray, lists: int = 0, iters: int = 10,
     """k-means build on device (jnp) — assignment distance matrices are
     matmuls, so a 1M x 128d build is sub-second on a v5e chip and still
     tractable on CPU test shapes."""
-    import jax.numpy as jnp
-
     x = np.asarray(x, dtype=np.float32)
     n, d = x.shape
     L = lists or _auto_lists(n)
@@ -68,35 +89,23 @@ def build_ivf(x: np.ndarray, lists: int = 0, iters: int = 10,
     rng = np.random.default_rng(seed)
     cent = x[rng.choice(n, size=L, replace=False)].copy()
 
-    import jax
-
+    # the data matrix rides as a jit ARGUMENT, never a closure capture: a
+    # captured array becomes a program constant and the remote-compile
+    # request would carry the whole 512MB (observed HTTP 413 at 1M x 128)
     xd = jnp.asarray(x)
 
-    def assign(c):
-        cd = jnp.asarray(c)
-        # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2; argmin drops ||x||^2
-        d2 = -2.0 * (xd @ cd.T) + jnp.sum(cd * cd, axis=1)[None, :]
-        return jnp.argmin(d2, axis=1)
-
-    @jax.jit
-    def update(a_dev):
-        # segment means on device: one scatter-add per iteration beats a
-        # host np.add.at sweep by orders of magnitude at 1M x 128
-        sums = jax.ops.segment_sum(xd, a_dev, num_segments=L)
-        cnt = jax.ops.segment_sum(
-            jnp.ones(xd.shape[0], jnp.float32), a_dev, num_segments=L)
-        return sums, cnt
-
-    a = np.asarray(assign(cent))
+    a = np.asarray(_kmeans_assign(xd, jnp.asarray(cent)))
     for _ in range(iters):
-        sums, cnt = (np.asarray(v) for v in update(jnp.asarray(a)))
+        sums, cnt = (
+            np.asarray(v) for v in _kmeans_update(xd, jnp.asarray(a), L)
+        )
         nonempty = cnt > 0
         cent[nonempty] = (
             sums[nonempty] / cnt[nonempty, None]).astype(np.float32)
         # re-seed empty clusters from random points
         for li in np.nonzero(~nonempty)[0]:
             cent[li] = x[rng.integers(0, n)]
-        a2 = np.asarray(assign(cent))
+        a2 = np.asarray(_kmeans_assign(xd, jnp.asarray(cent)))
         if np.array_equal(a2, a):
             a = a2
             break

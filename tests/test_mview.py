@@ -1,0 +1,127 @@
+"""Materialized views: full-refresh mviews defined by SELECT text
+(src/storage/mview analog — definition in meta, REFRESH re-plans and
+re-materializes in storage domain)."""
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+
+@pytest.fixture()
+def db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table sales (id int primary key, grp int, amt decimal(10,2))")
+    s.sql("insert into sales values (1, 1, 10.50), (2, 1, 4.50), (3, 2, 7.00)")
+    yield d
+    d.close()
+
+
+def test_create_query_refresh(db):
+    s = db.session()
+    s.sql("""
+        create materialized view mv_sales as
+        select grp, sum(amt) as total, count(*) as n
+        from sales group by grp order by grp
+    """)
+    rs = s.sql("select grp, total, n from mv_sales order by grp")
+    assert [(int(g), float(t), int(n)) for g, t, n in rs.rows()] == [
+        (1, 15.0, 2), (2, 7.0, 1)
+    ]
+    # stale until refreshed (snapshot semantics)
+    s.sql("insert into sales values (4, 2, 3.00)")
+    rs = s.sql("select sum(n) as rows_seen from mv_sales")
+    assert int(rs.columns["rows_seen"][0]) == 3
+    s.sql("refresh materialized view mv_sales")
+    rs = s.sql("select grp, total from mv_sales order by grp")
+    assert [(int(g), float(t)) for g, t in rs.rows()] == [
+        (1, 15.0), (2, 10.0)
+    ]
+
+
+def test_mview_joins_with_base(db):
+    s = db.session()
+    s.sql("""
+        create materialized view mv_g as
+        select grp, count(*) as n from sales group by grp
+    """)
+    rs = s.sql(
+        "select sum(s.amt) as t from sales as s, mv_g "
+        "where s.grp = mv_g.grp and mv_g.n > 1"
+    )
+    assert abs(float(rs.columns["t"][0]) - 15.0) < 1e-9
+
+
+def test_mview_dml_rejected_and_drop(db):
+    s = db.session()
+    s.sql("create materialized view m1 as select id from sales")
+    with pytest.raises(SqlError):
+        s.sql("insert into m1 values (99)")
+    s.sql("drop materialized view m1")
+    with pytest.raises(SqlError):
+        s.sql("refresh materialized view m1")
+
+
+def test_mview_preserves_nulls(db):
+    """NULLs survive materialization (review finding): the left join's
+    null-extended rows must stay NULL in the mview, not become 0."""
+    s = db.session()
+    s.sql("create table cust (ck int primary key)")
+    s.sql("insert into cust values (1), (2), (9)")
+    s.sql("""
+        create materialized view mv_n as
+        select c.ck as ck, o.amt as amt
+        from cust as c left join sales as o on c.ck = o.grp
+    """)
+    rs = s.sql("select ck, amt from mv_n where amt is null")
+    assert [int(r[0]) for r in rs.rows()] == [9]
+    rs2 = s.sql("select count(amt) as c, count(*) as n from mv_n")
+    # count(amt) skips NULLs; grp 1 has 2 sales rows, grp 2 has 1
+    assert int(rs2.columns["c"][0]) == 3
+    assert int(rs2.columns["n"][0]) == 4
+
+
+def test_mview_survives_restart(tmp_path):
+    data = str(tmp_path / "d")
+    db = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
+    s = db.session()
+    s.sql("create table t (a int primary key, b int)")
+    s.sql("insert into t values (1, 5), (2, 7)")
+    s.sql("create materialized view mv as select sum(b) as sb from t")
+    db.checkpoint()
+    db.close()
+    db2 = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
+    try:
+        rs = db2.session().sql("select sb from mv")
+        assert int(rs.columns["sb"][0]) == 12
+    finally:
+        db2.close()
+
+
+def test_refresh_requires_base_select(db):
+    """REFRESH re-reads the base tables, so it demands select on them —
+    revoking the base grant closes the refresh hole (review finding)."""
+    root = db.session()
+    root.sql("create user tia")
+    root.sql("grant create, select on mv_t to tia")
+    root.sql("grant select on sales to tia")
+    tia = db.session(user="tia")
+    tia.sql("create materialized view mv_t as select id from sales")
+    root.sql("revoke select on sales from tia")
+    with pytest.raises(SqlError) as e:
+        tia.sql("refresh materialized view mv_t")
+    assert e.value.code == 1142
+
+
+def test_mview_privileges(db):
+    root = db.session()
+    root.sql("create user ana")
+    root.sql("grant create, drop on mv_p to ana")
+    ana = db.session(user="ana")
+    with pytest.raises(SqlError) as e:  # no select on sales
+        ana.sql("create materialized view mv_p as select id from sales")
+    assert e.value.code == 1142
+    root.sql("grant select on sales to ana")
+    ana.sql("create materialized view mv_p as select id from sales")
+    root.sql("grant select on mv_p to ana")
+    assert ana.sql("select count(*) as n from mv_p").nrows == 1

@@ -42,6 +42,26 @@ def test_sql_audit_captures_errors(db):
     assert db.audit.records()[-1].error != ""
 
 
+def test_r4_virtual_tables_queryable(db):
+    """Round-4 widening: operator-surface tables (processlist, tablets,
+    users/privileges, deadlock, memory, indexes, external tables,
+    server stat) all answer through the SQL engine."""
+    s = db.session()
+    for vt in (
+        "__all_virtual_processlist", "__all_virtual_tablet",
+        "__all_virtual_user", "__all_virtual_privilege",
+        "__all_virtual_deadlock_stat", "__all_virtual_memory",
+        "__all_virtual_index", "__all_virtual_external_table",
+        "__all_virtual_server_stat",
+    ):
+        rs = s.sql(f"select count(*) as n from {vt}")
+        assert rs.nrows == 1, vt
+    rs = s.sql(
+        "select user_name from __all_virtual_user where is_root = 1"
+    )
+    assert [r[0] for r in rs.rows()] == ["root"]
+
+
 def test_audit_queryable_as_virtual_table(db):
     s = db.session()
     s.sql("select v from obs_t where k = 3")

@@ -100,6 +100,47 @@ def test_from_less_select(db):  # noqa: F811
     check(db, "select 1 as a, 2 * 3 as b", sort=False)
 
 
+# ------------------------------------------- outer-join simplification
+
+def test_outer_join_simplifies_under_null_rejecting_filter(db):  # noqa: F811
+    """WHERE o.o_totalprice > X null-rejects the LEFT join's right side:
+    the plan must convert to inner (and stay CORRECT vs sqlite)."""
+    from oceanbase_tpu.models.tpch.sql_suite import UNIQUE_KEYS
+    from oceanbase_tpu.sql.logical import JoinOp
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    tables, _sess, _conn = db
+    q = """
+    select c.c_custkey, o.o_totalprice
+    from customer as c left join orders as o on c.c_custkey = o.o_custkey
+    where o.o_totalprice > 1000
+    """
+    planned = Planner(tables, unique_keys=UNIQUE_KEYS).plan(parse(q))
+
+    def joins(op, out):
+        for a in ("child", "left", "right"):
+            c = getattr(op, a, None)
+            if c is not None:
+                joins(c, out)
+        if isinstance(op, JoinOp):
+            out.append(op)
+        return out
+
+    assert all(j.kind == "inner" for j in joins(planned.plan, []))
+    check(db, q)
+
+
+def test_outer_join_kept_without_null_rejection(db):  # noqa: F811
+    """No predicate on the right side: the LEFT join must SURVIVE and
+    produce null-extended rows (vs sqlite)."""
+    check(db, """
+    select c.c_custkey, o.o_orderkey
+    from customer as c left join orders as o on c.c_custkey = o.o_custkey
+    where c.c_custkey <= 50
+    """)
+
+
 # ------------------------------------------------------------------ rollup
 
 def _rollup_oracle(conn, table, keys, agg, where=""):

@@ -125,11 +125,37 @@ class TestMicroBlock:
         assert zones[0].vmin == cols[0].min() and zones[0].vmax == cols[0].max()
 
     def test_crc_detects_corruption(self, rng):
-        blob, _ = write_block([rng.integers(0, 10, 64).astype(np.int64)], [None])
-        bad = bytearray(blob)
-        bad[len(bad) // 2] ^= 0xFF
-        with pytest.raises(ValueError, match="crc"):
-            BlockReader.open(bytes(bad))
+        # a flipped byte must surface as ValueError on BOTH frames: the
+        # zlib wrapper (adler mismatch) and the raw block (crc trailer)
+        for compress in (True, False):
+            blob, _ = write_block(
+                [rng.integers(0, 10, 64).astype(np.int64)], [None],
+                compress=compress,
+            )
+            bad = bytearray(blob)
+            bad[len(bad) // 2] ^= 0xFF
+            with pytest.raises(ValueError, match="crc|decompress|magic"):
+                BlockReader.open(bytes(bad))
+
+    def test_compressed_roundtrip_smaller(self, rng):
+        """The zlib wrapper composes with the light encodings and only
+        engages when it actually shrinks the block."""
+        from oceanbase_tpu.storage.microblock import MAGIC_COMPRESSED
+        import struct as _s
+
+        # compressible payload: small-domain ints with long runs
+        a = np.repeat(rng.integers(0, 4, 64), 64).astype(np.int64)
+        txtish = (rng.integers(0, 3, 4096) * 7 + 100).astype(np.int64)
+        blob_c, _ = write_block([a, txtish], [None, None], compress=True)
+        blob_u, _ = write_block([a, txtish], [None, None], compress=False)
+        r = BlockReader.open(blob_c)
+        v, _valid = r.column(0)
+        assert np.array_equal(v, a)
+        v2, _ = r.column(1)
+        assert np.array_equal(v2, txtish)
+        if len(blob_c) < len(blob_u):
+            (m2, _rl) = _s.unpack_from("<II", blob_c, 0)
+            assert m2 == MAGIC_COMPRESSED
 
 
 def _make_sstable(rng, n=5000, block_rows=512):
