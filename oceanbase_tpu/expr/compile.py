@@ -165,7 +165,7 @@ def infer_type(e: Expr, schema: Schema) -> DataType:
             return DataType.float32()
         if e.name in ("extract_year", "extract_month", "extract_day"):
             return DataType.int32()
-        if e.name in ("like", "prefix", "contains"):
+        if e.name in ("like", "prefix", "contains", "fts_match"):
             return BOOL
         if e.name in ("abs", "neg"):
             return infer_type(e.args[0], schema)
@@ -672,6 +672,26 @@ def _eval_func(e: Func, batch: ColumnBatch):
         rx = _like_to_regex(str(pat.value))
         lut = np.fromiter(
             (rx.match(v) is not None for v in d.values()),
+            dtype=np.bool_,
+            count=len(d),
+        )
+        codes, valid = evaluate(col_expr, batch)
+        return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
+
+    if e.name == "fts_match":
+        # word-level full-text match against a dict-encoded column: the
+        # dictionary IS the index (reference: src/storage/fts tokenizes
+        # raw rows into an inverted index; here every distinct value
+        # tokenizes ONCE into a boolean LUT and rows match by code)
+        col_expr, q = e.args
+        assert isinstance(col_expr, ColRef) and isinstance(q, Literal)
+        d = batch.dicts[col_expr.name]
+        want = [t for t in str(q.value).lower().split() if t]
+        lut = np.fromiter(
+            (
+                all(t in v.lower().split() for t in want)
+                for v in d.values()
+            ),
             dtype=np.bool_,
             count=len(d),
         )

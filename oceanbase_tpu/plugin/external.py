@@ -72,10 +72,16 @@ def _arrow_to_table(name: str, at) -> Table:
                 if t.bit_width > 8
                 else DataType(TypeKind.INT8, nullable=nullable)
             )
-            data[col] = np.asarray(
-                arr.fill_null(0).to_numpy(zero_copy_only=False),
-                dtype=dt.storage_np,
-            )
+            raw = arr.fill_null(0).to_numpy(zero_copy_only=False)
+            if raw.dtype == np.uint64 and len(raw) and (
+                raw.max() > np.iinfo(np.int64).max
+            ):
+                # silent wraparound to negatives would corrupt results
+                raise ExternalFormatError(
+                    f"uint64 column {col} holds values beyond int64 "
+                    "(the engine has no unsigned 64-bit storage)"
+                )
+            data[col] = np.asarray(raw, dtype=dt.storage_np)
         elif pa.types.is_floating(t):
             dt = (
                 DataType.float32(nullable) if t.bit_width == 32
@@ -94,10 +100,10 @@ def _arrow_to_table(name: str, at) -> Table:
             )
         elif pa.types.is_decimal(t):
             dt = DataType.decimal(t.precision, t.scale, nullable)
-            scaled = arr.cast(pa.decimal128(38, t.scale)).fill_null(0)
+            # decimal.Decimal scaleb keeps exactness: value * 10^scale
             data[col] = np.asarray(
-                [int(v.scaled_value) if v is not None else 0
-                 for v in scaled],
+                [int(v.scaleb(t.scale)) if v is not None else 0
+                 for v in arr.fill_null(0).to_pylist()],
                 dtype=dt.storage_np,
             )
         elif pa.types.is_string(t) or pa.types.is_large_string(t):

@@ -296,6 +296,15 @@ class Database:
         self._mview_specs: dict[str, str] = (
             restored_meta.get("mview_specs", {}) if restored_meta else {}
         )
+        # stored procedures: name -> definition text (sql/pl.py); parsed
+        # lazily per process, persisted in node meta like schema
+        self._procedure_texts: dict[str, str] = (
+            restored_meta.get("procedures", {}) if restored_meta else {}
+        )
+        self._procedures_parsed: dict = {}
+        # XA: externally-coordinated txs parked between PREPARE and the
+        # commit/rollback decision (node-local; see DbSession._xa)
+        self._xa_prepared: dict[str, object] = {}
         # worker pool quota (ObTenant worker queues): bounds concurrent
         # statements of this tenant
         self._worker_sem = (
@@ -497,6 +506,7 @@ class Database:
             "vector_specs": dict(self._vector_specs),
             "external_specs": dict(self._external_specs),
             "mview_specs": dict(self._mview_specs),
+            "procedures": dict(self._procedure_texts),
         }
         from ..share.fsutil import atomic_write
 
@@ -744,7 +754,18 @@ class Database:
         with self._ddl_lock:
             if st.name in self.tables or st.name in self.catalog:
                 raise SqlError(f"table {st.name} already exists")
-            self._materialize_mview(st.name, st.query_sql)
+        # materialization (plan + XLA compile + run) happens OUTSIDE the
+        # DDL lock — it can take seconds and must not stall other DDL;
+        # the lock re-checks before the catalog swap
+        from ..sql import parser as P2
+
+        self.refresh_catalog(_tables_in_ast(P2.parse(st.query_sql)), tx=None)
+        t = self.engine.materialize(st.query_sql, st.name)
+        with self._ddl_lock:
+            if st.name in self.tables or st.name in self.catalog:
+                raise SqlError(f"table {st.name} already exists")
+            self.catalog[st.name] = t
+            self.engine.executor.invalidate_table(st.name)
             self._mview_specs[st.name] = st.query_sql
             self._save_node_meta()
 
@@ -761,9 +782,18 @@ class Database:
     def refresh_mview(self, name: str) -> None:
         with self._ddl_lock:
             sql_text = self._mview_specs.get(name)
-            if sql_text is None:
-                raise SqlError(f"no materialized view {name}")
-            self._materialize_mview(name, sql_text)
+        if sql_text is None:
+            raise SqlError(f"no materialized view {name}")
+        from ..sql import parser as P2
+
+        self.refresh_catalog(
+            _tables_in_ast(P2.parse(sql_text)), tx=None)
+        t = self.engine.materialize(sql_text, name)
+        with self._ddl_lock:
+            if name not in self._mview_specs:
+                return  # dropped concurrently: discard, don't resurrect
+            self.catalog[name] = t
+            self.engine.executor.invalidate_table(name)
 
     def drop_mview(self, name: str) -> None:
         with self._ddl_lock:
@@ -1313,13 +1343,29 @@ class DbSession:
         return ResultSet((), {})
 
     def _dispatch(self, text: str) -> ResultSet:
+        low = text.lstrip().lower()
+        if low.startswith("create procedure"):
+            self._last_stmt_type = "CreateProcedure"
+            return self._create_procedure(text)
+        if low.startswith("drop procedure"):
+            self._last_stmt_type = "DropProcedure"
+            return self._drop_procedure(text)
+        if low.startswith("call ") or low.startswith("call("):
+            self._last_stmt_type = "Call"
+            return self._call_procedure(text)
+        if low.startswith("xa "):
+            self._last_stmt_type = "Xa"
+            return self._xa(text)
         stmt = P.parse_statement(text)
         self._last_stmt_type = type(stmt).__name__
         self._check_privs(stmt)
+        return self._dispatch_stmt(stmt, P.normalize_for_cache(text)[0])
+
+    def _dispatch_stmt(self, stmt, norm_key: str) -> ResultSet:
         if isinstance(stmt, (A.CreateUser, A.DropUser, A.Grant, A.Revoke)):
             return self._dcl(stmt)
         if isinstance(stmt, (A.Select, A.SetSelect)):
-            return self._select(stmt, P.normalize_for_cache(text)[0])
+            return self._select(stmt, norm_key)
         if isinstance(stmt, A.CreateTable):
             self.db.create_table(stmt)
             return ResultSet((), {})
@@ -1383,6 +1429,174 @@ class DbSession:
         if isinstance(stmt, A.Delete):
             return self._dml(lambda tx: self._delete(stmt, tx))
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------ XA
+    def _xa(self, text: str) -> ResultSet:
+        """XA surface (src/storage/tx/ob_xa_ctx analog at this engine's
+        scale): START/END tag a session tx with an external xid, PREPARE
+        PARKS it in a node-wide registry (locks + staged rows held, the
+        session detaches), and COMMIT/ROLLBACK finish it from ANY
+        session — the external-coordinator contract. Parked state is
+        node-local and non-durable: a restart rolls in-flight XA back
+        (XA RECOVER reports what is actually recoverable, i.e. the
+        still-parked set)."""
+        import re as _re
+
+        m = _re.match(
+            r"\s*xa\s+(\w+)\s*(?:'([^']*)'|\"([^\"]*)\"|([^\s;]+))?",
+            text, _re.IGNORECASE,
+        )
+        if not m:
+            raise SqlError("bad XA syntax")
+        verb = m.group(1).lower()
+        if verb == "recover":
+            # owners see their branches; root sees everything
+            xids = sorted(
+                x for x, (_tx, owner) in self.db._xa_prepared.items()
+                if self.user == "root" or owner == self.user
+            )
+            return ResultSet(("xid",), {"xid": xids})
+        xid = next((g for g in m.groups()[1:] if g is not None), None)
+        if xid is None:
+            raise SqlError("XA needs an xid", code=1398)  # XAER_INVAL
+        if verb in ("start", "begin"):
+            if self._tx is not None:
+                raise SqlError("transaction already open", code=1399)
+            self._tx = _OpenTx(self.db)
+            self._xa_id = xid
+            return ResultSet((), {})
+        if verb == "end":
+            if self._tx is None or getattr(self, "_xa_id", None) != xid:
+                raise SqlError(f"unknown xid {xid!r}", code=1397)
+            return ResultSet((), {})  # idle marker; state kept implicit
+        if verb == "prepare":
+            if self._tx is None or getattr(self, "_xa_id", None) != xid:
+                raise SqlError(f"unknown xid {xid!r}", code=1397)
+            with self.db._ddl_lock:
+                if xid in self.db._xa_prepared:
+                    raise SqlError(f"xid {xid!r} already prepared",
+                                   code=1399)
+                self.db._xa_prepared[xid] = (self._tx, self.user)
+            self._tx = None
+            self._xa_id = None
+            return ResultSet((), {})
+        if verb in ("commit", "rollback"):
+            with self.db._ddl_lock:
+                hit = self.db._xa_prepared.get(xid)
+                if hit is not None:
+                    _tx, owner = hit
+                    # the decide step is guarded: only the preparing
+                    # user or root may finish a parked branch
+                    if self.user != "root" and owner != self.user:
+                        raise SqlError(
+                            f"xid {xid!r} belongs to {owner!r}",
+                            code=1227,
+                        )
+                    del self.db._xa_prepared[xid]
+            tx = hit[0] if hit is not None else None
+            if tx is None:
+                # one-phase: this session's own un-prepared xid
+                if self._tx is not None and \
+                        getattr(self, "_xa_id", None) == xid:
+                    tx = self._tx
+                    self._tx = None
+                    self._xa_id = None
+                else:
+                    raise SqlError(f"unknown xid {xid!r}", code=1397)
+            self._finish_tx(tx, commit=(verb == "commit"))
+            return ResultSet((), {})
+        raise SqlError(f"bad XA verb {verb!r}", code=1398)
+
+    # -------------------------------------------------- stored procedures
+    def _create_procedure(self, text: str) -> ResultSet:
+        from ..sql.pl import parse_procedure
+
+        if self.user != "root":
+            from ..share.privilege import AccessDenied
+
+            try:
+                self.db.privileges.check(self.user, "create", {"*"})
+            except AccessDenied as e:
+                raise SqlError(str(e), code=e.code) from None
+        try:
+            proc = parse_procedure(text)
+        except SyntaxError as e:
+            raise SqlError(f"PL syntax: {e}") from None
+        with self.db._ddl_lock:
+            if proc.name in self.db._procedure_texts:
+                raise SqlError(f"procedure {proc.name} already exists")
+            self.db._procedure_texts[proc.name] = text
+            self.db._procedures_parsed[proc.name] = proc
+            self.db._save_node_meta()
+        return ResultSet((), {})
+
+    def _drop_procedure(self, text: str) -> ResultSet:
+        if self.user != "root":
+            from ..share.privilege import AccessDenied
+
+            try:
+                self.db.privileges.check(self.user, "drop", {"*"})
+            except AccessDenied as e:
+                raise SqlError(str(e), code=e.code) from None
+        parts = text.split()
+        if len(parts) < 3:
+            raise SqlError("DROP PROCEDURE needs a name")
+        # the lexer lowercases identifiers at CREATE: match it
+        name = parts[2].rstrip(";").lower()
+        with self.db._ddl_lock:
+            if self.db._procedure_texts.pop(name, None) is None:
+                raise SqlError(f"no procedure {name}")
+            self.db._procedures_parsed.pop(name, None)
+            self.db._save_node_meta()
+        return ResultSet((), {})
+
+    def lookup_procedure(self, name: str):
+        proc = self.db._procedures_parsed.get(name)
+        if proc is None:
+            text = self.db._procedure_texts.get(name)
+            if text is None:
+                return None
+            from ..sql.pl import parse_procedure
+
+            proc = parse_procedure(text)
+            self.db._procedures_parsed[name] = proc
+        return proc
+
+    def run_statement(self, stmt, cache_key: str | None = None) -> ResultSet:
+        """Execute one already-parsed statement (PL interpreter's SQL
+        hook). Privileges enforce under the CALLING user (invoker
+        rights); `cache_key` must identify the STORED statement node
+        (not the per-call substituted copy) so plans stay cached across
+        invocations — literal substitutions parameterize away inside
+        the plan cache exactly like client literals."""
+        self._check_privs(stmt)
+        return self._dispatch_stmt(
+            stmt, cache_key or f"#pl:{id(stmt)}")
+
+    def _call_procedure(self, text: str) -> ResultSet:
+        from ..sql.pl import PlError, PlInterpreter, PlParser
+
+        p = PlParser(text.rstrip().rstrip(";") + ";")
+        try:
+            call = p._pl_statement()
+        except SyntaxError as e:
+            raise SqlError(f"bad CALL: {e}") from None
+        from ..sql.pl import PlCall
+
+        if not isinstance(call, PlCall):
+            raise SqlError("expected CALL name(args)")
+        proc = self.lookup_procedure(call.name)
+        if proc is None:
+            raise SqlError(f"no procedure {call.name}")
+        interp = PlInterpreter(self)
+        try:
+            args = [interp._eval(a, {}) for a in call.args]
+            ret, _env = interp.call(proc, args)
+        except PlError as e:
+            raise SqlError(f"PL: {e}") from None
+        if ret is None:
+            return ResultSet((), {})
+        return ResultSet(("result",), {"result": [ret]})
 
     # -------------------------------------------------------------- lock
     def _lock_table(self, st: A.LockTable) -> ResultSet:
@@ -1605,6 +1819,13 @@ class DbSession:
     def _end_tx(self, commit: bool) -> None:
         tx = self._tx
         self._tx = None
+        self._xa_id = None  # a finished tx sheds any XA association
+        self._finish_tx(tx, commit)
+
+    def _finish_tx(self, tx: "_OpenTx | None", commit: bool) -> None:
+        """Drive a transaction to its decision and clean up — shared by
+        COMMIT/ROLLBACK and the XA paths (where the tx may have been
+        PREPARED by a different session)."""
         if tx is None or tx.ctx is None:
             return
         touched = tx.touched_tables

@@ -408,6 +408,32 @@ class Resolver:
                 return self._agg_call(node)
             if node.name == "vec_l2":
                 return self._vec_l2_call(node, allow_agg)
+            if node.name == "fts_match":
+                # fts_match(varchar_col, 'tok tok ...') — word-level
+                # full-text match; evaluation sweeps the column's
+                # DICTIONARY (the engine's FTS 'index' is the dictionary
+                # itself: one LUT per distinct value, not per row)
+                from ..core.dtypes import TypeKind as _TK
+
+                if len(node.args) != 2:
+                    raise ResolveError("fts_match(column, 'tokens')")
+                col = self.expr(node.args[0], allow_agg)
+                ct = None
+                if isinstance(col, E.ColRef):
+                    for _alias, sc in self.scopes:
+                        try:
+                            ct = sc[col.name]
+                            break
+                        except Exception:
+                            continue
+                if ct is None or ct.kind is not _TK.VARCHAR:
+                    raise ResolveError(
+                        "fts_match first argument must be a VARCHAR column"
+                    )
+                q = self.expr(node.args[1], allow_agg)
+                if not isinstance(q, E.Literal):
+                    raise ResolveError("fts_match query must be a literal")
+                return E.Func("fts_match", (col, q))
             raise ResolveError(f"unknown function {node.name}")
         if isinstance(node, (A.ScalarSubquery, A.ExistsOp)):
             raise ResolveError("subquery handled by planner")

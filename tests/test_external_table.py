@@ -109,6 +109,32 @@ def test_external_survives_restart(tmp_path):
         db2.close()
 
 
+def test_decimal_and_uint64_columns(tmp_path):
+    import decimal
+
+    import pyarrow.parquet as pq
+
+    from oceanbase_tpu.plugin import ExternalFormatError, load_external
+
+    at = pa.table({
+        "price": pa.array(
+            [decimal.Decimal("12.34"), decimal.Decimal("0.05"), None],
+            pa.decimal128(10, 2)),
+        "n": pa.array([1, 2, 3], pa.uint32()),
+    })
+    p = tmp_path / "d.parquet"
+    pq.write_table(at, p)
+    t = load_external("d", "parquet", str(p))
+    assert [int(v) for v in t.data["price"]] == [1234, 5, 0]
+    assert not bool(t.valid["price"][2])
+    # uint64 beyond int64 must be a loud error, not a silent wrap
+    at2 = pa.table({"h": pa.array([2**63 + 5], pa.uint64())})
+    p2 = tmp_path / "u.parquet"
+    pq.write_table(at2, p2)
+    with pytest.raises(ExternalFormatError):
+        load_external("u", "parquet", str(p2))
+
+
 def test_custom_loader_registration():
     from oceanbase_tpu.core.dtypes import DataType, Field, Schema, TypeKind
     from oceanbase_tpu.plugin import load_external, register_loader

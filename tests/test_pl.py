@@ -1,0 +1,171 @@
+"""Stored procedures (sql/pl.py): control flow interpreted host-side,
+embedded SQL through the session dispatch + plan cache (src/pl +
+src/objit analog — the 'JIT' here is the XLA executable each inner
+statement compiles to)."""
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+
+@pytest.fixture()
+def db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table acct (id int primary key, bal int)")
+    s.sql("insert into acct values (1, 100), (2, 50)")
+    yield d
+    d.close()
+
+
+TRANSFER = """
+create procedure transfer (in src int, in dst int, in amt int)
+begin
+  declare sb int;
+  select bal into sb from acct where id = src;
+  if sb >= amt then
+    update acct set bal = bal - amt where id = src;
+    update acct set bal = bal + amt where id = dst;
+  end if;
+end
+"""
+
+
+def test_conditional_dml(db):
+    s = db.session()
+    s.sql(TRANSFER)
+    s.sql("call transfer(1, 2, 30)")
+    rs = s.sql("select id, bal from acct order by id")
+    assert [(int(a), int(b)) for a, b in rs.rows()] == [(1, 70), (2, 80)]
+    s.sql("call transfer(1, 2, 999)")  # guarded: no-op
+    rs = s.sql("select bal from acct where id = 1")
+    assert int(rs.columns["bal"][0]) == 70
+
+
+def test_while_loop_and_return(db):
+    s = db.session()
+    s.sql("""
+    create procedure fact (in n int)
+    begin
+      declare acc int default 1;
+      declare i int default 1;
+      while i <= n do
+        set acc = acc * i;
+        set i = i + 1;
+      end while;
+      return acc;
+    end
+    """)
+    rs = s.sql("call fact(6)")
+    assert rs.rows() == [(720,)]
+
+
+def test_nested_call_with_out_param(db):
+    s = db.session()
+    s.sql("""
+    create procedure get_bal (in aid int, out b int)
+    begin
+      select bal into b from acct where id = aid;
+    end
+    """)
+    s.sql("""
+    create procedure richer (in x int, in y int)
+    begin
+      declare bx int;
+      declare by int;
+      call get_bal(x, bx);
+      call get_bal(y, by);
+      if bx >= by then
+        return x;
+      end if;
+      return y;
+    end
+    """)
+    rs = s.sql("call richer(1, 2)")
+    assert rs.rows() == [(1,)]
+
+
+def test_loop_inserts_ride_plan_cache(db):
+    s = db.session()
+    s.sql("create table seqs (n int primary key)")
+    s.sql("""
+    create procedure fill (in k int)
+    begin
+      declare i int default 1;
+      while i <= k do
+        insert into seqs values (i);
+        set i = i + 1;
+      end while;
+    end
+    """)
+    s.sql("call fill(20)")
+    rs = s.sql("select count(*) as c, sum(n) as t from seqs")
+    assert int(rs.columns["c"][0]) == 20
+    assert int(rs.columns["t"][0]) == 210
+
+
+def test_procedures_survive_restart(tmp_path):
+    data = str(tmp_path / "d")
+    db = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
+    s = db.session()
+    s.sql("create table acct (id int primary key, bal int)")
+    s.sql("insert into acct values (1, 100), (2, 50)")
+    s.sql(TRANSFER)
+    db.checkpoint()
+    db.close()
+    db2 = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
+    try:
+        s2 = db2.session()
+        s2.sql("call transfer(1, 2, 10)")
+        rs = s2.sql("select bal from acct where id = 2")
+        assert int(rs.columns["bal"][0]) == 60
+    finally:
+        db2.close()
+
+
+def test_runaway_loop_guarded(db):
+    s = db.session()
+    s.sql("""
+    create procedure spin ()
+    begin
+      declare i int default 0;
+      while 1 = 1 do
+        set i = i + 1;
+      end while;
+    end
+    """)
+    with pytest.raises(SqlError, match="budget"):
+        s.sql("call spin()")
+
+
+def test_inner_sql_respects_privileges(db):
+    """Invoker rights: the caller's grants gate the embedded SQL."""
+    root = db.session()
+    root.sql(TRANSFER)
+    root.sql("create user pat")
+    root.sql("grant create on * to pat")
+    pat = db.session(user="pat")
+    with pytest.raises(SqlError) as e:
+        pat.sql("call transfer(1, 2, 5)")
+    assert e.value.code == 1142
+
+
+def test_drop_procedure(db):
+    s = db.session()
+    s.sql(TRANSFER)
+    s.sql("DROP PROCEDURE Transfer")  # names are case-insensitive
+    with pytest.raises(SqlError):
+        s.sql("call transfer(1, 2, 5)")
+    with pytest.raises(SqlError):
+        s.sql("drop procedure")  # missing name: clean error
+
+
+def test_drop_requires_privilege(db):
+    root = db.session()
+    root.sql(TRANSFER)
+    root.sql("create user sam")
+    sam = db.session(user="sam")
+    with pytest.raises(SqlError) as e:
+        sam.sql("drop procedure transfer")
+    assert e.value.code == 1142
+    assert root.lookup_procedure("transfer") is not None

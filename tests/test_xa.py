@@ -1,0 +1,138 @@
+"""XA externally-coordinated transactions (ob_xa_ctx analog): PREPARE
+parks the tx node-wide with locks and staged rows held; COMMIT/ROLLBACK
+finish it from any session."""
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+
+@pytest.fixture()
+def db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table t (a int primary key, b int)")
+    s.sql("insert into t values (1, 10)")
+    yield d
+    d.close()
+
+
+def test_prepare_commit_across_sessions(db):
+    s1 = db.session()
+    s1.sql("xa start 'x1'")
+    s1.sql("insert into t values (2, 20)")
+    s1.sql("xa end 'x1'")
+    s1.sql("xa prepare 'x1'")
+    # uncommitted: other sessions do not see the staged row
+    s2 = db.session()
+    assert int(s2.sql("select count(*) as n from t").columns["n"][0]) == 1
+    assert [r[0] for r in s2.sql("xa recover").rows()] == ["x1"]
+    # the DECIDING session is a different one
+    s2.sql("xa commit 'x1'")
+    assert int(s2.sql("select count(*) as n from t").columns["n"][0]) == 2
+    assert s2.sql("xa recover").nrows == 0
+
+
+def test_prepare_rollback(db):
+    s1 = db.session()
+    s1.sql("xa start 'r1'")
+    s1.sql("update t set b = 99 where a = 1")
+    s1.sql("xa prepare 'r1'")
+    db.session().sql("xa rollback 'r1'")
+    assert int(
+        db.session().sql("select b from t where a = 1").columns["b"][0]
+    ) == 10
+
+
+def test_one_phase_commit(db):
+    s = db.session()
+    s.sql("xa start 'p1'")
+    s.sql("insert into t values (5, 50)")
+    s.sql("xa commit 'p1'")  # never prepared: one-phase from the owner
+    assert int(
+        db.session().sql("select count(*) as n from t").columns["n"][0]
+    ) == 2
+
+
+def test_unknown_xid_and_double_prepare(db):
+    s = db.session()
+    with pytest.raises(SqlError) as e:
+        s.sql("xa commit 'ghost'")
+    assert e.value.code == 1397  # XAER_NOTA
+    s.sql("xa start 'd1'")
+    s.sql("insert into t values (7, 70)")
+    s.sql("xa prepare 'd1'")
+    s2 = db.session()
+    s2.sql("xa start 'd1'")  # same xid re-usable only while not prepared
+    with pytest.raises(SqlError):
+        s2.sql("xa prepare 'd1'")
+    s2.sql("rollback")
+    db.session().sql("xa rollback 'd1'")
+
+
+def test_plain_rollback_sheds_xa_tag(db):
+    """After ROLLBACK, the session's old xid must not tag a NEW plain
+    transaction (review finding)."""
+    s = db.session()
+    s.sql("xa start 'tag1'")
+    s.sql("insert into t values (8, 80)")
+    s.sql("rollback")
+    s.sql("begin")
+    s.sql("insert into t values (9, 90)")
+    with pytest.raises(SqlError) as e:
+        s.sql("xa prepare 'tag1'")  # stale xid must NOT park the new tx
+    assert e.value.code == 1397
+    s.sql("rollback")
+
+
+def test_xid_with_spaces(db):
+    s = db.session()
+    s.sql("xa start 'branch 1'")
+    s.sql("insert into t values (11, 1)")
+    s.sql("xa prepare 'branch 1'")
+    s2 = db.session()
+    s2.sql("xa start 'branch 2'")
+    s2.sql("insert into t values (12, 2)")
+    s2.sql("xa prepare 'branch 2'")  # distinct xid: must not collide
+    got = [r[0] for r in db.session().sql("xa recover").rows()]
+    assert got == ["branch 1", "branch 2"]
+    db.session().sql("xa commit 'branch 1'")
+    db.session().sql("xa rollback 'branch 2'")
+
+
+def test_decide_guarded_by_ownership(db):
+    root = db.session()
+    root.sql("create user eve")
+    root.sql("xa start 'own1'")
+    root.sql("insert into t values (13, 3)")
+    root.sql("xa prepare 'own1'")
+    eve = db.session(user="eve")
+    assert eve.sql("xa recover").nrows == 0  # not hers to see
+    with pytest.raises(SqlError) as e:
+        eve.sql("xa rollback 'own1'")
+    assert e.value.code in (1227, 1397)
+    root2 = db.session()
+    root2.sql("xa commit 'own1'")
+
+
+def test_prepared_locks_block_writers(db):
+    """The parked tx still holds its staged rows; a conflicting write
+    from another session must not corrupt them before the decision."""
+    s1 = db.session()
+    s1.sql("xa start 'l1'")
+    s1.sql("update t set b = 11 where a = 1")
+    s1.sql("xa prepare 'l1'")
+    s2 = db.session()
+    # first-committer-wins MVCC: the concurrent update either waits or
+    # errors, but after XA COMMIT the prepared write must be the base
+    try:
+        s2.sql("update t set b = 12 where a = 1")
+        conflicted = False
+    except Exception:  # WriteConflict / lock wait / SqlError all valid
+        conflicted = True
+    db.session().sql("xa commit 'l1'")
+    b = int(db.session().sql("select b from t where a = 1").columns["b"][0])
+    if conflicted:
+        assert b == 11
+    else:
+        assert b in (11, 12)
