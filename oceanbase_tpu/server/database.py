@@ -305,6 +305,16 @@ class Database:
         # XA: externally-coordinated txs parked between PREPARE and the
         # commit/rollback decision (node-local; see DbSession._xa)
         self._xa_prepared: dict[str, object] = {}
+        # sequences: name -> {"next": int, "inc": int, "reserved": int}.
+        # Durability via BLOCK RESERVATION (the reference's sequence
+        # cache): meta persists the end of the reserved block, so a
+        # crash skips at most one block and never repeats a value
+        self._sequences: dict[str, dict] = (
+            restored_meta.get("sequences", {}) if restored_meta else {}
+        )
+        for _sq in self._sequences.values():
+            _sq["next"] = _sq["reserved"]  # post-restart: start past block
+            _sq.pop("last", None)  # currval invalid until a nextval
         # worker pool quota (ObTenant worker queues): bounds concurrent
         # statements of this tenant
         self._worker_sem = (
@@ -507,6 +517,7 @@ class Database:
             "external_specs": dict(self._external_specs),
             "mview_specs": dict(self._mview_specs),
             "procedures": dict(self._procedure_texts),
+            "sequences": {k: dict(v) for k, v in self._sequences.items()},
         }
         from ..share.fsutil import atomic_write
 
@@ -744,6 +755,46 @@ class Database:
             self._ti_by_tablet = None
             self.engine.executor.invalidate_table(stmt.name)
             self._save_node_meta()
+
+    # ---------------------------------------------------------- sequences
+    SEQ_CACHE = 100  # values reserved per meta write
+
+    def create_sequence(self, name: str, start: int = 1,
+                        inc: int = 1) -> None:
+        with self._ddl_lock:
+            if name in self._sequences:
+                raise SqlError(f"sequence {name} already exists")
+            self._sequences[name] = {
+                "next": start, "inc": inc, "reserved": start,
+            }
+            self._save_node_meta()
+
+    def drop_sequence(self, name: str) -> None:
+        with self._ddl_lock:
+            if self._sequences.pop(name, None) is None:
+                raise SqlError(f"no sequence {name}")
+            self._save_node_meta()
+
+    def sequence_next(self, name: str) -> int:
+        with self._ddl_lock:
+            sq = self._sequences.get(name)
+            if sq is None:
+                raise SqlError(f"no sequence {name}")
+            v = sq["next"]
+            inc = sq["inc"]
+            sq["next"] = v + inc
+            sq["last"] = v  # in-process only: currval before any
+            # nextval (or right after restart) is an error, never a
+            # value that was skipped or never issued
+            past = (
+                sq["next"] > sq["reserved"] if inc > 0
+                else sq["next"] < sq["reserved"]
+            )
+            if past or v == sq["reserved"]:
+                # crossed into unreserved territory: reserve a new block
+                sq["reserved"] = sq["next"] + inc * self.SEQ_CACHE
+                self._save_node_meta()
+            return v
 
     # -------------------------------------------------- materialized views
     def create_mview(self, st: A.CreateMaterializedView) -> None:
@@ -1010,6 +1061,34 @@ class Database:
 
     def _leader_replica(self, ti: TableInfo):
         return self._leader_replica_ls(ti.ls_id)
+
+    def snapshot_table(self, name: str, snapshot: int) -> Table:
+        """FLASHBACK read: materialize `name` AS OF an older MVCC
+        snapshot (reference: ob_log_flashback_service / Oracle-mode
+        SELECT ... AS OF SNAPSHOT). Versions survive until major
+        compaction discards them — reads below the discarded snapshot
+        raise SnapshotDiscarded, the same undo-retention contract."""
+        ti = self.tables.get(name)
+        if ti is None:
+            raise SqlError(f"no such table {name}")
+        parts = []
+        for ls_id, tablet_id in ti.all_partitions():
+            rep = self._leader_replica_ls(ls_id)
+            parts.append(rep.tablets[tablet_id].scan(snapshot, tx_id=0))
+        if len(parts) == 1:
+            data = parts[0]
+        else:
+            data = {
+                c: np.concatenate([p[c] for p in parts])
+                for c in parts[0]
+            }
+        dicts = {}
+        for col in ti.dicts:
+            sd, remap = ti.sorted_dict(col)
+            if len(data[col]):
+                data[col] = remap[data[col]]
+            dicts[col] = sd
+        return Table(name, ti.schema, data, dicts)
 
     def refresh_catalog(self, names, tx=None) -> None:
         """Bring catalog snapshot Tables of the given tables up to date.
@@ -1356,10 +1435,111 @@ class DbSession:
         if low.startswith("xa "):
             self._last_stmt_type = "Xa"
             return self._xa(text)
+        if low.startswith("create sequence") or low.startswith("drop sequence"):
+            self._last_stmt_type = "Sequence"
+            return self._sequence_ddl(text)
         stmt = P.parse_statement(text)
         self._last_stmt_type = type(stmt).__name__
+        # privileges first: a DENIED statement must not burn sequence
+        # values or write node meta
         self._check_privs(stmt)
+        stmt = self._bind_sequences(stmt)
         return self._dispatch_stmt(stmt, P.normalize_for_cache(text)[0])
+
+    def _sequence_ddl(self, text: str) -> ResultSet:
+        from ..share.privilege import AccessDenied
+
+        if self.user != "root":
+            try:
+                self.db.privileges.check(self.user, "create", {"*"})
+            except AccessDenied as e:
+                raise SqlError(str(e), code=e.code) from None
+        toks = text.replace(";", " ").split()
+        if len(toks) < 3:
+            raise SqlError("sequence DDL needs a name")
+        name = toks[2].lower()
+        if toks[0].lower() == "drop":
+            self.db.drop_sequence(name)
+            return ResultSet((), {})
+        start, inc = 1, 1
+        low = [t.lower() for t in toks]
+
+        def clause_value(kw, filler):
+            # scan AFTER the name token so a sequence named 'start'
+            # cannot shadow its own clause; malformed values surface as
+            # SqlError, not IndexError
+            try:
+                i = low.index(kw, 3)
+            except ValueError:
+                return None
+            j = i + 2 if i + 1 < len(low) and low[i + 1] == filler else i + 1
+            if j >= len(toks):
+                raise SqlError(f"{kw.upper()} needs a value")
+            try:
+                return int(toks[j])
+            except ValueError:
+                raise SqlError(
+                    f"bad {kw.upper()} value {toks[j]!r}") from None
+
+        v = clause_value("start", "with")
+        if v is not None:
+            start = v
+        v = clause_value("increment", "by")
+        if v is not None:
+            inc = v
+        if inc == 0:
+            raise SqlError("INCREMENT BY must be nonzero")
+        self.db.create_sequence(name, start, inc)
+        return ResultSet((), {})
+
+    def _bind_sequences(self, stmt):
+        """Replace nextval('s')/currval('s') calls with literal values
+        BEFORE resolution (side-effecting functions cannot live in a
+        traced program; each textual occurrence draws once per
+        statement, the reference's per-statement sequence semantics)."""
+        import dataclasses
+
+        if not self.db._sequences:
+            return stmt
+
+        def rw(node):
+            if isinstance(node, A.FuncCall) and node.name in (
+                "nextval", "currval"
+            ):
+                if len(node.args) != 1 or not isinstance(
+                    node.args[0], A.StringLit
+                ):
+                    raise SqlError(f"{node.name}('sequence_name')")
+                sname = node.args[0].value.lower()
+                if node.name == "nextval":
+                    v = self.db.sequence_next(sname)
+                else:
+                    sq = self.db._sequences.get(sname)
+                    if sq is None:
+                        raise SqlError(f"no sequence {sname}")
+                    if "last" not in sq:
+                        raise SqlError(
+                            f"currval of {sname} before nextval in this "
+                            "server lifetime"
+                        )
+                    v = sq["last"]
+                return A.NumberLit(str(v))
+            if dataclasses.is_dataclass(node) and not isinstance(node, type):
+                ch = {}
+                for f in dataclasses.fields(node):
+                    cur = getattr(node, f.name)
+                    new = rw(cur)
+                    if new is not cur:
+                        ch[f.name] = new
+                return dataclasses.replace(node, **ch) if ch else node
+            if isinstance(node, tuple):
+                items = tuple(rw(x) for x in node)
+                if any(a is not b for a, b in zip(items, node)):
+                    return items
+                return node
+            return node
+
+        return rw(stmt)
 
     def _dispatch_stmt(self, stmt, norm_key: str) -> ResultSet:
         if isinstance(stmt, (A.CreateUser, A.DropUser, A.Grant, A.Revoke)):
@@ -1598,6 +1778,58 @@ class DbSession:
             return ResultSet((), {})
         return ResultSet(("result",), {"result": [ret]})
 
+    def _select_flashback(self, ast, fb) -> ResultSet:
+        """FLASHBACK query: every `t AS OF SNAPSHOT s` reference reads a
+        statement-scoped materialization of the OLDER MVCC snapshot;
+        plain references in the same statement read current data (so
+        `t` can join `t AS OF SNAPSHOT s` to diff history). Plans do
+        not cache: the snapshot tables are per-statement."""
+        import dataclasses as _dc
+
+        tmp_names = []
+        # session-scoped keys: two sessions flashing back to the SAME
+        # (table, snapshot) must not share one catalog entry — the first
+        # finisher would pop it under the other statement
+        sid = self.session_id
+        try:
+            for name, snap in fb:
+                tmp = f"#fb:{name}@{snap}#{sid}"
+                self.db.catalog[tmp] = self.db.snapshot_table(name, snap)
+                self.db.engine.executor.invalidate_table(tmp)
+                tmp_names.append(tmp)
+
+            def rw(node):
+                if isinstance(node, A.TableRef) and node.snapshot is not None:
+                    return A.TableRef(
+                        f"#fb:{node.name}@{node.snapshot}#{sid}",
+                        node.alias or node.name,
+                    )
+                if _dc.is_dataclass(node) and not isinstance(node, type):
+                    ch = {}
+                    for f in _dc.fields(node):
+                        cur = getattr(node, f.name)
+                        new = rw(cur)
+                        if new is not cur:
+                            ch[f.name] = new
+                    return _dc.replace(node, **ch) if ch else node
+                if isinstance(node, tuple):
+                    items = tuple(rw(x) for x in node)
+                    if any(a is not b for a, b in zip(items, node)):
+                        return items
+                    return node
+                return node
+
+            ast2 = rw(ast)
+            plain = _tables_in_ast(ast2) - set(tmp_names)
+            self.db.refresh_virtual(plain)
+            self.db.refresh_catalog(plain, tx=self._tx)
+            rs = self.db.engine.run_ast(ast2, "#flashback", use_cache=False)
+            return rs
+        finally:
+            for tmp in tmp_names:
+                self.db.catalog.pop(tmp, None)
+                self.db.engine.executor.invalidate_table(tmp)
+
     # -------------------------------------------------------------- lock
     def _lock_table(self, st: A.LockTable) -> ResultSet:
         from ..tx.tablelock import DeadlockDetected, LockMode
@@ -1764,6 +1996,9 @@ class DbSession:
         return {tref.name: Table(tref.name, ti.schema, data, dicts)}
 
     def _select(self, ast: A.Select, norm_key: str) -> ResultSet:
+        fb = _flashback_refs(ast)
+        if fb:
+            return self._select_flashback(ast, fb)
         names = _tables_in_ast(ast)
         any_vt = self.db.refresh_virtual(names)
         route = None
@@ -2208,6 +2443,24 @@ def _coerce(v, dt: DataType, d: Dictionary | None, col: str):
 
         return tuple(float(x) for x in bind_value(v, dt))
     raise SqlError(f"unsupported column type {dt} for DML")
+
+
+def _flashback_refs(node, out=None) -> list:
+    """(name, snapshot) pairs of AS OF SNAPSHOT references in the AST."""
+    import dataclasses
+
+    if out is None:
+        out = []
+    if isinstance(node, A.TableRef) and node.snapshot is not None:
+        if (node.name, node.snapshot) not in out:
+            out.append((node.name, node.snapshot))
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        for f in dataclasses.fields(node):
+            _flashback_refs(getattr(node, f.name), out)
+    elif isinstance(node, (tuple, list)):
+        for x in node:
+            _flashback_refs(x, out)
+    return out
 
 
 def _tables_in_ast(node) -> set[str]:

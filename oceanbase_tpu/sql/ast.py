@@ -165,6 +165,8 @@ class CastOp(Node):
 class TableRef(Node):
     name: str
     alias: str | None = None
+    # FLASHBACK read: AS OF SNAPSHOT <ts> (None = current snapshot)
+    snapshot: int | None = None
 
 
 @dataclass(frozen=True)

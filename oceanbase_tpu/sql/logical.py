@@ -246,7 +246,7 @@ def promote_types(l: DataType, r: DataType) -> DataType:
 
 # ---- resolver -------------------------------------------------------------
 
-_AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+_AGG_FUNCS = {"sum", "count", "min", "max", "avg", "approx_count_distinct"}
 
 
 class ResolveError(Exception):
@@ -543,6 +543,19 @@ class Resolver:
 
     def _agg_call(self, node: A.FuncCall) -> E.Expr:
         fn = node.name
+        if fn == "approx_count_distinct":
+            # the engine's scatter-free distinct-count is exact at full
+            # speed (first-occurrence masks, ops/hashagg.py), and exact
+            # trivially satisfies the approximate contract — so the
+            # reference's NDV sketch (ob_expr_approx_count_distinct)
+            # maps to COUNT(DISTINCT) rather than a lossy HLL
+            if len(node.args) != 1:
+                raise ResolveError(
+                    "approx_count_distinct takes exactly one argument "
+                    "(multi-column NDV is not supported)"
+                )
+            arg = self.expr(node.args[0])
+            return E.ColRef(self._add_agg("count", arg, True))
         if fn == "count" and (not node.args or isinstance(node.args[0], A.Star)):
             arg = None
         else:
@@ -698,6 +711,14 @@ def _parse_type(tn: str) -> DataType:
     tn = tn.lower()
     if tn.endswith("?"):  # DataType.__str__ nullable marker round-trip
         return _parse_type(tn[:-1]).with_nullable(True)
+    if tn in ("text", "mediumtext", "longtext", "blob", "clob"):
+        # LOB surface: dict-encoded varchar holds unbounded values (the
+        # dictionary stores the full string ONCE; rows are int32 codes),
+        # so TEXT/BLOB map onto the same storage. The reference's
+        # out-of-row LOB store (src/storage/lob) exists because its rows
+        # are materialized; columnar dict codes make that machinery moot
+        # at this engine's scale.
+        return DataType.varchar()
     if tn.startswith("vector"):
         if "(" not in tn:
             raise ResolveError("VECTOR needs a dimension: vector(d)")

@@ -747,11 +747,23 @@ class Parser:
         if name.kind not in ("name", "kw"):
             raise SyntaxError(f"expected table name, got {name.value!r}")
         alias = None
+        snapshot = None
         if self.accept("as"):
-            alias = self.next().value
+            if self.peek().value == "of":
+                # FLASHBACK: t AS OF SNAPSHOT <ts> [alias]
+                self.next()
+                if self.next().value != "snapshot":
+                    raise SyntaxError("expected AS OF SNAPSHOT <ts>")
+                snapshot = int(self.next().value)
+                if self.accept("as"):
+                    alias = self.next().value
+                elif self.peek().kind == "name":
+                    alias = self.next().value
+            else:
+                alias = self.next().value
         elif self.peek().kind == "name":
             alias = self.next().value
-        return A.TableRef(name.value, alias)
+        return A.TableRef(name.value, alias, snapshot)
 
     # -- expressions ----------------------------------------------------
     def expr(self) -> A.Node:

@@ -50,6 +50,25 @@ def test_case_insensitive_and_word_level(sess):
     assert rs.nrows == 0
 
 
+def test_text_lob_columns_roundtrip():
+    """TEXT/BLOB map onto dict-encoded varchar: unbounded values store
+    once in the dictionary and round-trip through DML + fts_match."""
+    from oceanbase_tpu.server.database import Database
+
+    db = Database(n_nodes=1, n_ls=1)
+    try:
+        s = db.session()
+        s.sql("create table notes (id int primary key, body text)")
+        long = "x" * 10000 + " end"
+        s.sql(f"insert into notes values (1, '{long}'), (2, 'short note')")
+        rs = s.sql("select id from notes where fts_match(body, 'end')")
+        assert [int(r[0]) for r in rs.rows()] == [1]
+        assert s.sql(
+            "select body from notes where id = 1").rows()[0][0] == long
+    finally:
+        db.close()
+
+
 def test_composes_with_predicates_and_aggs(sess):
     rs = sess.sql(
         "select count(*) as n from doc "

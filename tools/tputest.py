@@ -64,13 +64,20 @@ def main():
             cwd=REPO, env=env, capture_output=True, text=True,
             timeout=max(budget - (time.monotonic() - t0), 60),
         )
-        tail = (proc.stdout or "").strip().splitlines()[-1:]
-        results.append({
+        out_lines = (proc.stdout or "").strip().splitlines()
+        tail = out_lines[-1:]
+        failures = [
+            ln.strip() for ln in out_lines if ln.startswith("FAILED")
+        ][:20]
+        rec = {
             "suite": name,
             "rc": proc.returncode,
             "secs": round(time.monotonic() - t1, 1),
             "tail": tail[0] if tail else "",
-        })
+        }
+        if failures:
+            rec["failures"] = failures
+        results.append(rec)
         # write incrementally so a timeout keeps partial evidence
         write_artifact()
         print(json.dumps(results[-1]), flush=True)
