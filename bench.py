@@ -430,17 +430,23 @@ def main():
             assert entry is not None, "plan cache miss on timed re-fetch"
             prepared = entry.prepared
             prepared.run(qparams=qp)  # warm
-            # amortized dispatch: K back-to-back executions, one sync
-            K = 8
-
-            def _run_k(p=prepared, q=qp):
+            # amortized dispatch: K back-to-back executions, one sync.
+            # The tunnel's per-dispatch overhead amortizes DEEP (q6:
+            # 117ms at K=1, 17.5 at K=8, 5.0 at K=64), so short
+            # programs re-measure at K=64
+            def _run_k(K, p=prepared, q=qp):
                 out = None
                 for _ in range(K):
                     out = p.run_nocheck(qparams=q)
                 return int(out.nrows)
 
-            t, _ = _best(_run_k, reps)
+            K = 8
+            t, _ = _best(lambda: _run_k(K), reps)
+            if t / K < 0.03:
+                K = 64
+                t, _ = _best(lambda: _run_k(K), max(2, reps // 2))
             tpu_t[qname] = t / K
+            detail[f"{qname}_dispatch_k"] = K
             qd = {
                 "tpu_s": round(tpu_t[qname], 6),
                 "cpu_s": round(cpu_t[qname], 6),

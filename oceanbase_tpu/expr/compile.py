@@ -161,7 +161,7 @@ def infer_type(e: Expr, schema: Schema) -> DataType:
                 t = common_numeric_type(t, bt)
         return t
     if isinstance(e, Func):
-        if e.name == "vec_l2":
+        if e.name in ("vec_l2", "vec_ip", "vec_cosine"):
             return DataType.float32()
         if e.name in ("extract_year", "extract_month", "extract_day"):
             return DataType.int32()
@@ -708,15 +708,22 @@ def _eval_func(e: Func, batch: ColumnBatch):
         codes, valid = evaluate(col_expr, batch)
         return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
 
-    if e.name == "vec_l2":
-        # squared L2 distance of a VECTOR column to a query vector, in
-        # matmul form (||x||^2 - 2 x.q + ||q||^2): the n*d work lands on
-        # the MXU instead of a VPU subtract-square sweep. Used by both
-        # the brute-force exact path (ORDER BY vec_l2 ... LIMIT k = plain
-        # TopN) and the IVF candidate re-ranking.
+    if e.name in ("vec_l2", "vec_ip", "vec_cosine"):
+        # vector distances in matmul form (the n*d work lands on the MXU
+        # instead of a VPU sweep): squared L2 = ||x||^2 - 2 x.q + ||q||^2;
+        # vec_ip = NEGATIVE inner product and vec_cosine = 1 - cosine
+        # similarity, both oriented so ORDER BY <dist> ASC LIMIT k means
+        # "nearest" for every metric. Used by the brute-force exact path
+        # (plain TopN) and IVF candidate re-ranking.
         xv, valid = evaluate(e.args[0], batch)
         q = evaluate_vector_literal(e.args[1])
         xq = xv @ q
+        if e.name == "vec_ip":
+            return -xq, valid
+        if e.name == "vec_cosine":
+            xn = jnp.sqrt(jnp.sum(xv * xv, axis=1))
+            qn = jnp.sqrt(jnp.sum(q * q))
+            return 1.0 - xq / jnp.maximum(xn * qn, 1e-30), valid
         xn = jnp.sum(xv * xv, axis=1)
         return xn - 2.0 * xq + jnp.sum(q * q), valid
     if e.name == "abs":

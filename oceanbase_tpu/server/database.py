@@ -1438,6 +1438,9 @@ class DbSession:
         if low.startswith("create sequence") or low.startswith("drop sequence"):
             self._last_stmt_type = "Sequence"
             return self._sequence_ddl(text)
+        if low.split(None, 1)[:1] == ["explain"]:
+            self._last_stmt_type = "Explain"
+            return self._explain(text.lstrip()[len("explain"):].lstrip())
         stmt = P.parse_statement(text)
         self._last_stmt_type = type(stmt).__name__
         # privileges first: a DENIED statement must not burn sequence
@@ -1609,6 +1612,53 @@ class DbSession:
         if isinstance(stmt, A.Delete):
             return self._dml(lambda tx: self._delete(stmt, tx))
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------- explain
+    def _explain(self, text: str) -> ResultSet:
+        """EXPLAIN <select>: the routed plan with physical annotations
+        (never compiles — all host-side planning state). Privileges
+        apply exactly like the SELECT itself (a plan leaks table/column
+        names and estimates); inside an open tx the plan reflects the
+        tx's OWN view of the data, like the statement would."""
+        from ..sql.explain import explain_plan
+
+        ast = P.parse(text)
+        self._check_privs(ast)
+        names = _tables_in_ast(ast)
+        any_vt = self.db.refresh_virtual(names)
+        self.db.refresh_catalog(names, tx=self._tx)
+        in_tx = self._tx is not None and self._tx.ctx is not None
+        views = self._tx.views if in_tx else None
+        engine = self.db.engine
+        try:
+            with self.db.catalog.tx_scope(views):
+                planned = engine.planner.plan(ast)
+                ex = engine.executor
+                plan = ex._route_projections(planned.plan)
+                params = ex.seed_params(plan)
+                # host-only detection passes (same as compile())
+                from ..engine.executor import _number_nodes
+                from ..sql.logical import Aggregate as _Agg, TopN as _TopN
+
+                for nid, op in _number_nodes(plan).items():
+                    if isinstance(op, _Agg) and ex.clustered_agg_enabled:
+                        spec = ex._clustered_agg_spec(op)
+                        if spec is not None:
+                            params.clustered_aggs[nid] = spec
+                    if isinstance(op, _TopN) and ex.clustered_agg_enabled:
+                        vspec = ex._vector_topn_spec(op)
+                        if vspec is not None:
+                            params.vector_topns[nid] = vspec
+                lines = explain_plan(ex, plan, params)
+        finally:
+            if any_vt:
+                from .virtual_tables import PROVIDERS
+
+                for n in names:
+                    if n in PROVIDERS:
+                        self.db.catalog.pop(n, None)
+                        self.db.engine.executor.invalidate_table(n)
+        return ResultSet(("plan",), {"plan": lines})
 
     # ------------------------------------------------------------------ XA
     def _xa(self, text: str) -> ResultSet:

@@ -406,7 +406,7 @@ class Resolver:
                 if not allow_agg:
                     raise ResolveError(f"aggregate {node.name} not allowed here")
                 return self._agg_call(node)
-            if node.name == "vec_l2":
+            if node.name in ("vec_l2", "vec_ip", "vec_cosine"):
                 return self._vec_l2_call(node, allow_agg)
             if node.name == "fts_match":
                 # fts_match(varchar_col, 'tok tok ...') — word-level
@@ -520,7 +520,7 @@ class Resolver:
         every query vector (reference: obvec distance exprs over the
         vector index, src/storage/vector_index)."""
         if len(node.args) != 2:
-            raise ResolveError("vec_l2(column, query_vector) takes 2 args")
+            raise ResolveError(f"{node.name}(column, query_vector) takes 2 args")
         from ..core.dtypes import TypeKind
 
         col = self.expr(node.args[0], allow_agg)
@@ -533,11 +533,13 @@ class Resolver:
                 except Exception:
                     continue
         if ct is None or ct.kind is not TypeKind.VECTOR:
-            raise ResolveError("vec_l2 first argument must be a VECTOR column")
+            raise ResolveError(
+                f"{node.name} first argument must be a VECTOR column")
         q = self.expr(node.args[1], allow_agg)
         if not isinstance(q, E.Literal):
-            raise ResolveError("vec_l2 second argument must be a literal")
-        return E.Func("vec_l2", (col, E.Literal(
+            raise ResolveError(
+                f"{node.name} second argument must be a literal")
+        return E.Func(node.name, (col, E.Literal(
             q.value, DataType(TypeKind.VECTOR, precision=ct.precision)
         )))
 

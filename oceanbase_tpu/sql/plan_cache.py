@@ -123,7 +123,7 @@ class _Paramizer:
                 e.negated,
             )
         if isinstance(e, E.Func):
-            if e.name == "vec_l2":
+            if e.name in ("vec_l2", "vec_ip", "vec_cosine"):
                 # the QUERY VECTOR parameterizes (one executable per
                 # column serves every query point — the ANN qps story);
                 # the column ref stays structural

@@ -247,6 +247,38 @@ def test_rollup_survives_cte_wrapper(db):  # noqa: F811
     assert any(r[0] is None for r in got), "grand-total row missing"
 
 
+def test_rollup_under_px(db):  # noqa: F811
+    """Grouping sets distribute: the PX executor's per-set expansion
+    must agree with single-chip bit for bit."""
+    import pytest as _pt
+
+    from oceanbase_tpu.core.column import batch_rows_normalized
+    from oceanbase_tpu.engine.executor import Executor
+    from oceanbase_tpu.models.tpch.sql_suite import UNIQUE_KEYS
+    from oceanbase_tpu.parallel.mesh import make_mesh
+    from oceanbase_tpu.parallel.px import PxExecutor
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        _pt.skip("needs the 8-device virtual mesh")
+    tables, _sess, _conn = db
+    planner = Planner(tables)
+    single = Executor(tables, unique_keys=UNIQUE_KEYS)
+    px = PxExecutor(tables, make_mesh(8), unique_keys=UNIQUE_KEYS)
+    q = """select l_returnflag, l_linestatus, sum(l_quantity) as s,
+           count(*) as n from lineitem
+           group by rollup(l_returnflag, l_linestatus)"""
+    planned = planner.plan(parse(q))
+    want = sorted(batch_rows_normalized(
+        single.execute(planned.plan), planned.output_names), key=repr)
+    got = sorted(batch_rows_normalized(
+        px.execute(planned.plan), planned.output_names), key=repr)
+    assert got == want and len(got) > 0
+
+
 def test_rollup_with_having_and_order(db):  # noqa: F811
     """HAVING and ORDER BY compose over the expanded output."""
     _tables, sess, conn = db

@@ -109,6 +109,31 @@ def test_nprobe_full_is_exact():
         assert got == want
 
 
+def test_ip_and_cosine_metrics():
+    """vec_ip / vec_cosine rank by negative inner product and cosine
+    DISTANCE (brute-force matmul+top-k; ASC LIMIT k = nearest for every
+    metric)."""
+    cat, x, rng = _vec_table(n=3000)
+    sess = Session(cat)
+    q = x[11]
+    lit = "[" + ",".join(f"{v:.6f}" for v in q) + "]"
+    rs = sess.sql(
+        f"select id from docs order by vec_ip(emb, '{lit}') limit 5"
+    )
+    got = [int(v) for v in rs.columns["id"]]
+    want = np.argsort(-(x @ q), kind="stable")[:5]
+    assert got == [int(v) for v in want]
+    rs = sess.sql(
+        f"select id from docs order by vec_cosine(emb, '{lit}') limit 5"
+    )
+    got = [int(v) for v in rs.columns["id"]]
+    sims = (x @ q) / (
+        np.linalg.norm(x, axis=1) * np.linalg.norm(q) + 1e-30
+    )
+    want = np.argsort(-sims, kind="stable")[:5]
+    assert got == [int(v) for v in want]
+
+
 def test_build_ivf_structure():
     x = np.random.default_rng(1).normal(size=(1000, 8)).astype(np.float32)
     idx = build_ivf(x, lists=16)
