@@ -147,6 +147,9 @@ class PhysicalParams:
     # static slice capacity in scan_cap (overflow-bumped like join caps)
     scan_slice: dict = field(default_factory=dict)
     scan_cap: dict[int, int] = field(default_factory=dict)
+    # top-k candidate prefilter capacities (TopN via lax.top_k on the
+    # first key, exact under the tie-overflow guard)
+    topn_cand: dict[int, int] = field(default_factory=dict)
     # ANN: TopN-over-vec_l2 nodes served by an IVF index (nid -> spec)
     vector_topns: dict = field(default_factory=dict)
 
@@ -168,6 +171,12 @@ class PhysicalParams:
                 # resolve: drop back to the unsliced full scan (cap >=
                 # table rows disables slicing in the Scan emission)
                 self.scan_cap[nid] = 1 << 62
+            if nid in self.topn_cand:
+                # ties on a low-cardinality first key can exceed ANY
+                # candidate budget: the retry must always resolve, so
+                # one overflow disables the prefilter (cand >= capacity
+                # skips it at emit) and the exact full sort runs
+                self.topn_cand[nid] = 1 << 62
 
 
 class ClusteredPremiseInvalidated(Exception):
@@ -760,6 +769,15 @@ class Executor:
                 ps = getattr(self, "_pending_slices", {}).get(id(op))
                 if ps is not None and nid not in params.scan_slice:
                     params.scan_slice[nid], params.scan_cap[nid] = ps
+            if (
+                isinstance(op, TopN)
+                and self.clustered_agg_enabled  # whole-batch executors only
+                and op.n + op.offset <= 1024
+                and nid not in params.topn_cand
+            ):
+                params.topn_cand[nid] = max(
+                    256, -(-4 * (op.n + op.offset) // 64) * 64
+                )
             if (
                 isinstance(op, Aggregate) and len(op.group_keys) > 1
                 and op.grouping_sets is None
@@ -1539,7 +1557,7 @@ class Executor:
 
         overflow_nodes: list[int] = sorted(
             set(params.groupby_size) | set(params.join_cap)
-            | set(params.scan_cap)
+            | set(params.scan_cap) | set(params.topn_cand)
             | {
                 PACK_GUARD_BASE + nid
                 for nid in params.pack_guard
@@ -1652,6 +1670,17 @@ class Executor:
                     op, nid, vspec, inputs, emit, params
                 )
             child, ovf = emit(op.child, inputs)
+            cand = params.topn_cand.get(nid)
+            if cand is not None and cand < child.capacity:
+                got = self._topn_candidates(child, op.keys, cand)
+                if got is not None:
+                    mini, over = got
+                    ovf = dict(ovf)
+                    ovf[nid] = over
+                    return (
+                        self._topn_batch(mini, op.keys, op.n, op.offset),
+                        ovf,
+                    )
             return (
                 self._topn_batch(child, op.keys, op.n, op.offset),
                 ovf,
@@ -1694,6 +1723,56 @@ class Executor:
             schema=Schema(tuple(fields)),
             dicts=dicts,
         )
+
+    def _topn_candidates(self, child: ColumnBatch, keys, C: int):
+        """EXACT top-k candidate prefilter: lax.top_k on the FIRST sort
+        key picks C candidates; any true top-(n+offset) row under the
+        full lexicographic order has a first-key value >= the worst
+        candidate's, so when at most C live rows tie-or-beat that value
+        the candidate set is a superset — otherwise the tie count rides
+        the overflow channel and the plan retries with 4x candidates.
+        Replaces a full-capacity multi-operand sort (Q3: 15M rows) with
+        one top_k + a C-row sort. None = ineligible (nullable,
+        non-integer, or no key) and the generic sort path runs."""
+        if not keys:
+            return None
+        e0, desc0 = keys[0]
+        v, vv = evaluate(e0, child)
+        if vv is not None or getattr(v, "ndim", 1) != 1:
+            return None
+        if not jnp.issubdtype(v.dtype, jnp.integer):
+            return None  # float NaNs would outrank everything in top_k
+        flip = v.astype(jnp.int64)
+        if not desc0:
+            flip = ~flip  # exact order reversal, no int64-min overflow
+        dead = jnp.iinfo(jnp.int64).min
+        masked = jnp.where(child.sel, flip, dead)
+        cand_v, cand_i = jax.lax.top_k(masked, C)
+        kth = cand_v[C - 1]
+        cnt = jnp.sum((masked >= kth) & child.sel, dtype=jnp.int64)
+        cols, valid, csel = gather_payload(
+            child.cols, child.valid, cand_i, child.sel
+        )
+        # guard BOTH clip hazards: boundary ties beyond C, and a LIVE
+        # row whose flipped key equals the dead sentinel being displaced
+        # by dead rows inside top_k's index tie-break (it would vanish
+        # with cnt <= C) — fewer live candidates than min(C, nlive)
+        # means something real was dropped
+        nlive = jnp.sum(child.sel, dtype=jnp.int64)
+        live_cand = jnp.sum(csel, dtype=jnp.int64)
+        short = jnp.maximum(
+            jnp.minimum(jnp.int64(C), nlive) - live_cand, 0
+        )
+        over = jnp.maximum(cnt - C, 0) + short
+        mini = ColumnBatch(
+            cols=cols,
+            valid=valid,
+            sel=csel,
+            nrows=jnp.sum(csel, dtype=jnp.int64),
+            schema=child.schema,
+            dicts=child.dicts,
+        )
+        return mini, over
 
     def _topn_batch(self, child: ColumnBatch, keys, n: int, offset: int,
                     apply_offset: bool = True) -> ColumnBatch:

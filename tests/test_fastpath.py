@@ -230,6 +230,36 @@ def test_clustered_premise_revalidated_after_dml():
     assert rs2.rows() == rs1.rows()
 
 
+def test_topn_prefilter_hazards():
+    """The top-k candidate prefilter must stay EXACT under (a) massive
+    first-key ties (low-NDV key: overflow must disable the prefilter,
+    not error) and (b) a live row whose key collides with the dead-row
+    sentinel (int64 extremes)."""
+    n = 20000
+    rng = np.random.default_rng(9)
+    low_ndv = rng.integers(0, 3, n).astype(np.int64)  # 3 distinct values
+    tiebreak = rng.permutation(n).astype(np.int64)
+    ext = np.arange(n, dtype=np.int64)
+    ext[0] = np.iinfo(np.int64).max  # collides with ASC flip sentinel
+    ext[1] = np.iinfo(np.int64).min  # collides with DESC sentinel
+    t = Table(
+        "t",
+        Schema((Field("a", I64), Field("b", I64), Field("x", I64))),
+        {"a": low_ndv, "b": tiebreak, "x": ext},
+    )
+    sess = Session({"t": t})
+    # (a) low-NDV first key: ties >> candidate budget
+    rs = sess.sql("select a, b from t order by a desc, b limit 15")
+    want = sorted(zip(low_ndv, tiebreak), key=lambda r: (-r[0], r[1]))[:15]
+    assert [(int(x), int(y)) for x, y in rs.rows()] == \
+        [(int(x), int(y)) for x, y in want]
+    # (b) sentinel-valued rows must appear at their true positions
+    rs = sess.sql("select x from t order by x limit 3")
+    assert int(rs.columns["x"][0]) == np.iinfo(np.int64).min
+    rs = sess.sql("select x from t order by x desc limit 3")
+    assert int(rs.columns["x"][0]) == np.iinfo(np.int64).max
+
+
 def test_affine_through_join():
     """Build side that is itself a merge-joinable join output keeps the
     affine direct-address property of its probe-side key column."""
