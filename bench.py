@@ -332,7 +332,11 @@ def check_result(qname, rs, cpu_val):
 
 
 def main():
-    budget = float(os.environ.get("BENCH_BUDGET_S", "330"))
+    # every emitted line is a COMPLETE cumulative summary, so a driver
+    # kill mid-run never loses captured results — the self-budget only
+    # orders what gets measured first, and a slow-tunnel night (compile
+    # and H2D throughput vary ~5x between runs) needs the headroom
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
     stream_sf = float(os.environ.get("BENCH_STREAM_SF", "30"))
 
