@@ -244,6 +244,46 @@ def _server_stat(db) -> Table:
     ])
 
 
+def _procedures(db) -> Table:
+    names = sorted(db._procedure_texts)
+    return _t("__all_virtual_procedure", [
+        ("procedure_name", DataType.varchar(), names),
+        ("definition", DataType.varchar(),
+         [db._procedure_texts[n].strip()[:200] for n in names]),
+    ])
+
+
+def _sequences(db) -> Table:
+    names = sorted(db._sequences)
+    return _t("__all_virtual_sequence", [
+        ("sequence_name", DataType.varchar(), names),
+        ("next_value", DataType.int64(),
+         [int(db._sequences[n]["next"]) for n in names]),
+        ("increment_by", DataType.int64(),
+         [int(db._sequences[n]["inc"]) for n in names]),
+        ("reserved_until", DataType.int64(),
+         [int(db._sequences[n]["reserved"]) for n in names]),
+    ])
+
+
+def _mviews(db) -> Table:
+    names = sorted(db._mview_specs)
+    return _t("__all_virtual_mview", [
+        ("mview_name", DataType.varchar(), names),
+        ("definition", DataType.varchar(),
+         [db._mview_specs[n].strip()[:200] for n in names]),
+    ])
+
+
+def _xa(db) -> Table:
+    rows = sorted(db._xa_prepared.items())
+    return _t("__all_virtual_xa_transaction", [
+        ("xid", DataType.varchar(), [x for x, _ in rows]),
+        ("owner", DataType.varchar(), [o for _, (_t2, o) in rows]),
+        ("state", DataType.varchar(), ["PREPARED" for _ in rows]),
+    ])
+
+
 PROVIDERS = {
     "__all_virtual_parameters": _parameters,
     "__all_virtual_table": _tables,
@@ -262,4 +302,8 @@ PROVIDERS = {
     "__all_virtual_index": _indexes,
     "__all_virtual_external_table": _external_tables,
     "__all_virtual_server_stat": _server_stat,
+    "__all_virtual_procedure": _procedures,
+    "__all_virtual_sequence": _sequences,
+    "__all_virtual_mview": _mviews,
+    "__all_virtual_xa_transaction": _xa,
 }

@@ -60,6 +60,24 @@ def test_r4_virtual_tables_queryable(db):
         "select user_name from __all_virtual_user where is_root = 1"
     )
     assert [r[0] for r in rs.rows()] == ["root"]
+    # object-catalog tables for the r4 DDL surfaces
+    s.sql("create sequence vt_seq")
+    s.sql("create procedure vt_p () begin return 1; end")
+    s.sql("xa start 'vt_x'")
+    s.sql("xa prepare 'vt_x'")
+    try:
+        rs = s.sql("select sequence_name from __all_virtual_sequence")
+        assert "vt_seq" in [r[0] for r in rs.rows()]
+        rs = s.sql("select procedure_name from __all_virtual_procedure")
+        assert "vt_p" in [r[0] for r in rs.rows()]
+        rs = s.sql(
+            "select xid, state from __all_virtual_xa_transaction"
+        )
+        assert ("vt_x", "PREPARED") in [tuple(r) for r in rs.rows()]
+        rs = s.sql("select count(*) as n from __all_virtual_mview")
+        assert rs.nrows == 1
+    finally:
+        s.sql("xa rollback 'vt_x'")
 
 
 def test_audit_queryable_as_virtual_table(db):
