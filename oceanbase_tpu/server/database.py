@@ -1375,6 +1375,17 @@ class DbSession:
                     pm.check(self.user, "select", others)
             elif isinstance(stmt, (A.CreateTable, A.CreateExternalTable)):
                 pm.check(self.user, "create", {stmt.name})
+                if isinstance(stmt, A.CreateExternalTable):
+                    # secure_file_priv gate: a bare 'create' grant must
+                    # not turn SELECT into arbitrary-host-file read (a
+                    # CSV loader would happily ingest /etc/passwd).
+                    self._check_external_location(stmt.location)
+            elif isinstance(stmt, A.LockTable):
+                # shared holds need read rights, exclusive holds write
+                # rights — otherwise a zero-grant user can block writers.
+                pm.check(self.user,
+                         "update" if stmt.exclusive else "select",
+                         {stmt.name})
             elif isinstance(stmt, A.CreateMaterializedView):
                 pm.check(self.user, "create", {stmt.name})
                 pm.check(self.user, "select", self._referenced_tables(
@@ -1398,6 +1409,24 @@ class DbSession:
                         f"'{self.user}' lacks SUPER", 1227)
         except AccessDenied as e:
             raise SqlError(str(e), code=e.code) from None
+
+    def _check_external_location(self, location: str) -> None:
+        """Non-root external-table locations must resolve inside the
+        secure_file_priv directory (empty = root-only), checked on the
+        os.path.realpath so ../ and symlink escapes don't bypass it."""
+        import os
+
+        allowed = str(self.db.config.get("secure_file_priv") or "")
+        if not allowed:
+            raise SqlError(
+                "external tables are restricted to root "
+                "(secure_file_priv is unset)", code=1227)
+        real = os.path.realpath(location)
+        base = os.path.realpath(allowed)
+        if os.path.commonpath([real, base]) != base:
+            raise SqlError(
+                f"location {location!r} is outside secure_file_priv",
+                code=1227)
 
     def _dcl(self, stmt) -> ResultSet:
         from ..share.privilege import AccessDenied

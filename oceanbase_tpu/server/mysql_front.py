@@ -45,13 +45,31 @@ MYSQL_TYPE_VAR_STRING = 253
 
 
 def native_password_scramble(password: str, salt: bytes) -> bytes:
-    """mysql_native_password: SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw)))."""
+    """mysql_native_password CLIENT side:
+    SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw)))."""
     if not password:
         return b""
     h1 = hashlib.sha1(password.encode()).digest()
     h2 = hashlib.sha1(h1).digest()
     h3 = hashlib.sha1(salt + h2).digest()
     return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def verify_native_password(stage2_hex: str, auth: bytes, salt: bytes) -> bool:
+    """SERVER side: the stored credential is only the stage-2 hash
+    SHA1(SHA1(pw)) (privilege.stage2_hash) — recover stage1 from the
+    client scramble as auth XOR SHA1(salt + stage2) and check
+    SHA1(stage1) == stage2. The plaintext never exists server-side."""
+    import hmac
+
+    if not stage2_hex:
+        return len(auth) == 0
+    if len(auth) != 20:
+        return False
+    h2 = bytes.fromhex(stage2_hex)
+    h3 = hashlib.sha1(salt + h2).digest()
+    h1 = bytes(a ^ b for a, b in zip(auth, h3))
+    return hmac.compare_digest(hashlib.sha1(h1).digest(), h2)
 
 
 def _lenenc_int(n: int) -> bytes:
@@ -162,6 +180,13 @@ class MySqlFrontend:
                  users: dict[str, str] | None = None,
                  ssl_context=None):
         self.db = db
+        # An explicit `users` map arrives as plaintext (test/embedding
+        # convenience) — reduce to stage-2 hashes immediately; the
+        # frontend never holds plaintext credentials.
+        if users is not None:
+            from ..share.privilege import stage2_hash
+
+            users = {u: stage2_hash(p) for u, p in users.items()}
         self.users = users
         # ssl.SSLContext (share/tls.server_context): advertise CLIENT_SSL
         # and upgrade the connection on an SSLRequest packet, per the
@@ -279,13 +304,10 @@ class MySqlFrontend:
             return user or "root"  # open door (no privilege manager)
         if user not in users:
             return None
-        want = native_password_scramble(users[user], salt)
-        import hmac
-
-        # constant-time: the 20-byte digest compare must not leak a
-        # prefix-length timing side channel (the TcpBus HELLO path
-        # already uses compare_digest)
-        return user if hmac.compare_digest(auth, want) else None
+        # verify_native_password compares full SHA1 digests via
+        # hmac.compare_digest — constant-time, stage2-only at rest.
+        return user if verify_native_password(users[user], auth, salt) \
+            else None
 
     def _greet(self, conn: _Conn) -> bytes:
         caps = (

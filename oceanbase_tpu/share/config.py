@@ -145,6 +145,11 @@ def default_params() -> list[Param]:
               choices=("raw", "for", "rle", "auto")),
         Param("micro_block_rows", "int", 16384,
               "rows per micro block at dump time", min=256, max=1 << 20),
+        # security
+        Param("secure_file_priv", "str", "",
+              "directory non-root external-table locations must resolve "
+              "inside; empty = root-only (MySQL secure_file_priv analog)",
+              scope="cluster"),
     ]
 
 

@@ -16,7 +16,19 @@ SqlError.code (1142 ER_TABLEACCESS_DENIED_ERROR, 1045 for bad login,
 
 from __future__ import annotations
 
+import hashlib
+
 PRIVS = {"select", "insert", "update", "delete", "create", "drop", "index"}
+
+
+def stage2_hash(password: str) -> str:
+    """mysql_native_password stage-2 hash SHA1(SHA1(pw)) as hex ('' stays
+    ''). This is what __all_user stores in the reference — never the
+    plaintext — and it is all the front door needs to verify a login
+    scramble (see mysql_front.verify_native_password)."""
+    if not password:
+        return ""
+    return hashlib.sha1(hashlib.sha1(password.encode()).digest()).hexdigest()
 
 ER_TABLEACCESS_DENIED = 1142
 ER_CANNOT_USER = 1396
@@ -48,7 +60,10 @@ class PrivilegeManager:
         if name in self.users:
             raise AccessDenied(
                 f"CREATE USER failed: '{name}' exists", ER_CANNOT_USER)
-        self.users[name] = password
+        # Only the stage-2 hash is ever stored (or persisted via to_meta):
+        # plaintext at rest would disclose every credential to any
+        # meta-file read.
+        self.users[name] = stage2_hash(password)
         self.grants.setdefault(name, {})
 
     def drop_user(self, name: str) -> None:
@@ -61,7 +76,7 @@ class PrivilegeManager:
         self.grants.pop(name, None)
 
     def authenticate_db(self) -> dict[str, str]:
-        """name -> password map for the MySQL front door."""
+        """name -> stage2-hash map for the MySQL front door."""
         return dict(self.users)
 
     # --------------------------------------------------------- grants
@@ -109,6 +124,7 @@ class PrivilegeManager:
     def to_meta(self) -> dict:
         return {
             "users": dict(self.users),
+            "hashed": True,
             "grants": {
                 u: {o: sorted(p) for o, p in g.items()}
                 for u, g in self.grants.items()
@@ -119,4 +135,9 @@ class PrivilegeManager:
     def from_meta(cls, meta: dict | None) -> "PrivilegeManager":
         if not meta:
             return cls()
-        return cls(meta.get("users"), meta.get("grants"))
+        users = meta.get("users")
+        if users and not meta.get("hashed"):
+            # Pre-r5 metas persisted plaintext — hash on load, and the
+            # next to_meta writes the hashed form.
+            users = {u: stage2_hash(p) for u, p in users.items()}
+        return cls(users, meta.get("grants"))

@@ -216,6 +216,7 @@ class PlParser(Parser):
         # the statement parsers see a clean stream)
         start = t.pos
         depth = 0
+        toks: list = []  # (token, paren depth) — for token-level INTO strip
         while True:
             tok = self.peek()
             if tok.kind == "eof":
@@ -228,19 +229,29 @@ class PlParser(Parser):
                 end = tok.pos
                 self.next()
                 break
+            toks.append((tok, depth))
             self.next()
         text = self.sql[start:end]
         into: tuple[str, ...] = ()
-        low = text.lower()
-        if " into " in low and low.lstrip().startswith("select"):
-            # SELECT ... INTO v[, v] FROM ... : strip the INTO clause
-            i = low.index(" into ")
-            j = low.find(" from ", i)
-            j = j if j >= 0 else len(text)
-            into = tuple(
-                x.strip() for x in text[i + 6:j].split(",") if x.strip()
-            )
-            text = text[:i] + " " + text[j:]
+        if toks and toks[0][0].value == "select":
+            # SELECT ... INTO v[, v] [FROM ...]: strip the INTO clause at
+            # the TOKEN level — a string literal containing ' into ', or
+            # an INTO in a subquery (depth > 0), must not match.
+            ii = next((k for k, (tk, d) in enumerate(toks)
+                       if d == 0 and tk.kind == "kw" and tk.value == "into"),
+                      None)
+            if ii is not None:
+                jj = next((k for k in range(ii + 1, len(toks))
+                           if toks[k][1] == 0
+                           and toks[k][0].kind == "kw"
+                           and toks[k][0].value == "from"), None)
+                stop = jj if jj is not None else len(toks)
+                # variable names may lex as kw (row, key, date, ...);
+                # only the separating commas are ops
+                into = tuple(tk.value for tk, _ in toks[ii + 1:stop]
+                             if tk.kind in ("name", "kw"))
+                j = toks[jj][0].pos if jj is not None else end
+                text = self.sql[start:toks[ii][0].pos] + " " + self.sql[j:end]
         from . import parser as P
 
         return PlSql(P.parse_statement(text), into)
@@ -381,7 +392,10 @@ class PlInterpreter:
         self._tick()
         if isinstance(node, A.NumberLit):
             v = node.value
-            return float(v) if "." in v else int(v)
+            try:
+                return int(v)
+            except ValueError:
+                return float(v)  # '.' or scientific notation (1e5)
         if isinstance(node, A.StringLit):
             return node.value
         if isinstance(node, A.Name):

@@ -169,3 +169,49 @@ def test_drop_requires_privilege(db):
         sam.sql("drop procedure transfer")
     assert e.value.code == 1142
     assert root.lookup_procedure("transfer") is not None
+
+
+def test_into_not_matched_inside_string_literal(db):
+    """The INTO strip is token-level: a string literal containing
+    ' into ' (or ' from ') must not mangle the statement (r4 advisor)."""
+    s = db.session()
+    s.sql("create table msgs (id int primary key, note varchar)")
+    s.sql("""
+create procedure log_note (in i int)
+begin
+  insert into msgs values (i, 'went into the from zone');
+end
+""")
+    s.sql("call log_note(7)")
+    rs = s.sql("select note from msgs where id = 7")
+    assert rs.columns["note"][0] == "went into the from zone"
+
+
+def test_select_into_without_from(db):
+    """SELECT expr INTO v with no FROM clause binds the variable (the
+    token-level strip must use statement-end when there is no FROM)."""
+    s = db.session()
+    s.sql("""
+create procedure noq ()
+begin
+  declare v int;
+  select 6 * 7 into v;
+  return v;
+end
+""")
+    assert s.sql("call noq()").rows() == [(42,)]
+
+
+def test_into_keyword_named_variable(db):
+    """INTO targets whose names lex as keywords (row, key, ...) still
+    bind (review finding r5)."""
+    s = db.session()
+    s.sql("""
+create procedure kwvar ()
+begin
+  declare row int;
+  select 6 * 7 into row;
+  return row;
+end
+""")
+    assert s.sql("call kwvar()").rows() == [(42,)]

@@ -119,7 +119,11 @@ def test_grants_survive_restart(tmp_path):
 
     db2 = Database(n_nodes=1, n_ls=1, data_dir=data, fsync=False)
     try:
-        assert db2.privileges.users.get("frank") == "fpw"
+        # only the mysql_native stage-2 hash is at rest, never plaintext
+        from oceanbase_tpu.share.privilege import stage2_hash
+
+        assert db2.privileges.users.get("frank") == stage2_hash("fpw")
+        assert "fpw" not in repr(db2.privileges.users)
         frank = db2.session(user="frank")
         assert frank.sql("select sum(b) as s from t").columns["s"][0] == 5
         with pytest.raises(SqlError):
@@ -150,3 +154,68 @@ def test_front_door_authenticates_created_user(db):
         assert "1142" in str(e.value)
     finally:
         front.stop()
+
+
+def test_lock_table_requires_privilege(db):
+    """A zero-grant user cannot take table locks (shared needs select,
+    exclusive needs update) — otherwise it could block privileged
+    writers indefinitely."""
+    root = db.session()
+    root.sql("create user harry identified by 'h'")
+    harry = db.session(user="harry")
+    with pytest.raises(SqlError) as e:
+        harry.sql("lock table t in share mode")
+    assert code_of(e) == 1142
+    with pytest.raises(SqlError) as e:
+        harry.sql("lock table t in exclusive mode")
+    assert code_of(e) == 1142
+    root.sql("grant select on t to harry")
+    harry.sql("begin")
+    harry.sql("lock table t in share mode")
+    harry.sql("commit")
+    harry.sql("begin")
+    with pytest.raises(SqlError) as e:  # select != update
+        harry.sql("lock table t in exclusive mode")
+    assert code_of(e) == 1142
+    harry.sql("rollback")
+    root.sql("grant update on t to harry")
+    harry.sql("begin")
+    harry.sql("lock table t in exclusive mode")
+    harry.sql("commit")
+
+
+def test_external_table_secure_file_priv(db, tmp_path):
+    """Non-root CREATE EXTERNAL TABLE is gated by secure_file_priv: with
+    it unset the statement is root-only; set, locations must resolve
+    inside it (realpath, so ../ escapes are caught)."""
+    import csv
+
+    allowed = tmp_path / "allowed"
+    allowed.mkdir()
+    inside = allowed / "ok.csv"
+    with open(inside, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["a", "b"])
+        w.writerow([1, 2])
+    outside = tmp_path / "secret.csv"
+    outside.write_text("a,b\n9,9\n")
+
+    root = db.session()
+    root.sql("create user iris identified by 'i'")
+    root.sql("grant all on * to iris")
+    iris = db.session(user="iris")
+    with pytest.raises(SqlError) as e:  # unset -> root-only
+        iris.sql(f"create external table e1 using csv location '{inside}'")
+    assert code_of(e) == 1227
+    db.config.set("secure_file_priv", str(allowed))
+    iris.sql(f"create external table e1 using csv location '{inside}'")
+    assert iris.sql("select count(*) as n from e1").columns["n"][0] == 1
+    with pytest.raises(SqlError) as e:  # outside the allowlist
+        iris.sql(f"create external table e2 using csv location '{outside}'")
+    assert code_of(e) == 1227
+    with pytest.raises(SqlError) as e:  # ../ escape via realpath
+        iris.sql("create external table e3 using csv location "
+                 f"'{allowed}/../secret.csv'")
+    assert code_of(e) == 1227
+    # root is never gated
+    root.sql(f"create external table e4 using csv location '{outside}'")
