@@ -238,6 +238,16 @@ class Database:
         self.data_dir = data_dir
         self._fsync = fsync
         self.tenant_name = tenant_name
+        # XA branch registry rebuilt from the LOG (ob_trans_part_ctx.h:154
+        # logs prepare state): XA_PREPARE records add entries, the
+        # decision records remove them — populated during boot replay and
+        # kept current by normal apply. xid -> {tx_id, owner, parts,
+        # tablets}; must exist before any record observer can fire.
+        self._xa_registry: dict[str, dict] = {}
+        self._xa_txids: dict[int, str] = {}
+        # XA: externally-coordinated branches parked between PREPARE and
+        # the decision; value = (live _OpenTx | None-if-recovered, owner)
+        self._xa_prepared: dict[str, tuple] = {}
         self.unit = unit or TenantUnit()
         self._shared_cluster = cluster is not None
         self._unique_keys: dict[str, tuple[str, ...]] = {}
@@ -259,6 +269,17 @@ class Database:
             node_meta = self._load_node_meta() if data_dir is not None else None
             if node_meta is not None:
                 n_nodes, n_ls = node_meta["n_nodes"], node_meta["n_ls"]
+                # seed the XA registry from meta (covers branches whose
+                # XA_PREPARE predates the checkpoint the log recycled to);
+                # replayed decision records then prune entries decided
+                # after the meta snapshot
+                for _xid, _e in (node_meta.get("xa_registry") or {}).items():
+                    self._xa_registry[_xid] = {
+                        "tx_id": _e["tx_id"], "owner": _e["owner"],
+                        "parts": tuple(_e["parts"]),
+                        "tablets": set(_e["tablets"]),
+                    }
+                    self._xa_txids[_e["tx_id"]] = _xid
             self.cluster, self.rootservice = RootService.bootstrap(
                 n_nodes, n_ls, data_dir=data_dir, fsync=fsync, finalize=False
             )
@@ -302,9 +323,6 @@ class Database:
             restored_meta.get("procedures", {}) if restored_meta else {}
         )
         self._procedures_parsed: dict = {}
-        # XA: externally-coordinated txs parked between PREPARE and the
-        # commit/rollback decision (node-local; see DbSession._xa)
-        self._xa_prepared: dict[str, object] = {}
         # sequences: name -> {"next": int, "inc": int, "reserved": int}.
         # Durability via BLOCK RESERVATION (the reference's sequence
         # cache): meta persists the end of the reserved block, so a
@@ -418,6 +436,34 @@ class Database:
 
         self.lock_mgr = LockManager()
 
+        # XA recovery: every undecided branch in the log-rebuilt registry
+        # parks again — locks re-held, and the leader replica RE-STAGES the
+        # pending redo into its memtables so write-write conflict detection
+        # guards the prepared rows exactly as before the restart (the
+        # reference re-inserts prepared redo through the tx ctx on
+        # recovery, ob_trans_part_ctx.h:154).
+        from ..tx.tablelock import LockMode as _LockMode
+
+        for _xid, _e in self._xa_registry.items():
+            self._xa_prepared.setdefault(_xid, (None, _e["owner"]))
+            for _tab in _e["tablets"]:
+                try:
+                    self.lock_mgr.lock(_e["tx_id"], _tab, _LockMode.ROW_X)
+                except Exception:
+                    pass
+            for _ls in _e["parts"]:
+                for _rep in (self.cluster.ls_groups.get(_ls) or {}).values():
+                    if _rep.is_leader and _e["tx_id"] in _rep._pending_redo:
+                        _ms = _rep._pending_redo.pop(_e["tx_id"])
+                        _snap = self.cluster.gts.current()
+                        for _m in _ms:
+                            _t = _rep.tablets.get(_m.tablet_id)
+                            if _t is not None:
+                                _t.stage(_e["tx_id"], _snap, _m.key,
+                                         _m.op, _m.values)
+                        _rep._locally_staged.add(_e["tx_id"])
+                        _rep.tx_table[_e["tx_id"]] = "prepared"
+
         # indexes built since the last checkpoint lost their (unlogged)
         # backfill sstables in a crash: re-backfill now that leaders exist
         for ti, idx in getattr(self, "_index_rebuild_pending", []):
@@ -518,6 +564,14 @@ class Database:
             "mview_specs": dict(self._mview_specs),
             "procedures": dict(self._procedure_texts),
             "sequences": {k: dict(v) for k, v in self._sequences.items()},
+            # undecided XA branches: belt-and-braces alongside log replay
+            # (covers an XA_PREPARE recycled below a later checkpoint)
+            "xa_registry": {
+                x: {"tx_id": e["tx_id"], "owner": e["owner"],
+                    "parts": tuple(e["parts"]),
+                    "tablets": sorted(e["tablets"])}
+                for x, e in self._xa_registry.items()
+            },
         }
         from ..share.fsutil import atomic_write
 
@@ -588,8 +642,25 @@ class Database:
         ahead of replicated commit versions. Boot replay: re-applies logged
         dictionary appends (codes past the checkpointed dictionaries) —
         idempotent because codes are dense and append-ordered."""
+        from ..tx.records import RecordType as _RT
+
         if rec.commit_version:
             self.cluster.gts.advance_to(rec.commit_version)
+        # XA registry maintenance (idempotent: records apply once per
+        # replica; keyed by xid / pruned by tx_id)
+        if rec.rtype is _RT.XA_PREPARE and rec.xid and \
+                rec.tenant == self.tenant_name:
+            e = self._xa_registry.setdefault(rec.xid, {
+                "tx_id": rec.tx_id, "owner": rec.owner,
+                "parts": tuple(rec.participants), "tablets": set(),
+            })
+            e["tablets"].update(m.tablet_id for m in rec.mutations)
+            self._xa_txids[rec.tx_id] = rec.xid
+        elif rec.rtype in (_RT.COMMIT, _RT.ABORT, _RT.REDO_COMMIT):
+            _xid = self._xa_txids.pop(rec.tx_id, None)
+            if _xid is not None:
+                self._xa_registry.pop(_xid, None)
+                self._xa_prepared.pop(_xid, None)
         if not rec.dict_appends:
             return
         by_tab = self._ti_by_tablet
@@ -1693,12 +1764,14 @@ class DbSession:
     def _xa(self, text: str) -> ResultSet:
         """XA surface (src/storage/tx/ob_xa_ctx analog at this engine's
         scale): START/END tag a session tx with an external xid, PREPARE
-        PARKS it in a node-wide registry (locks + staged rows held, the
-        session detaches), and COMMIT/ROLLBACK finish it from ANY
-        session — the external-coordinator contract. Parked state is
-        node-local and non-durable: a restart rolls in-flight XA back
-        (XA RECOVER reports what is actually recoverable, i.e. the
-        still-parked set)."""
+        logs the branch's redo DURABLY through palf (XA_PREPARE records on
+        every participant LS, ob_trans_part_ctx.h:154) and parks it with
+        locks + staged rows held, and COMMIT/ROLLBACK finish it from ANY
+        session — the external-coordinator contract. A restart rebuilds
+        the parked set from log replay (+ the node-meta registry
+        snapshot), re-stages the pending redo on the leader, and re-holds
+        the locks: prepared branches survive kill-9 and remain decidable,
+        which is the window XA exists for."""
         import re as _re
 
         m = _re.match(
@@ -1729,13 +1802,32 @@ class DbSession:
                 raise SqlError(f"unknown xid {xid!r}", code=1397)
             return ResultSet((), {})  # idle marker; state kept implicit
         if verb == "prepare":
+            from ..tx.txn import NotMaster, TxState
+
             if self._tx is None or getattr(self, "_xa_id", None) != xid:
                 raise SqlError(f"unknown xid {xid!r}", code=1397)
             with self.db._ddl_lock:
                 if xid in self.db._xa_prepared:
                     raise SqlError(f"xid {xid!r} already prepared",
                                    code=1399)
-                self.db._xa_prepared[xid] = (self._tx, self.user)
+            tx = self._tx
+            try:
+                tx.svc.xa_prepare(tx.ctx, xid, self.user,
+                                  self.db.tenant_name)
+            except NotMaster as e:
+                self._tx = None
+                self._xa_id = None
+                raise SqlError(f"XA PREPARE failed: {e}", code=1399)
+            self.db.cluster.drive_until(
+                lambda: tx.ctx.state is not TxState.PREPARING)
+            if tx.ctx.state is not TxState.XA_PREPARED:
+                self._tx = None
+                self._xa_id = None
+                raise SqlError(
+                    f"XA PREPARE did not reach the log for {xid!r}",
+                    code=1399)
+            with self.db._ddl_lock:
+                self.db._xa_prepared[xid] = (tx, self.user)
             self._tx = None
             self._xa_id = None
             return ResultSet((), {})
@@ -1752,19 +1844,84 @@ class DbSession:
                             code=1227,
                         )
                     del self.db._xa_prepared[xid]
-            tx = hit[0] if hit is not None else None
-            if tx is None:
-                # one-phase: this session's own un-prepared xid
-                if self._tx is not None and \
-                        getattr(self, "_xa_id", None) == xid:
-                    tx = self._tx
-                    self._tx = None
-                    self._xa_id = None
+            if hit is not None:
+                parked_tx = hit[0]
+                if parked_tx is not None:
+                    self._xa_finish_parked(parked_tx,
+                                           commit=(verb == "commit"))
                 else:
-                    raise SqlError(f"unknown xid {xid!r}", code=1397)
+                    self._xa_finish_recovered(xid,
+                                              commit=(verb == "commit"))
+                return ResultSet((), {})
+            # one-phase: this session's own un-prepared xid
+            if self._tx is not None and \
+                    getattr(self, "_xa_id", None) == xid:
+                tx = self._tx
+                self._tx = None
+                self._xa_id = None
+            else:
+                raise SqlError(f"unknown xid {xid!r}", code=1397)
             self._finish_tx(tx, commit=(verb == "commit"))
             return ResultSet((), {})
         raise SqlError(f"bad XA verb {verb!r}", code=1398)
+
+    def _xa_finish_parked(self, tx: "_OpenTx", commit: bool) -> None:
+        """Decide a live parked (XA_PREPARED) branch: redo is already in
+        the log, so commit only logs the decision records."""
+        from ..tx.txn import TxState
+
+        ctx = tx.ctx
+        try:
+            tx.svc.xa_decide(ctx, commit)
+
+            def done() -> bool:
+                tx.svc.retry_decisions(ctx)
+                return ctx.is_done
+
+            if not self.db.cluster.drive_until(done):
+                raise SqlError(f"XA decision for tx {ctx.tx_id} timed out")
+        finally:
+            committed_ok = commit and ctx.state is TxState.COMMITTED
+            self._post_tx_cleanup(tx, committed_ok)
+
+    def _xa_finish_recovered(self, xid: str, commit: bool) -> None:
+        """Decide a branch recovered from log replay after a restart: no
+        live ctx exists — submit the decision records straight to the
+        participant leader replicas and wait for apply (which commits the
+        re-staged rows / replays pending redo)."""
+        from ..tx.records import RecordType, TxRecord
+
+        e = self.db._xa_registry.get(xid)
+        if e is None:
+            return  # decision already applied (e.g. raced another session)
+        tx_id, parts = e["tx_id"], tuple(e["parts"])
+        version = self.db.cluster.gts.next_ts() if commit else 0
+        rtype = RecordType.COMMIT if commit else RecordType.ABORT
+        for ls in parts:
+            group = self.db.cluster.ls_groups.get(ls) or {}
+
+            def try_submit(ls=ls, group=group) -> bool:
+                for rep in group.values():
+                    if rep.is_ready and rep.submit_record(
+                            TxRecord(rtype, tx_id, (), version)) is not None:
+                        return True
+                return False
+
+            if not self.db.cluster.drive_until(try_submit):
+                raise SqlError(
+                    f"no ready leader for ls {ls} to decide xid {xid!r}")
+        if not self.db.cluster.drive_until(
+                lambda: xid not in self.db._xa_registry):
+            raise SqlError(f"XA decision for xid {xid!r} did not apply")
+        self.db.lock_mgr.release_all(tx_id)
+        if commit:
+            by_tab = {ti.tablet_id: ti for ti in self.db.tables.values()}
+            for tab in e["tablets"]:
+                ti = by_tab.get(tab)
+                if ti is not None:
+                    ti.data_version += 1
+                    ti.cached_data_version = -1
+            self.db.run_maintenance()
 
     # -------------------------------------------------- stored procedures
     def _create_procedure(self, text: str) -> ResultSet:
@@ -2166,30 +2323,36 @@ class DbSession:
             else:
                 tx.svc.abort(tx.ctx)
         finally:
-            # locks hold through the commit decision, then release
-            self.db.lock_mgr.release_all(tx.ctx.tx_id)
-            by_tablet = {}
-            for name in touched:
-                ti = self.db.tables.get(name)
+            self._post_tx_cleanup(tx, committed_ok)
+
+    def _post_tx_cleanup(self, tx: "_OpenTx", committed_ok: bool) -> None:
+        """Shared decision epilogue: release locks, refresh table versions,
+        note durably-logged dictionary growth, trigger maintenance."""
+        touched = tx.touched_tables
+        # locks hold through the commit decision, then release
+        self.db.lock_mgr.release_all(tx.ctx.tx_id)
+        by_tablet = {}
+        for name in touched:
+            ti = self.db.tables.get(name)
+            if ti is not None:
+                by_tablet[ti.tablet_id] = ti
+                if committed_ok:
+                    ti.data_version += 1
+                ti.cached_data_version = -1
+        if committed_ok:
+            # the appends are durable now (committed_ok, NOT the commit
+            # intent: a failed commit logged nothing): later commits
+            # need not re-log them
+            for tab_id, col, code, _s in tx.ctx.dict_appends:
+                ti = by_tablet.get(tab_id)
                 if ti is not None:
-                    by_tablet[ti.tablet_id] = ti
-                    if committed_ok:
-                        ti.data_version += 1
-                    ti.cached_data_version = -1
-            if committed_ok:
-                # the appends are durable now (committed_ok, NOT the commit
-                # intent: a failed commit logged nothing): later commits
-                # need not re-log them
-                for tab_id, col, code, _s in tx.ctx.dict_appends:
-                    ti = by_tablet.get(tab_id)
-                    if ti is not None:
-                        ti.logged_dict_len[col] = max(
-                            ti.logged_dict_len.get(col, 0), code + 1
-                        )
-            if committed_ok and touched:
-                # post-commit freeze/compaction check (the tenant freezer's
-                # write-path trigger; cheap when under the memstore limit)
-                self.db.run_maintenance()
+                    ti.logged_dict_len[col] = max(
+                        ti.logged_dict_len.get(col, 0), code + 1
+                    )
+        if committed_ok and touched:
+            # post-commit freeze/compaction check (the tenant freezer's
+            # write-path trigger; cheap when under the memstore limit)
+            self.db.run_maintenance()
 
     # --------------------------------------------------------------- DML
     @staticmethod

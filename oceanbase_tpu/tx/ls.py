@@ -103,7 +103,7 @@ class LSReplica:
                 self._replay_mutations(rec.mutations, rec.commit_version)
             self.tx_table.pop(rec.tx_id, None)
             self._notify(rec.tx_id, rec.rtype, rec.commit_version)
-        elif rec.rtype is RecordType.PREPARE:
+        elif rec.rtype in (RecordType.PREPARE, RecordType.XA_PREPARE):
             if not staged:
                 # follower: remember redo; rows become visible at COMMIT with
                 # the final version (staging uncommitted rows would need

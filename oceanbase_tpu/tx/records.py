@@ -23,6 +23,13 @@ class RecordType(enum.IntEnum):
     PREPARE = 2  # 2PC phase 1: mutations, participant list
     COMMIT = 3  # 2PC phase 2: commit version
     ABORT = 4
+    # XA phase 1: like PREPARE (redo + participants reach the log, replicas
+    # retain pending redo) but the decision belongs to an EXTERNAL
+    # coordinator — applying it must never auto-commit. The record also
+    # carries the xid/owner/tenant so a restarted node can rebuild its
+    # parked-branch registry from replay alone (the reference logs prepare
+    # state through the part ctx, ob_trans_part_ctx.h:154).
+    XA_PREPARE = 5
 
 
 @dataclass(frozen=True)
@@ -47,11 +54,18 @@ class TxRecord:
     # (the multi-data-source analog: non-row state atomically logged with
     # the tx, storage/multi_data_source).
     dict_appends: tuple = ()
+    # XA_PREPARE only: external branch id + the preparing user + owning
+    # tenant (records are observed by every tenant on a shared cluster;
+    # tenant scopes the registry rebuild)
+    xid: str = ""
+    owner: str = ""
+    tenant: str = ""
 
     def to_bytes(self) -> bytes:
         return bytes([self.rtype]) + pickle.dumps(
             (self.tx_id, self.mutations, self.commit_version,
-             self.coordinator_ls, self.participants, self.dict_appends),
+             self.coordinator_ls, self.participants, self.dict_appends,
+             self.xid, self.owner, self.tenant),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
 

@@ -40,6 +40,9 @@ from .records import Mutation, RecordType, TxRecord
 class TxState(enum.Enum):
     ACTIVE = "active"
     PREPARING = "preparing"
+    # XA: prepared and PARKED — redo is durable in the log on every
+    # participant, the decision belongs to an external coordinator
+    XA_PREPARED = "xa_prepared"
     COMMITTING = "committing"
     COMMITTED = "committed"
     ABORTED = "aborted"
@@ -58,6 +61,9 @@ class TxContext:
     # dictionary appends to log with the commit (see TxRecord.dict_appends)
     dict_appends: list = field(default_factory=list)
     commit_version: int = 0
+    # XA participant set: fixed at xa_prepare (includes the home LS when the
+    # branch has no writes, so even an empty branch leaves a durable record)
+    xa_parts: tuple = ()
     _prepared: set[int] = field(default_factory=set)
     _committed_ls: set[int] = field(default_factory=set)
     # COMMIT decisions whose submit was rejected (transient non-leader
@@ -181,6 +187,60 @@ class TransService:
                 raise NotMaster(f"ls {ls} rejected prepare")
             logged.append(ls)
 
+    # ------------------------------------------------------------- XA
+    def xa_prepare(self, ctx: TxContext, xid: str, owner: str,
+                   tenant: str = "") -> None:
+        """Durable XA phase 1 (ob_trans_part_ctx.h:154 logs prepare through
+        the part ctx): each participant's redo reaches its replicated log
+        in an XA_PREPARE record tagged with the xid, then the tx PARKS in
+        XA_PREPARED — no auto-commit; the external coordinator decides.
+        Terminal XA_PREPARED arrives via apply callbacks (drive to it)."""
+        if ctx.state is not TxState.ACTIVE:
+            raise RuntimeError(f"tx {ctx.tx_id} is {ctx.state.value}")
+        parts = [ls for ls, ms in ctx.mutations.items() if ms]
+        if not parts:
+            parts = [min(self.replicas)]  # empty branch: one marker record
+        for ls in parts:
+            if not self.replicas[ls].is_leader:
+                self.abort(ctx)
+                raise NotMaster(f"ls {ls} lost leadership before XA prepare")
+        ctx.xa_parts = tuple(parts)
+        ctx.state = TxState.PREPARING
+        logged: list[int] = []
+        for ls in parts:
+            rec = TxRecord(RecordType.XA_PREPARE, ctx.tx_id,
+                           tuple(ctx.mutations.get(ls, ())), 0, parts[0],
+                           tuple(parts), dict_appends=tuple(ctx.dict_appends),
+                           xid=xid, owner=owner, tenant=tenant)
+            if self.replicas[ls].submit_record(rec) is None:
+                self._rollback(ctx, logged_ls=tuple(logged))
+                raise NotMaster(f"ls {ls} rejected XA prepare")
+            logged.append(ls)
+
+    def xa_decide(self, ctx: TxContext, commit: bool) -> None:
+        """External-coordinator decision for a parked (XA_PREPARED) branch.
+        Commit logs COMMIT records with a fresh GTS version; replicas that
+        staged the rows commit them, replicas (or a restarted node) holding
+        only pending redo replay it."""
+        if ctx.state is not TxState.XA_PREPARED:
+            raise RuntimeError(f"tx {ctx.tx_id} is {ctx.state.value}")
+        if not commit:
+            for ls in ctx.mutations:
+                self.replicas[ls].abort_locally(ctx.tx_id)
+            for ls in ctx.xa_parts:
+                self.replicas[ls].submit_record(
+                    TxRecord(RecordType.ABORT, ctx.tx_id))
+            ctx.state = TxState.ABORTED
+            self._finish(ctx)
+            return
+        ctx.commit_version = self.gts.next_ts()
+        ctx.state = TxState.COMMITTING
+        for ls in ctx.xa_parts:
+            rec = TxRecord(RecordType.COMMIT, ctx.tx_id, (),
+                           ctx.commit_version)
+            if self.replicas[ls].submit_record(rec) is None:
+                ctx._undelivered[ls] = rec
+
     def abort(self, ctx: TxContext) -> None:
         """Client-driven abort. Refused once the decision is in flight: a tx
         in COMMITTING has decisive records submitted to the log and MUST
@@ -192,7 +252,11 @@ class TransService:
             raise RuntimeError(
                 f"tx {ctx.tx_id} commit already in flight; cannot abort"
             )
-        logged = tuple(ctx.mutations) if ctx.state is TxState.PREPARING else ()
+        logged = (
+            tuple(set(ctx.mutations) | set(ctx.xa_parts))
+            if ctx.state in (TxState.PREPARING, TxState.XA_PREPARED)
+            else ()
+        )
         self._rollback(ctx, logged_ls=logged)
 
     def retry_decisions(self, ctx: TxContext) -> None:
@@ -227,6 +291,12 @@ class TransService:
             ctx.commit_version = version
             ctx.state = TxState.COMMITTED
             self._finish(ctx)
+        elif rtype is RecordType.XA_PREPARE and ctx.state is TxState.PREPARING:
+            # XA: record prepared parts, park when all are in — NEVER
+            # auto-commit (that is the external coordinator's call)
+            ctx._prepared.add(ls_id)
+            if ctx._prepared >= set(ctx.xa_parts):
+                ctx.state = TxState.XA_PREPARED
         elif rtype is RecordType.PREPARE and ctx.state is TxState.PREPARING:
             ctx._prepared.add(ls_id)
             if ctx._prepared >= set(ctx.mutations.keys()):
