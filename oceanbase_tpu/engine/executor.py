@@ -2664,6 +2664,17 @@ class Executor:
 
     # ---- aggregate emission --------------------------------------------
     def _emit_aggregate(self, op: Aggregate, nid, inputs, emit, params):
+        if any(fn == "approx_ndv" for _n, fn, _a, _d in op.aggs) and (
+            op.group_keys or op.grouping_sets is not None
+        ):
+            # grouped approx NDV: per-group register arrays would need a
+            # [groups, 16K] sketch — the exact first-occurrence distinct
+            # count is the better grouped plan (bounded by group rows)
+            op = replace(op, aggs=tuple(
+                (n, "count", a, True) if fn == "approx_ndv"
+                else (n, fn, a, d)
+                for n, fn, a, d in op.aggs
+            ))
         if op.grouping_sets is not None:
             return self._emit_grouping_sets(op, nid, inputs, emit, params)
         spec = params.clustered_aggs.get(nid)
@@ -2787,7 +2798,7 @@ class Executor:
             ):
                 (v,) = scalar_aggregate(am, [aop], [av])
                 cols[name] = v[None]
-                if aop != "count":
+                if aop not in ("count", "approx_ndv"):
                     out_valid[name] = jnp.any(am)[None]
             sel = jnp.ones(1, dtype=jnp.bool_)
 
@@ -3064,7 +3075,7 @@ def _agg_schema(op: Aggregate, child_schema: Schema) -> Schema:
             t = replace(t, nullable=True)  # NULL-filled in coarser sets
         fields.append(Field(name, t))
     for name, fn, arg, _ in op.aggs:
-        if fn == "count":
+        if fn in ("count", "approx_ndv"):
             fields.append(Field(name, DataType.int64()))
         else:
             t = infer_type(arg, child_schema)

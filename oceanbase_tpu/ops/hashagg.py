@@ -391,6 +391,11 @@ def scalar_aggregate(
         if op == "count":
             out.append(jnp.sum(mask, dtype=jnp.int64))
             continue
+        if op == "approx_ndv":
+            from .hll import hll_count
+
+            out.append(hll_count(v, mask))
+            continue
         if op == "sum":
             acc = (
                 jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer) else v.dtype

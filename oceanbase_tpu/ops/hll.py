@@ -1,0 +1,103 @@
+"""HyperLogLog NDV sketch — scatter-free, fixed-memory, mergeable.
+
+Reference surface: src/share/aggregate/approx_count_distinct.cpp (the
+ObAggregateProcessor HLL with 2^14 buckets). The rebuild keeps the same
+register geometry (m = 2^14, alpha = 0.7213/(1+1.079/m), linear-counting
+small-range correction) but computes the register array WITHOUT scatters
+— the measured TPU cliff that shaped every kernel in ops/ (see
+ops/hashagg.py):
+
+  * bucket index and rank come from two INDEPENDENT 32-bit mixes of the
+    value (murmur3 fmix32 with different seeds), giving an effective
+    46-bit hash space — no large-range correction needed at any NDV the
+    device can hold;
+  * (bucket << 6 | rank) packs into one int32 sort key; after ONE sort,
+    the maximum rank of every touched bucket is the last element of its
+    run;
+  * the dense [m] register array materializes by a searchsorted + gather
+    over the sorted keys (m lookups, no scatter).
+
+The register array is the mergeable form (elementwise max), sized 16K
+int32 — constant memory regardless of input NDV, which is the entire
+point of the operator: the exact distinct-count path (first-occurrence
+masks) needs the full value set resident, this needs 64KB.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .hashing import _GOLDEN32, fold32, mix32
+
+M_LOG2 = 14
+M = 1 << M_LOG2  # 16384 registers, matching the reference's bucket count
+_RANK_BITS = 6  # ranks are 1..33; 6 bits
+
+
+def _two_hashes(col: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 32-bit avalanche hashes of a key column.
+
+    Float columns are BITCAST to same-width ints before folding: fold32's
+    value-cast would truncate 0.1..0.9 all to 0, and unlike every other
+    fold32 consumer (joins/blooms re-check real keys) a sketch has no
+    equality recheck to absorb the collision."""
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        import jax
+
+        wide = col.astype(jnp.float64)
+        col = jax.lax.bitcast_convert_type(wide, jnp.int64)
+    f = fold32(col)
+    h1 = mix32(f + _GOLDEN32)
+    h2 = mix32(h1 ^ f ^ jnp.uint32(0x85EBCA6B))
+    return h1, h2
+
+
+def hll_registers(col: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """[N] values + live mask -> [M] int32 HLL register array."""
+    h1, h2 = _two_hashes(col)
+    bucket = (h1 & jnp.uint32(M - 1)).astype(jnp.int32)
+    # rank = leading zeros of h2 (as a 32-bit word) + 1; h2 == 0 -> 33.
+    # floor(log2) via float64 is exact for values < 2^32 (52-bit mantissa).
+    h2f = h2.astype(jnp.float64)
+    rank = jnp.where(
+        h2 == 0,
+        jnp.int32(33),
+        (jnp.int32(32) - jnp.floor(jnp.log2(jnp.maximum(h2f, 1.0))).astype(jnp.int32)),
+    )
+    packed = jnp.where(
+        mask, (bucket << _RANK_BITS) | rank, jnp.int32(-1)
+    )
+    sp = jnp.sort(packed)  # dead rows (-1) sort first
+    # register j = rank part of the largest packed value in j's bucket
+    buckets = jnp.arange(M, dtype=jnp.int32)
+    pos = jnp.searchsorted(sp, (buckets + 1) << _RANK_BITS, side="left") - 1
+    v = sp[jnp.clip(pos, 0, None)]
+    hit = (pos >= 0) & (v >= (buckets << _RANK_BITS)) & (v >= 0)
+    return jnp.where(hit, v & ((1 << _RANK_BITS) - 1), 0).astype(jnp.int32)
+
+
+def hll_estimate(regs: jnp.ndarray) -> jnp.ndarray:
+    """Register array -> int64 cardinality estimate (standard corrections:
+    linear counting below 2.5m with empty registers present)."""
+    m = regs.shape[0]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = jnp.sum(jnp.exp2(-regs.astype(jnp.float64)))
+    raw = alpha * m * m / inv
+    zeros = jnp.sum(regs == 0)
+    small = m * jnp.log(m / jnp.maximum(zeros, 1).astype(jnp.float64))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), small, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def hll_count(col: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """One-shot approx NDV of a masked column (scalar-aggregate path)."""
+    return hll_estimate(hll_registers(col, mask))
+
+
+def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Union of two sketches = elementwise register max — the merge form
+    for OVERLAPPING inputs (out-of-core chunk streaming, where chunk value
+    sets intersect). PX does NOT use this: it hash-colocates rows by the
+    argument first, so shards sketch DISJOINT sets and the int64 estimates
+    simply psum (parallel/px.py)."""
+    return jnp.maximum(a, b)

@@ -257,8 +257,9 @@ class PxExecutor(Executor):
                     est(op.right))
             if isinstance(op, Aggregate) and (
                 op.group_keys
-                # scalar DISTINCT aggs exchange by the distinct argument
-                or any(a[3] for a in op.aggs)
+                # scalar DISTINCT (and approx_ndv) aggs exchange by the
+                # distinct argument
+                or any(a[3] or a[1] == "approx_ndv" for a in op.aggs)
             ):
                 params.exchange_cap[_exch_id(nid, _AGG_CHILD)] = lane_cap(
                     est(op.child))
@@ -810,7 +811,10 @@ class PxExecutor(Executor):
         # Grouped: the generic hash-repartition on group keys below already
         # does that. Scalar: repartition on the (single) distinct argument,
         # then partials are disjoint and psum-merge correctly.
-        distinct_args = {a[2] for a in op.aggs if a[3]}
+        # approx_ndv joins the distinct-colocation set: once rows are
+        # hash-colocated by the argument, each shard sketches a DISJOINT
+        # value set and the estimates psum-merge (union of disjoint sets)
+        distinct_args = {a[2] for a in op.aggs if a[3] or a[1] == "approx_ndv"}
         if distinct_args and not op.group_keys:
             if len(distinct_args) == 1:
                 cap = params.exchange_cap[_exch_id(nid, _AGG_CHILD)]
@@ -840,7 +844,7 @@ class PxExecutor(Executor):
             merged = dict(out.cols)
             for name, fn, _arg, _d in op.aggs:
                 col = out.cols[name]
-                if fn in ("sum", "count"):
+                if fn in ("sum", "count", "approx_ndv"):
                     merged[name] = lax.psum(col, SHARD_AXIS)
                 elif fn == "min":
                     merged[name] = lax.pmin(col, SHARD_AXIS)

@@ -246,7 +246,10 @@ class Database:
         self._xa_registry: dict[str, dict] = {}
         self._xa_txids: dict[int, str] = {}
         # XA: externally-coordinated branches parked between PREPARE and
-        # the decision; value = (live _OpenTx | None-if-recovered, owner)
+        # the decision; value = (live _OpenTx | None-if-recovered | the
+        # _XA_PREPARING reservation, owner, registry-snapshot-or-None).
+        # The snapshot lets a RETRY of a failed decide finish cleanup even
+        # after the live registry entry popped.
         self._xa_prepared: dict[str, tuple] = {}
         self.unit = unit or TenantUnit()
         self._shared_cluster = cluster is not None
@@ -445,7 +448,14 @@ class Database:
         from ..tx.tablelock import LockMode as _LockMode
 
         for _xid, _e in self._xa_registry.items():
-            self._xa_prepared.setdefault(_xid, (None, _e["owner"]))
+            self._xa_prepared.setdefault(_xid, (None, _e["owner"], _e))
+            # the recovered branch keeps its pre-crash tx_id: the owning
+            # node's counter must never re-issue it (a collision would
+            # hand the branch's locks + re-staged rows to a stranger)
+            _svc = self.cluster.services.get(
+                _e["tx_id"] // 1_000_000_000)
+            if _svc is not None:
+                _svc.ensure_tx_id_above(_e["tx_id"])
             for _tab in _e["tablets"]:
                 try:
                     self.lock_mgr.lock(_e["tx_id"], _tab, _LockMode.ROW_X)
@@ -1290,6 +1300,11 @@ class Database:
         return DbSession(self, user=user)
 
 
+# reservation marker in _xa_prepared while a PREPARE is still logging:
+# blocks duplicate xids atomically without presenting as decidable
+_XA_PREPARING = object()
+
+
 class _OpenTx:
     """Client-side state of an open transaction."""
 
@@ -1782,10 +1797,12 @@ class DbSession:
             raise SqlError("bad XA syntax")
         verb = m.group(1).lower()
         if verb == "recover":
-            # owners see their branches; root sees everything
+            # owners see their branches; root sees everything; branches
+            # still mid-PREPARE are not yet recoverable
             xids = sorted(
-                x for x, (_tx, owner) in self.db._xa_prepared.items()
-                if self.user == "root" or owner == self.user
+                x for x, entry in self.db._xa_prepared.items()
+                if entry[0] is not _XA_PREPARING
+                and (self.user == "root" or entry[1] == self.user)
             )
             return ResultSet(("xid",), {"xid": xids})
         xid = next((g for g in m.groups()[1:] if g is not None), None)
@@ -1806,36 +1823,54 @@ class DbSession:
 
             if self._tx is None or getattr(self, "_xa_id", None) != xid:
                 raise SqlError(f"unknown xid {xid!r}", code=1397)
+            # RESERVE the xid before logging (one atomic check+insert): two
+            # concurrent prepares under the same xid must not both log —
+            # the loser's branch would park forever without a handle
             with self.db._ddl_lock:
                 if xid in self.db._xa_prepared:
                     raise SqlError(f"xid {xid!r} already prepared",
                                    code=1399)
+                self.db._xa_prepared[xid] = (_XA_PREPARING, self.user, None)
             tx = self._tx
-            try:
-                tx.svc.xa_prepare(tx.ctx, xid, self.user,
-                                  self.db.tenant_name)
-            except NotMaster as e:
-                self._tx = None
-                self._xa_id = None
-                raise SqlError(f"XA PREPARE failed: {e}", code=1399)
-            self.db.cluster.drive_until(
-                lambda: tx.ctx.state is not TxState.PREPARING)
-            if tx.ctx.state is not TxState.XA_PREPARED:
-                self._tx = None
-                self._xa_id = None
-                raise SqlError(
-                    f"XA PREPARE did not reach the log for {xid!r}",
-                    code=1399)
-            with self.db._ddl_lock:
-                self.db._xa_prepared[xid] = (tx, self.user)
             self._tx = None
             self._xa_id = None
+            try:
+                try:
+                    tx.svc.xa_prepare(tx.ctx, xid, self.user,
+                                      self.db.tenant_name)
+                except NotMaster as e:
+                    # xa_prepare already rolled the tx back locally (and
+                    # logged ABORT where a PREPARE reached the log): only
+                    # the server-side locks remain to release
+                    self._post_tx_cleanup(tx, committed_ok=False)
+                    raise SqlError(f"XA PREPARE failed: {e}", code=1399)
+                self.db.cluster.drive_until(
+                    lambda: tx.ctx.state is not TxState.PREPARING)
+                if tx.ctx.state is not TxState.XA_PREPARED:
+                    try:
+                        if not tx.ctx.is_done:
+                            tx.svc.abort(tx.ctx)
+                    except Exception:
+                        pass
+                    self._post_tx_cleanup(tx, committed_ok=False)
+                    raise SqlError(
+                        f"XA PREPARE did not reach the log for {xid!r}",
+                        code=1399)
+            except BaseException:
+                with self.db._ddl_lock:
+                    self.db._xa_prepared.pop(xid, None)
+                raise
+            with self.db._ddl_lock:
+                self.db._xa_prepared[xid] = (tx, self.user, None)
             return ResultSet((), {})
         if verb in ("commit", "rollback"):
             with self.db._ddl_lock:
                 hit = self.db._xa_prepared.get(xid)
                 if hit is not None:
-                    _tx, owner = hit
+                    _tx, owner = hit[0], hit[1]
+                    if _tx is _XA_PREPARING:
+                        raise SqlError(
+                            f"xid {xid!r} is being prepared", code=1399)
                     # the decide step is guarded: only the preparing
                     # user or root may finish a parked branch
                     if self.user != "root" and owner != self.user:
@@ -1846,12 +1881,21 @@ class DbSession:
                     del self.db._xa_prepared[xid]
             if hit is not None:
                 parked_tx = hit[0]
-                if parked_tx is not None:
-                    self._xa_finish_parked(parked_tx,
-                                           commit=(verb == "commit"))
-                else:
-                    self._xa_finish_recovered(xid,
-                                              commit=(verb == "commit"))
+                try:
+                    if parked_tx is not None:
+                        self._xa_finish_parked(parked_tx,
+                                               commit=(verb == "commit"))
+                    else:
+                        self._xa_finish_recovered(
+                            xid, hit[2], commit=(verb == "commit"))
+                except BaseException:
+                    # a FAILED decide must stay decidable: restore the
+                    # handle so a retry can re-drive the same decision
+                    # (locks stay held until it lands — see the gated
+                    # cleanup in the finish helpers)
+                    with self.db._ddl_lock:
+                        self.db._xa_prepared.setdefault(xid, hit)
+                    raise
                 return ResultSet((), {})
             # one-phase: this session's own un-prepared xid
             if self._tx is not None and \
@@ -1867,33 +1911,59 @@ class DbSession:
 
     def _xa_finish_parked(self, tx: "_OpenTx", commit: bool) -> None:
         """Decide a live parked (XA_PREPARED) branch: redo is already in
-        the log, so commit only logs the decision records."""
+        the log, so commit only logs the decision records. Locks release
+        ONLY once the decision lands (ctx.is_done) — releasing on a
+        timeout while COMMIT records sit undelivered would let a new
+        writer slip under the prepared rows (lost update). A timed-out
+        decide leaves the branch parked for retry (same decision)."""
         from ..tx.txn import TxState
 
         ctx = tx.ctx
         try:
             tx.svc.xa_decide(ctx, commit)
+        except RuntimeError as e:
+            raise SqlError(str(e), code=1399) from None
 
-            def done() -> bool:
-                tx.svc.retry_decisions(ctx)
-                return ctx.is_done
+        def done() -> bool:
+            tx.svc.retry_decisions(ctx)
+            return ctx.is_done
 
-            if not self.db.cluster.drive_until(done):
-                raise SqlError(f"XA decision for tx {ctx.tx_id} timed out")
-        finally:
+        ok = self.db.cluster.drive_until(done)
+        if ctx.is_done:
             committed_ok = commit and ctx.state is TxState.COMMITTED
             self._post_tx_cleanup(tx, committed_ok)
+        if not ok:
+            raise SqlError(f"XA decision for tx {ctx.tx_id} timed out")
 
-    def _xa_finish_recovered(self, xid: str, commit: bool) -> None:
+    def _xa_finish_recovered(self, xid: str, snapshot: dict | None,
+                             commit: bool) -> None:
         """Decide a branch recovered from log replay after a restart: no
         live ctx exists — submit the decision records straight to the
         participant leader replicas and wait for apply (which commits the
-        re-staged rows / replays pending redo)."""
+        re-staged rows / replays pending redo). `snapshot` is the handle's
+        registry snapshot: a retry after a failed decide can finish
+        cleanup from it even once the live registry entry has popped."""
         from ..tx.records import RecordType, TxRecord
 
-        e = self.db._xa_registry.get(xid)
+        e = self.db._xa_registry.get(xid) or snapshot
         if e is None:
             return  # decision already applied (e.g. raced another session)
+        if xid not in self.db._xa_registry:
+            # decision applied between the failed attempt and this retry:
+            # only the epilogue remains
+            self.db.lock_mgr.release_all(e["tx_id"])
+            if commit:
+                self._xa_bump_versions(e)
+            return
+        want = "commit" if commit else "rollback"
+        prior = e.get("decision")
+        if prior is not None and prior != want:
+            # records of the FIRST decision may already sit in participant
+            # logs; reversing would split the branch across directions
+            raise SqlError(
+                f"xid {xid!r} already deciding {prior}; retry that",
+                code=1399)
+        e["decision"] = want
         tx_id, parts = e["tx_id"], tuple(e["parts"])
         version = self.db.cluster.gts.next_ts() if commit else 0
         rtype = RecordType.COMMIT if commit else RecordType.ABORT
@@ -1910,18 +1980,31 @@ class DbSession:
             if not self.db.cluster.drive_until(try_submit):
                 raise SqlError(
                     f"no ready leader for ls {ls} to decide xid {xid!r}")
-        if not self.db.cluster.drive_until(
-                lambda: xid not in self.db._xa_registry):
+
+        def all_applied() -> bool:
+            # the branch is decided only when the decision has applied on
+            # EVERY participant replica (registry pop happens at the FIRST
+            # apply — returning then would expose a torn multi-LS branch)
+            for ls in parts:
+                for rep in (self.db.cluster.ls_groups.get(ls) or {}).values():
+                    if tx_id in rep.tx_table:
+                        return False
+            return xid not in self.db._xa_registry
+
+        if not self.db.cluster.drive_until(all_applied):
             raise SqlError(f"XA decision for xid {xid!r} did not apply")
         self.db.lock_mgr.release_all(tx_id)
         if commit:
-            by_tab = {ti.tablet_id: ti for ti in self.db.tables.values()}
-            for tab in e["tablets"]:
-                ti = by_tab.get(tab)
-                if ti is not None:
-                    ti.data_version += 1
-                    ti.cached_data_version = -1
-            self.db.run_maintenance()
+            self._xa_bump_versions(e)
+
+    def _xa_bump_versions(self, e: dict) -> None:
+        by_tab = {ti.tablet_id: ti for ti in self.db.tables.values()}
+        for tab in e["tablets"]:
+            ti = by_tab.get(tab)
+            if ti is not None:
+                ti.data_version += 1
+                ti.cached_data_version = -1
+        self.db.run_maintenance()
 
     # -------------------------------------------------- stored procedures
     def _create_procedure(self, text: str) -> ResultSet:

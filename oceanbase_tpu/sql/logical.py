@@ -546,18 +546,18 @@ class Resolver:
     def _agg_call(self, node: A.FuncCall) -> E.Expr:
         fn = node.name
         if fn == "approx_count_distinct":
-            # the engine's scatter-free distinct-count is exact at full
-            # speed (first-occurrence masks, ops/hashagg.py), and exact
-            # trivially satisfies the approximate contract — so the
-            # reference's NDV sketch (ob_expr_approx_count_distinct)
-            # maps to COUNT(DISTINCT) rather than a lossy HLL
+            # the reference's NDV sketch (ob_expr_approx_count_distinct):
+            # the executor runs a true fixed-memory HLL (ops/hll.py) on the
+            # scalar path, and falls back to the exact first-occurrence
+            # distinct count under GROUP BY (group cardinalities are
+            # bounded by the group's row count there)
             if len(node.args) != 1:
                 raise ResolveError(
                     "approx_count_distinct takes exactly one argument "
                     "(multi-column NDV is not supported)"
                 )
             arg = self.expr(node.args[0])
-            return E.ColRef(self._add_agg("count", arg, True))
+            return E.ColRef(self._add_agg("approx_ndv", arg, False))
         if fn == "count" and (not node.args or isinstance(node.args[0], A.Star)):
             arg = None
         else:

@@ -1156,7 +1156,7 @@ def _select_has_agg(sel: A.Select) -> bool:
         if isinstance(n, A.InOp) and n.subquery is not None:
             return False
         if isinstance(n, A.FuncCall) and n.name in (
-            "sum", "count", "min", "max", "avg",
+            "sum", "count", "min", "max", "avg", "approx_count_distinct",
         ):
             return True
         for attr in getattr(n, "__dataclass_fields__", {}):

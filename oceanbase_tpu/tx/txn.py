@@ -64,6 +64,10 @@ class TxContext:
     # XA participant set: fixed at xa_prepare (includes the home LS when the
     # branch has no writes, so even an empty branch leaves a durable record)
     xa_parts: tuple = ()
+    # the external decision once taken ("commit"/"rollback"): a retry after
+    # a transient submit failure must re-drive the SAME decision, never
+    # reverse one whose records may already be in a participant log
+    xa_decision: str | None = None
     _prepared: set[int] = field(default_factory=set)
     _committed_ls: set[int] = field(default_factory=set)
     # COMMIT decisions whose submit was rejected (transient non-leader
@@ -221,25 +225,38 @@ class TransService:
         """External-coordinator decision for a parked (XA_PREPARED) branch.
         Commit logs COMMIT records with a fresh GTS version; replicas that
         staged the rows commit them, replicas (or a restarted node) holding
-        only pending redo replay it."""
+        only pending redo replay it. Either decision record rides the
+        _undelivered/retry_decisions channel through transient non-leader
+        windows — a dropped ABORT would leave the branch undecided in the
+        log and resurrectable after a restart. Idempotent under retry of
+        the SAME decision; reversing an in-flight decision is refused."""
+        if ctx.state is TxState.COMMITTING and ctx.xa_decision is not None:
+            if (ctx.xa_decision == "commit") != commit:
+                raise RuntimeError(
+                    f"tx {ctx.tx_id} already deciding "
+                    f"{ctx.xa_decision}; cannot reverse")
+            return  # retry: caller re-drives retry_decisions
         if ctx.state is not TxState.XA_PREPARED:
             raise RuntimeError(f"tx {ctx.tx_id} is {ctx.state.value}")
+        ctx.xa_decision = "commit" if commit else "rollback"
+        ctx.commit_version = self.gts.next_ts() if commit else 0
+        ctx.state = TxState.COMMITTING  # decision (either way) in flight
         if not commit:
             for ls in ctx.mutations:
                 self.replicas[ls].abort_locally(ctx.tx_id)
-            for ls in ctx.xa_parts:
-                self.replicas[ls].submit_record(
-                    TxRecord(RecordType.ABORT, ctx.tx_id))
-            ctx.state = TxState.ABORTED
-            self._finish(ctx)
-            return
-        ctx.commit_version = self.gts.next_ts()
-        ctx.state = TxState.COMMITTING
+        rtype = RecordType.COMMIT if commit else RecordType.ABORT
         for ls in ctx.xa_parts:
-            rec = TxRecord(RecordType.COMMIT, ctx.tx_id, (),
-                           ctx.commit_version)
+            rec = TxRecord(rtype, ctx.tx_id, (), ctx.commit_version)
             if self.replicas[ls].submit_record(rec) is None:
                 ctx._undelivered[ls] = rec
+
+    def ensure_tx_id_above(self, floor: int) -> None:
+        """Restart recovery: a recovered (still-undecided) XA branch keeps
+        its pre-crash tx_id; the fresh counter must never re-issue it —
+        a collision would let an unrelated new transaction adopt the
+        branch's locks and re-staged rows."""
+        nxt = next(self._tx_ids)
+        self._tx_ids = itertools.count(max(nxt, floor + 1))
 
     def abort(self, ctx: TxContext) -> None:
         """Client-driven abort. Refused once the decision is in flight: a tx
@@ -313,8 +330,17 @@ class TransService:
                 ctx.state = TxState.COMMITTED
                 self._finish(ctx)
         elif rtype is RecordType.ABORT:
-            ctx.state = TxState.ABORTED
-            self._finish(ctx)
+            if ctx.xa_parts and ctx.state is TxState.COMMITTING:
+                # XA rollback decision: like commit, it is final only when
+                # the ABORT record has applied on EVERY participant (the
+                # caller's drive loop retries undelivered submissions)
+                ctx._committed_ls.add(ls_id)
+                if ctx._committed_ls >= set(ctx.xa_parts):
+                    ctx.state = TxState.ABORTED
+                    self._finish(ctx)
+            else:
+                ctx.state = TxState.ABORTED
+                self._finish(ctx)
 
     def _finish(self, ctx: TxContext) -> None:
         with self._lock:

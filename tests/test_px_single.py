@@ -41,3 +41,21 @@ def test_px_matches_single_chip(env, qid):
         env["px"].execute(planned.plan), planned.output_names)
     assert got == want
     assert len(got) > 0
+
+
+def test_px_scalar_approx_ndv(env):
+    """Scalar approx_count_distinct under PX: rows colocate by the
+    argument, per-shard HLL sketches of disjoint value sets psum-merge."""
+    sql = "select approx_count_distinct(l_partkey) as n from lineitem"
+    planned = env["planner"].plan(parse(sql))
+    single = batch_rows_normalized(
+        env["single"].execute(planned.plan), planned.output_names)
+    px = batch_rows_normalized(
+        env["px"].execute(planned.plan), planned.output_names)
+    import numpy as np
+
+    exact = len(np.unique(np.asarray(
+        env["tables"]["lineitem"].data["l_partkey"])))
+    for got in (single, px):
+        (n,) = got[0]
+        assert abs(int(n) - exact) / max(exact, 1) < 0.05
