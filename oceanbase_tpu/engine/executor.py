@@ -2684,11 +2684,22 @@ class Executor:
             )
         child, ovf = emit(op.child, inputs)
         key_vals = []
+        key_valids = []
         domains = []
         for _, e in op.group_keys:
-            v, _ = evaluate(e, child)
+            v, vv = evaluate(e, child)
+            if vv is None and isinstance(e, E.ColRef):
+                vv = child.valid.get(e.name)
+            if vv is not None:
+                # SQL: NULLs form ONE group — canonicalize the value under
+                # invalidity (it is arbitrary there) and key on (value,
+                # validity) so NULL cannot merge with a genuine 0/""-coded
+                # row (review: json_extract NULLs vs real empty strings)
+                v = jnp.where(vv, v, jnp.zeros_like(v))
             key_vals.append(v)
+            key_valids.append(vv)
             domains.append(_dict_domain(child, e))
+        n_nullable = sum(1 for vv in key_valids if vv is not None)
 
         # per-aggregate (op, values, effective row mask): count(col)/sum/min/
         # max skip NULL inputs via the argument's validity mask (SQL null
@@ -2705,30 +2716,44 @@ class Executor:
                 if distinct and fn in ("count", "sum", "avg"):
                     # DISTINCT: restrict the agg's mask to the first live
                     # occurrence of each (group keys, value); min/max are
-                    # distinct-invariant and skip the extra sort
+                    # distinct-invariant and skip the extra sort. Validity
+                    # planes join the dedup key — the NULL group must not
+                    # share first-occurrences with the canonical-0 group
                     from ..ops.hashagg import distinct_first_mask
 
-                    am = am & distinct_first_mask(key_vals, v, am)
+                    dk = key_vals + [
+                        kv.astype(jnp.int32)
+                        for kv in key_valids if kv is not None
+                    ]
+                    am = am & distinct_first_mask(dk, v, am)
                 agg_ops.append(fn)
                 agg_vals.append(None if fn == "count" else v)
                 agg_masks.append(am)
 
         out_schema = _agg_schema(op, child.schema)
 
+        out_valid = {}
         if (
             op.group_keys
             and all(d is not None for d in domains)
-            and int(np.prod([d for d in domains])) <= DIRECT_GROUPBY_MAX_DOMAIN
+            and int(np.prod([d for d in domains])) * (2 ** n_nullable)
+            <= DIRECT_GROUPBY_MAX_DOMAIN
         ):
-            # direct path: one fused masked reduction per (slot, aggregate)
-            packed, domain = pack_keys(key_vals, domains)
+            # direct path: one fused masked reduction per (slot, aggregate);
+            # nullable keys contribute a domain-2 validity plane
+            pk_vals, pk_doms = list(key_vals), list(domains)
+            for vv in key_valids:
+                if vv is not None:
+                    pk_vals.append(vv.astype(jnp.int64))
+                    pk_doms.append(2)
+            packed, domain = pack_keys(pk_vals, pk_doms)
             slot_is = [packed == g for g in range(domain)]
             live = jnp.stack([
                 jnp.sum(child.sel & g_, dtype=jnp.int64) for g_ in slot_is
             ])
             slot_used = live > 0
             # unpack keys from slot index
-            bits = [max(1, int(d - 1).bit_length()) for d in domains]
+            bits = [max(1, int(d - 1).bit_length()) for d in pk_doms]
             slots = jnp.arange(domain, dtype=jnp.int64)
             cols = {}
             shift = 0
@@ -2738,6 +2763,11 @@ class Executor:
                     t.storage_np
                 )
                 shift += b
+            for (name, _e), vv in zip(op.group_keys, key_valids):
+                if vv is not None:
+                    # each validity plane is exactly one bit, in key order
+                    out_valid[name] = ((slots >> shift) & 1) == 1
+                    shift += 1
             for (name, _, _, _), aop, av, am in zip(
                 op.aggs, agg_ops, agg_vals, agg_masks
             ):
@@ -2749,6 +2779,11 @@ class Executor:
                 params.pack_guard.get(nid)
                 if nid not in params.groupby_nopack else None
             )
+            if n_nullable:
+                # validity planes don't fit the static pack spec: take the
+                # multi-operand sort path (nullable keys are rare and never
+                # the TPC-H hot group-bys)
+                pack_spec = None
             if pack_spec is not None:
                 # pack all keys into ONE int64 sort key (static bits from
                 # stats/dict domains); a validity counter rides the
@@ -2778,12 +2813,22 @@ class Executor:
                     cols[name] = (part + vmin).astype(v.dtype)
                     shift += bits
             else:
+                vplanes = [
+                    vv.astype(jnp.int32) for vv in key_valids
+                    if vv is not None
+                ]
                 skeys, sel, agg_cols, order = sort_groupby(
-                    key_vals, child.sel, agg_ops, agg_vals, agg_masks
+                    key_vals + vplanes, child.sel, agg_ops, agg_vals,
+                    agg_masks
                 )
                 cols = {}
                 for (name, _e), kv in zip(op.group_keys, skeys):
                     cols[name] = kv
+                vi = len(op.group_keys)
+                for (name, _e), vv in zip(op.group_keys, key_valids):
+                    if vv is not None:
+                        out_valid[name] = skeys[vi].astype(jnp.bool_)
+                        vi += 1
             for (name, _, _, _), av in zip(op.aggs, agg_cols):
                 cols[name] = av
         else:
@@ -2792,7 +2837,6 @@ class Executor:
             from ..ops.hashagg import scalar_aggregate
 
             cols = {}
-            out_valid = {}
             for (name, _, _, _), aop, av, am in zip(
                 op.aggs, agg_ops, agg_vals, agg_masks
             ):
@@ -2808,7 +2852,7 @@ class Executor:
                 dicts[name] = child.dicts[e.name]
         out = ColumnBatch(
             cols=cols,
-            valid=(out_valid if not op.group_keys else {}),
+            valid=out_valid,
             sel=sel,
             nrows=jnp.sum(sel, dtype=jnp.int64),
             schema=out_schema,

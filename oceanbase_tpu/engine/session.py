@@ -51,13 +51,13 @@ class ResultSet:
 class Session:
     def __init__(self, catalog: dict[str, Table], unique_keys=None,
                  plan_cache: PlanCache | None = None, key_extra_fn=None,
-                 cache_enabled_fn=None, plan_monitor=None):
+                 cache_enabled_fn=None, plan_monitor=None, views=None):
         self.catalog = catalog
         from ..share.stats import StatsManager
 
         self.stats = StatsManager(catalog)
         self.planner = Planner(
-            catalog, stats=self.stats, unique_keys=unique_keys
+            catalog, stats=self.stats, unique_keys=unique_keys, views=views
         )
         self.executor = Executor(
             catalog, unique_keys=unique_keys, stats=self.stats
@@ -169,6 +169,21 @@ class Session:
                 out_batch, names = run_recursive(self, ast)
                 host = batch_to_host(out_batch)
                 return ResultSet(tuple(names), {n: host[n] for n in names})
+        # JSON_OBJECT/JSON_ARRAY select items: device executes the argument
+        # columns, host formats the JSON text at result assembly
+        # (sql/json_host.py); the spec joins the cache key — same
+        # normalized text with different constructor literals must not
+        # share an entry
+        from ..sql.json_host import apply_host_json, split_host_json
+
+        try:
+            ast, jspecs, jhidden = split_host_json(ast)
+        except ValueError as err:
+            from ..sql.logical import ResolveError
+
+            raise ResolveError(str(err)) from None
+        if jspecs:
+            norm_key = f"{norm_key}|jh:{jspecs!r}"
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
         key = self._cache_key(norm_key, pz)
@@ -181,6 +196,7 @@ class Session:
             prepared = self.executor.prepare(pz.plan)
             compile_s = time.perf_counter() - t0
             entry = CacheEntry(prepared, planned.output_names, pz.dtypes)
+            entry.json_specs, entry.json_hidden = jspecs, jhidden
             if self.plan_monitor is not None and self.plan_monitor.enabled:
                 entry.monitor = self.plan_monitor.register(norm_key, compile_s)
             if use_cache:
@@ -192,7 +208,12 @@ class Session:
         host = batch_to_host(out_batch)
         # order columns per select list
         cols = {n: host[n] for n in entry.output_names}
-        rs = ResultSet(entry.output_names, cols, plan_cache_hit=was_hit)
+        out_names = entry.output_names
+        jn = getattr(entry, "json_specs", ())
+        if jn:
+            out_names, cols = apply_host_json(
+                jn, entry.json_hidden, out_names, cols)
+        rs = ResultSet(out_names, cols, plan_cache_hit=was_hit)
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1

@@ -165,8 +165,14 @@ def infer_type(e: Expr, schema: Schema) -> DataType:
             return DataType.float32()
         if e.name in ("extract_year", "extract_month", "extract_day"):
             return DataType.int32()
-        if e.name in ("like", "prefix", "contains", "fts_match"):
+        if e.name in ("like", "prefix", "contains", "fts_match",
+                      "json_valid"):
             return BOOL
+        if e.name in ("json_extract", "json_unquote", "json_type"):
+            # path misses / invalid docs yield SQL NULL
+            return DataType.varchar(nullable=True)
+        if e.name == "json_array_length":
+            return DataType.int64(nullable=True)
         if e.name in ("abs", "neg"):
             return infer_type(e.args[0], schema)
         if e.name in ("least", "greatest"):
@@ -309,7 +315,17 @@ def evaluate(e: Expr, batch: ColumnBatch):
         return ~v, valid
 
     if isinstance(e, IsNull):
-        _, valid = evaluate(e.arg, batch)
+        # string-view exprs (json_*/substr) carry NULLness in their view,
+        # not in a device validity channel: fold it here
+        view = (
+            _string_view(e.arg, batch)
+            if isinstance(e.arg, Func) else None
+        )
+        if view is not None:
+            codes, valid, vals = view
+            valid = _fold_view_nulls(codes, valid, vals)
+        else:
+            _, valid = evaluate(e.arg, batch)
         if valid is None:
             out = jnp.zeros(batch.capacity, dtype=jnp.bool_)
         else:
@@ -474,8 +490,12 @@ def _eval_compare(e: Compare, batch: ColumnBatch):
             view = _string_view(e.left, batch)
             if view is not None:
                 codes, valid, vals = view
+                valid = _fold_view_nulls(codes, valid, vals)
                 lut = np.fromiter(
-                    (_CMP[e.op](v, e.right.value) for v in vals),
+                    (
+                        False if v is None else _CMP[e.op](v, e.right.value)
+                        for v in vals
+                    ),
                     dtype=np.bool_, count=len(vals),
                 )
                 n = max(len(vals) - 1, 0)
@@ -535,6 +555,45 @@ def _dict_compare(col_expr: ColRef, op: str, value: str, batch: ColumnBatch):
 def _eval_cast(e: Cast, batch: ColumnBatch):
     src_t = infer_type(e.arg, batch.schema)
     dst = e.dtype
+    if src_t.kind is TypeKind.VARCHAR and dst.kind is not TypeKind.VARCHAR:
+        # string -> number through the dictionary: parse each DISTINCT
+        # value once into a numeric LUT (unparseable -> SQL NULL); this is
+        # what makes predicates on extracted JSON scalars pushable —
+        # CAST(j->>'$.price' AS decimal) compiles to one gather + compare
+        view = _string_view(e.arg, batch)
+        if view is None:
+            raise NotImplementedError(
+                f"CAST from varchar requires a dictionary view: {e.arg}")
+        codes, valid, vals = view
+
+        def parse(v):
+            if v is None:
+                return None
+            try:
+                return float(v)
+            except ValueError:
+                return None
+
+        nums = [parse(v) for v in vals]
+        nn = np.fromiter(
+            (x is not None for x in nums), dtype=np.bool_,
+            count=len(nums),
+        )
+        fl = np.fromiter(
+            (0.0 if x is None else x for x in nums), dtype=np.float64,
+            count=len(nums),
+        )
+        n = max(len(vals) - 1, 0)
+        cl = jnp.clip(codes, 0, n)
+        fv = jnp.asarray(fl)[cl]
+        valid = _merge_valid(valid, jnp.asarray(nn)[cl])
+        if dst.is_decimal:
+            out = jnp.round(fv * dst.decimal_factor).astype(dst.storage_np)
+        elif dst.is_integer:
+            out = jnp.round(fv).astype(dst.storage_np)
+        else:
+            out = fv.astype(dst.storage_np)
+        return out, valid
     v, valid = evaluate(e.arg, batch)
     if src_t.is_decimal and dst.is_decimal:
         return _rescale_decimal(v, src_t.scale, dst.scale).astype(dst.storage_np), valid
@@ -576,9 +635,11 @@ def _eval_in_list(e: InList, batch: ColumnBatch):
         if view is None:
             raise NotImplementedError(f"IN over varchar expr {e.arg}")
         codes, valid, vals = view
+        valid = _fold_view_nulls(codes, valid, vals)
         members = set(e.values)
         lut = np.fromiter(
-            (v in members for v in vals), dtype=np.bool_, count=len(vals)
+            (v is not None and v in members for v in vals),
+            dtype=np.bool_, count=len(vals),
         )
         out = jnp.asarray(lut)[jnp.clip(codes, 0, max(len(vals) - 1, 0))]
         return (~out if e.negated else out), valid
@@ -634,11 +695,54 @@ def _string_view(e: Expr, batch: ColumnBatch):
         s0 = int(e.args[1].value) - 1  # SQL is 1-based
         length = int(e.args[2].value)
         if length >= 0:
-            vals2 = [v[s0 : s0 + length] for v in vals]
+            vals2 = [None if v is None else v[s0 : s0 + length] for v in vals]
         else:
-            vals2 = [v[s0:] for v in vals]
+            vals2 = [None if v is None else v[s0:] for v in vals]
+        return codes, valid, vals2
+    if isinstance(e, Func) and e.name in (
+        "json_extract", "json_unquote", "json_type"
+    ):
+        # JSON transforms compose through the view like substr: evaluated
+        # once per DISTINCT document, rows map by code; a None in vals is
+        # SQL NULL and is folded into `valid` by _fold_view_nulls at the
+        # consumer boundary (ob_expr_json_extract.cpp evaluates per row —
+        # the columnar LUT is the redesign)
+        from .jsonpath import (
+            extract_repr,
+            json_type_of,
+            parse_path,
+            unquote,
+        )
+
+        base = _string_view(e.args[0], batch)
+        if base is None:
+            return None
+        codes, valid, vals = base
+        if e.name == "json_extract":
+            if not isinstance(e.args[1], Literal):
+                return None
+            steps = parse_path(str(e.args[1].value))
+            vals2 = [
+                None if v is None else extract_repr(v, steps) for v in vals
+            ]
+        elif e.name == "json_unquote":
+            vals2 = [unquote(v) for v in vals]
+        else:
+            vals2 = [json_type_of(v) for v in vals]
         return codes, valid, vals2
     return None
+
+
+def _fold_view_nulls(codes, valid, vals):
+    """NULL results in a string view (None entries) become row-level
+    invalidity; remaining values are safe to feed LUT builders."""
+    if any(v is None for v in vals):
+        nn = np.fromiter(
+            (v is not None for v in vals), dtype=np.bool_, count=len(vals)
+        )
+        notnull = jnp.asarray(nn)[jnp.clip(codes, 0, max(len(vals) - 1, 0))]
+        valid = _merge_valid(valid, notnull)
+    return valid
 
 
 def derive_dict_column(e: Expr, batch: ColumnBatch):
@@ -647,13 +751,17 @@ def derive_dict_column(e: Expr, batch: ColumnBatch):
     (group-by, joins, output decode) see an ordinary dict column."""
     from ..core.dictionary import Dictionary
 
-    if not (isinstance(e, Func) and e.name == "substr"):
+    if not (isinstance(e, Func) and e.name in (
+        "substr", "json_extract", "json_unquote", "json_type"
+    )):
         return None
     view = _string_view(e, batch)
     if view is None:
         return None
     codes, valid, vals = view
-    d2, mapping = Dictionary.from_strings_bulk(np.asarray(vals, dtype=str))
+    valid = _fold_view_nulls(codes, valid, vals)
+    safe = ["" if v is None else v for v in vals]  # NULL rows are invalid
+    d2, mapping = Dictionary.from_strings_bulk(np.asarray(safe, dtype=str))
     lut = jnp.asarray(mapping.astype(np.int32))
     n = max(len(vals) - 1, 0)
     return lut[jnp.clip(codes, 0, n)], valid, d2
@@ -697,6 +805,44 @@ def _eval_func(e: Func, batch: ColumnBatch):
         )
         codes, valid = evaluate(col_expr, batch)
         return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(d) - 1, 0))], valid
+
+    if e.name == "json_valid":
+        view = _string_view(e.args[0], batch)
+        if view is None:
+            raise NotImplementedError("json_valid needs a dictionary view")
+        from .jsonpath import is_valid
+
+        codes, valid, vals = view
+        lut = np.fromiter(
+            (v is not None and is_valid(v) for v in vals),
+            dtype=np.bool_, count=len(vals),
+        )
+        return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(vals) - 1, 0))], valid
+
+    if e.name == "json_array_length":
+        from .jsonpath import array_length, parse_path
+
+        view = _string_view(e.args[0], batch)
+        if view is None:
+            raise NotImplementedError(
+                "json_array_length needs a dictionary view")
+        codes, valid, vals = view
+        steps = (
+            parse_path(str(e.args[1].value)) if len(e.args) > 1 else ()
+        )
+        lens = [None if v is None else array_length(v, steps) for v in vals]
+        valid = _fold_view_nulls(codes, valid, lens)
+        lut = np.fromiter(
+            (0 if x is None else x for x in lens), dtype=np.int64,
+            count=len(lens),
+        )
+        return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(vals) - 1, 0))], valid
+
+    if e.name in ("json_extract", "json_unquote", "json_type"):
+        # value context without a dictionary sink (e.g. a join key):
+        # unreachable from projections (derive_dict_column handles those)
+        raise NotImplementedError(
+            f"{e.name} used where a dictionary column cannot form")
 
     if e.name in ("prefix", "contains"):
         col_expr, pat = e.args

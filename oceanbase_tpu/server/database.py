@@ -320,6 +320,19 @@ class Database:
         self._mview_specs: dict[str, str] = (
             restored_meta.get("mview_specs", {}) if restored_meta else {}
         )
+        # PLAIN views: name -> defining SELECT text; nothing materializes
+        # — the planner expands (and where possible MERGES) the body at
+        # plan time (sql/planner.py _merge_view). The dict is shared with
+        # the planner by reference, so DDL changes apply immediately.
+        self._view_specs: dict[str, str] = (
+            restored_meta.get("view_specs", {}) if restored_meta else {}
+        )
+        # row triggers: name -> {timing, event, table, body}; parsed form
+        # cached lazily per process (sql/trigger.py)
+        self._trigger_specs: dict[str, dict] = (
+            restored_meta.get("trigger_specs", {}) if restored_meta else {}
+        )
+        self._trigger_parsed: dict[str, tuple] = {}
         # stored procedures: name -> definition text (sql/pl.py); parsed
         # lazily per process, persisted in node meta like schema
         self._procedure_texts: dict[str, str] = (
@@ -487,6 +500,7 @@ class Database:
             key_extra_fn=self._key_extra,
             cache_enabled_fn=lambda: self.config["ob_enable_plan_cache"],
             plan_monitor=self.plan_monitor,
+            views=self._view_specs,
         )
         self._ddl_lock = threading.RLock()
         # re-materialize restored mviews against the recovered base data
@@ -572,6 +586,8 @@ class Database:
             "vector_specs": dict(self._vector_specs),
             "external_specs": dict(self._external_specs),
             "mview_specs": dict(self._mview_specs),
+            "view_specs": dict(self._view_specs),
+            "trigger_specs": dict(self._trigger_specs),
             "procedures": dict(self._procedure_texts),
             "sequences": {k: dict(v) for k, v in self._sequences.items()},
             # undecided XA branches: belt-and-braces alongside log replay
@@ -876,6 +892,107 @@ class Database:
                 sq["reserved"] = sq["next"] + inc * self.SEQ_CACHE
                 self._save_node_meta()
             return v
+
+    # --------------------------------------------------------- plain views
+    def create_view(self, st: "A.CreateView") -> None:
+        """CREATE [OR REPLACE] VIEW (ob_create_view_resolver.h analog):
+        only the definition text persists; expansion/merge happens at plan
+        time through the planner's shared view dict."""
+        from ..sql import parser as P2
+
+        with self._ddl_lock:
+            if st.name in self.tables or st.name in self._mview_specs or \
+                    st.name in self._external_specs:
+                raise SqlError(f"object {st.name} already exists")
+            if st.name in self._view_specs and not st.or_replace:
+                raise SqlError(f"view {st.name} already exists")
+            body = P2.parse(st.query_sql)
+            if not isinstance(body, (A.Select, A.SetSelect)):
+                raise SqlError("CREATE VIEW body must be a SELECT")
+            # validate references NOW (MySQL checks at create): every
+            # referenced name must be a table, view, or mview
+            for n in self.expand_views(_tables_in_ast(body)):
+                if n not in self.tables and n not in self._mview_specs \
+                        and n not in self.catalog:
+                    raise SqlError(f"view references unknown table {n}")
+            self._view_specs[st.name] = st.query_sql
+            self._save_node_meta()
+
+    def drop_view(self, name: str) -> None:
+        with self._ddl_lock:
+            if self._view_specs.pop(name, None) is None:
+                raise SqlError(f"no view {name}")
+            self._save_node_meta()
+
+    def expand_views(self, names: set) -> set:
+        """Map a statement's referenced names through view definitions to
+        the BASE tables that must be fresh in the analytic catalog."""
+        from ..sql import parser as P2
+
+        out: set = set()
+        stack, seen = list(names), set()
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            spec = self._view_specs.get(n)
+            if spec is None:
+                out.add(n)
+                continue
+            try:
+                stack.extend(_tables_in_ast(P2.parse(spec)))
+            except SyntaxError:
+                pass
+        return out
+
+    # ------------------------------------------------------------ triggers
+    def create_trigger(self, st: "A.CreateTrigger") -> None:
+        from ..sql.trigger import TriggerError, parse_body
+
+        with self._ddl_lock:
+            if st.name in self._trigger_specs:
+                raise SqlError(f"trigger {st.name} already exists")
+            if st.table not in self.tables:
+                raise SqlError(f"no such table {st.table}")
+            try:
+                acts = parse_body(st.body_sql)
+            except (TriggerError, SyntaxError) as e:
+                raise SqlError(f"bad trigger body: {e}") from None
+            if st.timing == "after" and any(a[0] == "setnew" for a in acts):
+                raise SqlError("SET NEW.x is only valid in BEFORE triggers")
+            if st.event == "delete" and any(a[0] == "setnew" for a in acts):
+                raise SqlError("DELETE triggers have no NEW row")
+            self._trigger_specs[st.name] = {
+                "timing": st.timing, "event": st.event,
+                "table": st.table, "body": st.body_sql,
+            }
+            self._trigger_parsed[st.name] = acts
+            self._save_node_meta()
+
+    def drop_trigger(self, name: str) -> None:
+        with self._ddl_lock:
+            if self._trigger_specs.pop(name, None) is None:
+                raise SqlError(f"no trigger {name}")
+            self._trigger_parsed.pop(name, None)
+            self._save_node_meta()
+
+    def triggers_for(self, table: str, event: str, timing: str) -> list:
+        """Parsed bodies of matching triggers, in name order (the firing
+        order contract)."""
+        from ..sql.trigger import parse_body
+
+        out = []
+        for name in sorted(self._trigger_specs):
+            spec = self._trigger_specs[name]
+            if spec["table"] != table or spec["event"] != event or \
+                    spec["timing"] != timing:
+                continue
+            acts = self._trigger_parsed.get(name)
+            if acts is None:
+                acts = self._trigger_parsed[name] = parse_body(spec["body"])
+            out.append((name, acts))
+        return out
 
     # -------------------------------------------------- materialized views
     def create_mview(self, st: A.CreateMaterializedView) -> None:
@@ -1472,10 +1589,19 @@ class DbSession:
                 pm.check(self.user,
                          "update" if stmt.exclusive else "select",
                          {stmt.name})
-            elif isinstance(stmt, A.CreateMaterializedView):
+            elif isinstance(stmt, (A.CreateMaterializedView, A.CreateView)):
                 pm.check(self.user, "create", {stmt.name})
                 pm.check(self.user, "select", self._referenced_tables(
                     P.parse(stmt.query_sql)))
+            elif isinstance(stmt, A.DropView):
+                pm.check(self.user, "drop", {stmt.name})
+            elif isinstance(stmt, A.CreateTrigger):
+                # trigger bodies run with the firing statement's rights;
+                # creating one therefore needs write-shaping power over
+                # the subject table
+                pm.check(self.user, "create", {stmt.table})
+            elif isinstance(stmt, A.DropTrigger):
+                pm.check(self.user, "drop", {stmt.name})
             elif isinstance(stmt, A.RefreshMaterializedView):
                 pm.check(self.user, "create", {stmt.name})
                 spec = self.db._mview_specs.get(stmt.name)
@@ -1679,6 +1805,18 @@ class DbSession:
         if isinstance(stmt, A.CreateExternalTable):
             self.db.create_external_table(stmt)
             return ResultSet((), {})
+        if isinstance(stmt, A.CreateView):
+            self.db.create_view(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.CreateTrigger):
+            self.db.create_trigger(stmt)
+            return ResultSet((), {})
+        if isinstance(stmt, A.DropTrigger):
+            self.db.drop_trigger(stmt.name)
+            return ResultSet((), {})
+        if isinstance(stmt, A.DropView):
+            self.db.drop_view(stmt.name)
+            return ResultSet((), {})
         if isinstance(stmt, A.CreateMaterializedView):
             self.db.create_mview(stmt)
             return ResultSet((), {})
@@ -1739,7 +1877,7 @@ class DbSession:
 
         ast = P.parse(text)
         self._check_privs(ast)
-        names = _tables_in_ast(ast)
+        names = self.db.expand_views(_tables_in_ast(ast))
         any_vt = self.db.refresh_virtual(names)
         self.db.refresh_catalog(names, tx=self._tx)
         in_tx = self._tx is not None and self._tx.ctx is not None
@@ -1948,43 +2086,43 @@ class DbSession:
         e = self.db._xa_registry.get(xid) or snapshot
         if e is None:
             return  # decision already applied (e.g. raced another session)
-        if xid not in self.db._xa_registry:
-            # decision applied between the failed attempt and this retry:
-            # only the epilogue remains
-            self.db.lock_mgr.release_all(e["tx_id"])
-            if commit:
-                self._xa_bump_versions(e)
-            return
         want = "commit" if commit else "rollback"
         prior = e.get("decision")
         if prior is not None and prior != want:
             # records of the FIRST decision may already sit in participant
-            # logs; reversing would split the branch across directions
+            # logs; reversing would split the branch across directions —
+            # this guard holds on RETRIES too (the registry entry may have
+            # popped, but the handle snapshot remembers the direction)
             raise SqlError(
                 f"xid {xid!r} already deciding {prior}; retry that",
                 code=1399)
         e["decision"] = want
         tx_id, parts = e["tx_id"], tuple(e["parts"])
-        version = self.db.cluster.gts.next_ts() if commit else 0
-        rtype = RecordType.COMMIT if commit else RecordType.ABORT
-        for ls in parts:
-            group = self.db.cluster.ls_groups.get(ls) or {}
+        if xid in self.db._xa_registry:
+            # first attempt (or retry whose records never reached a log):
+            # submit the decision to every participant leader
+            version = self.db.cluster.gts.next_ts() if commit else 0
+            rtype = RecordType.COMMIT if commit else RecordType.ABORT
+            for ls in parts:
+                group = self.db.cluster.ls_groups.get(ls) or {}
 
-            def try_submit(ls=ls, group=group) -> bool:
-                for rep in group.values():
-                    if rep.is_ready and rep.submit_record(
-                            TxRecord(rtype, tx_id, (), version)) is not None:
-                        return True
-                return False
+                def try_submit(ls=ls, group=group) -> bool:
+                    for rep in group.values():
+                        if rep.is_ready and rep.submit_record(
+                                TxRecord(rtype, tx_id, (), version)
+                        ) is not None:
+                            return True
+                    return False
 
-            if not self.db.cluster.drive_until(try_submit):
-                raise SqlError(
-                    f"no ready leader for ls {ls} to decide xid {xid!r}")
+                if not self.db.cluster.drive_until(try_submit):
+                    raise SqlError(
+                        f"no ready leader for ls {ls} to decide xid {xid!r}")
 
         def all_applied() -> bool:
             # the branch is decided only when the decision has applied on
             # EVERY participant replica (registry pop happens at the FIRST
-            # apply — returning then would expose a torn multi-LS branch)
+            # apply — releasing locks then would expose a torn multi-LS
+            # branch / lost-update window)
             for ls in parts:
                 for rep in (self.db.cluster.ls_groups.get(ls) or {}).values():
                     if tx_id in rep.tx_table:
@@ -2318,7 +2456,7 @@ class DbSession:
         fb = _flashback_refs(ast)
         if fb:
             return self._select_flashback(ast, fb)
-        names = _tables_in_ast(ast)
+        names = self.db.expand_views(_tables_in_ast(ast))
         any_vt = self.db.refresh_virtual(names)
         route = None
         if self._tx is None and not any_vt and isinstance(ast, A.Select):
@@ -2515,6 +2653,55 @@ class DbSession:
             f"unique index {idx.name} violation on {ikey} in {ti.name}"
         )
 
+    # ------------------------------------------------------- trigger firing
+    _MAX_TRIGGER_DEPTH = 8
+
+    def _fire_triggers(self, table: str, event: str, timing: str,
+                       rows: list, tx: _OpenTx) -> None:
+        """Fire matching row triggers for each (new_map, old_map) in
+        `rows`. SET NEW.x mutates new_map in place (BEFORE); DML actions
+        substitute NEW/OLD as literals and run through the normal handlers
+        INSIDE the same transaction."""
+        trigs = self.db.triggers_for(table, event, timing)
+        if not trigs:
+            return
+        from ..sql.trigger import TriggerError, substitute
+
+        depth = getattr(self, "_trigger_depth", 0)
+        if depth >= self._MAX_TRIGGER_DEPTH:
+            raise SqlError(
+                f"trigger recursion deeper than {self._MAX_TRIGGER_DEPTH}")
+        self._trigger_depth = depth + 1
+        try:
+            for new_map, old_map in rows:
+                for _name, acts in trigs:
+                    for act in acts:
+                        if act[0] == "setnew":
+                            _k, col, expr = act
+                            if new_map is None or col not in new_map:
+                                raise SqlError(
+                                    f"trigger SET NEW.{col}: no such column")
+                            new_map[col] = _eval_const(
+                                substitute(expr, new_map, old_map))
+                        else:
+                            st2 = substitute(act[1], new_map, old_map)
+                            if isinstance(st2, A.Insert):
+                                self._insert(st2, tx)
+                            elif isinstance(st2, A.Update):
+                                self._update(st2, tx)
+                            else:
+                                self._delete(st2, tx)
+        except TriggerError as e:
+            raise SqlError(str(e)) from None
+        finally:
+            self._trigger_depth = depth
+
+    def _has_triggers(self, table: str, event: str) -> bool:
+        return any(
+            s["table"] == table and s["event"] == event
+            for s in self.db._trigger_specs.values()
+        )
+
     def _insert(self, st: A.Insert, tx: _OpenTx) -> int:
         ti = self.db.tables.get(st.table)
         if ti is None:
@@ -2533,6 +2720,18 @@ class DbSession:
             py_rows = list(zip(*src)) if src else []
         else:
             py_rows = [tuple(_eval_const(e) for e in row) for row in st.rows]
+
+        fire = self._has_triggers(st.table, "insert")
+        new_maps: list[dict] = []
+        if fire:
+            for row in py_rows:  # arity must hold BEFORE dict(zip) truncates
+                if len(row) != len(names):
+                    raise SqlError("value count does not match column count")
+            new_maps = [dict(zip(names, row)) for row in py_rows]
+            self._fire_triggers(
+                st.table, "insert", "before",
+                [(m, None) for m in new_maps], tx)
+            py_rows = [tuple(m[n] for n in names) for m in new_maps]
 
         order = [names.index(n) for n in ti.schema.names()]
         staged: list[tuple[int, int, tuple, tuple]] = []
@@ -2577,7 +2776,12 @@ class DbSession:
                     self._check_unique(tx, ti, idx, ikey)
                 index_muts.append((idx.tablet_id, ikey, OP_PUT, ivals))
         self._note_dict_appends(tx, ti)
-        return self._stage_all(tx, ti, muts, index_muts)
+        n = self._stage_all(tx, ti, muts, index_muts)
+        if fire:
+            self._fire_triggers(
+                st.table, "insert", "after",
+                [(m, None) for m in new_maps], tx)
+        return n
 
     def _qualify(self, st, ti: TableInfo, cols: list[str],
                  set_exprs: tuple[tuple[str, A.Node], ...] = ()) -> ResultSet:
@@ -2630,13 +2834,38 @@ class DbSession:
         seen_i: dict[str, set[tuple]] = {
             idx.name: set() for idx in ti.indexes.values() if idx.unique
         }
+        fire = self._has_triggers(st.table, "update")
+        fired_rows: list[tuple] = []
         for r in range(rs.nrows):
+            new_map = old_map = None
+            if fire:
+                old_map = {
+                    f.name: rs.columns[f.name][r] for f in ti.schema.fields
+                }
+                new_map = {}
+                for f in ti.schema.fields:
+                    if f.name in const_sets:
+                        new_map[f.name] = const_sets[f.name]
+                    else:
+                        src = set_cols.get(f.name)
+                        new_map[f.name] = (
+                            src[r] if src is not None else old_map[f.name]
+                        )
+                self._fire_triggers(
+                    st.table, "update", "before", [(new_map, old_map)], tx)
+                for k in ti.key_cols:
+                    if new_map[k] != old_map[k]:
+                        raise SqlError(
+                            f"trigger changed key column {k}")
+                fired_rows.append((new_map, old_map))
             vals = []
             old_vals = []
             for f in ti.schema.fields:
                 ov = rs.columns[f.name][r]
                 old_vals.append(_coerce(ov, f.dtype, ti.dicts.get(f.name), f.name))
-                if f.name in const_sets:
+                if new_map is not None:
+                    v = new_map[f.name]
+                elif f.name in const_sets:
                     v = const_sets[f.name]
                 else:
                     src = set_cols.get(f.name)
@@ -2665,7 +2894,10 @@ class DbSession:
                 index_muts.append((idx.tablet_id, old_ik, OP_DELETE, None))
                 index_muts.append((idx.tablet_id, new_ik, OP_PUT, new_iv))
         self._note_dict_appends(tx, ti)
-        return self._stage_all(tx, ti, muts, index_muts)
+        n = self._stage_all(tx, ti, muts, index_muts)
+        if fire:
+            self._fire_triggers(st.table, "update", "after", fired_rows, tx)
+        return n
 
     def _delete(self, st: A.Delete, tx: _OpenTx) -> int:
         ti = self.db.tables.get(st.table)
@@ -2673,14 +2905,23 @@ class DbSession:
             raise SqlError(f"no such table {st.table}")
         # the qualification scan must surface every indexed column so the
         # old index entries can be tombstoned alongside the base rows
+        # (plus the whole row when delete triggers need OLD.*)
+        fire = self._has_triggers(st.table, "delete")
         cols = list(dict.fromkeys(
             list(ti.key_cols)
             + [c for idx in ti.indexes.values() for c in idx.key_cols]
+            + (list(ti.schema.names()) if fire else [])
         ))
         rs = self._qualify(st, ti, cols)
+        fired_rows: list[tuple] = []
         muts: list[tuple[tuple, int, tuple | None]] = []
         index_muts: list[tuple[int, tuple, int, tuple | None]] = []
         for r in range(rs.nrows):
+            if fire:
+                old_map = {c: rs.columns[c][r] for c in cols}
+                self._fire_triggers(
+                    st.table, "delete", "before", [(None, old_map)], tx)
+                fired_rows.append((None, old_map))
             row = {
                 c: _coerce(rs.columns[c][r], ti.schema[c], ti.dicts.get(c), c)
                 for c in cols
@@ -2691,7 +2932,10 @@ class DbSession:
             for idx in ti.indexes.values():
                 ikey = tuple(int(row[c]) for c in idx.key_cols)
                 index_muts.append((idx.tablet_id, ikey, OP_DELETE, None))
-        return self._stage_all(tx, ti, muts, index_muts)
+        n = self._stage_all(tx, ti, muts, index_muts)
+        if fire:
+            self._fire_triggers(st.table, "delete", "after", fired_rows, tx)
+        return n
 
 
 # ---- helpers ---------------------------------------------------------------

@@ -279,7 +279,7 @@ def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
         ("xid", DataType.varchar(), [x for x, _ in rows]),
-        ("owner", DataType.varchar(), [o for _, (_t2, o) in rows]),
+        ("owner", DataType.varchar(), [e[1] for _, e in rows]),
         ("state", DataType.varchar(), ["PREPARED" for _ in rows]),
     ])
 

@@ -330,6 +330,41 @@ class LockTable(Node):
 
 
 @dataclass(frozen=True)
+class CreateTrigger(Node):
+    """CREATE TRIGGER name {BEFORE|AFTER} {INSERT|UPDATE|DELETE} ON table
+    FOR EACH ROW <body> (ob_trigger_resolver.cpp analog; body grammar in
+    sql/trigger.py)."""
+
+    name: str
+    timing: str  # before | after
+    event: str  # insert | update | delete
+    table: str
+    body_sql: str
+
+
+@dataclass(frozen=True)
+class DropTrigger(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW name AS <select text> — a PLAIN view:
+    only the definition text persists; every query referencing it expands
+    the text at plan time (merged into the outer block when the body is
+    simple select-project-join — ob_transform_view_merge analog)."""
+
+    name: str
+    query_sql: str
+    or_replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Node):
+    name: str
+
+
+@dataclass(frozen=True)
 class CreateMaterializedView(Node):
     """CREATE MATERIALIZED VIEW name AS <select text> — materialized at
     creation; REFRESH re-runs the defining query (full refresh, the
@@ -432,3 +467,33 @@ class Rollback(Node):
 
 
 Statement = Node  # any of the above or Select
+
+
+def rewrite(node, fn):
+    """Generic top-down AST rewrite: `fn(node)` returns a replacement node
+    (stopping descent there) or None to keep walking. Non-Node values and
+    tuples (including one level of nested tuples, e.g. CTE pairs) pass
+    through structurally. Shared by trigger NEW/OLD substitution and the
+    planner's view-merge requalification — one walker to maintain."""
+    if isinstance(node, Node):
+        r = fn(node)
+        if r is not None:
+            return r
+    if not isinstance(node, Node):
+        return node
+    from dataclasses import replace as _rep
+
+    def val(v):
+        if isinstance(v, Node):
+            return rewrite(v, fn)
+        if isinstance(v, tuple):
+            return tuple(val(x) for x in v)
+        return v
+
+    kw = {}
+    for fld in node.__dataclass_fields__:
+        v = getattr(node, fld)
+        v2 = val(v)
+        if v2 is not v:
+            kw[fld] = v2
+    return _rep(node, **kw) if kw else node

@@ -266,6 +266,11 @@ class Resolver:
         self.catalog = catalog  # dict name -> Table (core.table.Table)
         self.outer = outer
         self.scopes: list[tuple[str, Schema]] = []  # (alias, schema)
+        # merged-view aliases (ob_transform_view_merge): view alias ->
+        # {output column -> qualified inner column}; consulted by
+        # resolve_name so outer references to the view splice straight
+        # onto the inlined base tables
+        self.redirects: dict[str, dict[str, str]] = {}
         self.agg_exprs: list[tuple[str, str, E.Expr | None, bool]] = []
         self.correlated: list[E.Expr] = []
         # window-function sink: (name, fn, arg, partition keys, order keys);
@@ -286,6 +291,11 @@ class Resolver:
     def resolve_name(self, parts: tuple[str, ...]) -> str:
         if len(parts) == 2:
             alias, col = parts
+            rd = self.redirects.get(alias)
+            if rd is not None:
+                if col in rd:
+                    return rd[col]
+                raise ResolveError(f"unknown column {'.'.join(parts)}")
             for a, s in self.scopes:
                 if a == alias:
                     q = f"{a}.{col}"
@@ -297,9 +307,17 @@ class Resolver:
         col = parts[0]
         matches = []
         for a, s in self.scopes:
+            if "#" in a:
+                # merged-view internals: reachable only through the view's
+                # redirect map, never by bare-name search (columns outside
+                # the view's select list stay hidden)
+                continue
             q = f"{a}.{col}"
             if q in s:
                 matches.append(q)
+        for rd in self.redirects.values():
+            if col in rd and rd[col] not in matches:
+                matches.append(rd[col])
         if len(matches) == 1:
             return matches[0]
         if len(matches) > 1:
@@ -434,12 +452,72 @@ class Resolver:
                 if not isinstance(q, E.Literal):
                     raise ResolveError("fts_match query must be a literal")
                 return E.Func("fts_match", (col, q))
+            if node.name in ("json_extract", "json_unquote", "json_valid",
+                             "json_type", "json_array_length"):
+                return self._json_call(node, allow_agg)
+            if node.name in ("json_object", "json_array"):
+                raise ResolveError(
+                    f"{node.name} is supported in the select list only "
+                    "(host-side construction, sql/json_host.py)")
             raise ResolveError(f"unknown function {node.name}")
         if isinstance(node, (A.ScalarSubquery, A.ExistsOp)):
             raise ResolveError("subquery handled by planner")
         if isinstance(node, A.IntervalLit):
             raise ResolveError("interval outside date arithmetic")
         raise ResolveError(f"cannot resolve {node!r}")
+
+    def _json_call(self, node: A.FuncCall, allow_agg: bool) -> E.Expr:
+        """JSON function family (ob_expr_json_extract.cpp and siblings):
+        documents are dict-encoded varchar, so every function evaluates
+        once per DISTINCT document through the expression compiler's
+        string-view LUTs (expr/compile.py, expr/jsonpath.py)."""
+        name = node.name
+        if not node.args:
+            raise ResolveError(f"{name} needs arguments")
+        doc = self.expr(node.args[0], allow_agg)
+        if name == "json_extract":
+            if len(node.args) != 2:
+                raise ResolveError("json_extract(doc, 'path')")
+            p = self.expr(node.args[1], allow_agg)
+            if not isinstance(p, E.Literal):
+                raise ResolveError("json path must be a literal")
+            self._check_json_path(str(p.value))
+            return E.Func("json_extract", (doc, p))
+        if name == "json_unquote":
+            if len(node.args) != 1:
+                raise ResolveError("json_unquote(value)")
+            return E.Func("json_unquote", (doc,))
+        if name == "json_valid":
+            if len(node.args) != 1:
+                raise ResolveError("json_valid(doc)")
+            return E.Func("json_valid", (doc,))
+        if name == "json_type":
+            if len(node.args) == 2:
+                p = self.expr(node.args[1], allow_agg)
+                if not isinstance(p, E.Literal):
+                    raise ResolveError("json path must be a literal")
+                self._check_json_path(str(p.value))
+                doc = E.Func("json_extract", (doc, p))
+            return E.Func("json_type", (doc,))
+        if name == "json_array_length":
+            args = [doc]
+            if len(node.args) == 2:
+                p = self.expr(node.args[1], allow_agg)
+                if not isinstance(p, E.Literal):
+                    raise ResolveError("json path must be a literal")
+                self._check_json_path(str(p.value))
+                args.append(p)
+            return E.Func("json_array_length", tuple(args))
+        raise ResolveError(f"unknown function {name}")
+
+    @staticmethod
+    def _check_json_path(path: str) -> None:
+        from ..expr.jsonpath import JsonPathError, parse_path
+
+        try:
+            parse_path(path)
+        except JsonPathError as e:
+            raise ResolveError(str(e)) from None
 
     @staticmethod
     def _is_null_comparison(node) -> bool:
@@ -713,7 +791,7 @@ def _parse_type(tn: str) -> DataType:
     tn = tn.lower()
     if tn.endswith("?"):  # DataType.__str__ nullable marker round-trip
         return _parse_type(tn[:-1]).with_nullable(True)
-    if tn in ("text", "mediumtext", "longtext", "blob", "clob"):
+    if tn in ("text", "mediumtext", "longtext", "blob", "clob", "json"):
         # LOB surface: dict-encoded varchar holds unbounded values (the
         # dictionary stores the full string ONCE; rows are int32 codes),
         # so TEXT/BLOB map onto the same storage. The reference's

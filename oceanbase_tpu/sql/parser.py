@@ -20,7 +20,7 @@ _TOKEN_RE = re.compile(
   | (?P<num>\d+\.\d+|\.\d+|\d+)
   | (?P<str>'(?:[^']|'')*')
   | (?P<name>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.;=<>])
+  | (?P<op>->>|->|<>|!=|>=|<=|\|\||[-+*/%(),.;=<>])
     """,
     re.VERBOSE,
 )
@@ -253,6 +253,43 @@ class Parser:
             return A.CreateMaterializedView(
                 name, self.sql[t.pos + 2:].strip().rstrip(";")
             )
+        if self.peek().value == "view" or (
+            self.peek().value == "or" and self.peek(1).value == "replace"
+        ):
+            replace = False
+            if self.peek().value == "or":
+                self.next()
+                self.next()
+                replace = True
+            if self.next().value != "view":
+                raise SyntaxError("expected VIEW")
+            name = self.next().value
+            t = self.expect("as")
+            self.i = len(self.toks) - 1  # definition kept as text
+            return A.CreateView(
+                name, self.sql[t.pos + 2:].strip().rstrip(";"), replace
+            )
+        if self.peek().value == "trigger":
+            self.next()
+            name = self.next().value
+            timing = self.next().value
+            if timing not in ("before", "after"):
+                raise SyntaxError("expected BEFORE or AFTER")
+            event = self.next().value
+            if event not in ("insert", "update", "delete"):
+                raise SyntaxError("expected INSERT, UPDATE or DELETE")
+            self.expect("on")
+            table = self.next().value
+            if self.next().value != "for":
+                raise SyntaxError("expected FOR EACH ROW")
+            if self.next().value != "each":
+                raise SyntaxError("expected FOR EACH ROW")
+            t = self.next()
+            if t.value != "row":
+                raise SyntaxError("expected FOR EACH ROW")
+            self.i = len(self.toks) - 1  # body kept as text
+            body = self.sql[t.pos + 3:].strip().rstrip(";")
+            return A.CreateTrigger(name, timing, event, table, body)
         if self.peek().value == "external":
             self.next()
             self.expect("table")
@@ -387,6 +424,12 @@ class Parser:
             if self.next().value != "view":
                 raise SyntaxError("expected MATERIALIZED VIEW")
             return A.DropMaterializedView(self.next().value)
+        if self.peek().value == "view":
+            self.next()
+            return A.DropView(self.next().value)
+        if self.peek().value == "trigger":
+            self.next()
+            return A.DropTrigger(self.next().value)
         if self.peek().value == "vector" and self.peek(1).value == "index":
             self.next()
             self.next()
@@ -821,6 +864,13 @@ class Parser:
             return A.LikeOp(e, self.additive(), negated)
         if self.accept("is"):
             neg = self.accept("not")
+            t2 = self.peek()
+            if t2.kind == "name" and t2.value == "json":
+                # x IS [NOT] JSON -> json_valid(x) (the SQL/JSON predicate;
+                # MySQL spells it json_valid, Oracle IS JSON)
+                self.next()
+                f = A.FuncCall("json_valid", (e,))
+                return A.UnaryOp("not", f) if neg else f
             self.expect("null")
             return A.IsNullOp(e, neg)
         return e
@@ -852,7 +902,20 @@ class Parser:
         if self.peek().value == "+" and self.peek().kind == "op":
             self.next()
             return self.unary()
-        return self.atom()
+        return self._postfix(self.atom())
+
+    def _postfix(self, e: A.Node) -> A.Node:
+        """MySQL JSON arrow operators: col->'$.p' = json_extract,
+        col->>'$.p' = json_unquote(json_extract)."""
+        while self.peek().kind == "op" and self.peek().value in ("->", "->>"):
+            op = self.next().value
+            t = self.next()
+            if t.kind != "str":
+                raise SyntaxError(
+                    f"JSON path string expected after {op} @{t.pos}")
+            ex = A.FuncCall("json_extract", (e, A.StringLit(t.value)))
+            e = ex if op == "->" else A.FuncCall("json_unquote", (ex,))
+        return e
 
     def atom(self) -> A.Node:
         t = self.peek()

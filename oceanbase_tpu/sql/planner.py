@@ -174,13 +174,22 @@ def _contains_subquery(node: A.Node) -> bool:
 
 
 class Planner:
-    def __init__(self, catalog, stats=None, unique_keys=None):
+    def __init__(self, catalog, stats=None, unique_keys=None, views=None):
         self.catalog = catalog  # name -> Table
         # share/stats.StatsManager (None = heuristic-only estimates)
         self.stats = stats
         # table -> unique key column tuple (DISTINCT elimination)
         self.unique_keys = unique_keys or {}
         self.ctes: dict[str, A.Select] = {}
+        # plain views: name -> defining SELECT text (shared MUTABLE dict —
+        # the server's DDL updates it in place). Expanded at plan time;
+        # simple SPJ bodies MERGE into the referencing block
+        # (ob_transform_view_merge), everything else plans as a derived
+        # table. Plan-cache safety: planning precedes the cache lookup and
+        # plan_fingerprint is part of the key, so redefinition changes the
+        # key automatically.
+        self.views: dict[str, str] = views if views is not None else {}
+        self._view_depth = 0
 
     def _distinct_redundant(self, plan) -> bool:
         """True when `plan`'s rows are already unique, so a Distinct above
@@ -247,6 +256,7 @@ class Planner:
             return self._plan_setop(sel, outer)
         plan, r, out_items, visible = self._plan_block(sel, outer)
         plan = self._simplify_outer_joins(plan)
+        plan = self._eliminate_left_joins(plan)
         return PlannedQuery(plan, visible)
 
     def _simplify_outer_joins(self, op, null_rejected: frozenset = frozenset()):
@@ -363,12 +373,39 @@ class Planner:
         relations: list[Relation] = []
         join_conds: list[E.Expr] = []
         outer_join_specs: list[tuple[str, str, A.Node | None]] = []  # (kind, right_alias, on)
+        merged_where_asts: list[A.Node] = []
+        outer_has_star = any(isinstance(it.expr, A.Star) for it in sel.items)
 
-        def add_relation_from(node: A.Node):
+        def add_relation_from(node: A.Node, allow_merge: bool = True):
             if isinstance(node, A.TableRef):
                 alias = node.alias or node.name
                 if node.name in self.ctes:
                     relations.append(self._plan_derived(self.ctes[node.name], alias, r))
+                elif node.name in self.views and node.name not in self.catalog:
+                    if self._view_depth > 16:
+                        raise ResolveError(
+                            f"view expansion too deep at {node.name} "
+                            "(cyclic views?)")
+                    from .parser import parse as _parse
+
+                    self._view_depth += 1
+                    try:
+                        body = _parse(self.views[node.name])
+                        if (allow_merge
+                                and not outer_has_star
+                                and self._view_mergeable(body)):
+                            # ob_transform_view_merge: splice the view's
+                            # tables + predicates into THIS block so the
+                            # optimizer join-orders across the boundary
+                            # and predicates push into the view's scans
+                            self._merge_view(
+                                body, alias, r, add_relation_from,
+                                merged_where_asts)
+                        else:
+                            relations.append(
+                                self._plan_derived(body, alias, r))
+                    finally:
+                        self._view_depth -= 1
                 else:
                     relations.append(Relation(alias, r.add_table(node.name, alias), True))
                 return alias
@@ -383,8 +420,13 @@ class Planner:
                         join_conds.extend(split_conjuncts(r.expr(node.on)))
                     return None
                 if node.kind in ("left", "full"):
-                    add_relation_from(node.left)
-                    ra = add_relation_from(node.right)
+                    # the null-extended side must stay ONE relation — a
+                    # merged view would splice in as inner tables and its
+                    # WHERE would wrongly filter null-extended rows (FULL
+                    # null-extends BOTH sides)
+                    add_relation_from(
+                        node.left, allow_merge=(node.kind == "left"))
+                    ra = add_relation_from(node.right, allow_merge=False)
                     if ra is None:
                         raise ResolveError(
                             f"{node.kind} join right side must be a relation"
@@ -395,7 +437,7 @@ class Planner:
                     # A RIGHT JOIN B == B LEFT JOIN A (the reference's
                     # resolver does the same side swap)
                     la = add_relation_from(node.right)
-                    ra = add_relation_from(node.left)
+                    ra = add_relation_from(node.left, allow_merge=False)
                     if ra is None:
                         raise ResolveError("right join left side must be a relation")
                     outer_join_specs.append(("left", ra, node.on))
@@ -410,7 +452,10 @@ class Planner:
         semi_specs = []  # (kind, sub_plan_rel, keys, residual)
         post_join_filters: list[E.Expr] = []
         where_conjs: list[E.Expr] = []
-        for ast_c in split_ast_conjuncts(sel.where):
+        where_ast_conjs = split_ast_conjuncts(sel.where)
+        for mw in merged_where_asts:  # merged views' predicates (pushable)
+            where_ast_conjs.extend(split_ast_conjuncts(mw))
+        for ast_c in where_ast_conjs:
             if isinstance(ast_c, A.ExistsOp):
                 semi_specs.append(self._plan_exists(ast_c.subquery, ast_c.negated, r))
             elif isinstance(ast_c, A.UnaryOp) and ast_c.op == "not" and isinstance(ast_c.operand, A.ExistsOp):
@@ -433,6 +478,14 @@ class Planner:
         # classify: single-relation -> pushdown; equi-join; residual
         by_alias = {rel.alias: rel for rel in relations}
         outer_right = {ra for _, ra, _ in outer_join_specs}
+
+        # ---- predicate move-around (ob_transform_predicate_move_around):
+        # x = y makes every single-column restriction on x equally true of
+        # y, so the restriction CLONES onto y's relation and pre-filters
+        # its scan — both scans shrink before the join instead of one
+        where_conjs.extend(
+            self._move_around_predicates(where_conjs, outer_right)
+        )
         # a FULL join null-extends BOTH sides, so no WHERE conjunct may be
         # pushed below it — scans pre-filtered on the preserved side would
         # resurrect their partners as spurious unmatched rows
@@ -511,9 +564,21 @@ class Planner:
         agg_order_keys: list[tuple[E.Expr, bool]] | None = None
         scalar_join_after_agg: list[tuple] = []
         if group_nodes or has_agg_in_select or sel.having is not None:
+            item_alias_ast = {
+                it.alias: it.expr for it in sel.items if it.alias
+            }
             key_exprs = []
             for i, g in enumerate(group_nodes):
-                ge = r.expr(g)
+                try:
+                    ge = r.expr(g)
+                except ResolveError:
+                    # MySQL scoping: GROUP BY may name a select alias
+                    if (isinstance(g, A.Name) and len(g.parts) == 1
+                            and g.parts[0] in item_alias_ast):
+                        ge = r.expr(item_alias_ast[g.parts[0]])
+                        key_exprs.append((g.parts[0], ge))
+                        continue
+                    raise
                 name = ge.name if isinstance(ge, E.ColRef) else f"$gkey{i}"
                 key_exprs.append((name, ge))
             out_items = []
@@ -662,6 +727,32 @@ class Planner:
     def _build_aggregate(self, plan, key_exprs, agg_exprs, group_sets=None):
         """Build the Aggregate node; expands DISTINCT aggregates into a
         pre-dedup (Distinct over keys+arg) + plain aggregate."""
+        # group keys that are dictionary TRANSFORMS (substr / json_*)
+        # cannot evaluate inside the aggregate (the engine's group-by
+        # paths see plain columns): pre-project them below the Aggregate
+        # into named dict columns (derive_dict_column) and group by those
+        # select items referencing a transformed key must substitute by the
+        # ORIGINAL expression, not the post-rewrite ColRef
+        orig_key_exprs = list(key_exprs)
+        viewy = {
+            n for n, e in key_exprs
+            if isinstance(e, E.Func) and e.name in (
+                "substr", "json_extract", "json_unquote", "json_type")
+        }
+        if viewy:
+            needed: set[str] = set()
+            for _n, _fn, arg, _d in agg_exprs:
+                if arg is not None:
+                    needed |= set(E.referenced_columns(arg))
+            for n, e in key_exprs:
+                if n not in viewy:
+                    needed |= set(E.referenced_columns(e))
+            proj = [(n, e) for n, e in key_exprs if n in viewy]
+            proj += [(c, E.ColRef(c)) for c in sorted(needed - viewy)]
+            plan = Project(plan, tuple(proj))
+            key_exprs = [
+                (n, E.ColRef(n) if n in viewy else e) for n, e in key_exprs
+            ]
         distinct_aggs = [a for a in agg_exprs if a[3]]
         if group_sets is not None:
             # ROLLUP/CUBE/GROUPING SETS: one EXPAND-style Aggregate
@@ -682,23 +773,246 @@ class Planner:
                 plan, tuple(key_refs),
                 ((name, "count", E.ColRef("$darg"), False),),
             )
-            sub = {e: E.ColRef(n) for n, e in key_exprs}
+            sub = {e: E.ColRef(n) for n, e in orig_key_exprs}
             return plan, sub
         # mixed / multiple / non-count DISTINCT aggregates flow through:
         # the executor masks each distinct agg to first occurrences
         plan = Aggregate(plan, tuple(key_exprs), tuple(agg_exprs),
                          grouping_sets=group_sets)
-        sub = {e: E.ColRef(n) for n, e in key_exprs}
+        sub = {e: E.ColRef(n) for n, e in orig_key_exprs}
         return plan, sub
 
     # ------------------------------------------------- derived tables
-    def _plan_derived(self, sub_sel: A.Select, alias: str, r: Resolver) -> Relation:
+    def _plan_derived(self, sub_sel: "A.Select | A.SetSelect", alias: str,
+                      r: Resolver) -> Relation:
+        if isinstance(sub_sel, A.SetSelect):
+            pq = self._plan_setop(sub_sel, None)
+            renamed = tuple(
+                (f"{alias}.{n}", E.ColRef(n)) for n in pq.output_names
+            )
+            plan = Project(pq.plan, renamed)
+            r.scopes.append((alias, output_schema(plan)))
+            return Relation(alias, plan, False)
         sub_plan, _, out_items, visible = self._plan_block(sub_sel, None)
         # rename outputs into this block's namespace: alias.col
         renamed = tuple((f"{alias}.{n}", E.ColRef(n)) for n in visible)
         plan = Project(sub_plan, renamed)
         r.scopes.append((alias, output_schema(plan)))
         return Relation(alias, plan, False)
+
+    # --------------------------------------------- predicate move-around
+    @staticmethod
+    def _move_around_predicates(where_conjs: list, outer_right: set) -> list:
+        """Derive transferable restrictions across equi-join equivalence
+        classes. Sound because an INNER equi-join result satisfies x = y
+        with both non-NULL, so P(x) <=> P(y) on surviving rows; columns
+        touching a null-extended side never participate."""
+        eq_pairs = []
+        for c in where_conjs:
+            ej = _is_equi_join(c)
+            if ej is None:
+                continue
+            if {ej[0].name.split(".")[0], ej[1].name.split(".")[0]} \
+                    & outer_right:
+                continue
+            eq_pairs.append(ej)
+        if not eq_pairs:
+            return []
+        parent: dict[str, str] = {}
+
+        def find(x: str) -> str:
+            while parent.get(x, x) != x:
+                x = parent[x]
+            return x
+
+        for l_, r_ in eq_pairs:
+            a, b = find(l_.name), find(r_.name)
+            if a != b:
+                parent[a] = b
+        classes: dict[str, list[str]] = {}
+        for n in sorted({n for p in eq_pairs for n in (p[0].name, p[1].name)}):
+            classes.setdefault(find(n), []).append(n)
+        seen = {repr(c) for c in where_conjs}
+        derived = []
+        for c in where_conjs:
+            if _is_equi_join(c) is not None:
+                continue
+            refs = set(E.referenced_columns(c))
+            if len(refs) != 1:
+                continue
+            (src,) = refs
+            if src.split(".")[0] in outer_right:
+                continue
+            for other in classes.get(find(src), ()):
+                if other == src or other.split(".")[0] in outer_right:
+                    continue
+                c2 = _substitute(c, {E.ColRef(src): E.ColRef(other)})
+                if repr(c2) not in seen:
+                    seen.add(repr(c2))
+                    derived.append(c2)
+        return derived
+
+    # --------------------------------------------- join elimination
+    @staticmethod
+    def _node_col_refs(op) -> set:
+        """Column names referenced by THIS node's expressions (children
+        excluded)."""
+        import dataclasses as _dc
+
+        out: set = set()
+
+        def grab(v):
+            if isinstance(v, E.Expr):
+                out.update(E.referenced_columns(v))
+            elif isinstance(v, tuple):
+                for x in v:
+                    grab(x)
+
+        for f in _dc.fields(op):
+            v = getattr(op, f.name)
+            if isinstance(v, LogicalOp):
+                continue
+            grab(v)
+        return out
+
+    def _eliminate_left_joins(self, op, needed: frozenset = frozenset()):
+        """ob_transform_join_elimination: a LEFT JOIN on a UNIQUE key of
+        the right side whose columns nothing above consumes changes
+        neither row count (unique key -> at most one match per left row;
+        unmatched rows null-extend) nor any surviving column — drop it."""
+        import dataclasses as _dc
+
+        if isinstance(op, JoinOp) and op.kind == "left":
+            rnames = set(output_schema(op.right).names())
+            if not (rnames & needed) and isinstance(op.right, Scan):
+                uk = self.unique_keys.get(op.right.table)
+                rk = {
+                    k.name for k in op.right_keys if isinstance(k, E.ColRef)
+                }
+                if uk and {f"{op.right.alias}.{c}" for c in uk} == rk \
+                        and len(rk) == len(op.right_keys):
+                    return self._eliminate_left_joins(op.left, needed)
+        # whole-row operators consume every child column implicitly
+        if isinstance(op, (Distinct, SetOp)):
+            sub_needed = needed
+            for f in _dc.fields(op):
+                v = getattr(op, f.name)
+                if isinstance(v, LogicalOp):
+                    sub_needed = sub_needed | set(output_schema(v).names())
+        else:
+            sub_needed = needed | frozenset(self._node_col_refs(op))
+        kw = {}
+        for f in _dc.fields(op):
+            v = getattr(op, f.name)
+            if isinstance(v, LogicalOp):
+                v2 = self._eliminate_left_joins(v, frozenset(sub_needed))
+                if v2 is not v:
+                    kw[f.name] = v2
+        return _dc.replace(op, **kw) if kw else op
+
+    # ------------------------------------------------- view merge
+    def _view_mergeable(self, body) -> bool:
+        """True when the view body is simple select-project-join over
+        catalog base tables: bare-column outputs, optional WHERE without
+        subqueries, inner joins only (ob_transform_view_merge scope)."""
+        if not isinstance(body, A.Select):
+            return False
+        if (body.group_by or body.having is not None or body.distinct
+                or body.order_by or body.limit is not None or body.offset
+                or body.ctes or body.group_sets or not body.from_):
+            return False
+        if _select_has_agg(body):
+            return False
+        if not all(isinstance(it.expr, A.Name) for it in body.items):
+            return False
+        if body.where is not None and _contains_subquery(body.where):
+            return False
+
+        def leafs_ok(node) -> bool:
+            if isinstance(node, A.TableRef):
+                return node.name in self.catalog
+            if isinstance(node, A.Join):
+                return (node.kind in ("inner", "cross")
+                        and leafs_ok(node.left) and leafs_ok(node.right))
+            return False
+
+        return all(leafs_ok(f) for f in body.from_)
+
+    def _merge_view(self, body: A.Select, alias: str, r,
+                    add_relation_from, merged_where_asts: list) -> None:
+        """Inline a mergeable view body into the CURRENT block: base
+        tables join the outer relation list under gensym'd aliases, the
+        view's WHERE joins the outer conjunct pool, and the view alias
+        becomes a resolver REDIRECT mapping its output columns onto the
+        inlined tables."""
+        # inner alias -> (renamed alias, table name)
+        ren: dict[str, tuple[str, str]] = {}
+
+        def collect(node):
+            if isinstance(node, A.TableRef):
+                ia = node.alias or node.name
+                # '#' is outside the lexer's name charset: the internal
+                # alias is UNTYPEABLE, so user text can never address the
+                # merged-in tables directly (a view grant must not leak
+                # base columns outside the view's select list)
+                ren[ia] = (f"{alias}#{ia}", node.name)
+            else:
+                collect(node.left)
+                collect(node.right)
+
+        for f in body.from_:
+            collect(f)
+
+        def owner_of(col: str) -> str:
+            hits = [
+                ra for ia, (ra, tn) in ren.items()
+                if any(f.name == col for f in self.catalog[tn].schema.fields)
+            ]
+            if len(hits) != 1:
+                raise ResolveError(
+                    f"column {col} is {'ambiguous' if hits else 'unknown'} "
+                    f"inside view {alias}")
+            return hits[0]
+
+        def rn_expr(node):
+            """Requalify every column reference onto the renamed aliases
+            (one shared walker: ast.rewrite)."""
+
+            def fn(n):
+                if not isinstance(n, A.Name):
+                    return None
+                if n.parts == ("null",):
+                    return n
+                if len(n.parts) == 2 and n.parts[0] in ren:
+                    return A.Name((ren[n.parts[0]][0], n.parts[1]))
+                if len(n.parts) == 1:
+                    return A.Name((owner_of(n.parts[0]), n.parts[0]))
+                return n
+
+            return A.rewrite(node, fn)
+
+        def rn_from(node):
+            if isinstance(node, A.TableRef):
+                ia = node.alias or node.name
+                return A.TableRef(node.name, ren[ia][0])
+            return A.Join(
+                node.kind, rn_from(node.left), rn_from(node.right),
+                rn_expr(node.on) if node.on is not None else None,
+            )
+
+        for f in body.from_:
+            add_relation_from(rn_from(f))
+        if body.where is not None:
+            merged_where_asts.append(rn_expr(body.where))
+        colmap: dict[str, str] = {}
+        for it in body.items:
+            parts = it.expr.parts
+            if len(parts) == 2:
+                tgt = f"{ren[parts[0]][0]}.{parts[1]}"
+            else:
+                tgt = f"{owner_of(parts[0])}.{parts[0]}"
+            colmap[it.alias or parts[-1]] = tgt
+        r.redirects[alias] = colmap
 
     def _push_filter(self, rel: Relation, c: E.Expr) -> None:
         if rel.is_scan:

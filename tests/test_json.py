@@ -1,0 +1,228 @@
+"""JSON type + functions, oracle-tested against sqlite's json1.
+
+Reference surface: the ob_expr_json_* family (ob_expr_json_extract.cpp,
+ob_expr_json_object.cpp, ...). Documents are dict-encoded varchar; every
+path evaluates once per DISTINCT doc (expr/jsonpath.py) and rows map by
+code. ->>/json_unquote follow MySQL semantics (sqlite json_extract
+returns the unquoted SQL value, so the oracle comparisons use ->> or
+parse both sides)."""
+
+import json
+import sqlite3
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+DOCS = [
+    (1, '{"name": "ann", "age": 31, "score": 4.5, '
+        '"tags": ["a", "b"], "addr": {"city": "sf", "zip": "94105"}}'),
+    (2, '{"name": "bob", "age": 25, "score": 3.25, '
+        '"tags": [], "addr": {"city": "nyc"}}'),
+    (3, '{"name": "cy", "tags": ["x", "y", "z"], "meta": null}'),
+    (4, 'not valid json at all'),
+    (5, '{"name": "dee", "age": 42, "nested": {"deep": {"k": [1, 2, 3]}}}'),
+    (6, '[10, 20, {"in": "arr"}]'),
+]
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table docs (id int primary key, j json)")
+    vals = ", ".join(
+        "({}, '{}')".format(i, t.replace("'", "''")) for i, t in DOCS
+    )
+    s.sql(f"insert into docs values {vals}")
+    yield d
+    d.close()
+
+
+@pytest.fixture(scope="module")
+def lite():
+    c = sqlite3.connect(":memory:")
+    c.execute("create table docs (id integer primary key, j text)")
+    c.executemany("insert into docs values (?, ?)", DOCS)
+    return c
+
+
+@pytest.mark.parametrize("path", [
+    "$.name", "$.age", "$.addr.city", "$.tags[0]", "$.tags[2]",
+    "$.nested.deep.k[1]", "$[1]", "$.missing",
+])
+def test_unquoted_extract_matches_sqlite(db, lite, path):
+    """engine ->> (MySQL unquote semantics) vs sqlite json_extract: for
+    string/missing results they agree directly; numbers compare parsed."""
+    got = {r[0]: r[1] for r in db.session().sql(
+        f"select id, j->>'{path}' as v from docs order by id").rows()}
+    want = dict(lite.execute(
+        "select id, case when json_valid(j) then json_extract(j, ?) "
+        "end from docs", (path,)))
+    assert set(got) == set(want)
+    for k in want:
+        g, w = got[k], want[k]
+        if w is None:
+            assert g is None, (k, g)
+        elif isinstance(w, (int, float)):
+            assert g is not None and float(g) == float(w), (k, g, w)
+        else:
+            assert g == str(w), (k, g, w)
+
+
+def test_quoted_extract_json_form(db, lite):
+    """-> keeps JSON representation: strings stay quoted."""
+    got = {r[0]: r[1] for r in db.session().sql(
+        "select id, j->'$.name' as v from docs order by id").rows()}
+    for i, t in DOCS:
+        try:
+            doc = json.loads(t)
+        except ValueError:
+            assert got[i] is None
+            continue
+        if isinstance(doc, dict) and "name" in doc:
+            assert json.loads(got[i]) == doc["name"]
+        else:
+            assert got[i] is None
+
+
+def test_json_valid_matches_sqlite(db, lite):
+    got = {r[0]: bool(r[1]) for r in db.session().sql(
+        "select id, json_valid(j) as v from docs").rows()}
+    want = {k: bool(v) for k, v in lite.execute(
+        "select id, json_valid(j) from docs")}
+    assert got == want
+
+
+def test_is_json_predicate(db):
+    rows = db.session().sql(
+        "select id from docs where j is json order by id").rows()
+    assert [r[0] for r in rows] == [1, 2, 3, 5, 6]
+    rows = db.session().sql(
+        "select id from docs where j is not json").rows()
+    assert [r[0] for r in rows] == [4]
+
+
+def test_json_array_length_matches_sqlite(db, lite):
+    got = {r[0]: r[1] for r in db.session().sql(
+        "select id, json_array_length(j, '$.tags') as v from docs").rows()}
+    want = dict(lite.execute(
+        "select id, case when json_valid(j) and "
+        "json_type(j, '$.tags') = 'array' then "
+        "json_array_length(j, '$.tags') end from docs"))
+    assert {k: (None if v is None else int(v)) for k, v in got.items()} == want
+
+
+def test_json_type(db):
+    got = {r[0]: r[1] for r in db.session().sql(
+        "select id, json_type(j) as t from docs").rows()}
+    assert got == {1: "OBJECT", 2: "OBJECT", 3: "OBJECT", 4: None,
+                   5: "OBJECT", 6: "ARRAY"}
+    got2 = {r[0]: r[1] for r in db.session().sql(
+        "select id, json_type(j, '$.age') as t from docs").rows()}
+    assert got2[1] == "INTEGER" and got2[3] is None and got2[6] is None
+
+
+def test_numeric_predicate_pushdown(db, lite):
+    """CAST(->> AS ...) predicates: the extracted scalar compares on
+    device through a numeric LUT (one gather + compare per row)."""
+    got = [r[0] for r in db.session().sql(
+        "select id from docs where cast(j->>'$.age' as int) > 28 "
+        "order by id").rows()]
+    want = [k for (k,) in lite.execute(
+        "select id from docs where json_valid(j) and "
+        "cast(json_extract(j, '$.age') as int) > 28 order by id")]
+    assert got == want
+    got2 = [r[0] for r in db.session().sql(
+        "select id from docs where cast(j->>'$.score' as decimal(10,2)) "
+        "< 4.0").rows()]
+    assert got2 == [2]
+
+
+def test_extract_in_group_by(db):
+    rs = db.session().sql(
+        "select j->>'$.addr.city' as city, count(*) as n from docs "
+        "where j->>'$.addr.city' is not null group by city order by city")
+    assert rs.rows() == [("nyc", 1), ("sf", 1)]
+
+
+def test_json_object_constructor(db, lite):
+    got = db.session().sql(
+        "select json_object('id', id, 'who', j->>'$.name') as o "
+        "from docs where id <= 2 order by id").rows()
+    want = lite.execute(
+        "select json_object('id', id, 'who', json_extract(j, '$.name')) "
+        "from docs where id <= 2 order by id").fetchall()
+    for (g,), (w,) in zip(got, want):
+        assert json.loads(g) == json.loads(w)
+
+
+def test_json_array_constructor_nested(db):
+    (row,) = db.session().sql(
+        "select json_array(1, 'x', json_object('k', id)) as a "
+        "from docs where id = 1").rows()
+    assert json.loads(row[0]) == [1, "x", {"k": 1}]
+
+
+def test_constructor_literals_not_cache_confused(db):
+    """Two statements differing ONLY in constructor literals must not
+    share a cached formatting spec (the spec rides the cache key)."""
+    s = db.session()
+    a = s.sql("select json_object('a', id) as o from docs where id = 1")
+    b = s.sql("select json_object('b', id) as o from docs where id = 1")
+    assert json.loads(a.rows()[0][0]) == {"a": 1}
+    assert json.loads(b.rows()[0][0]) == {"b": 1}
+
+
+def test_json_in_dml_roundtrip(db):
+    s = db.session()
+    s.sql("create table t2 (k int primary key, d json)")
+    s.sql('insert into t2 values (1, \'{"v": 7}\')')
+    s.sql('update t2 set d = \'{"v": 8}\' where k = 1')
+    assert s.sql("select d->>'$.v' as v from t2").rows() == [("8",)]
+    s.sql("drop table t2")
+
+
+def test_bad_path_is_resolve_error(db):
+    from oceanbase_tpu.sql.logical import ResolveError
+
+    with pytest.raises((SqlError, ResolveError)):
+        db.session().sql("select j->'no dollar' as x from docs")
+
+
+def test_unquote_of_nonstring_keeps_json_text(db):
+    (r,) = db.session().sql(
+        "select json_unquote(json_extract(j, '$.tags')) as t "
+        "from docs where id = 1").rows()
+    assert json.loads(r[0]) == ["a", "b"]
+
+
+def test_null_and_empty_string_group_separately(db):
+    """Review finding: extracted SQL NULLs must not merge with genuine
+    empty strings under GROUP BY."""
+    s = db.session()
+    s.sql("create table ge (k int primary key, j json)")
+    s.sql("insert into ge values (1, '{\"e\": \"\"}'), (2, '{\"a\": 2}'), "
+          "(3, '{\"e\": \"\"}'), (4, '{\"e\": \"x\"}')")
+    rs = s.sql("select j->>'$.e' as e, count(*) as n from ge "
+               "group by e order by n desc")
+    got = {r[0]: r[1] for r in rs.rows()}
+    assert got == {"": 2, None: 1, "x": 1}
+    s.sql("drop table ge")
+
+
+def test_group_by_constructor_rejected_cleanly(db):
+    from oceanbase_tpu.sql.logical import ResolveError
+
+    with pytest.raises((SqlError, ResolveError)):
+        db.session().sql(
+            "select json_object('k', id) as o, count(*) as c "
+            "from docs group by o")
+
+
+@pytest.mark.parametrize("path", ['$."abc', "$.b[1", "$.b[x]", "no dollar"])
+def test_malformed_paths_clean_errors(db, path):
+    from oceanbase_tpu.sql.logical import ResolveError
+
+    with pytest.raises((SqlError, ResolveError)):
+        db.session().sql(f"select json_extract(j, '{path}') as x from docs")
