@@ -34,15 +34,75 @@ section), OB_TPU_DEVICE_BUDGET for the non-streamed device budget.
 
 import json
 import os
+import subprocess
 import time
 
 import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(REPO, ".bench_cache")
-ORDER = ["q6", "q14", "q3", "q1"]  # joins before Q1's 65s CPU baseline
+# cheap-first so a slow-tunnel night still lands every headline query:
+# q6/q14 slice-scans, q1 (46ms device + CACHED 65s cpu baseline), q3 last
+# (the join that ate the r4 budget) — and results PERSIST across runs, so
+# nothing measured is ever lost to a kill (r4 verdict weak #1)
+ORDER = ["q6", "q14", "q1", "q3"]
 QID = {"q1": 1, "q6": 6, "q3": 3, "q14": 14}
 START = time.monotonic()
+
+
+def _git_rev() -> str:
+    """HEAD short rev + a working-tree diff hash: uncommitted engine
+    changes must invalidate persisted measurements too."""
+    try:
+        rev = subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+        diff = subprocess.run(
+            ["git", "-C", REPO, "diff", "HEAD", "--", "oceanbase_tpu",
+             "bench.py"],
+            capture_output=True, text=True, timeout=20,
+        ).stdout
+        if diff:
+            import hashlib
+
+            rev += "-dirty" + hashlib.md5(diff.encode()).hexdigest()[:8]
+        return rev
+    except Exception:
+        return "unknown"
+
+
+REV = _git_rev()
+_RESULTS_PATH = os.path.join(CACHE, "results_v5.json")
+
+
+def _results() -> dict:
+    try:
+        with open(_RESULTS_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _results_put(key: str, rec: dict) -> None:
+    r = _results()
+    rec["rev"] = REV
+    r[key] = rec
+    try:
+        os.makedirs(CACHE, exist_ok=True)
+        tmp = _RESULTS_PATH + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(r, f)
+        os.replace(tmp, _RESULTS_PATH)
+    except OSError:
+        pass
+
+
+def _results_get(key: str) -> dict | None:
+    rec = _results().get(key)
+    if rec is not None and rec.get("rev") == REV:
+        return rec
+    return None
 
 # lineitem columns covered by the l_shipdate sorted projection (every
 # column the four headline queries touch)
@@ -331,6 +391,45 @@ def check_result(qname, rs, cpu_val):
 # ---------------------------------------------------------------------------
 
 
+def cpu_suite_main(sf: float) -> None:
+    """Measure the 22-query warm end-to-end suite on THIS jax backend and
+    persist to cpu_suite_sf{sf}.json (the TPU run's engine-vs-engine
+    baseline). Incremental: a partial run resumes where it stopped."""
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+
+    path = os.path.join(CACHE, f"cpu_suite_sf{sf:g}.json")
+    out = {}
+    try:
+        with open(path) as f:
+            out = json.load(f)
+    except (OSError, ValueError):
+        pass
+    tables, source = load_or_generate(sf)
+    ensure_projection(tables, sf)
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    seed_stats(sess, tables, sf)
+    for qid in range(1, 23):
+        if f"q{qid}" in out:
+            continue
+        text = QUERIES[qid]
+        t0 = time.perf_counter()
+        sess.sql(text)  # compile + first run
+        first = time.perf_counter() - t0
+        e2e, _ = _best(lambda t=text: sess.sql(t), 2)
+        out[f"q{qid}"] = round(e2e, 6)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, path)
+        emit({"metric": "cpu_suite_progress", "value": qid,
+              "unit": "queries",
+              "detail": {"q": qid, "e2e_s": out[f"q{qid}"],
+                         "first_s": round(first, 2)}})
+    emit({"metric": "cpu_suite_done", "value": len(out), "unit": "queries",
+          "detail": out})
+
+
 def main():
     # every emitted line is a COMPLETE cumulative summary, so a driver
     # kill mid-run never loses captured results — the self-budget only
@@ -357,6 +456,12 @@ def main():
 
     sf = float(os.environ.get("BENCH_SF", "10"))
     cpu_reps = 2 if sf <= 1 else 1
+
+    if os.environ.get("BENCH_CPU_SUITE") == "1":
+        # offline populator: the engine itself on the CPU backend is the
+        # suite baseline (run with JAX_PLATFORMS=cpu); writes
+        # cpu_suite_sf{sf}.json incrementally
+        return cpu_suite_main(sf)
 
     from oceanbase_tpu.engine import Session
     from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
@@ -413,12 +518,30 @@ def main():
     detail["stats_s"] = round(time.perf_counter() - t0, 1)
     tpu_t, cpu_t = {}, {}
     summary(tpu_t, cpu_t)  # tables line: a kill during q6 still parses
-    worst_q = 45.0
+
+    def _restore(qname: str) -> bool:
+        """Reuse a persisted same-rev measurement (kills never erase)."""
+        rec = _results_get(f"head:{qname}@sf{sf:g}")
+        if rec is None or rec.get("correct") is not True:
+            return False  # never immortalize a wrong-result measurement
+        tpu_t[qname] = rec["tpu_s"]
+        cpu_t[qname] = rec["cpu_s"]
+        for k, v in rec.items():
+            if k != "rev":
+                detail[f"{qname}_{k}"] = v
+        detail[f"{qname}_restored"] = True
+        return True
+
+    # conservative fresh-measurement cost estimates (seconds); cached CPU
+    # baselines make repeat runs far cheaper than these
+    est_cost = {"q6": 60.0, "q14": 60.0, "q1": 90.0, "q3": 120.0}
     for qname in ORDER:
-        if elapsed() > budget - worst_q:
+        if _restore(qname):
+            summary(tpu_t, cpu_t)
+            continue
+        if elapsed() > budget - est_cost[qname]:
             detail[f"{qname}_skipped"] = "budget"
             continue
-        q_start = elapsed()
         text = QUERIES[QID[qname]]
         try:
             cpu_t[qname], cpu_val, src = cpu_baseline(
@@ -450,8 +573,8 @@ def main():
                 K = 64
                 t, _ = _best(lambda: _run_k(K), max(2, reps // 2))
             tpu_t[qname] = t / K
-            detail[f"{qname}_dispatch_k"] = K
             qd = {
+                "dispatch_k": K,
                 "tpu_s": round(tpu_t[qname], 6),
                 "cpu_s": round(cpu_t[qname], 6),
                 "cpu_source": src,
@@ -463,9 +586,67 @@ def main():
             }
             for k, v in qd.items():
                 detail[f"{qname}_{k}"] = v
+            _results_put(f"head:{qname}@sf{sf:g}", qd)
         except Exception as e:  # pragma: no cover — keep partial results
             detail[f"{qname}_error"] = f"{type(e).__name__}: {e}"
-        worst_q = max(worst_q, (elapsed() - q_start) * 1.1)
+        summary(tpu_t, cpu_t)
+
+    # ---- full 22-query timed suite (QphH-style composite) -------------
+    # Every query times its WARM end-to-end latency through the session;
+    # per-query results persist across runs (the XLA persistent cache
+    # makes repeat compiles cheap), so the suite fills incrementally and
+    # a complete composite emerges even under tight budgets. Baseline:
+    # the SAME engine on the CPU backend (a vectorized CPU engine),
+    # measured offline into cpu_suite_sf{sf}.json.
+    run_suite = os.environ.get("BENCH_SUITE", "1") == "1"
+    if run_suite and elapsed() < budget - 30:
+        cpu_suite = {}
+        try:
+            with open(os.path.join(CACHE, f"cpu_suite_sf{sf:g}.json")) as f:
+                cpu_suite = json.load(f)
+        except (OSError, ValueError):
+            pass
+        suite_times = {}
+        for qid in range(1, 23):
+            key = f"suite:q{qid}@sf{sf:g}"
+            rec = _results_get(key)
+            if rec is not None:
+                suite_times[qid] = rec["e2e_s"]
+                continue
+            if elapsed() > budget - 45:
+                break
+            try:
+                text = QUERIES[qid]
+                sess.sql(text)  # compile (persistent-cache assisted)
+                e2e, _ = _best(lambda t=text: sess.sql(t), 2)
+                suite_times[qid] = e2e
+                _results_put(key, {"e2e_s": round(e2e, 6)})
+            except Exception as e:
+                detail[f"suite_q{qid}_error"] = f"{type(e).__name__}: {e}"
+        if suite_times:
+            ts = list(suite_times.values())
+            geo = float(np.exp(np.mean(np.log(ts))))
+            detail["suite_queries_timed"] = len(suite_times)
+            detail["suite_total_s"] = round(float(np.sum(ts)), 3)
+            detail["suite_geomean_s"] = round(geo, 4)
+            # QphH-style power metric: 3600 * SF / geometric-mean seconds
+            detail["suite_power_at_sf"] = round(3600.0 * sf / geo, 1)
+            detail["suite_times_s"] = {
+                f"q{q}": round(t, 4) for q, t in sorted(suite_times.items())
+            }
+            if cpu_suite:
+                sps = [
+                    cpu_suite[f"q{q}"] / t
+                    for q, t in suite_times.items()
+                    if f"q{q}" in cpu_suite
+                ]
+                if sps:
+                    detail["suite_geomean_speedup_vs_cpu_engine"] = round(
+                        float(np.exp(np.mean(np.log(sps)))), 3
+                    )
+                    detail["suite_cpu_engine_source"] = (
+                        f"cpu_suite_sf{sf:g}.json (same engine, cpu backend)"
+                    )
         summary(tpu_t, cpu_t)
 
     # ---- out-of-core streamed section (SF >= 30 through the chunked

@@ -233,16 +233,28 @@ def batch_to_host(batch: ColumnBatch, decode_strings: bool = True) -> dict[str, 
     garbage payloads stored under invalid slots.
     """
     sel = np.asarray(batch.sel)
+    cols = {f.name: np.asarray(batch.cols[f.name]) for f in batch.schema.fields}
+    valid = {n: np.asarray(v) for n, v in batch.valid.items()}
+    return host_rows(
+        batch.schema, batch.dicts, cols, valid, sel,
+        decode_strings=decode_strings,
+    )
+
+
+def host_rows(schema, dicts, hcols, hvalid, hsel,
+              decode_strings: bool = True) -> dict[str, np.ndarray | list]:
+    """batch_to_host over ALREADY-FETCHED numpy arrays (the single-
+    device_get dispatch path, engine/executor.py run_host)."""
     out: dict[str, np.ndarray | list] = {}
-    for f in batch.schema.fields:
-        a = np.asarray(batch.cols[f.name])[sel]
-        v = batch.valid.get(f.name)
-        vm = np.asarray(v)[sel] if v is not None else None
-        if f.dtype.kind is TypeKind.VARCHAR and decode_strings and f.name in batch.dicts:
+    for f in schema.fields:
+        a = np.asarray(hcols[f.name])[hsel]
+        v = hvalid.get(f.name)
+        vm = np.asarray(v)[hsel] if v is not None else None
+        if f.dtype.kind is TypeKind.VARCHAR and decode_strings and f.name in dicts:
             codes = a.copy()
             if vm is not None:
                 codes[~vm] = -1  # Dictionary.decode maps negatives to None
-            out[f.name] = batch.dicts[f.name].decode(codes)
+            out[f.name] = dicts[f.name].decode(codes)
         elif f.dtype.is_decimal:
             d = a.astype(np.float64) / f.dtype.decimal_factor
             if vm is not None:

@@ -1568,9 +1568,12 @@ class Executor:
         def emit(op, inputs) -> tuple[ColumnBatch, dict[int, jnp.ndarray]]:
             return self._emit_node(op, inputs, emit, params, id_of)
 
+        qparam_spec = _collect_qparam_spec(plan)
+
         def run(inputs: dict[str, ColumnBatch], qparams: tuple = ()):
             from ..expr import compile as expr_compile
 
+            qparams = _unpack_qparams(qparams, qparam_spec)
             prev = expr_compile.set_params(qparams if qparams else None)
             try:
                 out, ovf = emit(plan, inputs)
@@ -1579,9 +1582,11 @@ class Executor:
             out, oc = compact_batch(out, params.join_cap[ROOT_COMPACT])
             ovf = dict(ovf)
             ovf[ROOT_COMPACT] = oc
-            ovf_vec = [
+            # ONE stacked vector: the host reads every counter in a single
+            # fetch (per-scalar int() costs one tunnel roundtrip EACH)
+            ovf_vec = jnp.stack([
                 ovf.get(nid, jnp.zeros((), jnp.int64)) for nid in overflow_nodes
-            ]
+            ]) if overflow_nodes else jnp.zeros((0,), jnp.int64)
             return out, ovf_vec
 
         return jax.jit(run), input_spec, overflow_nodes
@@ -2902,6 +2907,110 @@ class Executor:
         return self.prepare(plan).run(max_retries)
 
 
+def _collect_qparam_spec(plan) -> list | None:
+    """Parameter slots of a parameterized plan, in slot order: list of
+    DataType per slot, or None when any parameter cannot ride the packed
+    int64 vector (vector literals). The packed form exists because every
+    separate qparam scalar is one more host->device transfer per dispatch
+    — through the axon tunnel each costs a roundtrip."""
+    import dataclasses as _dc
+
+    slots: dict[int, object] = {}
+    bad = False
+
+    def expr_walk(e):
+        nonlocal bad
+        if isinstance(e, E.Literal):
+            if e.slot is not None:
+                if e.dtype.kind is TypeKind.VECTOR:
+                    bad = True
+                slots[e.slot] = e.dtype
+            return
+        if not hasattr(e, "__dataclass_fields__"):
+            return
+        for f in _dc.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, E.Expr):
+                expr_walk(v)
+            elif isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, E.Expr):
+                        expr_walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, E.Expr):
+                                expr_walk(y)
+
+    def op_walk(op):
+        for f in _dc.fields(op):
+            v = getattr(op, f.name)
+            if isinstance(v, LogicalOp):
+                op_walk(v)
+            elif isinstance(v, E.Expr):
+                expr_walk(v)
+            elif isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, LogicalOp):
+                        op_walk(x)
+                    elif isinstance(x, E.Expr):
+                        expr_walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, LogicalOp):
+                                op_walk(y)
+                            elif isinstance(y, E.Expr):
+                                expr_walk(y)
+
+    op_walk(plan)
+    if bad:
+        return None
+    if not slots:
+        return []
+    if sorted(slots) != list(range(len(slots))):
+        return None  # non-dense slots: stay on the legacy tuple
+    return [slots[i] for i in range(len(slots))]
+
+
+def _unpack_qparams(qparams, spec):
+    """Inside the traced program: rebuild the per-slot scalar tuple from
+    the packed int64 vector (floats ride as bitcast bits)."""
+    if not isinstance(qparams, jnp.ndarray):
+        return qparams  # legacy tuple path (PX, chunked, direct callers)
+    if spec is None:
+        raise AssertionError("packed qparams without a pack spec")
+    out = []
+    for i, dt in enumerate(spec):
+        raw = qparams[i]
+        if dt.is_float:
+            v = jax.lax.bitcast_convert_type(raw, jnp.float64)
+            out.append(v.astype(dt.storage_np))
+        else:
+            out.append(raw.astype(dt.storage_np))
+    return tuple(out)
+
+
+def pack_qparams(values, dtypes, spec) -> "np.ndarray | tuple":
+    """Host side of the packed-parameter ABI: one int64 vector for the
+    whole parameter set (or the legacy tuple when the spec opted out)."""
+    from ..expr.compile import bind_value
+
+    if spec is None or len(spec) != len(values):
+        import jax.numpy as _jnp
+
+        return tuple(
+            _jnp.asarray(bind_value(v, t)) for v, t in zip(values, dtypes)
+        )
+    out = np.empty(len(values), dtype=np.int64)
+    for i, (v, t) in enumerate(zip(values, dtypes)):
+        s = bind_value(v, t)
+        a = np.asarray(s)
+        if a.dtype.kind == "f":
+            out[i] = np.float64(a).view(np.int64)
+        else:
+            out[i] = np.int64(a)
+    return out
+
+
 class PreparedPlan:
     """A compiled plan: jitted XLA program + static capacities. Re-runnable;
     transparently recompiles at larger capacities on overflow."""
@@ -2914,6 +3023,12 @@ class PreparedPlan:
         self.input_spec = input_spec
         self.overflow_nodes = overflow_nodes
         self.retries = 0  # lifetime overflow-recompile count (plan monitor)
+        self._qparam_spec = _collect_qparam_spec(plan)
+
+    def bind(self, values, dtypes):
+        """Values -> the dispatch form (one packed int64 vector when the
+        plan's parameter set allows it — one upload instead of N)."""
+        return pack_qparams(values, dtypes, self._qparam_spec)
 
     def _inputs(self):
         try:
@@ -2946,17 +3061,50 @@ class PreparedPlan:
             checkpoint()  # between overflow retries (and before the first run)
             inputs = self._inputs()
             out, ovf_vec = self.jitted(inputs, qparams)
-            overflows = {
-                nid: int(v)
-                for nid, v in zip(self.overflow_nodes, ovf_vec)
-                if int(v) > 0
-            }
+            overflows = self._overflows(np.asarray(ovf_vec))  # ONE fetch
             if not overflows:
                 return out
             if attempt == max_retries:
                 raise RuntimeError(
                     f"capacity overflow after {max_retries} retries: {overflows}"
                 )
+            self.retries += 1
+            self.params.bump(overflows)
+            self.jitted, self.input_spec, self.overflow_nodes = (
+                self.executor.compile(self.plan, self.params)
+            )
+        raise AssertionError
+
+    def _overflows(self, hovf) -> dict:
+        return {
+            nid: int(v)
+            for nid, v in zip(self.overflow_nodes, hovf)
+            if int(v) > 0
+        }
+
+    def run_host(self, max_retries: int = 3, qparams: tuple = ()):
+        """Dispatch + fetch EVERYTHING (result columns, validity, sel,
+        overflow counters) in ONE device_get. The separate run() +
+        batch_to_host path costs one tunnel roundtrip per array; for a
+        short query those roundtrips dominate end-to-end latency. Returns
+        (host_cols, host_valid, host_sel, schema, dicts)."""
+        import jax as _jax
+
+        from ..share.interrupt import checkpoint
+
+        for attempt in range(max_retries + 1):
+            checkpoint()
+            inputs = self._inputs()
+            out, ovf_vec = self.jitted(inputs, qparams)
+            hovf, hcols, hvalid, hsel = _jax.device_get(
+                (ovf_vec, out.cols, out.valid, out.sel))
+            overflows = self._overflows(hovf)
+            if not overflows:
+                return hcols, hvalid, hsel, out.schema, out.dicts
+            if attempt == max_retries:
+                raise RuntimeError(
+                    f"capacity overflow after {max_retries} retries: "
+                    f"{overflows}")
             self.retries += 1
             self.params.bump(overflows)
             self.jitted, self.input_spec, self.overflow_nodes = (

@@ -138,6 +138,11 @@ class Session:
         entry = self.plan_cache.get(key)
         if entry is None:
             return None, None
+        if hasattr(entry.prepared, "bind"):
+            # the SAME dispatch form sql() used (packed int64 vector):
+            # a tuple here would change the jit signature and silently
+            # re-trace + re-compile the plan (review finding)
+            return entry, entry.prepared.bind(pz.values, entry.dtypes)
         return entry, bind(pz.values, entry.dtypes)
 
     def _cache_key(self, norm_key: str, pz) -> tuple:
@@ -201,11 +206,26 @@ class Session:
                 entry.monitor = self.plan_monitor.register(norm_key, compile_s)
             if use_cache:
                 self.plan_cache.put(key, entry)
-        qparams = bind(pz.values, entry.dtypes)
-        t0 = time.perf_counter()
-        out_batch = entry.prepared.run(qparams=qparams)
-        exec_s = time.perf_counter() - t0
-        host = batch_to_host(out_batch)
+        if hasattr(entry.prepared, "run_host"):
+            # packed parameter upload + single-device_get dispatch: ONE
+            # host->device transfer for the whole parameter set, ONE
+            # device->host fetch for results + validity + sel + overflow
+            # counters (per-array fetches each cost a tunnel roundtrip)
+            from ..core.column import host_rows
+
+            qparams = entry.prepared.bind(pz.values, entry.dtypes)
+            t0 = time.perf_counter()
+            hcols, hvalid, hsel, oschema, odicts = entry.prepared.run_host(
+                qparams=qparams)
+            exec_s = time.perf_counter() - t0
+            host = host_rows(oschema, odicts, hcols, hvalid, hsel)
+        else:
+            # chunked / PX prepared plans: device-batch contract
+            qparams = bind(pz.values, entry.dtypes)
+            t0 = time.perf_counter()
+            out_batch = entry.prepared.run(qparams=qparams)
+            exec_s = time.perf_counter() - t0
+            host = batch_to_host(out_batch)
         # order columns per select list
         cols = {n: host[n] for n in entry.output_names}
         out_names = entry.output_names

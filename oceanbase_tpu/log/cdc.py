@@ -41,6 +41,10 @@ class TxChange:
     # (tablet_id, column, code, string): dictionary growth logged with the
     # tx, letting consumers decode VARCHAR codes without leader state
     dict_appends: tuple = ()
+    # 2PC/XA: every participant LS (from the prepare record) — consumers
+    # needing cross-LS atomicity (the standby) hold a tx until all
+    # participants' streams emitted it
+    participants: tuple[int, ...] = ()
 
 
 @dataclass
@@ -57,24 +61,30 @@ class CdcClient:
             if rec.rtype is RecordType.REDO_COMMIT:
                 out.append(self._tx_change(rec.tx_id, rec.commit_version,
                                            rec.mutations, rec.dict_appends))
-            elif rec.rtype is RecordType.PREPARE:
-                self._pending[rec.tx_id] = (rec.mutations, rec.dict_appends)
+            elif rec.rtype in (RecordType.PREPARE, RecordType.XA_PREPARE):
+                # XA parks between prepare and the external decision but
+                # the CDC contract is identical: redo surfaces only with
+                # the COMMIT record's version
+                self._pending[rec.tx_id] = (
+                    rec.mutations, rec.dict_appends, rec.participants)
             elif rec.rtype is RecordType.COMMIT:
-                muts, da = self._pending.pop(rec.tx_id, ((), ()))
+                muts, da, parts = self._pending.pop(
+                    rec.tx_id, ((), (), ()))
                 out.append(self._tx_change(rec.tx_id, rec.commit_version,
-                                           muts, da))
+                                           muts, da, parts))
             elif rec.rtype is RecordType.ABORT:
                 self._pending.pop(rec.tx_id, None)
         return out
 
-    def _tx_change(self, tx_id, version, mutations, dict_appends) -> TxChange:
+    def _tx_change(self, tx_id, version, mutations, dict_appends,
+                   participants=()) -> TxChange:
         rows = tuple(
             RowChange(m.tablet_id, "put" if m.op == 0 else "delete",
                       m.key, m.values)
             for m in mutations
         )
         return TxChange(tx_id, version, self.ls_id, rows,
-                        tuple(dict_appends))
+                        tuple(dict_appends), tuple(participants))
 
     def poll_palf(self, palf) -> list[TxChange]:
         """Consume newly committed entries from a live replica."""

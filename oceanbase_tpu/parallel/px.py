@@ -913,9 +913,16 @@ class PxExecutor(Executor):
         def emit(op, inputs):
             return self._emit_node(op, inputs, emit, params, id_of)
 
+        from ..engine.executor import _collect_qparam_spec, _unpack_qparams
+
+        qparam_spec = _collect_qparam_spec(plan)
+
         def run_local(raw_inputs, qparams):
             from ..expr import compile as expr_compile
 
+            # packed-vector ABI parity with the single-chip PreparedPlan
+            # (a packed array here would otherwise hit bool(tracer))
+            qparams = _unpack_qparams(qparams, qparam_spec)
             inputs = {}
             for alias, raw in raw_inputs.items():
                 schema, dicts = side[alias]
@@ -946,12 +953,12 @@ class PxExecutor(Executor):
             # overflow counters must leave the shard_map replicated; psum
             # may multiply already-replicated counters by nsh, which is
             # harmless (the driver only tests >0)
-            ovf_vec = [
+            ovf_vec = jnp.stack([
                 lax.psum(
                     ovf.get(n, jnp.zeros((), jnp.int64)), SHARD_AXIS
                 )
                 for n in overflow_nodes
-            ]
+            ]) if overflow_nodes else jnp.zeros((0,), jnp.int64)
             return out, ovf_vec
 
         def run(raw_inputs, qparams):

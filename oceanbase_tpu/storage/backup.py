@@ -132,6 +132,10 @@ def restore_database(root: str, n_nodes: int = 3, n_ls: int = 2,
         old_to_new[tmeta["tablet_id"]] = (ti, schema)
 
     db.cluster.gts.advance_to(backup_scn)
+    # PRIMARY tablet id -> restored TableInfo: archived redo and standby
+    # tailing (ha/standby.py) address original tablet ids
+    db._restore_tablet_map = {old: ti for old, (ti, _s) in old_to_new.items()}
+    db._restore_backup_scn = backup_scn
 
     if archive_root is not None:
         # PITR: replay archived commits in version order past the backup
