@@ -405,6 +405,8 @@ def cpu_suite_main(sf: float) -> None:
             out = json.load(f)
     except (OSError, ValueError):
         pass
+    if out.get("_rev") != REV:
+        out = {}  # partial suite from another engine build: start fresh
     tables, source = load_or_generate(sf)
     ensure_projection(tables, sf)
     sess = Session(tables, unique_keys=UNIQUE_KEYS)
@@ -418,6 +420,7 @@ def cpu_suite_main(sf: float) -> None:
         first = time.perf_counter() - t0
         e2e, _ = _best(lambda t=text: sess.sql(t), 2)
         out[f"q{qid}"] = round(e2e, 6)
+        out["_rev"] = REV  # provenance: which engine build measured these
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(out, f)
@@ -647,6 +650,10 @@ def main():
                     detail["suite_cpu_engine_source"] = (
                         f"cpu_suite_sf{sf:g}.json (same engine, cpu backend)"
                     )
+                    # provenance: the CPU numbers' engine build vs this one
+                    detail["suite_cpu_engine_rev"] = cpu_suite.get(
+                        "_rev", "unknown")
+                    detail["suite_tpu_engine_rev"] = REV
         summary(tpu_t, cpu_t)
 
     # ---- out-of-core streamed section (SF >= 30 through the chunked

@@ -580,8 +580,10 @@ class ChunkedPreparedPlan:
             s = e
         if n == 0:
             windows.append((0, 0))
-        pending: deque = deque()  # (s, e, attempts, out, ovf_dev)
+        pending: deque = deque()  # (s, e, gen, out, ovf_dev)
         attempts_of: dict = {}
+        params_gen = 0  # bumps once per recompile (review: two in-flight
+        # chunks overflowing the same node must not DOUBLE-bump capacities)
         cols: dict[str, list] = {f.name: [] for f in self.partial_schema.fields}
         valids: dict[str, list] = {}
         dicts = {}
@@ -591,13 +593,13 @@ class ChunkedPreparedPlan:
             self.chunk_exec.set_chunk(ws, we)
             out, ovf = self.chunk_prepared.jitted(
                 self.chunk_prepared._inputs(), qparams)
-            pending.append((ws, we, out, ovf))
+            pending.append((ws, we, params_gen, out, ovf))
 
         while windows or pending:
             checkpoint()  # a killed query stops between chunks
             while windows and len(pending) < depth:
                 dispatch(windows.popleft())
-            ws, we, out, ovf = pending.popleft()
+            ws, we, gen, out, ovf = pending.popleft()
             fetch_cols = {
                 f.name: out.cols[f.name] for f in self.partial_schema.fields
             }
@@ -609,21 +611,29 @@ class ChunkedPreparedPlan:
                 (ovf, fetch_cols, fetch_valid, out.sel))
             overflows = self.chunk_prepared._overflows(np.asarray(hovf))
             if overflows:
-                a = attempts_of.get(ws, 0)
-                if a >= max_retries:
-                    raise RuntimeError(
-                        f"chunk [{ws},{we}) capacity overflow after "
-                        f"{max_retries} retries: {overflows}")
-                attempts_of[ws] = a + 1
-                self.retries += 1
-                self.chunk_prepared.retries += 1
-                self.chunk_prepared.params.bump(overflows)
-                (self.chunk_prepared.jitted,
-                 self.chunk_prepared.input_spec,
-                 self.chunk_prepared.overflow_nodes) = (
-                    self.chunk_prepared.executor.compile(
-                        self.chunk_prepared.plan,
-                        self.chunk_prepared.params))
+                if gen == params_gen:
+                    # first overflow since the last recompile: bump and
+                    # rebuild. Only THIS path consumes a retry attempt —
+                    # a sibling chunk dispatched pre-bump re-runs on the
+                    # grown capacities for free (its overflow may already
+                    # be covered; capacities grow monotonically, so the
+                    # loop always progresses)
+                    a = attempts_of.get(ws, 0)
+                    if a >= max_retries:
+                        raise RuntimeError(
+                            f"chunk [{ws},{we}) capacity overflow after "
+                            f"{max_retries} retries: {overflows}")
+                    attempts_of[ws] = a + 1
+                    self.retries += 1
+                    self.chunk_prepared.retries += 1
+                    self.chunk_prepared.params.bump(overflows)
+                    (self.chunk_prepared.jitted,
+                     self.chunk_prepared.input_spec,
+                     self.chunk_prepared.overflow_nodes) = (
+                        self.chunk_prepared.executor.compile(
+                            self.chunk_prepared.plan,
+                            self.chunk_prepared.params))
+                    params_gen += 1
                 # in-flight chunks used the SMALL capacities: their own
                 # counters decide their fate when drained; this chunk
                 # re-dispatches at the head of the queue

@@ -180,7 +180,7 @@ def infer_type(e: Expr, schema: Schema) -> DataType:
             for a in e.args[1:]:
                 t = common_numeric_type(t, infer_type(a, schema))
             return t
-        if e.name == "substr":
+        if e.name == "substr" or e.name in CASE_FUNC_IMPL:
             return DataType.varchar(infer_type(e.args[0], schema).nullable)
         raise NotImplementedError(f"function {e.name}")
     raise NotImplementedError(type(e))
@@ -205,6 +205,18 @@ def _like_to_regex(pattern: str) -> re.Pattern:
         else:
             out.append(re.escape(ch))
     return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+# every function evaluable as a per-dictionary-value transform (the
+# string-view family): ONE list shared by type inference, projection
+# derivation, value-context errors, and the planner's group-key
+# pre-projection — add new string functions here once
+STRING_VIEW_FUNCS = (
+    "substr", "json_extract", "json_unquote", "json_type",
+    "lower", "upper", "trim",
+)
+# host implementations of the simple case/space transforms
+CASE_FUNC_IMPL = {"lower": str.lower, "upper": str.upper, "trim": str.strip}
 
 
 def _merge_valid(*vs):
@@ -699,6 +711,16 @@ def _string_view(e: Expr, batch: ColumnBatch):
         else:
             vals2 = [None if v is None else v[s0:] for v in vals]
         return codes, valid, vals2
+    if isinstance(e, Func) and e.name in CASE_FUNC_IMPL:
+        # case mapping / trimming once per DISTINCT value: the engine's
+        # answer to case-insensitive collations (ob_charset.h) — compare /
+        # group / join on lower(col) instead of a per-row collation sweep
+        base = _string_view(e.args[0], batch)
+        if base is None:
+            return None
+        codes, valid, vals = base
+        f = CASE_FUNC_IMPL[e.name]
+        return codes, valid, [None if v is None else f(v) for v in vals]
     if isinstance(e, Func) and e.name in (
         "json_extract", "json_unquote", "json_type"
     ):
@@ -751,9 +773,7 @@ def derive_dict_column(e: Expr, batch: ColumnBatch):
     (group-by, joins, output decode) see an ordinary dict column."""
     from ..core.dictionary import Dictionary
 
-    if not (isinstance(e, Func) and e.name in (
-        "substr", "json_extract", "json_unquote", "json_type"
-    )):
+    if not (isinstance(e, Func) and e.name in STRING_VIEW_FUNCS):
         return None
     view = _string_view(e, batch)
     if view is None:
@@ -838,7 +858,7 @@ def _eval_func(e: Func, batch: ColumnBatch):
         )
         return jnp.asarray(lut)[jnp.clip(codes, 0, max(len(vals) - 1, 0))], valid
 
-    if e.name in ("json_extract", "json_unquote", "json_type"):
+    if e.name in STRING_VIEW_FUNCS and e.name != "substr":
         # value context without a dictionary sink (e.g. a join key):
         # unreachable from projections (derive_dict_column handles those)
         raise NotImplementedError(

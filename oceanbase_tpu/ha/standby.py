@@ -56,32 +56,52 @@ class StandbyCluster:
         # tablet id on the PRIMARY -> restored TableInfo (archived redo
         # addresses original tablet ids; restore_database records the map)
         self._by_primary_tablet = dict(self.db._restore_tablet_map)
-        # multi-LS txs buffered until all participants emitted
-        self._partial: dict[int, dict] = {}
+        # per-LS FIFO of not-yet-applied changes: apply must follow each
+        # stream's LOG ORDER — a held cross-LS tx BLOCKS everything behind
+        # it on its stream (prefix consistency: a later tx may depend on
+        # state — e.g. dictionary codes — the held tx creates)
+        from collections import deque
+
+        self._queues: dict[int, deque] = {
+            ls: deque() for ls in self.db.cluster.ls_groups
+        }
         self.catch_up()
 
     # ------------------------------------------------------------- tailing
     def catch_up(self) -> int:
-        """Poll every LS archive and apply newly complete transactions.
-        Returns the number of transactions applied."""
+        """Poll every LS archive and apply the COMPLETE PREFIX of each
+        stream: single-LS txs apply in log order; a cross-LS tx applies
+        only once it heads every participant's queue (atomic, and nothing
+        behind it on any stream overtakes it). Returns txs applied."""
         if self.promoted:
             raise StandbyError("already promoted; standby tailing ended")
-        fresh = []
         for ls, cdc in self._cdc.items():
-            fresh.extend(
+            self._queues[ls].extend(
                 cdc.poll_archive(ArchiveReader(self.archive_root, ls)))
         ready = []
-        for ch in fresh:
-            nparts = len(set(ch.participants)) or 1
-            if nparts <= 1:
-                ready.append(ch)
-                continue
-            ent = self._partial.setdefault(
-                ch.tx_id, {"seen": {}, "nparts": nparts})
-            ent["seen"][ch.ls_id] = ch
-            if len(ent["seen"]) == ent["nparts"]:
-                ready.extend(ent["seen"].values())
-                del self._partial[ch.tx_id]
+        progress = True
+        while progress:
+            progress = False
+            for ls in sorted(self._queues):
+                q = self._queues[ls]
+                while q:
+                    ch = q[0]
+                    parts = set(ch.participants) or {ls}
+                    if len(parts) <= 1:
+                        ready.append(q.popleft())
+                        progress = True
+                        continue
+                    heads_ok = all(
+                        self._queues.get(p)
+                        and self._queues[p][0].tx_id == ch.tx_id
+                        for p in parts
+                    )
+                    if heads_ok:
+                        for p in sorted(parts):
+                            ready.append(self._queues[p].popleft())
+                        progress = True
+                        continue
+                    break  # blocked: everything behind waits (prefix order)
         n = 0
         seen_tx = set()
         for ch in merge_streams(ready):
@@ -94,19 +114,11 @@ class StandbyCluster:
     def _apply_tx(self, ch) -> None:
         if ch.commit_version <= self._snapshot_scn:
             return  # inside the restored snapshot already
+        from ..server.database import apply_dict_appends
+
         db = self.db
         # dictionary growth first: row values reference the codes
-        for tab_id, col, code, s in ch.dict_appends:
-            ti = self._by_primary_tablet.get(tab_id)
-            if ti is None:
-                continue
-            d = ti.dicts.get(col)
-            if d is None:
-                continue
-            if code == len(d):
-                d.encode_one(s)
-            ti.logged_dict_len[col] = max(
-                ti.logged_dict_len.get(col, 0), code + 1)
+        apply_dict_appends(self._by_primary_tablet, ch.dict_appends)
         touched = set()
         for row in ch.rows:
             ti = self._by_primary_tablet.get(row.tablet_id)
@@ -140,11 +152,12 @@ class StandbyCluster:
         """End the standby role: final catch-up, then open for writes.
         Returns the now-primary Database."""
         self.catch_up()
-        if self._partial:
-            # a torn multi-LS tx at the failover point: the primary died
-            # before every participant archived its COMMIT — the decided
-            # half must not apply (the reference resolves through the
-            # coordinator log; without it, consistent = drop the tail)
-            self._partial.clear()
+        # a torn multi-LS tx at the failover point: the primary died
+        # before every participant archived its COMMIT — the decided
+        # half (and everything queued behind it) must not apply (the
+        # reference resolves through the coordinator log; without it,
+        # consistent = drop the tail)
+        for q in self._queues.values():
+            q.clear()
         self.promoted = True
         return self.db

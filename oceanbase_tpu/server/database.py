@@ -694,18 +694,7 @@ class Database:
             by_tab = self._ti_by_tablet = {
                 ti.tablet_id: ti for ti in self.tables.values()
             }
-        for tab_id, col, code, s in rec.dict_appends:
-            ti = by_tab.get(tab_id)
-            if ti is None:
-                continue
-            d = ti.dicts.get(col)
-            if d is None:
-                continue
-            if code == len(d):
-                d.encode_one(s)
-            ti.logged_dict_len[col] = max(
-                ti.logged_dict_len.get(col, 0), code + 1
-            )
+        apply_dict_appends(by_tab, rec.dict_appends)
 
     def checkpoint(self, recycle: bool = True) -> bool:
         """slog-ckpt analog: snapshot every replica's storage state, then
@@ -2955,6 +2944,24 @@ def _norm_stmt(tag: str, st) -> str:
 
         _LIT_MASK_RE = re.compile(r"(NumberLit|DateLit)\(value='[^']*'\)")
     return tag + ":" + _LIT_MASK_RE.sub(r"\1(value='?')", repr(st))
+
+
+def apply_dict_appends(by_tab: dict, dict_appends) -> None:
+    """Re-apply logged dictionary growth onto TableInfos (idempotent:
+    codes are dense and append-ordered). Shared by live record
+    observation (_on_applied_record) and the standby tail (ha/standby)."""
+    for tab_id, col, code, s in dict_appends:
+        ti = by_tab.get(tab_id)
+        if ti is None:
+            continue
+        d = ti.dicts.get(col)
+        if d is None:
+            continue
+        if code == len(d):
+            d.encode_one(s)
+        ti.logged_dict_len[col] = max(
+            ti.logged_dict_len.get(col, 0), code + 1
+        )
 
 
 def _eval_const(node: A.Node):

@@ -266,6 +266,30 @@ def _sequences(db) -> Table:
     ])
 
 
+def _views(db) -> Table:
+    names = sorted(db._view_specs)
+    return _t("__all_virtual_view", [
+        ("view_name", DataType.varchar(), names),
+        ("definition", DataType.varchar(),
+         [db._view_specs[n].strip()[:200] for n in names]),
+    ])
+
+
+def _triggers(db) -> Table:
+    names = sorted(db._trigger_specs)
+    return _t("__all_virtual_trigger", [
+        ("trigger_name", DataType.varchar(), names),
+        ("timing", DataType.varchar(),
+         [db._trigger_specs[n]["timing"] for n in names]),
+        ("event", DataType.varchar(),
+         [db._trigger_specs[n]["event"] for n in names]),
+        ("table_name", DataType.varchar(),
+         [db._trigger_specs[n]["table"] for n in names]),
+        ("body", DataType.varchar(),
+         [db._trigger_specs[n]["body"].strip()[:200] for n in names]),
+    ])
+
+
 def _mviews(db) -> Table:
     names = sorted(db._mview_specs)
     return _t("__all_virtual_mview", [
@@ -303,6 +327,8 @@ PROVIDERS = {
     "__all_virtual_external_table": _external_tables,
     "__all_virtual_server_stat": _server_stat,
     "__all_virtual_procedure": _procedures,
+    "__all_virtual_view": _views,
+    "__all_virtual_trigger": _triggers,
     "__all_virtual_sequence": _sequences,
     "__all_virtual_mview": _mviews,
     "__all_virtual_xa_transaction": _xa,

@@ -455,6 +455,19 @@ class Resolver:
             if node.name in ("json_extract", "json_unquote", "json_valid",
                              "json_type", "json_array_length"):
                 return self._json_call(node, allow_agg)
+            if node.name in ("lower", "upper", "trim", "lcase", "ucase"):
+                if len(node.args) != 1:
+                    raise ResolveError(f"{node.name}(string)")
+                canon = {"lcase": "lower", "ucase": "upper"}.get(
+                    node.name, node.name)
+                from ..expr.compile import CASE_FUNC_IMPL
+
+                arg = self.expr(node.args[0], allow_agg)
+                if isinstance(arg, E.Literal):
+                    # constant fold (also the only executable form for a
+                    # non-dictionary argument)
+                    return E.lit(CASE_FUNC_IMPL[canon](str(arg.value)))
+                return E.Func(canon, (arg,))
             if node.name in ("json_object", "json_array"):
                 raise ResolveError(
                     f"{node.name} is supported in the select list only "

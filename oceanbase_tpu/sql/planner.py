@@ -734,10 +734,11 @@ class Planner:
         # select items referencing a transformed key must substitute by the
         # ORIGINAL expression, not the post-rewrite ColRef
         orig_key_exprs = list(key_exprs)
+        from ..expr.compile import STRING_VIEW_FUNCS
+
         viewy = {
             n for n, e in key_exprs
-            if isinstance(e, E.Func) and e.name in (
-                "substr", "json_extract", "json_unquote", "json_type")
+            if isinstance(e, E.Func) and e.name in STRING_VIEW_FUNCS
         }
         if viewy:
             needed: set[str] = set()
@@ -1337,13 +1338,57 @@ class Planner:
             return plan
         remaining = {rel.alias: rel for rel in relations}
         sizes = {rel.alias: self._rel_rows(rel) for rel in relations}
+        alias_table = {
+            rel.alias: (rel.scan.table if rel.is_scan else None)
+            for rel in relations
+        }
+
+        def key_ndv(ref: E.ColRef) -> float | None:
+            alias, col = ref.name.split(".", 1)
+            t = alias_table.get(alias)
+            if t is None or self.stats is None:
+                return None
+            ts = self.stats.table_stats(t)
+            if ts is not None:
+                n = ts.ndv_of(col)
+                if n:
+                    return float(n)
+            uk = self.unique_keys.get(t)
+            if uk and tuple(uk) == (col,):
+                return float(self.catalog[t].nrows or 1)
+            return None
+
+        def est_out(cur: float, alias: str, keys) -> float:
+            """|R join S| ~= |R||S| / max(V(R,k), V(S,k)) — the NDV rule
+            that keeps many-to-many keys (Q5's c_nationkey=s_nationkey,
+            25 distinct values over millions of rows) from being picked
+            just because S itself is small."""
+            rows_a = sizes[alias]
+            best_sel = None
+            for l, r_ in keys:
+                a_ref, j_ref = (
+                    (l, r_) if l.name.split(".")[0] == alias else (r_, l)
+                )
+                va = key_ndv(a_ref)
+                vj = key_ndv(j_ref)
+                denom = max(
+                    min(va if va is not None else rows_a, rows_a),
+                    min(vj if vj is not None else cur, cur),
+                    1.0,
+                )
+                sel = 1.0 / denom
+                best_sel = sel if best_sel is None else min(best_sel, sel)
+            return cur * rows_a * (best_sel if best_sel is not None else 1.0)
+
         start = max(sizes, key=lambda a: sizes[a])
         joined = {start}
         plan = remaining.pop(start).plan
+        cur_rows = sizes[start]
         pending_equi = list(equi)
         while remaining:
             best = None
-            for alias in remaining:
+            best_rank = None
+            for alias in sorted(remaining):
                 keys = [
                     (l, r_)
                     for l, r_ in pending_equi
@@ -1358,14 +1403,18 @@ class Planner:
                 ]
                 if not keys:
                     continue
-                if best is None or sizes[alias] < sizes[best[0]]:
+                rank = (est_out(cur_rows, alias, keys), sizes[alias])
+                if best_rank is None or rank < best_rank:
                     best = (alias, keys)
+                    best_rank = rank
             if best is None:
                 alias = min(remaining, key=lambda a: sizes[a])
+                cur_rows *= max(sizes[alias], 1.0)
                 plan = JoinOp("cross", plan, remaining.pop(alias).plan)
                 joined.add(alias)
                 continue
             alias, keys = best
+            cur_rows = max(best_rank[0], 1.0)
             lkeys, rkeys = [], []
             for l, r_ in keys:
                 if l.name.split(".")[0] == alias:

@@ -122,3 +122,32 @@ def test_promote_failover(primary):
     with pytest.raises(StandbyError):
         sb.sql("select 1 as x")  # standby role ended
     newp.close()
+
+
+def test_prefix_consistency_behind_held_cross_ls_tx(primary):
+    """A later single-LS tx on the SAME stream must not overtake a held
+    cross-LS tx (review finding: it may depend on dictionary codes the
+    held tx creates — and committed-prefix order is the standby contract)."""
+    p, s, tmp = primary
+    sb = _standby(tmp)
+    # cross-LS tx A creates a new dictionary code; tx B reuses it
+    s.sql("begin")
+    s.sql("insert into t values (21, 1, 'shared-code')")
+    s.sql("update u set w = 7 where k = 1")
+    s.sql("commit")
+    s.sql("insert into t values (22, 2, 'shared-code')")  # 1PC, same LS
+    # archive ONLY t's LS: A is incomplete, so B must wait behind it
+    ti = p.tables["t"]
+    node = p.location.leader(ti.ls_id)
+    ArchiveWriter(str(tmp / "arch"), ti.ls_id).archive_from(
+        p.cluster.ls_groups[ti.ls_id][node].palf)
+    sb.catch_up()
+    assert sb.sql("select count(*) as c from t").rows() == [(2,)]
+    # full archive: A then B apply, in order, atomically
+    archive_database(p, str(tmp / "arch"))
+    sb.catch_up()
+    assert sb.sql("select name from t where k = 21").rows() == \
+        [("shared-code",)]
+    assert sb.sql("select name from t where k = 22").rows() == \
+        [("shared-code",)]
+    assert sb.sql("select w from u where k = 1").rows() == [(7,)]

@@ -256,3 +256,17 @@ def test_distinct_agg_null_group_separation(db):
                "group by g order by n desc")
     got = {r[0]: r[1] for r in rs.rows()}
     assert got == {"": 2, None: 2}
+
+
+def test_catalog_virtual_tables(db):
+    s = db.session()
+    s.sql("create view catv as select o_id from orders")
+    s.sql("create table ct (k int primary key)")
+    s.sql("create trigger catt before insert on ct for each row "
+          "set new.k = new.k")
+    rows = s.sql("select view_name from __all_virtual_view "
+                 "where view_name = 'catv'").rows()
+    assert rows == [("catv",)]
+    rows = s.sql("select trigger_name, timing, event, table_name "
+                 "from __all_virtual_trigger").rows()
+    assert ("catt", "before", "insert", "ct") in rows

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""TPC-DS star-join timed benchmark (BASELINE config 5's surface).
+
+Runs the star suite (Q3/Q42/Q52/Q55) at a real scale factor on the
+current jax backend, correctness-checked against a numpy oracle computed
+from the generated columns, and writes an incremental JSON artifact —
+each flush is a complete record, so a kill loses nothing.
+
+Usage:
+    python tools/tpcds_bench.py TPCDS_r05.json [sf]          # chip run
+    JAX_PLATFORMS=cpu python tools/tpcds_bench.py cpu.json 1 # baseline
+
+The CPU leg writes .bench_cache/tpcds_cpu_sf{sf}.json style numbers when
+pointed there; the chip run folds them in as vs_cpu_engine if present.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+CACHE = os.path.join(REPO, ".bench_cache")
+
+
+def _best(f, reps):
+    ts, out = [], None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def oracle_sums(tables, qid):
+    """Numpy oracle: the query's top-100 group sums in ITS order, as a
+    value list (order ties carry equal sums, so the value list is
+    deterministic even where tie order is not)."""
+    ss = tables["store_sales"]
+    item = tables["item"]
+    dt = tables["date_dim"]
+    item_m, year = {
+        3: (np.asarray(item.data["i_manufact_id"]) == 128, None),
+        42: (np.asarray(item.data["i_manager_id"]) == 1, 2000),
+        52: (np.asarray(item.data["i_manager_id"]) == 1, 2000),
+        55: (np.asarray(item.data["i_manager_id"]) == 28, 1999),
+    }[qid]
+    dm = np.asarray(dt.data["d_moy"]) == 11
+    if year is not None:
+        dm &= np.asarray(dt.data["d_year"]) == year
+    dsk = np.asarray(dt.data["d_date_sk"])
+    isk = np.asarray(item.data["i_item_sk"])
+    hi = int(max(dsk.max(), isk.max())) + 2
+    d_ok = np.zeros(hi, bool)
+    d_ok[dsk[dm]] = True
+    d_year = np.zeros(hi, np.int64)
+    d_year[dsk] = np.asarray(dt.data["d_year"])
+    i_ok = np.zeros(hi, bool)
+    i_ok[isk[item_m]] = True
+    i_grp = np.zeros(hi, np.int64)
+    gcol = "i_category_id" if qid == 42 else "i_brand_id"
+    i_grp[isk] = np.asarray(item.data[gcol])
+    fdt = np.asarray(ss.data["ss_sold_date_sk"])
+    fit = np.asarray(ss.data["ss_item_sk"])
+    fm = d_ok[fdt] & i_ok[fit]
+    years = d_year[fdt[fm]]
+    grp = i_grp[fit[fm]]
+    price = np.asarray(ss.data["ss_ext_sales_price"])[fm].astype(np.int64)
+    key = years * 1_000_000 + grp
+    uk, inv = np.unique(key, return_inverse=True)
+    sums = np.zeros(len(uk), np.int64)
+    np.add.at(sums, inv, price)
+    uy, ug = uk // 1_000_000, uk % 1_000_000
+    if qid in (3, 52):
+        order = np.lexsort((ug, -sums, uy))
+    elif qid == 42:
+        order = np.lexsort((ug, -sums))
+    else:
+        order = np.lexsort((ug, -sums))
+    top = order[:100]
+    return [round(float(s) / 100.0, 2) for s in sums[top]]
+
+
+def check(tables, qid, rs) -> bool:
+    want = oracle_sums(tables, qid)
+    scol = {3: "sum_agg", 42: "s", 52: "ext_price", 55: "ext_price"}[qid]
+    got = [round(float(v), 2) for v in rs.columns[scol]]
+    return len(got) == len(want) and all(
+        abs(g - w) < 0.02 for g, w in zip(got, want)
+    )
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "TPCDS_r05.json"
+    sf = float(sys.argv[2]) if len(sys.argv) > 2 else float(
+        os.environ.get("TPCDS_SF", "3"))
+
+    import jax
+
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.models.tpcds import datagen
+    from oceanbase_tpu.models.tpcds.sql_suite import QUERIES, UNIQUE_KEYS
+
+    res = {
+        "platform": jax.devices()[0].platform,
+        "sf": sf,
+        "queries": {},
+    }
+
+    def flush():
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(res, f, indent=1)
+        os.replace(tmp, out_path)
+
+    t0 = time.perf_counter()
+    tables = datagen.generate(sf=sf)
+    res["rows_store_sales"] = int(tables["store_sales"].nrows)
+    res["datagen_s"] = round(time.perf_counter() - t0, 1)
+    flush()
+
+    cpu_ref = {}
+    try:
+        with open(os.path.join(CACHE, f"tpcds_cpu_sf{sf:g}.json")) as f:
+            cpu_ref = json.load(f).get("queries", {})
+    except (OSError, ValueError):
+        pass
+
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    for qid in sorted(QUERIES):
+        text = QUERIES[qid]
+        t0 = time.perf_counter()
+        rs = sess.sql(text)
+        first = time.perf_counter() - t0
+        ok = check(tables, qid, rs)
+        e2e, _ = _best(lambda t=text: sess.sql(t), 3)
+        q = {
+            "e2e_s": round(e2e, 5),
+            "first_s": round(first, 2),
+            "rows": rs.nrows,
+            "correct": bool(ok),
+        }
+        ref = cpu_ref.get(str(qid)) or cpu_ref.get(f"q{qid}")
+        if isinstance(ref, dict):
+            ref = ref.get("e2e_s")
+        if ref:
+            q["vs_cpu_engine"] = round(float(ref) / e2e, 2)
+        res["queries"][f"q{qid}"] = q
+        flush()
+        print(f"q{qid}: e2e {e2e:.4f}s correct={ok}", flush=True)
+    ts = [q["e2e_s"] for q in res["queries"].values()]
+    if ts:
+        res["geomean_s"] = round(float(np.exp(np.mean(np.log(ts)))), 5)
+        res["all_correct"] = all(q["correct"] for q in res["queries"].values())
+    flush()
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
