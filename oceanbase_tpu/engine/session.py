@@ -51,7 +51,8 @@ class ResultSet:
 class Session:
     def __init__(self, catalog: dict[str, Table], unique_keys=None,
                  plan_cache: PlanCache | None = None, key_extra_fn=None,
-                 cache_enabled_fn=None, plan_monitor=None, views=None):
+                 cache_enabled_fn=None, plan_monitor=None, views=None,
+                 metrics=None):
         self.catalog = catalog
         from ..share.stats import StatsManager
 
@@ -73,6 +74,11 @@ class Session:
         self.cache_enabled_fn = cache_enabled_fn
         # hook: server/diag.PlanMonitor (per-plan compile/exec stats)
         self.plan_monitor = plan_monitor
+        # hook: share/metrics.MetricsRegistry (phase histograms + counters)
+        self.metrics = metrics
+        # per-statement phase breakdown of the LAST run_ast call (EXPLAIN
+        # ANALYZE reads it right after executing the analyzed statement)
+        self.last_phases: dict = {}
 
     def materialize(self, text: str, name: str) -> Table:
         """Run a SELECT and materialize its result as a storage-domain
@@ -189,13 +195,16 @@ class Session:
             raise ResolveError(str(err)) from None
         if jspecs:
             norm_key = f"{norm_key}|jh:{jspecs!r}"
+        t0 = time.perf_counter()
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
         key = self._cache_key(norm_key, pz)
+        plan_s = time.perf_counter() - t0
         if use_cache is None:
             use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
         entry = self.plan_cache.get(key) if use_cache else None
         was_hit = entry is not None
+        compile_s = 0.0
         if entry is None:
             t0 = time.perf_counter()
             prepared = self.executor.prepare(pz.plan)
@@ -206,6 +215,7 @@ class Session:
                 entry.monitor = self.plan_monitor.register(norm_key, compile_s)
             if use_cache:
                 self.plan_cache.put(key, entry)
+        retries0 = getattr(entry.prepared, "retries", 0)
         if hasattr(entry.prepared, "run_host"):
             # packed parameter upload + single-device_get dispatch: ONE
             # host->device transfer for the whole parameter set, ONE
@@ -240,4 +250,18 @@ class Session:
             mon.total_exec_s += exec_s
             mon.last_rows = rs.nrows
             mon.overflow_retries = entry.prepared.retries
+        self.last_phases = {
+            "plan_s": plan_s, "compile_s": compile_s, "exec_s": exec_s,
+            "cache_hit": was_hit, "rows": rs.nrows,
+        }
+        m = self.metrics
+        if m is not None and m.enabled:
+            m.observe("sql plan", plan_s)
+            if not was_hit:
+                m.observe("sql compile", compile_s)
+            m.observe("sql execute", exec_s)
+            m.add("result rows returned", rs.nrows)
+            retries = getattr(entry.prepared, "retries", 0) - retries0
+            if retries > 0:
+                m.add("overflow recompiles", retries)
         return rs

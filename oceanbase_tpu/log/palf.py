@@ -197,6 +197,10 @@ class PalfReplica:
     _scn: int = 0
     _term_start_lsn: int = 0
     _last_ack: dict[int, float] = field(default_factory=dict)
+    # wait-event bookkeeping (virtual-clock timestamps): submit->commit
+    # per lsn, append-send->ack per peer (both leader-side)
+    _submit_at: dict[int, float] = field(default_factory=dict)
+    _sent_at: dict[int, float] = field(default_factory=dict)
 
     def __post_init__(self):
         # constructor-provided membership = the config floor a truncation
@@ -288,9 +292,20 @@ class PalfReplica:
         lsn = len(self.log)
         self._scn = max(self._scn + 1, scn or 0)
         e = LogEntry(lsn, self.term, self._scn, payload)
+        m = getattr(self.bus, "metrics", None)
         self.log.append(e)
-        self._persist_append((e,))
-        self._persist_sync()  # durable before counting self in the quorum
+        if m is not None:
+            # "palf append": the leader's local durability window; "palf
+            # commit" (recorded on commit advance) measures the
+            # replication round on the bus's virtual clock
+            self._submit_at[lsn] = self.bus.now
+            m.add("palf log entries submitted")
+            with m.waiting("palf append"):
+                self._persist_append((e,))
+                self._persist_sync()
+        else:
+            self._persist_append((e,))
+            self._persist_sync()  # durable before counting self in the quorum
         self._advance_commit()  # single-replica groups commit immediately
         return lsn
 
@@ -436,6 +451,7 @@ class PalfReplica:
     def _advance_commit(self) -> None:
         # highest lsn replicated on a majority AND from the current term
         floor = max(self.commit_lsn, self.log.base - 1)
+        prev_commit = self.commit_lsn
         for lsn in range(len(self.log) - 1, floor, -1):
             if self.log[lsn].term != self.term:
                 break
@@ -443,6 +459,12 @@ class PalfReplica:
             if acked >= self._majority():
                 self.commit_lsn = lsn
                 break
+        if self.commit_lsn > prev_commit and self._submit_at:
+            m = getattr(self.bus, "metrics", None)
+            for lsn in range(prev_commit + 1, self.commit_lsn + 1):
+                t = self._submit_at.pop(lsn, None)
+                if t is not None and m is not None:
+                    m.wait("palf commit", self.bus.now - t)
         self._apply()
 
     def _apply(self) -> None:
@@ -511,6 +533,9 @@ class PalfReplica:
             else:
                 self.log.append(e)
                 appended.append(e)
+        mx = getattr(self.bus, "metrics", None)
+        if mx is not None and appended:
+            mx.add("palf log entries replicated", len(appended))
         if appended:
             self._persist_append(appended)
             # adopt any membership change in the appended suffix (config
@@ -534,6 +559,12 @@ class PalfReplica:
             self._step_down(m.term, None)
             return
         self._last_ack[src] = self.bus.now
+        mx = getattr(self.bus, "metrics", None)
+        if mx is not None:
+            mx.add("palf acks received")
+            sent = self._sent_at.pop(src, None)
+            if sent is not None:
+                mx.wait("palf ack", self.bus.now - sent)
         if m.success:
             self._match_lsn[src] = max(self._match_lsn.get(src, -1), m.ack_lsn)
             self._next_lsn[src] = self._match_lsn[src] + 1
@@ -558,6 +589,9 @@ class PalfReplica:
         else:
             prev_term = self.log[prev_lsn].term
         entries = tuple(self.log[nxt : nxt + MAX_INFLIGHT])
+        # oldest outstanding send wins: the ack wait must cover the full
+        # round, not reset on every heartbeat re-send
+        self._sent_at.setdefault(p, self.bus.now)
         self.bus.send(
             self.node_id, p,
             AppendReq(self.term, self.node_id, prev_lsn, prev_term, entries, self.commit_lsn),

@@ -43,10 +43,19 @@ class LocalBus:
     _partitions: set[frozenset] = field(default_factory=set)
     _down: set[int] = field(default_factory=set)
     _rng: random.Random = None  # type: ignore[assignment]
+    # tenant metrics registry (share/metrics.MetricsRegistry); when wired,
+    # sent/dropped/delivered surface in __all_virtual_sysstat as
+    # "rpc packets ..." instead of living only in the private dict below
+    metrics: Any = None
     stats: dict[str, int] = field(default_factory=lambda: defaultdict(int))
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self.stats[key] += n
+        if self.metrics is not None:
+            self.metrics.add(f"rpc packets {key}", n)
 
     def register(self, node_id: int, handler: Callable[[int, Any], None]) -> None:
         self._handlers[node_id] = handler
@@ -75,12 +84,12 @@ class LocalBus:
 
     # ---------------------------------------------------------- delivery
     def send(self, src: int, dst: int, msg: Any) -> None:
-        self.stats["sent"] += 1
+        self._bump("sent")
         if self._blocked(src, dst):
-            self.stats["dropped"] += 1
+            self._bump("dropped")
             return
         if self.drop_prob and self._rng.random() < self.drop_prob:
-            self.stats["dropped"] += 1
+            self._bump("dropped")
             return
         self._queue.append(Envelope(src, dst, msg, self.now + self.latency))
 
@@ -96,11 +105,11 @@ class LocalBus:
             due.sort(key=lambda e: e.deliver_at)
             for e in due:
                 if self._blocked(e.src, e.dst):
-                    self.stats["dropped"] += 1
+                    self._bump("dropped")
                     continue
                 h = self._handlers.get(e.dst)
                 if h is not None:
                     h(e.src, e.msg)
                     delivered += 1
-        self.stats["delivered"] += delivered
+        self._bump("delivered", delivered)
         return delivered

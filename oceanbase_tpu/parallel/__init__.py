@@ -1,4 +1,10 @@
-from .mesh import SHARD_AXIS, make_mesh, replicated, row_sharding
+from .mesh import (
+    SHARD_AXIS,
+    make_mesh,
+    replicated,
+    row_sharding,
+    shard_map_compat,
+)
 from .exchange import (
     broadcast_rows,
     dest_by_hash,
@@ -13,6 +19,7 @@ __all__ = [
     "make_mesh",
     "replicated",
     "row_sharding",
+    "shard_map_compat",
     "broadcast_rows",
     "dest_by_hash",
     "dest_by_range",

@@ -22,7 +22,12 @@ Capacity discipline replaces dynamic buffers: each (src shard -> dst shard)
 lane carries a static `cap` rows; overflow is counted and returned so the
 engine can re-execute with a larger capacity (same pattern as joins).
 
-All functions run INSIDE shard_map over mesh axis "shard".
+All functions run INSIDE shard_map over mesh axis "shard" — which is why
+there is no metrics recording here: Python side effects don't survive
+tracing. DTL accounting (per-exchange lane capacity, shuffle rows/bytes,
+worker spans) happens host-side at the px.py emission sites
+(PxExecutor._note_exchange), once per compile, where capacities and
+column counts are still static Python ints.
 """
 
 from __future__ import annotations

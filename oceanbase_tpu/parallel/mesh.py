@@ -17,6 +17,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shard"
 
+# jax >= 0.6 exposes shard_map at top level with a check_vma kwarg; older
+# releases keep it in jax.experimental with the check_rep spelling. The
+# replication-check intent ("statically verify output replication") is
+# the same — only the location and keyword differ.
+if hasattr(jax, "shard_map"):
+    _shard_map, _SM_CHECK_KW = jax.shard_map, "check_vma"
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SM_CHECK_KW = "check_rep"
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs,
+                     check_replication=True):
+    """Version-portable shard_map: every SPMD program in the engine (and
+    its tests) routes through here instead of spelling the jax API."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_SM_CHECK_KW: check_replication})
+
 
 # Multi-process runtimes (the DCN half of SURVEY §2.7's architectural
 # translation: ICI within a slice = one process's devices, DCN across

@@ -73,7 +73,7 @@ from ..sql.logical import (
     Window,
 )
 from .exchange import broadcast_rows, dest_by_hash, repartition
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map_compat
 
 SHARDED = "sharded"
 REPLICATED = "replicated"
@@ -184,7 +184,8 @@ class PxExecutor(Executor):
                  join_bloom: bool = True,
                  bloom_max_bits: int = 1 << 20,
                  hybrid_hash: "bool | str" = "auto", stats=None,
-                 device_budget=None, chunk_rows=None):
+                 device_budget=None, chunk_rows=None,
+                 tracer=None, metrics=None):
         if stats is None:
             # histogram-backed cardinalities drive the exchange-method
             # choice (broadcast-vs-hash cost, skew-triggered hybrid hash)
@@ -206,6 +207,68 @@ class PxExecutor(Executor):
         # ob_sql_define.h:393); True forces it, False disables
         self.hybrid_hash = hybrid_hash
         self._dist: dict[int, str] = {}
+        # observability hooks (server/diag.Tracer + share/metrics registry).
+        # Exchange helpers run INSIDE traced shard_map code, so accounting
+        # happens host-side: once per compile at emission time (static
+        # capacities/column counts are Python ints during tracing) and per
+        # execute around the dispatch.
+        self.tracer = tracer
+        self.metrics = metrics
+        # (ncols, lane_cap) per exchange emitted by the LAST compile —
+        # execute() turns these into per-DFO worker spans
+        self._exch_log: list[tuple[str, int, int]] = []
+
+    def _note_exchange(self, kind: str, ncols: int, cap: int) -> None:
+        """Host-side DTL accounting, called at TRACE time (once per
+        compile): per-lane capacity x lane count x 8-byte columns is the
+        shuffle volume the program moves each dispatch."""
+        self._exch_log.append((kind, ncols, cap))
+        m = self.metrics
+        if m is not None:
+            # broadcast all_gathers cap rows per shard; repartition is an
+            # all_to_all over nsh^2 (src,dst) lanes of cap rows each
+            lanes = self.nsh if kind == "broadcast" else self.nsh * self.nsh
+            m.add("px exchanges compiled")
+            m.add("px exchange rows capacity", cap * lanes)
+            m.add("px exchange bytes capacity", ncols * cap * lanes * 8)
+
+    def execute(self, plan, max_retries: int = 3):
+        """Coordinator-side execution wrapper: when a tracer is wired, the
+        whole distributed query runs under one coordinator span and every
+        compiled exchange gets a worker span nested inside it — so all PX
+        spans share the coordinator's trace_id (the DTL channel-id ->
+        trace propagation of the reference's full-link tracing)."""
+        tr, m = self.tracer, self.metrics
+        if tr is None and m is None:
+            return super().execute(plan, max_retries)
+        import time as _time
+        from contextlib import nullcontext
+
+        cm = (tr.span("px_coordinator", dop=self.nsh)
+              if tr is not None else nullcontext())
+        with cm as root:
+            self._exch_log = []
+            t0 = _time.perf_counter()
+            prepared = self.prepare(plan)
+            compile_s = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            out = prepared.run(max_retries)
+            exec_s = _time.perf_counter() - t0
+            if tr is not None:
+                # per-DFO worker spans (one per exchange boundary the
+                # compile emitted), inside the coordinator span
+                for i, (kind, ncols, cap) in enumerate(self._exch_log):
+                    with tr.span("px_worker", dfo=i, exchange=kind,
+                                 lane_cap=cap, cols=ncols):
+                        pass
+                root.tags["compile_us"] = int(compile_s * 1e6)
+                root.tags["exec_us"] = int(exec_s * 1e6)
+            if m is not None:
+                m.add("px executions")
+                m.observe("px compile", compile_s)
+                m.observe("px execute", exec_s)
+                m.wait("px dispatch", exec_s)
+        return out
 
     # ------------------------------------------------------------ inputs
     def table_batch(self, name: str, cols: tuple[str, ...]):
@@ -311,6 +374,8 @@ class PxExecutor(Executor):
     # -------------------------------------------------------- exchanges
     def _gather_batch(self, b: ColumnBatch) -> ColumnBatch:
         """GATHER/BROADCAST: replicate all rows on every shard."""
+        self._note_exchange("broadcast", len(b.cols) + len(b.valid),
+                            int(b.sel.shape[0]))
         payload = {f"c:{n}": a for n, a in b.cols.items()}
         payload.update({f"v:{n}": a for n, a in b.valid.items()})
         out, mask = broadcast_rows(payload, b.sel)
@@ -325,6 +390,7 @@ class PxExecutor(Executor):
 
     def _exchange_dest(self, b: ColumnBatch, dest, cap: int):
         """Redistribute rows of a batch to per-row dest shards (all_to_all)."""
+        self._note_exchange("repartition", len(b.cols) + len(b.valid), cap)
         payload = {f"c:{n}": a for n, a in b.cols.items()}
         payload.update({f"v:{n}": a for n, a in b.valid.items()})
         out, mask, ovf = repartition(payload, b.sel, dest, self.nsh, cap)
@@ -966,16 +1032,17 @@ class PxExecutor(Executor):
                 jax.tree.map(lambda _: P(SHARD_AXIS), raw_inputs),
                 jax.tree.map(lambda _: P(), qparams),
             )
-            # check_vma=False: replication of the outputs (all_gathered or
-            # psum-merged) is guaranteed by construction but not statically
-            # inferable through gather-then-local-compute chains; the PX
-            # test suite verifies it against single-chip results
-            return jax.shard_map(
+            # no replication check: replication of the outputs
+            # (all_gathered or psum-merged) is guaranteed by construction
+            # but not statically inferable through gather-then-local-
+            # compute chains; the PX test suite verifies it against
+            # single-chip results
+            return shard_map_compat(
                 run_local,
                 mesh=self.mesh,
                 in_specs=in_specs,
                 out_specs=P(),
-                check_vma=False,
+                check_replication=False,
             )(raw_inputs, qparams)
 
         return jax.jit(run), input_spec, overflow_nodes

@@ -401,6 +401,17 @@ class Database:
             "plan_cache_capacity",
             lambda _n, _o, v: setattr(self.plan_cache, "capacity", v),
         )
+        # tenant-wide metrics fabric (GV$SYSSTAT / GV$SYSTEM_EVENT /
+        # QUERY_RESPONSE_TIME analog): one registry threaded through the
+        # statement pipeline, plan cache, replication bus and tx commit
+        from ..share.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self.plan_cache.metrics = self.metrics
+        if getattr(self.cluster.bus, "metrics", None) is None:
+            # shared-cluster mode: the first tenant (sys) owns the bus
+            # stats — rpc traffic is cluster-wide, not per-tenant
+            self.cluster.bus.metrics = self.metrics
         # diagnostics (observer/virtual_table surface)
         from .diag import AshSampler, PlanMonitor, SqlAudit, Tracer
 
@@ -501,6 +512,7 @@ class Database:
             cache_enabled_fn=lambda: self.config["ob_enable_plan_cache"],
             plan_monitor=self.plan_monitor,
             views=self._view_specs,
+            metrics=self.metrics,
         )
         self._ddl_lock = threading.RLock()
         # re-materialize restored mviews against the recovered base data
@@ -1402,6 +1414,16 @@ class Database:
         self.interrupts[0].interrupt(iid, reason)
 
     # ------------------------------------------------------------ session
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the whole engine (one scrape):
+        every counter/gauge/wait-event/histogram in the tenant registry,
+        plus the cache and audit-ring stats kept outside it."""
+        m = self.metrics
+        m.gauge_set("plan cache entries", len(self.plan_cache))
+        m.gauge_set("sql audit records", len(self.audit.records()))
+        m.gauge_set("active statements", len(self._active_stmts))
+        return m.prometheus_text()
+
     def session(self, user: str = "root") -> "DbSession":
         return DbSession(self, user=user)
 
@@ -1472,7 +1494,11 @@ class DbSession:
         # statements; waiting beyond the queue timeout fails the statement
         sem = db._worker_sem
         if sem is not None:
-            if not sem.acquire(timeout=db.unit.queue_timeout_s):
+            tq = _time.perf_counter()
+            ok = sem.acquire(timeout=db.unit.queue_timeout_s)
+            db.metrics.wait("tenant worker queue", _time.perf_counter() - tq)
+            if not ok:
+                db.metrics.add("worker queue timeouts")
                 raise SqlError(
                     f"tenant {db.tenant_name}: worker queue timeout "
                     f"({db.unit.max_workers} workers busy)"
@@ -1507,12 +1533,23 @@ class DbSession:
                     err = f"{type(e).__name__}: {e}"
                     raise
                 finally:
+                    elapsed_s = _time.perf_counter() - t0
+                    m = db.metrics
+                    m.add("sql statements")
+                    stype = self._last_stmt_type or "Unknown"
+                    if stype in ("Select", "SetSelect"):
+                        m.add("sql select count")
+                    elif stype in ("Insert", "Update", "Delete"):
+                        m.add("sql dml count")
+                    if err:
+                        m.add("sql fail count")
+                    m.observe("sql response time", elapsed_s)
                     db.audit.record(
                         session_id=self.session_id,
                         trace_id=sp.trace_id,
                         sql=text,
                         stmt_type=self._last_stmt_type,
-                        elapsed_s=_time.perf_counter() - t0,
+                        elapsed_s=elapsed_s,
                         rows=rs.nrows if rs is not None else 0,
                         affected=rs.affected if rs is not None else 0,
                         plan_cache_hit=(rs.plan_cache_hit
@@ -1671,7 +1708,11 @@ class DbSession:
         if low.split(None, 1)[:1] == ["explain"]:
             self._last_stmt_type = "Explain"
             return self._explain(text.lstrip()[len("explain"):].lstrip())
+        import time as _time
+
+        tp = _time.perf_counter()
         stmt = P.parse_statement(text)
+        self.db.metrics.observe("sql parse", _time.perf_counter() - tp)
         self._last_stmt_type = type(stmt).__name__
         # privileges first: a DENIED statement must not burn sequence
         # values or write node meta
@@ -1861,10 +1902,25 @@ class DbSession:
         (never compiles — all host-side planning state). Privileges
         apply exactly like the SELECT itself (a plan leaks table/column
         names and estimates); inside an open tx the plan reflects the
-        tx's OWN view of the data, like the statement would."""
+        tx's OWN view of the data, like the statement would.
+
+        EXPLAIN ANALYZE <select> additionally EXECUTES the statement
+        through the normal dispatch path and appends the measured phase
+        breakdown (parse/plan/compile/execute) and actual row count —
+        the per-plan analog of GV$SQL_PLAN_MONITOR's timing columns."""
+        import time as _time
+
         from ..sql.explain import explain_plan
 
+        head = text.split(None, 1)
+        analyze = bool(head) and head[0].lower() == "analyze"
+        if analyze:
+            text = text[len(head[0]):].lstrip()
+            if not text:
+                raise SqlError("EXPLAIN ANALYZE needs a statement")
+        tp = _time.perf_counter()
         ast = P.parse(text)
+        parse_s = _time.perf_counter() - tp
         self._check_privs(ast)
         names = self.db.expand_views(_tables_in_ast(ast))
         any_vt = self.db.refresh_virtual(names)
@@ -1900,6 +1956,25 @@ class DbSession:
                     if n in PROVIDERS:
                         self.db.catalog.pop(n, None)
                         self.db.engine.executor.invalidate_table(n)
+        if analyze:
+            engine.last_phases = {}
+            rs = self._select(ast, P.normalize_for_cache(text)[0])
+            ph = engine.last_phases
+
+            def us(s: float) -> int:
+                return int(s * 1e6)
+
+            lines = list(lines)
+            lines.append("")
+            hit = "hit" if ph.get("cache_hit") else "miss"
+            lines.append(
+                f"ANALYZE rows={rs.nrows} plan_cache={hit}"
+            )
+            lines.append(f"  phase parse:   {us(parse_s)} us")
+            if ph:
+                lines.append(f"  phase plan:    {us(ph['plan_s'])} us")
+                lines.append(f"  phase compile: {us(ph['compile_s'])} us")
+                lines.append(f"  phase execute: {us(ph['exec_s'])} us")
         return ResultSet(("plan",), {"plan": lines})
 
     # ------------------------------------------------------------------ XA
@@ -2509,13 +2584,18 @@ class DbSession:
         PREPARED by a different session)."""
         if tx is None or tx.ctx is None:
             return
+        import time as _time
+
         touched = tx.touched_tables
         committed_ok = False
+        m = self.db.metrics
+        tc0 = _time.perf_counter()
         try:
             if commit:
                 try:
                     if touched:
-                        self.db.cluster.commit_sync(tx.svc, tx.ctx)
+                        with m.waiting("tx commit log sync"):
+                            self.db.cluster.commit_sync(tx.svc, tx.ctx)
                     else:
                         tx.svc.commit(tx.ctx)  # empty tx: finishes immediately
                 except Exception:
@@ -2533,6 +2613,13 @@ class DbSession:
             else:
                 tx.svc.abort(tx.ctx)
         finally:
+            if commit and committed_ok:
+                m.add("tx commits")
+                m.observe("tx commit", _time.perf_counter() - tc0)
+            elif commit:
+                m.add("tx commit failures")
+            else:
+                m.add("tx rollbacks")
             self._post_tx_cleanup(tx, committed_ok)
 
     def _post_tx_cleanup(self, tx: "_OpenTx", committed_ok: bool) -> None:

@@ -38,10 +38,14 @@ class Span:
     start: float
     end: float = 0.0
     tags: dict = field(default_factory=dict)
+    # the owning tracer's clock: a live span's elapsed must tick on the
+    # SAME timebase as start/end, or injected-clock tests read nonsense
+    clock: object = None
 
     @property
     def elapsed(self) -> float:
-        return (self.end or time.perf_counter()) - self.start
+        end = self.end or (self.clock or time.perf_counter)()
+        return end - self.start
 
 
 class Tracer:
@@ -53,6 +57,7 @@ class Tracer:
         self._local = threading.local()
         self._done: deque[Span] = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self.enabled = True
 
     def _stack(self) -> list[Span]:
         st = getattr(self._local, "stack", None)
@@ -71,10 +76,21 @@ class Tracer:
             name=name,
             start=self._clock(),
             tags=dict(tags),
+            clock=self._clock,
         )
+        if not self.enabled:
+            # still hand out a span (callers read trace_id) but record
+            # nothing — the zero-overhead path the bench compares against
+            yield s
+            return
         st.append(s)
         try:
             yield s
+        except BaseException as exc:
+            # failed statements must stay findable in the span ring
+            # (__all_virtual_trace_span filters on error != '')
+            s.tags["error"] = repr(exc)
+            raise
         finally:
             s.end = self._clock()
             st.pop()

@@ -108,6 +108,57 @@ def _trace(db) -> Table:
         ("parent_id", DataType.int64(), [s.parent_id for s in sp]),
         ("span_name", DataType.varchar(), [s.name for s in sp]),
         ("elapsed_us", DataType.int64(), [int(s.elapsed * 1e6) for s in sp]),
+        ("error", DataType.varchar(),
+         [str(s.tags.get("error", "")) for s in sp]),
+    ])
+
+
+def _sysstat(db) -> Table:
+    """GV$SYSSTAT analog: every counter and gauge in the tenant registry."""
+    cs = db.metrics.counters_snapshot()
+    gs = db.metrics.gauges_snapshot()
+    rows = sorted(
+        [(n, float(v), "counter") for n, v in cs.items()]
+        + [(n, float(v), "gauge") for n, v in gs.items()]
+    )
+    return _t("__all_virtual_sysstat", [
+        ("name", DataType.varchar(), [r[0] for r in rows]),
+        ("value", DataType.int64(), [int(r[1]) for r in rows]),
+        ("stat_class", DataType.varchar(), [r[2] for r in rows]),
+    ])
+
+
+def _system_event(db) -> Table:
+    """GV$SYSTEM_EVENT analog: wait classes with count/total/max/avg."""
+    ws = sorted(db.metrics.waits_snapshot(), key=lambda w: w.event)
+    return _t("__all_virtual_system_event", [
+        ("event", DataType.varchar(), [w.event for w in ws]),
+        ("total_waits", DataType.int64(), [w.count for w in ws]),
+        ("time_waited", DataType.int64(),
+         [int(w.total_s * 1e6) for w in ws]),
+        ("max_wait", DataType.int64(), [int(w.max_s * 1e6) for w in ws]),
+        ("average_wait", DataType.int64(),
+         [int(w.avg_s * 1e6) for w in ws]),
+    ])
+
+
+def _query_response_time(db) -> Table:
+    """QUERY_RESPONSE_TIME analog: per-histogram latency buckets plus a
+    quantile row set (p50/p95/p99 as bucket upper-bound estimates)."""
+    rows = []
+    for h in sorted(db.metrics.hists_snapshot(), key=lambda x: x.name):
+        acc = 0
+        for bound, c in zip(h.bounds, h.counts):
+            acc += c
+            rows.append((h.name, "bucket", int(bound * 1e6), acc))
+        rows.append((h.name, "count", 0, h.count))
+        for q, v in (("p50", h.p50), ("p95", h.p95), ("p99", h.p99)):
+            rows.append((h.name, q, int(v * 1e6), h.count))
+    return _t("__all_virtual_query_response_time", [
+        ("histogram", DataType.varchar(), [r[0] for r in rows]),
+        ("kind", DataType.varchar(), [r[1] for r in rows]),
+        ("le_us", DataType.int64(), [r[2] for r in rows]),
+        ("count", DataType.int64(), [r[3] for r in rows]),
     ])
 
 
@@ -316,6 +367,9 @@ PROVIDERS = {
     "__all_virtual_sql_plan_monitor": _plan_monitor,
     "__all_virtual_ash": _ash,
     "__all_virtual_trace_span": _trace,
+    "__all_virtual_sysstat": _sysstat,
+    "__all_virtual_system_event": _system_event,
+    "__all_virtual_query_response_time": _query_response_time,
     "__all_virtual_ls": _ls,
     "__all_virtual_processlist": _processlist,
     "__all_virtual_tablet": _tablets,

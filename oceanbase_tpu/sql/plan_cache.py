@@ -299,10 +299,13 @@ class PlanCache:
     """LRU cache: (normalized SQL, param signature, baked literals) ->
     compiled plan. One entry = one XLA executable."""
 
-    def __init__(self, capacity: int = 128):
+    def __init__(self, capacity: int = 128, metrics=None):
         self.capacity = capacity
         self._entries: OrderedDict[tuple, CacheEntry] = OrderedDict()
         self.stats = PlanCacheStats()
+        # tenant metrics registry (share/metrics): mirrors hit/miss/evict
+        # into __all_virtual_sysstat next to every other engine stat
+        self.metrics = metrics
 
     def __len__(self):
         return len(self._entries)
@@ -313,8 +316,12 @@ class PlanCache:
             self._entries.move_to_end(key)
             ent.hits += 1
             self.stats.hits += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache hit")
         else:
             self.stats.misses += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache miss")
         return ent
 
     def put(self, key: tuple, entry: CacheEntry):
@@ -323,6 +330,8 @@ class PlanCache:
         while len(self._entries) > self.capacity:
             self._entries.popitem(last=False)
             self.stats.evictions += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache eviction")
 
     def flush(self):
         self._entries.clear()

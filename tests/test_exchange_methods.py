@@ -19,7 +19,11 @@ from oceanbase_tpu.parallel.exchange import (
     repartition,
     sample_range_bounds,
 )
-from oceanbase_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from oceanbase_tpu.parallel.mesh import (
+    SHARD_AXIS,
+    make_mesh,
+    shard_map_compat,
+)
 
 import pytest as _pytest
 
@@ -60,11 +64,11 @@ def test_range_repartition_balances_and_orders(mesh):
         return (out["k"], nm, ovf, in_range[None], cnt[None],
                 lax.pmax(cnt, SHARD_AXIS), lax.pmin(cnt, SHARD_AXIS))
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map_compat(
         step, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P(SHARD_AXIS),
                    P(SHARD_AXIS), P(), P()),
-        check_vma=False,
+        check_replication=False,
     ))
     k_out, m_out, ovf, in_range, cnts, cmax, cmin = f(
         _sharded(mesh, keys), _sharded(mesh, mask))
@@ -88,9 +92,9 @@ def test_bc2host_stripes_hosts(mesh):
         out, nm = bc2host({"v": v}, m, per_host)
         return out["v"], nm
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map_compat(
         step, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False,
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_replication=False,
     ))
     v_out, m_out = f(_sharded(mesh, vals), _sharded(mesh, mask))
     v_out = np.asarray(v_out).reshape(NSH, -1)
@@ -119,9 +123,9 @@ def test_dest_by_partition_affine(mesh):
         ok = jnp.all(jnp.where(nm, jnp.asarray(owner)[out["p"]] == sid, True))
         return ok[None], ovf
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map_compat(
         step, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(SHARD_AXIS), P()), check_vma=False,
+        out_specs=(P(SHARD_AXIS), P()), check_replication=False,
     ))
     ok, ovf = f(_sharded(mesh, part), _sharded(mesh, np.ones(n, bool)))
     assert int(ovf) == 0 and bool(np.all(np.asarray(ok)))

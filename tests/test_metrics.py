@@ -1,0 +1,318 @@
+"""Metrics fabric: sysstat/wait-event registry, histograms, EXPLAIN ANALYZE.
+
+Reference: ob_stat_event.h counters (GV$SYSSTAT), ob_wait_event.h wait
+classes (GV$SYSTEM_EVENT), QUERY_RESPONSE_TIME histogram, plus the PX/DTL
+trace propagation of full-link tracing (ObTrace).
+"""
+
+import re
+
+import jax
+import pytest
+
+from oceanbase_tpu.core.column import batch_rows_normalized
+from oceanbase_tpu.log.transport import LocalBus
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.diag import Tracer
+from oceanbase_tpu.share.metrics import Histogram, MetricsRegistry
+
+
+# ---- registry unit behavior -------------------------------------------------
+
+
+def test_counters_gauges_waits():
+    m = MetricsRegistry()
+    m.add("x")
+    m.add("x", 4)
+    assert m.counter("x") == 5
+    assert m.counter("never") == 0
+    m.gauge_set("g", 7)
+    m.gauge_set("g", 3)
+    assert m.gauge("g") == 3
+    m.wait("w", 0.010)
+    m.wait("w", 0.030)
+    w = m.wait_event("w")
+    assert w.count == 2
+    assert abs(w.total_s - 0.040) < 1e-12
+    assert w.max_s == 0.030
+    assert abs(w.avg_s - 0.020) < 1e-12
+
+
+def test_disabled_registry_records_nothing():
+    m = MetricsRegistry()
+    m.enabled = False
+    m.add("x")
+    m.gauge_set("g", 1)
+    m.wait("w", 1.0)
+    m.observe("h", 1.0)
+    with m.waiting("w2"):
+        pass
+    with m.timed("h2"):
+        pass
+    assert m.counter("x") == 0
+    assert m.gauge("g") == 0
+    assert m.wait_event("w") is None
+    assert m.histogram("h") is None
+    assert m.counters_snapshot() == {}
+    assert m.waits_snapshot() == []
+
+
+def test_waiting_and_timed_use_injected_clock():
+    t = [0.0]
+    m = MetricsRegistry(clock=lambda: t[0])
+    with m.waiting("q"):
+        t[0] += 2.5
+    w = m.wait_event("q")
+    assert w.count == 1 and w.total_s == 2.5 and w.max_s == 2.5
+    with m.timed("lat"):
+        t[0] += 0.2
+    h = m.histogram("lat")
+    assert h.count == 1 and h.sum_s == pytest.approx(0.2)
+
+
+def test_histogram_quantiles():
+    h = Histogram("t")
+    for _ in range(90):
+        h.observe(0.0004)  # lands in the <=500us bucket
+    for _ in range(10):
+        h.observe(0.2)  # lands in the <=250ms bucket
+    assert h.count == 100
+    assert abs(h.sum_s - (90 * 0.0004 + 10 * 0.2)) < 1e-9
+    assert h.p50 == pytest.approx(500e-6)
+    assert h.p95 == pytest.approx(0.25)
+    assert h.p99 == pytest.approx(0.25)
+    # overflow observations report the largest finite bound, not +Inf
+    h2 = Histogram("o")
+    h2.observe(99.0)
+    assert h2.quantile(0.5) == h2.bounds[-1]
+    # empty histogram quantiles are 0 (no div-by-zero)
+    assert Histogram("e").p99 == 0.0
+
+
+def test_prometheus_text_unit():
+    m = MetricsRegistry()
+    m.add("sql select count", 3)
+    m.wait("palf commit", 0.002)
+    m.observe("sql response time", 0.004)
+    text = m.prometheus_text()
+    assert "# TYPE ob_sql_select_count_total counter" in text
+    assert "ob_sql_select_count_total 3" in text
+    assert "ob_wait_palf_commit_seconds_count 1" in text
+    assert "# TYPE ob_sql_response_time_seconds histogram" in text
+    assert 'ob_sql_response_time_seconds_bucket{le="+Inf"} 1' in text
+    assert "ob_sql_response_time_seconds_count 1" in text
+
+
+# ---- database-wide workload -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = Database(n_nodes=3, n_ls=2)
+    s = d.session()
+    s.sql("create table mt (k bigint primary key, v bigint not null)")
+    s.sql("insert into mt values (1, 10), (2, 20), (3, 30)")
+    s.sql("select v from mt where k = 2")
+    s.sql("select v from mt where k = 2")  # plan-cache hit
+    s.sql("update mt set v = v + 1 where k = 3")
+    try:
+        s.sql("select nope from mt")  # one failed statement for error stats
+    except Exception:
+        pass
+    return d
+
+
+def test_sysstat_virtual_table(db):
+    s = db.session()
+    rs = s.sql("select name, value from __all_virtual_sysstat")
+    stats = {name: value for name, value in rs.rows()}
+    assert len(stats) >= 8
+    assert stats["sql statements"] >= 5
+    assert stats["sql select count"] >= 2
+    assert stats["sql dml count"] >= 2
+    assert stats["sql fail count"] >= 1
+    assert stats["plan cache miss"] >= 1
+    assert stats["tx commits"] >= 2
+    # replication flowed through palf + bus under the same registry
+    assert stats["palf log entries submitted"] >= 1
+    assert stats["rpc packets sent"] >= 1
+
+
+def test_system_event_virtual_table(db):
+    s = db.session()
+    rs = s.sql(
+        "select event, total_waits, time_waited from __all_virtual_system_event"
+    )
+    rows = {event: (waits, waited) for event, waits, waited in rs.rows()}
+    assert "tx commit log sync" in rows
+    assert rows["tx commit log sync"][0] >= 2  # autocommit insert + update
+    assert "palf commit" in rows
+    assert rows["palf commit"][0] >= 1
+    assert rows["palf commit"][1] > 0  # bus virtual-clock replication time
+    assert "palf append" in rows
+    assert any(waited > 0 for _w, waited in rows.values())
+
+
+def test_query_response_time_virtual_table(db):
+    s = db.session()
+    rs = s.sql(
+        "select kind, le_us from __all_virtual_query_response_time "
+        "where kind = 'p95'"
+    )
+    assert rs.nrows >= 1
+    rs = s.sql(
+        "select kind from __all_virtual_query_response_time "
+        "where kind = 'bucket'"
+    )
+    assert rs.nrows >= len(Histogram("_").bounds)  # at least one full ladder
+    h = db.metrics.histogram("sql response time")
+    assert h is not None and h.count >= 5 and h.sum_s > 0
+
+
+def test_plan_cache_hit_counter_grows(db):
+    s = db.session()
+    n0 = db.metrics.counter("plan cache hit")
+    s.sql("select v from mt where k = 1")
+    s.sql("select v from mt where k = 3")  # same normalized text -> hit
+    assert db.metrics.counter("plan cache hit") >= n0 + 2
+
+
+def test_explain_analyze(db):
+    s = db.session()
+    rs = s.sql("explain analyze select v from mt where k = 1")
+    assert rs.names == ("plan",)
+    lines = list(rs.columns["plan"])
+    assert len(lines) > 4  # plan body + blank + ANALYZE block
+    assert any(ln.startswith("ANALYZE rows=1 plan_cache=") for ln in lines)
+    joined = "\n".join(lines)
+    for phase in ("parse", "plan", "compile", "execute"):
+        assert re.search(rf"phase {phase}:\s+\d+ us", joined), phase
+    # the analyzed statement really executed: response-time histogram moved
+    h = db.metrics.histogram("sql execute")
+    assert h is not None and h.count >= 1
+    # plain EXPLAIN is unchanged (no execution, no ANALYZE block)
+    rs2 = s.sql("explain select v from mt where k = 1")
+    assert rs2.names == ("plan",)
+    assert not any("ANALYZE" in ln for ln in rs2.columns["plan"])
+    with pytest.raises(Exception):
+        s.sql("explain analyze")
+
+
+def test_failed_statement_span_carries_error(db):
+    s = db.session()
+    rs = s.sql(
+        "select count(*) as n from __all_virtual_trace_span "
+        "where error != ''"
+    )
+    assert rs.rows()[0][0] >= 1  # the fixture's failing SELECT was tagged
+
+
+def test_metrics_text_prometheus_exposition(db):
+    text = db.metrics_text()
+    lines = [ln for ln in text.strip().split("\n")]
+    assert lines
+    sample = re.compile(
+        r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? -?[0-9][0-9eE+.\-]*$'
+    )
+    for ln in lines:
+        assert (
+            ln.startswith("# HELP ") or ln.startswith("# TYPE ")
+            or sample.match(ln)
+        ), ln
+    assert "ob_sql_statements_total" in text
+    assert "# TYPE ob_plan_cache_entries gauge" in text
+    assert "ob_wait_tx_commit_log_sync_seconds_count" in text
+    assert 'le="+Inf"' in text
+
+
+# ---- tracer fixes (spans on live clock, error tagging) ----------------------
+
+
+def test_span_elapsed_on_tracer_clock():
+    t = [100.0]
+    tr = Tracer(clock=lambda: t[0])
+    with tr.span("s") as sp:
+        t[0] = 103.0
+        assert sp.elapsed == 3.0  # live span ticks on the tracer's clock
+    assert sp.elapsed == 3.0  # finished span uses its recorded end
+
+
+def test_tracer_tags_error_and_reraises():
+    tr = Tracer()
+    with pytest.raises(ValueError, match="boom"):
+        with tr.span("failing"):
+            raise ValueError("boom")
+    sp = tr.spans()[-1]
+    assert sp.name == "failing"
+    assert "ValueError" in sp.tags["error"]
+    assert sp.end >= sp.start  # failed spans still close and get recorded
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer()
+    tr.enabled = False
+    with tr.span("quiet") as sp:
+        assert sp.trace_id > 0  # callers may still read ids
+    assert tr.spans() == []
+
+
+# ---- bus stats mirrored into the registry -----------------------------------
+
+
+def test_bus_mirrors_stats_into_registry():
+    m = MetricsRegistry()
+    bus = LocalBus(metrics=m)
+    got = []
+    bus.register(1, lambda src, msg: got.append(msg))
+    bus.kill(2)
+    bus.send(0, 1, "hello")
+    bus.send(0, 2, "lost")  # target down -> dropped
+    bus.advance(0.01)
+    assert got == ["hello"]
+    assert bus.stats["sent"] == 2 and bus.stats["dropped"] == 1
+    assert m.counter("rpc packets sent") == 2
+    assert m.counter("rpc packets dropped") == 1
+    assert m.counter("rpc packets delivered") == 1
+    # a bare bus (deterministic consensus tests) still keeps its dict stats
+    bus2 = LocalBus()
+    bus2.send(0, 1, "y")
+    assert bus2.stats["sent"] == 1
+
+
+# ---- PX: trace propagation + DTL metrics ------------------------------------
+
+
+def test_px_spans_share_trace_id_and_metrics():
+    from oceanbase_tpu.models.tpch import datagen
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+    from oceanbase_tpu.parallel.mesh import make_mesh
+    from oceanbase_tpu.parallel.px import PxExecutor
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    tables = datagen.generate(sf=0.002)
+    mesh = make_mesh(len(jax.devices()))
+    tr = Tracer()
+    m = MetricsRegistry()
+    px = PxExecutor(tables, mesh, unique_keys=UNIQUE_KEYS,
+                    tracer=tr, metrics=m)
+    planned = Planner(tables).plan(parse(QUERIES[3]))  # join -> exchanges
+    out = px.execute(planned.plan)
+    assert len(batch_rows_normalized(out, planned.output_names)) > 0
+    spans = tr.spans()
+    coords = [s for s in spans if s.name == "px_coordinator"]
+    workers = [s for s in spans if s.name == "px_worker"]
+    assert len(coords) == 1 and len(workers) >= 1
+    # the DTL trace-propagation contract: every worker span carries the
+    # coordinator's trace_id
+    assert {w.trace_id for w in workers} == {coords[0].trace_id}
+    assert all(w.parent_id == coords[0].span_id for w in workers)
+    assert coords[0].tags["dop"] == px.nsh
+    assert coords[0].tags["exec_us"] >= 0
+    # DTL accounting: exchange capacity counters moved at compile time
+    assert m.counter("px executions") == 1
+    assert m.counter("px exchanges compiled") == len(workers)
+    assert m.counter("px exchange rows capacity") > 0
+    assert m.counter("px exchange bytes capacity") > 0
+    assert m.histogram("px compile").count == 1
+    assert m.wait_event("px dispatch").count == 1

@@ -152,6 +152,42 @@ def test_virtual_plan_cache_stat_join(db):
     assert hits + misses >= entries
 
 
+def test_ash_sampler_start_stop_lifecycle():
+    from oceanbase_tpu.server.diag import AshSampler
+
+    a = AshSampler(interval_s=30.0)  # long interval: never fires in-test
+    assert a._timer is None
+    a.start()
+    t1 = a._timer
+    assert t1 is not None
+    a.start()  # idempotent: a second start keeps the running timer
+    assert a._timer is t1
+    a.stop()
+    assert a._timer is None
+    a.stop()  # stop on a stopped sampler is a no-op
+    a.start()  # and the sampler restarts cleanly after a stop
+    t2 = a._timer
+    assert t2 is not None and t2 is not t1
+    a.stop()
+    assert a._timer is None
+
+
+def test_sql_audit_shrink_keeps_newest():
+    from oceanbase_tpu.server.diag import SqlAudit
+
+    a = SqlAudit(capacity=100)
+    for i in range(10):
+        a.record(session_id=1, trace_id=0, sql=f"s{i}", stmt_type="Select",
+                 elapsed_s=0.0, rows=0, affected=0, plan_cache_hit=False)
+    a.set_capacity(3)
+    assert [r.sql for r in a.records()] == ["s7", "s8", "s9"]
+    # growing back keeps the survivors and accepts new appends
+    a.set_capacity(5)
+    a.record(session_id=1, trace_id=0, sql="s10", stmt_type="Select",
+             elapsed_s=0.0, rows=0, affected=0, plan_cache_hit=False)
+    assert [r.sql for r in a.records()] == ["s7", "s8", "s9", "s10"]
+
+
 def test_audit_toggle_via_config(db):
     s = db.session()
     s.sql("alter system set enable_sql_audit = false")

@@ -10,7 +10,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from oceanbase_tpu.parallel import (
@@ -21,6 +20,7 @@ from oceanbase_tpu.parallel import (
     make_mesh,
     merge_partials,
     repartition,
+    shard_map_compat as shard_map,
 )
 
 
