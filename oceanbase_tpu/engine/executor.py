@@ -320,6 +320,25 @@ def _dict_domain(batch: ColumnBatch, e: E.Expr) -> int | None:
     return None
 
 
+def _device_nbytes(obj) -> int:
+    """Sum nbytes over the device arrays inside an executor input — a
+    ColumnBatch, the PX raw cols/valid/sel dict, or derived-structure
+    tuples (fk_ranges, ivf arrays)."""
+    if hasattr(obj, "nbytes"):
+        return int(obj.nbytes)
+    if isinstance(obj, ColumnBatch):
+        return (
+            _device_nbytes(obj.cols)
+            + _device_nbytes(obj.valid)
+            + _device_nbytes(obj.sel)
+        )
+    if isinstance(obj, dict):
+        return sum(_device_nbytes(v) for v in obj.values())
+    if isinstance(obj, (tuple, list)):
+        return sum(_device_nbytes(v) for v in obj)
+    return 0
+
+
 class Executor:
     # subclasses that manage their own placement (PX) disable chunking
     chunking_enabled = True
@@ -352,6 +371,10 @@ class Executor:
         # TWO tables (fk_ranges) revalidate against both versions, since
         # the key-prefix delete in invalidate_table only covers one
         self._table_version: dict[str, int] = {}
+        # lifetime host->device upload bytes (QueryProfile reads the delta
+        # around one execution: cache hits upload nothing, which is the
+        # point of the per-column device cache)
+        self.h2d_bytes = 0
 
     # ---- input preparation -------------------------------------------
     def _collect_scans(self, plan: LogicalOp) -> list[Scan]:
@@ -420,6 +443,19 @@ class Executor:
         self._table_version[name] = self._table_version.get(name, 0) + 1
         for key in [k for k in self._batch_cache if k[0] == name]:
             del self._batch_cache[key]
+
+    def input_device_bytes(self, input_spec) -> int:
+        """Device-resident footprint of a prepared plan's inputs (array
+        nbytes at the operator boundary) — QueryProfile's device_bytes
+        source. Called after execution, so every input is already in the
+        device cache and this walks cached arrays without new uploads."""
+        total = 0
+        for alias, table, cols in input_spec:
+            try:
+                total += _device_nbytes(self.input_batch(alias, table, cols))
+            except Exception:  # noqa: BLE001 - accounting must never fail a query
+                continue
+        return total
 
     def fk_ranges(self, probe_table: str, fk_col: str,
                   build_table: str, pk_col: str):
@@ -592,6 +628,9 @@ class Executor:
                     vdev = jnp.asarray(v)
                 hit = (dev, vdev)
                 self._batch_cache[key] = hit
+                self.h2d_bytes += int(dev.nbytes) + (
+                    int(vdev.nbytes) if vdev is not None else 0
+                )
             dcols[f.name] = hit[0]
             if hit[1] is not None:
                 dvalid[f.name] = hit[1]
@@ -602,6 +641,7 @@ class Executor:
             s[:n] = True
             sel = jnp.asarray(s)
             self._batch_cache[skey] = sel
+            self.h2d_bytes += int(sel.nbytes)
         return ColumnBatch(
             cols=dcols,
             valid=dvalid,

@@ -52,7 +52,7 @@ class Session:
     def __init__(self, catalog: dict[str, Table], unique_keys=None,
                  plan_cache: PlanCache | None = None, key_extra_fn=None,
                  cache_enabled_fn=None, plan_monitor=None, views=None,
-                 metrics=None):
+                 metrics=None, tracer=None, profile_enabled_fn=None):
         self.catalog = catalog
         from ..share.stats import StatsManager
 
@@ -76,9 +76,21 @@ class Session:
         self.plan_monitor = plan_monitor
         # hook: share/metrics.MetricsRegistry (phase histograms + counters)
         self.metrics = metrics
+        # hook: server/diag.Tracer — PX executions stitch per-DFO worker
+        # spans into the active statement's trace through it
+        self.tracer = tracer
+        # hook: config enable_query_profile (None = always profile)
+        self.profile_enabled_fn = profile_enabled_fn
         # per-statement phase breakdown of the LAST run_ast call (EXPLAIN
         # ANALYZE reads it right after executing the analyzed statement)
         self.last_phases: dict = {}
+        # per-statement TPU resource attribution (server/diag.QueryProfile)
+        # of the LAST run_ast call; None when profiling is off or the
+        # statement bypassed run_ast (pure DDL)
+        self.last_profile = None
+        # logical plan of the LAST run_ast call (flight-recorder bundles
+        # capture its repr as the plan text)
+        self.last_plan = None
 
     def materialize(self, text: str, name: str) -> Table:
         """Run a SELECT and materialize its result as a storage-domain
@@ -151,13 +163,17 @@ class Session:
             return entry, entry.prepared.bind(pz.values, entry.dtypes)
         return entry, bind(pz.values, entry.dtypes)
 
-    def _cache_key(self, norm_key: str, pz) -> tuple:
+    def _cache_key(self, norm_key: str, pz, executor=None) -> tuple:
         extra = ()
         if self.key_extra_fn is not None:
             tables = tuple(sorted(
                 {s.table for s in self.executor._collect_scans(pz.plan)}
             ))
             extra = self.key_extra_fn(tables)
+        # an executor override (PX routing) compiles a DIFFERENT program
+        # for the same text: the entry must not collide with single-chip
+        if executor is not None and executor is not self.executor:
+            extra = (*extra, "#exec", id(executor))
         # id(catalog) scopes entries to one table set (cache sharing is per
         # tenant = per catalog; entries pin their executor -> catalog, so the
         # id cannot be recycled while the entry lives); the plan fingerprint
@@ -165,14 +181,44 @@ class Session:
         return (id(self.catalog), norm_key, pz.sig, pz.baked,
                 plan_fingerprint(pz.plan), extra)
 
-    def run_ast(self, ast, norm_key: str, use_cache: bool | None = None) -> ResultSet:
+    def _emit_px_spans(self, prepared, start: float, end: float) -> None:
+        """Per-DFO / per-shard worker spans for a PX execution, stitched
+        under the active statement span. Works for CACHED plans too: the
+        exchange layout rides the prepared plan from compile time."""
+        tr = self.tracer
+        exchanges = getattr(prepared, "px_exchanges", None)
+        if tr is None or not tr.enabled or exchanges is None:
+            return
+        ctx = tr.current_ctx()
+        nsh = getattr(prepared, "px_nsh", 1)
+        coord = tr.record_span("px coordinator", ctx, start, end, dop=nsh)
+        cctx = (coord.trace_id, coord.span_id) if coord is not None else ctx
+        if exchanges:
+            for i, (kind, ncols, cap) in enumerate(exchanges):
+                for node in range(nsh):
+                    tr.record_span(
+                        "px worker", cctx, start, end, node=node, dfo=i,
+                        exchange=kind, lane_cap=cap, cols=ncols,
+                    )
+        else:
+            # exchange-free plan (fully local per shard): one worker span
+            # per mesh device so the trace still shows the fan-out
+            for node in range(nsh):
+                tr.record_span("px worker", cctx, start, end, node=node,
+                               dfo=0)
+
+    def run_ast(self, ast, norm_key: str, use_cache: bool | None = None,
+                executor=None) -> ResultSet:
         """Plan + execute an already-parsed SELECT under the plan cache.
 
         Shared by text queries and internal consumers (the DML layer's
         UPDATE/DELETE qualification scans, virtual-table queries).
         use_cache=False bypasses the plan cache entirely (virtual-table
         statements: their per-materialization dictionaries make entries
-        never reusable, and caching them would evict user plans)."""
+        never reusable, and caching them would evict user plans).
+        `executor` overrides the compiling/executing backend for this
+        statement (PX routing: the server layer passes its PxExecutor when
+        the session's DOP variable asks for distributed execution)."""
         if getattr(ast, "ctes", None):
             from .recursive import recursive_cte_of, run_recursive
 
@@ -195,19 +241,23 @@ class Session:
             raise ResolveError(str(err)) from None
         if jspecs:
             norm_key = f"{norm_key}|jh:{jspecs!r}"
+        ex = executor if executor is not None else self.executor
         t0 = time.perf_counter()
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
-        key = self._cache_key(norm_key, pz)
+        key = self._cache_key(norm_key, pz, executor)
         plan_s = time.perf_counter() - t0
         if use_cache is None:
             use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
         entry = self.plan_cache.get(key) if use_cache else None
         was_hit = entry is not None
+        profiling = (self.profile_enabled_fn() if self.profile_enabled_fn
+                     else True)
+        h2d0 = ex.h2d_bytes if profiling else 0
         compile_s = 0.0
         if entry is None:
             t0 = time.perf_counter()
-            prepared = self.executor.prepare(pz.plan)
+            prepared = ex.prepare(pz.plan)
             compile_s = time.perf_counter() - t0
             entry = CacheEntry(prepared, planned.output_names, pz.dtypes)
             entry.json_specs, entry.json_hidden = jspecs, jhidden
@@ -216,6 +266,8 @@ class Session:
             if use_cache:
                 self.plan_cache.put(key, entry)
         retries0 = getattr(entry.prepared, "retries", 0)
+        d2h_bytes = 0
+        exec_t0 = time.perf_counter()
         if hasattr(entry.prepared, "run_host"):
             # packed parameter upload + single-device_get dispatch: ONE
             # host->device transfer for the whole parameter set, ONE
@@ -228,6 +280,12 @@ class Session:
             hcols, hvalid, hsel, oschema, odicts = entry.prepared.run_host(
                 qparams=qparams)
             exec_s = time.perf_counter() - t0
+            if profiling:
+                d2h_bytes = sum(
+                    int(getattr(a, "nbytes", 0))
+                    for d in (hcols, hvalid)
+                    for a in d.values()
+                ) + int(getattr(hsel, "nbytes", 0))
             host = host_rows(oschema, odicts, hcols, hvalid, hsel)
         else:
             # chunked / PX prepared plans: device-batch contract
@@ -236,6 +294,11 @@ class Session:
             out_batch = entry.prepared.run(qparams=qparams)
             exec_s = time.perf_counter() - t0
             host = batch_to_host(out_batch)
+            if profiling:
+                d2h_bytes = sum(
+                    int(getattr(a, "nbytes", 0)) for a in host.values()
+                )
+        self._emit_px_spans(entry.prepared, exec_t0, time.perf_counter())
         # order columns per select list
         cols = {n: host[n] for n in entry.output_names}
         out_names = entry.output_names
@@ -244,12 +307,43 @@ class Session:
             out_names, cols = apply_host_json(
                 jn, entry.json_hidden, out_names, cols)
         rs = ResultSet(out_names, cols, plan_cache_hit=was_hit)
+        profile = None
+        if profiling:
+            from ..server.diag import QueryProfile
+
+            device_bytes = 0
+            input_spec = getattr(entry.prepared, "input_spec", None)
+            if input_spec is not None:
+                device_bytes = ex.input_device_bytes(input_spec)
+            # peak working set: device-resident inputs + the result's
+            # footprint + PX exchange lane capacity (the collective's
+            # buffers are live simultaneously with both)
+            peak = device_bytes + d2h_bytes
+            for _kind, ncols, cap in getattr(entry.prepared, "px_exchanges",
+                                             ()):
+                nsh = getattr(entry.prepared, "px_nsh", 1)
+                lanes = nsh if _kind == "broadcast" else nsh * nsh
+                peak += ncols * cap * lanes * 8
+            profile = QueryProfile(
+                compile_hit=was_hit,
+                compile_s=compile_s,
+                h2d_bytes=ex.h2d_bytes - h2d0,
+                d2h_bytes=d2h_bytes,
+                device_bytes=device_bytes,
+                peak_bytes=peak,
+            )
+        self.last_profile = profile
+        self.last_plan = pz.plan
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1
             mon.total_exec_s += exec_s
             mon.last_rows = rs.nrows
             mon.overflow_retries = entry.prepared.retries
+            if profile is not None:
+                mon.total_transfer_bytes += profile.transfer_bytes
+                mon.last_device_bytes = profile.device_bytes
+                mon.peak_bytes = max(mon.peak_bytes, profile.peak_bytes)
         self.last_phases = {
             "plan_s": plan_s, "compile_s": compile_s, "exec_s": exec_s,
             "cache_hit": was_hit, "rows": rs.nrows,

@@ -201,6 +201,10 @@ class PalfReplica:
     # per lsn, append-send->ack per peer (both leader-side)
     _submit_at: dict[int, float] = field(default_factory=dict)
     _sent_at: dict[int, float] = field(default_factory=dict)
+    # trace context captured at submit_log, so the commit advance can emit
+    # a retrospective "palf replication" span into the submitting
+    # statement's trace tree (full-link tracing across the bus)
+    _submit_ctx: dict[int, Any] = field(default_factory=dict)
 
     def __post_init__(self):
         # constructor-provided membership = the config floor a truncation
@@ -293,6 +297,12 @@ class PalfReplica:
         self._scn = max(self._scn + 1, scn or 0)
         e = LogEntry(lsn, self.term, self._scn, payload)
         m = getattr(self.bus, "metrics", None)
+        tr = getattr(self.bus, "tracer", None)
+        if tr is not None:
+            ctx = tr.current_ctx()
+            if ctx is not None:
+                self._submit_at.setdefault(lsn, self.bus.now)
+                self._submit_ctx[lsn] = ctx
         self.log.append(e)
         if m is not None:
             # "palf append": the leader's local durability window; "palf
@@ -459,12 +469,21 @@ class PalfReplica:
             if acked >= self._majority():
                 self.commit_lsn = lsn
                 break
-        if self.commit_lsn > prev_commit and self._submit_at:
+        if self.commit_lsn > prev_commit and (self._submit_at or self._submit_ctx):
             m = getattr(self.bus, "metrics", None)
+            tr = getattr(self.bus, "tracer", None)
             for lsn in range(prev_commit + 1, self.commit_lsn + 1):
                 t = self._submit_at.pop(lsn, None)
                 if t is not None and m is not None:
                     m.wait("palf commit", self.bus.now - t)
+                ctx = self._submit_ctx.pop(lsn, None)
+                if ctx is not None and tr is not None and t is not None:
+                    # retrospective span: the whole replication round for
+                    # this lsn (submit -> majority ack) on the virtual clock
+                    tr.record_span(
+                        "palf replication", ctx, t, self.bus.now,
+                        node=self.node_id, lsn=lsn,
+                    )
         self._apply()
 
     def _apply(self) -> None:
@@ -537,6 +556,15 @@ class PalfReplica:
         if mx is not None and appended:
             mx.add("palf log entries replicated", len(appended))
         if appended:
+            tr = getattr(self.bus, "tracer", None)
+            ctx = self.bus.delivery_ctx() if hasattr(self.bus, "delivery_ctx") else None
+            if tr is not None and ctx is not None:
+                # follower-side durability work, tagged with THIS node so
+                # SHOW TRACE shows which replica appended for the statement
+                tr.record_span(
+                    "palf append", ctx, self.bus.now, self.bus.now,
+                    node=self.node_id, entries=len(appended),
+                )
             self._persist_append(appended)
             # adopt any membership change in the appended suffix (config
             # is effective at append; the newest one wins)
@@ -560,11 +588,19 @@ class PalfReplica:
             return
         self._last_ack[src] = self.bus.now
         mx = getattr(self.bus, "metrics", None)
+        sent = self._sent_at.pop(src, None)
         if mx is not None:
             mx.add("palf acks received")
-            sent = self._sent_at.pop(src, None)
             if sent is not None:
                 mx.wait("palf ack", self.bus.now - sent)
+        tr = getattr(self.bus, "tracer", None)
+        ctx = self.bus.delivery_ctx() if hasattr(self.bus, "delivery_ctx") else None
+        if tr is not None and ctx is not None and m.success:
+            tr.record_span(
+                "palf ack", ctx,
+                sent if sent is not None else self.bus.now, self.bus.now,
+                node=src, ack_lsn=m.ack_lsn,
+            )
         if m.success:
             self._match_lsn[src] = max(self._match_lsn.get(src, -1), m.ack_lsn)
             self._next_lsn[src] = self._match_lsn[src] + 1

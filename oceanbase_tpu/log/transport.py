@@ -28,6 +28,11 @@ class Envelope:
     dst: int
     msg: Any
     deliver_at: float
+    # full-link trace context: (trace_id, parent_span_id) of the statement
+    # that caused this message, or None for autonomous traffic (ticks,
+    # elections). Carried across hops so replica-side work lands in the
+    # originating statement's span tree (ObTrace's flt_trace_id analog).
+    trace_ctx: Any = None
 
 
 @dataclass
@@ -47,10 +52,28 @@ class LocalBus:
     # sent/dropped/delivered surface in __all_virtual_sysstat as
     # "rpc packets ..." instead of living only in the private dict below
     metrics: Any = None
+    # tenant tracer (server/diag.Tracer); when wired, send() stamps each
+    # envelope with the sender's current trace context and advance() makes
+    # it visible to handlers via delivery_ctx(), so replies sent while
+    # handling a delivery inherit the originating statement's trace
+    tracer: Any = None
     stats: dict[str, int] = field(default_factory=lambda: defaultdict(int))
+    _delivery_ctx: Any = field(default=None, repr=False)
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
+
+    def delivery_ctx(self) -> Any:
+        """Trace context of the envelope currently being delivered (only
+        meaningful inside a handler called from advance())."""
+        return self._delivery_ctx
+
+    def _current_ctx(self) -> Any:
+        if self.tracer is not None:
+            ctx = self.tracer.current_ctx()
+            if ctx is not None:
+                return ctx
+        return self._delivery_ctx
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
@@ -91,7 +114,10 @@ class LocalBus:
         if self.drop_prob and self._rng.random() < self.drop_prob:
             self._bump("dropped")
             return
-        self._queue.append(Envelope(src, dst, msg, self.now + self.latency))
+        self._queue.append(
+            Envelope(src, dst, msg, self.now + self.latency,
+                     trace_ctx=self._current_ctx())
+        )
 
     def advance(self, dt: float) -> int:
         """Advance virtual time, delivering everything due. Returns count."""
@@ -109,7 +135,11 @@ class LocalBus:
                     continue
                 h = self._handlers.get(e.dst)
                 if h is not None:
-                    h(e.src, e.msg)
+                    self._delivery_ctx = e.trace_ctx
+                    try:
+                        h(e.src, e.msg)
+                    finally:
+                        self._delivery_ctx = None
                     delivered += 1
         self._bump("delivered", delivered)
         return delivered

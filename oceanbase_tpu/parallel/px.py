@@ -270,35 +270,59 @@ class PxExecutor(Executor):
                 m.wait("px dispatch", exec_s)
         return out
 
+    def prepare(self, plan):
+        """Compile + attach the exchange layout to the prepared plan, so a
+        session executing a CACHED PX plan can still emit per-DFO worker
+        spans (the exchange list is a compile-time artifact; re-deriving
+        it per execution would mean re-tracing)."""
+        self._exch_log = []
+        prepared = super().prepare(plan)
+        prepared.px_exchanges = list(self._exch_log)
+        prepared.px_nsh = self.nsh
+        return prepared
+
     # ------------------------------------------------------------ inputs
     def table_batch(self, name: str, cols: tuple[str, ...]):
         """Raw sharded input: cols/valid/sel arrays padded to a multiple of
         nsh*1024 and placed with row sharding (the granule map)."""
+        is_private = getattr(self.catalog, "is_private", None)
+        if is_private is not None and is_private(name):
+            # tx-private view: shard + upload fresh, NEVER through the
+            # shared cache (same isolation contract as the base executor)
+            return self._shard_upload(name, cols)
         key = (name, cols)
         if key not in self._batch_cache:
-            from ..core.column import make_batch
-
-            t = self.catalog[name]
-            sub_schema = Schema(
-                tuple(f for f in t.schema.fields if f.name in cols)
-            )
-            unit = 1024 * self.nsh
-            cap = max(unit, -(-(t.nrows or 1) // unit) * unit)
-            b = make_batch(
-                {c: t.data[c] for c in sub_schema.names()},
-                sub_schema,
-                {c: d for c, d in t.dicts.items() if c in cols},
-                capacity=cap,
-                valid={c: v for c, v in t.valid.items() if c in cols},
-            )
-            shard = NamedSharding(self.mesh, P(SHARD_AXIS))
-            raw = {
-                "cols": {n: jax.device_put(a, shard) for n, a in b.cols.items()},
-                "valid": {n: jax.device_put(a, shard) for n, a in b.valid.items()},
-                "sel": jax.device_put(b.sel, shard),
-            }
-            self._batch_cache[key] = raw
+            self._batch_cache[key] = self._shard_upload(name, cols)
         return self._batch_cache[key]
+
+    def _shard_upload(self, name: str, cols: tuple[str, ...]):
+        from ..core.column import make_batch
+
+        t = self.catalog[name]
+        sub_schema = Schema(
+            tuple(f for f in t.schema.fields if f.name in cols)
+        )
+        unit = 1024 * self.nsh
+        cap = max(unit, -(-(t.nrows or 1) // unit) * unit)
+        b = make_batch(
+            {c: t.data[c] for c in sub_schema.names()},
+            sub_schema,
+            {c: d for c, d in t.dicts.items() if c in cols},
+            capacity=cap,
+            valid={c: v for c, v in t.valid.items() if c in cols},
+        )
+        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+        raw = {
+            "cols": {n: jax.device_put(a, shard) for n, a in b.cols.items()},
+            "valid": {n: jax.device_put(a, shard) for n, a in b.valid.items()},
+            "sel": jax.device_put(b.sel, shard),
+        }
+        self.h2d_bytes += sum(
+            int(a.nbytes)
+            for d in (raw["cols"], raw["valid"])
+            for a in d.values()
+        ) + int(raw["sel"].nbytes)
+        return raw
 
     # ------------------------------------------------------- capacities
     def seed_params(self, plan):

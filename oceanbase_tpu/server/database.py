@@ -413,14 +413,30 @@ class Database:
             # stats — rpc traffic is cluster-wide, not per-tenant
             self.cluster.bus.metrics = self.metrics
         # diagnostics (observer/virtual_table surface)
-        from .diag import AshSampler, PlanMonitor, SqlAudit, Tracer
+        from .diag import (
+            AshSampler,
+            FlightRecorder,
+            LongOps,
+            PlanMonitor,
+            SqlAudit,
+            Tracer,
+        )
 
         self.tracer = Tracer()
+        if getattr(self.cluster.bus, "tracer", None) is None:
+            # full-link propagation: replication messages stamped with the
+            # sending statement's trace context land replica-side spans in
+            # the same tree (first tenant owns, like bus.metrics)
+            self.cluster.bus.tracer = self.tracer
         self.audit = SqlAudit(
             capacity=max(64, self.config["sql_audit_memory_limit"] // 4096)
         )
         self.plan_monitor = PlanMonitor()
         self.ash = AshSampler()
+        self.long_ops = LongOps()
+        self.flight = FlightRecorder(
+            watermark_s=self.config["trace_log_slow_query_watermark"]
+        )
         self.audit.enabled = self.config["enable_sql_audit"]
         self.plan_monitor.enabled = self.config["enable_perf_event"]
         self.config.on_change(
@@ -432,6 +448,9 @@ class Database:
         self.config.on_change(
             "sql_audit_memory_limit",
             lambda _n, _o, v: self.audit.set_capacity(max(64, v // 4096)))
+        self.config.on_change(
+            "trace_log_slow_query_watermark",
+            lambda _n, _o, v: setattr(self.flight, "watermark_s", v))
         self._session_ids = itertools.count(1)
 
         # storage maintenance: block cache, dag scheduler, freeze loop
@@ -451,7 +470,9 @@ class Database:
                 ss.cache = self.block_cache
             if t.base is not None:
                 t.base.cache = self.block_cache
-        self.dag_scheduler = TenantDagScheduler()
+        self.dag_scheduler = TenantDagScheduler(
+            tracer=self.tracer, long_ops=self.long_ops
+        )
         self.maintenance = MaintenanceService(
             self.dag_scheduler,
             config=self.config,
@@ -513,7 +534,13 @@ class Database:
             plan_monitor=self.plan_monitor,
             views=self._view_specs,
             metrics=self.metrics,
+            tracer=self.tracer,
+            profile_enabled_fn=lambda: self.config["enable_query_profile"],
         )
+        # distributed (PX) executor, built lazily on the first statement a
+        # session routes with ob_px_dop — mesh construction touches every
+        # device, so tenants that never use PX never pay for it
+        self._px_executor_obj = None
         self._ddl_lock = threading.RLock()
         # re-materialize restored mviews against the recovered base data
         # (failures keep the registration: REFRESH can retry once the
@@ -748,6 +775,31 @@ class Database:
                     rep.palf.store.close()
 
     # ------------------------------------------------------------ schema
+    def _invalidate(self, name: str) -> None:
+        """Drop one table's cached device batches on EVERY executor that
+        may hold them — the single-chip engine executor and (when built)
+        the PX executor, whose sharded upload cache is separate."""
+        self.engine.executor.invalidate_table(name)
+        if self._px_executor_obj is not None:
+            self._px_executor_obj.invalidate_table(name)
+
+    def _px_executor(self):
+        """Lazily-built distributed executor over the full device mesh
+        (sessions route statements here via SET ob_px_dop)."""
+        if self._px_executor_obj is None:
+            from ..parallel.mesh import make_mesh
+            from ..parallel.px import PxExecutor
+
+            self._px_executor_obj = PxExecutor(
+                self.catalog,
+                make_mesh(),
+                unique_keys=self._unique_keys,
+                stats=self.engine.stats,
+                tracer=self.tracer,
+                metrics=self.metrics,
+            )
+        return self._px_executor_obj
+
     def _key_extra(self, table_names: tuple[str, ...]) -> tuple:
         """Plan-cache key material: schema + dictionary versions of the
         referenced DML-backed tables (string literals bake dictionary
@@ -772,7 +824,7 @@ class Database:
             if p is None:
                 continue
             self.catalog[name] = p(self)
-            self.engine.executor.invalidate_table(name)
+            self._invalidate(name)
             any_vt = True
         return any_vt
 
@@ -851,7 +903,7 @@ class Database:
             self.catalog.pop(stmt.name, None)
             self._unique_keys.pop(stmt.name, None)
             self._ti_by_tablet = None
-            self.engine.executor.invalidate_table(stmt.name)
+            self._invalidate(stmt.name)
             self._save_node_meta()
 
     # ---------------------------------------------------------- sequences
@@ -1015,7 +1067,7 @@ class Database:
             if st.name in self.tables or st.name in self.catalog:
                 raise SqlError(f"table {st.name} already exists")
             self.catalog[st.name] = t
-            self.engine.executor.invalidate_table(st.name)
+            self._invalidate(st.name)
             self._mview_specs[st.name] = st.query_sql
             self._save_node_meta()
 
@@ -1027,7 +1079,7 @@ class Database:
         self.refresh_catalog(
             _tables_in_ast(P2.parse(sql_text)), tx=None)
         self.catalog[name] = self.engine.materialize(sql_text, name)
-        self.engine.executor.invalidate_table(name)
+        self._invalidate(name)
 
     def refresh_mview(self, name: str) -> None:
         with self._ddl_lock:
@@ -1043,14 +1095,14 @@ class Database:
             if name not in self._mview_specs:
                 return  # dropped concurrently: discard, don't resurrect
             self.catalog[name] = t
-            self.engine.executor.invalidate_table(name)
+            self._invalidate(name)
 
     def drop_mview(self, name: str) -> None:
         with self._ddl_lock:
             if self._mview_specs.pop(name, None) is None:
                 raise SqlError(f"no materialized view {name}")
             self.catalog.pop(name, None)
-            self.engine.executor.invalidate_table(name)
+            self._invalidate(name)
             self._save_node_meta()
 
     def create_external_table(self, st: A.CreateExternalTable) -> None:
@@ -1349,7 +1401,7 @@ class Database:
                     from ..storage.sorted_projection import drop_projections
 
                     for pname in projs.values():
-                        self.engine.executor.invalidate_table(pname)
+                        self._invalidate(pname)
                     drop_projections(self.catalog, name)
                     self.plan_cache.flush()
                 self.catalog[name] = t
@@ -1360,7 +1412,7 @@ class Database:
                     for col, (lists, nprobe) in vspecs.items():
                         register_vector_index(
                             self.catalog, name, col, lists, nprobe)
-                self.engine.executor.invalidate_table(name)
+                self._invalidate(name)
                 ti.cached_data_version = ti.data_version
                 self._enforce_memory(keep=name)
 
@@ -1396,7 +1448,7 @@ class Database:
                 for f in ti.schema.fields
             })
             ti.cached_data_version = -1
-            self.engine.executor.invalidate_table(name)
+            self._invalidate(name)
             if self._resident_bytes() <= limit:
                 return
         if self._resident_bytes() > limit:
@@ -1478,6 +1530,16 @@ class DbSession:
         self.session_id = next(db._session_ids)
         self._last_stmt_type = ""
         self._stmt_cache_hit = False
+        # session variables (SET <name> = <value>): full-link trace
+        # collection flag + PX degree-of-parallelism routing
+        self._vars: dict[str, int] = {
+            "ob_enable_show_trace": 0,
+            "ob_px_dop": 0,
+        }
+        # trace_id of the last traced NON-meta statement — what SHOW TRACE
+        # renders (meta statements: SHOW/SET themselves, so the flag and
+        # the inspection don't overwrite the statement under diagnosis)
+        self._last_trace_id = 0
 
     # ------------------------------------------------------------ public
     def sql(self, text: str) -> ResultSet:
@@ -1524,6 +1586,9 @@ class DbSession:
 
         db = self.db
         err, rs = "", None
+        # last_profile is per-run_ast; statements that never reach run_ast
+        # (pure DDL, SHOW) must not inherit the previous statement's
+        db.engine.last_profile = None
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
@@ -1544,6 +1609,8 @@ class DbSession:
                     if err:
                         m.add("sql fail count")
                     m.observe("sql response time", elapsed_s)
+                    prof = db.engine.last_profile
+                    pd = prof.as_dict() if prof is not None else {}
                     db.audit.record(
                         session_id=self.session_id,
                         trace_id=sp.trace_id,
@@ -1555,8 +1622,54 @@ class DbSession:
                         plan_cache_hit=(rs.plan_cache_hit
                                         if rs is not None else False),
                         error=err,
+                        compile_s=prof.compile_s if prof else 0.0,
+                        device_bytes=pd.get("device_bytes", 0),
+                        transfer_bytes=pd.get("transfer_bytes", 0),
+                        peak_bytes=pd.get("peak_bytes", 0),
                     )
+                    if stype not in ("Show", "SetVar", ""):
+                        if self._vars.get("ob_enable_show_trace"):
+                            self._last_trace_id = sp.trace_id
+                        self._maybe_flight_record(
+                            text, sp, elapsed_s, rs, err, prof)
         return rs
+
+    def _maybe_flight_record(self, text, sp, elapsed_s, rs, err,
+                             prof) -> None:
+        """Slow-query flight recorder: when a statement crosses the
+        trace_log_slow_query_watermark, freeze the evidence (span tree,
+        plan text, audit-shaped record, metrics delta, active config)
+        into the bounded bundle ring — tools/obdiag_dump.py exports it."""
+        db = self.db
+        if not db.flight.should_record(elapsed_s):
+            return
+        spans = [
+            {
+                "depth": depth,
+                "name": s.name,
+                "node": s.tags.get("node", ""),
+                "elapsed_us": int(s.elapsed * 1e6),
+                "tags": {k: repr(v) for k, v in s.tags.items()},
+            }
+            for depth, s in db.tracer.trace_tree(sp.trace_id)
+        ]
+        bundle = {
+            "trace_id": sp.trace_id,
+            "session_id": self.session_id,
+            "sql": text,
+            "stmt_type": self._last_stmt_type,
+            "elapsed_s": elapsed_s,
+            "rows": rs.nrows if rs is not None else 0,
+            "error": err,
+            "profile": prof.as_dict() if prof is not None else {},
+            "plan": repr(db.engine.last_plan),
+            "spans": spans,
+            "config": {
+                n: v for n, v, _p in db.config.snapshot()
+            },
+        }
+        db.flight.record(bundle, counters=db.metrics.counters_snapshot())
+        db.metrics.add("flight recorder bundles")
 
     @staticmethod
     def _referenced_tables(node) -> set:
@@ -1702,6 +1815,9 @@ class DbSession:
         if low.startswith("xa "):
             self._last_stmt_type = "Xa"
             return self._xa(text)
+        if low.startswith("set ") and not low.startswith("set transaction"):
+            self._last_stmt_type = "SetVar"
+            return self._set_session_var(text)
         if low.startswith("create sequence") or low.startswith("drop sequence"):
             self._last_stmt_type = "Sequence"
             return self._sequence_ddl(text)
@@ -1955,7 +2071,7 @@ class DbSession:
                 for n in names:
                     if n in PROVIDERS:
                         self.db.catalog.pop(n, None)
-                        self.db.engine.executor.invalidate_table(n)
+                        self.db._invalidate(n)
         if analyze:
             engine.last_phases = {}
             rs = self._select(ast, P.normalize_for_cache(text)[0])
@@ -2316,7 +2432,7 @@ class DbSession:
             for name, snap in fb:
                 tmp = f"#fb:{name}@{snap}#{sid}"
                 self.db.catalog[tmp] = self.db.snapshot_table(name, snap)
-                self.db.engine.executor.invalidate_table(tmp)
+                self.db._invalidate(tmp)
                 tmp_names.append(tmp)
 
             def rw(node):
@@ -2349,7 +2465,7 @@ class DbSession:
         finally:
             for tmp in tmp_names:
                 self.db.catalog.pop(tmp, None)
-                self.db.engine.executor.invalidate_table(tmp)
+                self.db._invalidate(tmp)
 
     # -------------------------------------------------------------- lock
     def _lock_table(self, st: A.LockTable) -> ResultSet:
@@ -2370,7 +2486,60 @@ class DbSession:
         return ResultSet((), {})
 
     # -------------------------------------------------------------- show
+    _BOOL_WORDS = {"true": 1, "on": 1, "false": 0, "off": 0}
+
+    def _set_session_var(self, text: str) -> ResultSet:
+        """SET <name> = <value> — session-scoped variables (the reference's
+        sys-var surface, narrowed to the diagnosability knobs):
+        ob_enable_show_trace gates full-link collection for THIS session,
+        ob_px_dop routes SELECTs through the distributed (PX) executor."""
+        body = text.strip().rstrip(";")
+        body = body[3:].strip()  # after SET
+        name, eq, val = body.partition("=")
+        if not eq:
+            raise SqlError("SET needs <variable> = <value>")
+        name = name.strip().lower().lstrip("@").strip()
+        if name not in self._vars:
+            raise SqlError(f"unknown session variable {name!r}")
+        sval = val.strip().strip("'\"").lower()
+        try:
+            iv = int(sval)
+        except ValueError:
+            iv = self._BOOL_WORDS.get(sval)
+            if iv is None:
+                raise SqlError(
+                    f"bad value {val.strip()!r} for {name}") from None
+        self._vars[name] = iv
+        if name == "ob_enable_show_trace" and iv:
+            # collection implies recording: a session asking for SHOW
+            # TRACE needs spans in the ring regardless of the global flag
+            self.db.tracer.enabled = True
+        return ResultSet((), {})
+
+    def _show_trace(self) -> ResultSet:
+        if not self._vars.get("ob_enable_show_trace"):
+            raise SqlError(
+                "SHOW TRACE needs SET ob_enable_show_trace = 1 before the "
+                "statement under diagnosis")
+        tree = self.db.tracer.trace_tree(self._last_trace_id)
+        names, nodes, elapsed, tags = [], [], [], []
+        for depth, s in tree:
+            names.append("  " * depth + s.name)
+            nodes.append(str(s.tags.get("node", "")))
+            elapsed.append(int(s.elapsed * 1e6))
+            tags.append(", ".join(
+                f"{k}={v}" for k, v in sorted(s.tags.items())
+                if k != "node"
+            ))
+        return ResultSet(
+            ("span_name", "node", "elapsed_us", "tags"),
+            {"span_name": names, "node": nodes, "elapsed_us": elapsed,
+             "tags": tags},
+        )
+
     def _show(self, st: A.Show) -> ResultSet:
+        if st.what == "trace":
+            return self._show_trace()
         if st.what == "parameters":
             import fnmatch
 
@@ -2536,11 +2705,32 @@ class DbSession:
         self.db.refresh_catalog(names, tx=self._tx)
         in_tx = self._tx is not None and self._tx.ctx is not None
         views = self._tx.views if in_tx else None
+        # PX routing: non-virtual statements of a session with a DOP
+        # variable run on the distributed executor. In-tx reads are safe:
+        # the PX executor bypasses its shared input cache for tx-private
+        # views (is_private), mirroring the single-chip isolation contract.
+        px = None
+        if self._vars.get("ob_px_dop", 0) > 0 and not any_vt:
+            px = self.db._px_executor()
         try:
             with self.db.catalog.tx_scope(views):
-                rs = self.db.engine.run_ast(
-                    ast, norm_key, use_cache=False if any_vt else None
-                )
+                try:
+                    rs = self.db.engine.run_ast(
+                        ast, norm_key,
+                        use_cache=False if any_vt else None,
+                        executor=px,
+                    )
+                except Exception:
+                    if px is None:
+                        raise
+                    # PX degradation: distributed compile/execute failures
+                    # fall back to the single-chip path (genuine SQL
+                    # errors re-raise identically from it)
+                    self.db.metrics.add("px fallbacks")
+                    rs = self.db.engine.run_ast(
+                        ast, norm_key,
+                        use_cache=False if any_vt else None,
+                    )
             # surfaces in the audit record; for DML the qualification
             # scan's plan reuse IS the statement's plan-cache behavior
             self._stmt_cache_hit = rs.plan_cache_hit
@@ -2554,7 +2744,7 @@ class DbSession:
                 for n in names:
                     if n in PROVIDERS:
                         self.db.catalog.pop(n, None)
-                        self.db.engine.executor.invalidate_table(n)
+                        self.db._invalidate(n)
 
     # --------------------------------------------------------------- tx
     def _dml(self, body) -> ResultSet:

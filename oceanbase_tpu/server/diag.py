@@ -49,7 +49,18 @@ class Span:
 
 
 class Tracer:
-    """Per-database tracer: thread-local span stacks, finished-span ring."""
+    """Per-database tracer: thread-local span stacks, finished-span ring.
+
+    Two recording shapes:
+      * `span()` — a contextmanager for work on the CURRENT thread; nests
+        via the thread-local stack (or an explicit `ctx=` parent when the
+        logical parent lives on another thread, e.g. a DAG task running a
+        statement-initiated compaction);
+      * `record_span()` — a retrospective finished span for work measured
+        on a DIFFERENT clock/thread (palf replication rounds timed on the
+        bus virtual clock), stitched into a trace via an explicit
+        (trace_id, parent_span_id) context captured at submit time.
+    """
 
     def __init__(self, capacity: int = 4096, clock=time.perf_counter):
         self._ids = itertools.count(1)
@@ -66,23 +77,32 @@ class Tracer:
         return st
 
     @contextmanager
-    def span(self, name: str, **tags):
+    def span(self, name: str, ctx: tuple | None = None, **tags):
         st = self._stack()
         parent = st[-1] if st else None
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif ctx:
+            # adopt a propagated (trace_id, parent_span_id) — task
+            # dispatch across threads / bus hops carries this explicitly
+            # because thread-locals do not travel
+            trace_id, parent_id = int(ctx[0]), int(ctx[1])
+        else:
+            trace_id, parent_id = next(self._ids), 0
         s = Span(
-            trace_id=parent.trace_id if parent else next(self._ids),
+            trace_id=trace_id,
             span_id=next(self._ids),
-            parent_id=parent.span_id if parent else 0,
+            parent_id=parent_id,
             name=name,
             start=self._clock(),
             tags=dict(tags),
             clock=self._clock,
         )
-        if not self.enabled:
-            # still hand out a span (callers read trace_id) but record
-            # nothing — the zero-overhead path the bench compares against
-            yield s
-            return
+        # the span goes on the stack even when disabled: nested spans must
+        # inherit the parent's trace_id either way, or callers that stash
+        # current_trace_id() get ids that differ by flag state. Only the
+        # RING write (the allocation that costs memory) is gated.
+        record = self.enabled
         st.append(s)
         try:
             yield s
@@ -94,16 +114,70 @@ class Tracer:
         finally:
             s.end = self._clock()
             st.pop()
-            with self._lock:
-                self._done.append(s)
+            if record:
+                with self._lock:
+                    self._done.append(s)
 
     def current_trace_id(self) -> int:
         st = self._stack()
         return st[-1].trace_id if st else 0
 
+    def current_ctx(self) -> tuple[int, int] | None:
+        """(trace_id, span_id) of the active span — the propagation
+        context stamped onto bus messages and background-task dispatch."""
+        st = self._stack()
+        return (st[-1].trace_id, st[-1].span_id) if st else None
+
+    def record_span(self, name: str, ctx: tuple | None, start: float,
+                    end: float, **tags) -> Span | None:
+        """Append an already-finished span measured elsewhere (bus virtual
+        clock, another node). `ctx` is the propagated parent context; a
+        missing one mints a fresh trace so the span is still findable."""
+        if not self.enabled:
+            return None
+        if ctx:
+            trace_id, parent_id = int(ctx[0]), int(ctx[1])
+        else:
+            trace_id, parent_id = next(self._ids), 0
+        s = Span(
+            trace_id=trace_id,
+            span_id=next(self._ids),
+            parent_id=parent_id,
+            name=name,
+            start=start,
+            end=end,
+            tags=dict(tags),
+            clock=self._clock,
+        )
+        with self._lock:
+            self._done.append(s)
+        return s
+
     def spans(self) -> list[Span]:
         with self._lock:
             return list(self._done)
+
+    def trace_tree(self, trace_id: int) -> list[tuple[int, Span]]:
+        """Spans of one trace as a depth-first (depth, span) walk — the
+        rendering order of SHOW TRACE. Orphans (parent fell off the ring
+        or lives on another tenant's tracer) surface at depth 0."""
+        spans = [s for s in self.spans() if s.trace_id == trace_id]
+        by_parent: dict[int, list[Span]] = {}
+        ids = {s.span_id for s in spans}
+        for s in spans:
+            pid = s.parent_id if s.parent_id in ids else 0
+            by_parent.setdefault(pid, []).append(s)
+        for v in by_parent.values():
+            v.sort(key=lambda s: (s.start, s.span_id))
+        out: list[tuple[int, Span]] = []
+
+        def walk(pid: int, depth: int) -> None:
+            for s in by_parent.get(pid, ()):
+                out.append((depth, s))
+                walk(s.span_id, depth + 1)
+
+        walk(0, 0)
+        return out
 
 
 # ---- sql_audit --------------------------------------------------------------
@@ -122,16 +196,25 @@ class AuditRecord:
     plan_cache_hit: bool
     error: str = ""
     ts: float = 0.0
+    # per-query resource profile (QueryProfile): compile + data-movement
+    # attribution, the accelerator analog of sql_audit's rpc/io columns
+    compile_s: float = 0.0
+    device_bytes: int = 0
+    transfer_bytes: int = 0
+    peak_bytes: int = 0
 
 
 class SqlAudit:
     """Fixed-capacity ring of per-statement records (ob_mysql_request_manager
-    keeps a memory-bounded ring; entry count is the proxy here)."""
+    keeps a memory-bounded ring; entry count is the proxy here). The
+    timestamp clock is injectable so virtual-clock tests get deterministic
+    `ts` values (live servers keep wall time)."""
 
-    def __init__(self, capacity: int = 10000):
+    def __init__(self, capacity: int = 10000, clock=time.time):
         self._ring: deque[AuditRecord] = deque(maxlen=capacity)
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        self._clock = clock
         self.enabled = True
 
     def record(self, **kw) -> None:
@@ -139,7 +222,7 @@ class SqlAudit:
             return
         with self._lock:
             self._ring.append(
-                AuditRecord(request_id=next(self._ids), ts=time.time(), **kw)
+                AuditRecord(request_id=next(self._ids), ts=self._clock(), **kw)
             )
 
     def records(self) -> list[AuditRecord]:
@@ -165,6 +248,11 @@ class PlanMonitorEntry:
     total_exec_s: float = 0.0
     last_rows: int = 0
     overflow_retries: int = 0
+    # QueryProfile accumulation across runs of this plan: data movement
+    # and working-set footprint per compiled executable
+    total_transfer_bytes: int = 0
+    last_device_bytes: int = 0
+    peak_bytes: int = 0
 
     @property
     def avg_exec_s(self) -> float:
@@ -209,11 +297,13 @@ class AshSampler:
     deployments (`start`), or on demand (`sample_once`) in deterministic
     tests. History is a bounded ring like the reference's ASH buffer."""
 
-    def __init__(self, capacity: int = 90000, interval_s: float = 1.0):
+    def __init__(self, capacity: int = 90000, interval_s: float = 1.0,
+                 clock=time.time):
         self._active: dict[int, tuple[str, str, int]] = {}
         self._ring: deque[AshSample] = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._interval = interval_s
+        self._clock = clock
         self._timer: threading.Timer | None = None
 
     @contextmanager
@@ -228,7 +318,7 @@ class AshSampler:
                 self._active.pop(session_id, None)
 
     def sample_once(self, now: float | None = None) -> int:
-        ts = time.time() if now is None else now
+        ts = self._clock() if now is None else now
         with self._lock:
             for sid, (act, sql, tid) in self._active.items():
                 self._ring.append(AshSample(ts, sid, act, sql, tid))
@@ -258,3 +348,165 @@ class AshSampler:
     def samples(self) -> list[AshSample]:
         with self._lock:
             return list(self._ring)
+
+
+# ---- per-query resource profile ---------------------------------------------
+
+
+@dataclass
+class QueryProfile:
+    """TPU cost attribution for ONE statement execution.
+
+    The unit economics of an accelerator engine are compile time, bytes
+    moved across the host<->device boundary, and device-resident working
+    set (PAPERS.md: Tailwind's accounting prerequisite). All numbers are
+    host-observed: array `nbytes` at the operator boundaries (input
+    batches, parameter upload, result fetch) — nothing here runs inside
+    traced code."""
+
+    compile_hit: bool = False  # plan cache served the XLA executable
+    compile_s: float = 0.0  # trace + XLA compile seconds (0 on hit)
+    h2d_bytes: int = 0  # host->device: new batch uploads + parameters
+    d2h_bytes: int = 0  # device->host: result columns/validity/sel fetch
+    device_bytes: int = 0  # device-resident input + output footprint
+    peak_bytes: int = 0  # working-set estimate (inputs+outputs+exchanges)
+
+    @property
+    def transfer_bytes(self) -> int:
+        return self.h2d_bytes + self.d2h_bytes
+
+    def as_dict(self) -> dict:
+        return {
+            "compile_hit": self.compile_hit,
+            "compile_us": int(self.compile_s * 1e6),
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "transfer_bytes": self.transfer_bytes,
+            "device_bytes": self.device_bytes,
+            "peak_bytes": self.peak_bytes,
+        }
+
+
+# ---- long-running operations ------------------------------------------------
+
+
+@dataclass
+class LongOp:
+    """One background job's progress row (__all_virtual_long_ops analog:
+    the reference surfaces index build / migration / compaction progress
+    through ob_all_virtual_long_ops_status)."""
+
+    op_id: int
+    name: str  # e.g. "mini_compaction", "index_backfill", "ha_migration"
+    target: str  # what it operates on (tablet/table/ls identity)
+    total: int = 0  # work units expected (0 = unknown)
+    done: int = 0
+    status: str = "RUNNING"  # RUNNING | DONE | FAILED
+    trace_id: int = 0  # initiating statement's trace (0 = autonomous)
+    start_ts: float = 0.0
+    end_ts: float = 0.0
+    message: str = ""
+
+    @property
+    def percent(self) -> float:
+        if self.status == "DONE":
+            return 100.0
+        return 100.0 * self.done / self.total if self.total else 0.0
+
+
+class LongOps:
+    """Registry of running + recently-finished background jobs. Handles
+    are plain LongOp rows the owning job mutates through the registry
+    (update/finish), so readers always see a consistent snapshot."""
+
+    def __init__(self, capacity: int = 256, clock=time.perf_counter):
+        self._ids = itertools.count(1)
+        self._active: dict[int, LongOp] = {}
+        self._finished: deque[LongOp] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def start(self, name: str, target: str = "", total: int = 0,
+              trace_id: int = 0) -> LongOp:
+        op = LongOp(next(self._ids), name, target, total=total,
+                    trace_id=trace_id, start_ts=self._clock())
+        with self._lock:
+            self._active[op.op_id] = op
+        return op
+
+    def update(self, op: LongOp, done: int | None = None,
+               message: str = "") -> None:
+        with self._lock:
+            if done is not None:
+                op.done = done
+            if message:
+                op.message = message
+
+    def finish(self, op: LongOp, ok: bool = True, message: str = "") -> None:
+        with self._lock:
+            if self._active.pop(op.op_id, None) is None:
+                return  # double-finish: first decision wins
+            op.status = "DONE" if ok else "FAILED"
+            op.end_ts = self._clock()
+            if ok and op.total:
+                op.done = op.total
+            if message:
+                op.message = message
+            self._finished.append(op)
+
+    def ops(self) -> list[LongOp]:
+        with self._lock:
+            return list(self._finished) + sorted(
+                self._active.values(), key=lambda o: o.op_id
+            )
+
+
+# ---- slow-query flight recorder ---------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of diagnostic bundles for statements that crossed the
+    trace_log_slow_query_watermark — evidence captured AT the moment the
+    slow statement finished, not reconstructed later (the obdiag 'gather'
+    pain point: by the time anyone runs it, sysstat moved on).
+
+    The metrics-delta baseline advances on every recorded bundle: each
+    bundle's `metrics_delta` covers the window since the previous bundle
+    (or process start) at zero per-statement cost — snapshotting counters
+    around EVERY statement would show up in the overhead bench."""
+
+    def __init__(self, capacity: int = 64, watermark_s: float = 1.0):
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._baseline: dict[str, float] = {}
+        self._ids = itertools.count(1)
+        self.watermark_s = watermark_s
+        self.enabled = True
+
+    def should_record(self, elapsed_s: float) -> bool:
+        return self.enabled and elapsed_s >= self.watermark_s
+
+    def record(self, bundle: dict, counters: dict | None = None) -> dict:
+        """Store one bundle; when a counters snapshot is provided, attach
+        the delta vs the previous bundle's baseline."""
+        with self._lock:
+            bundle = dict(bundle)
+            bundle["bundle_id"] = next(self._ids)
+            if counters is not None:
+                delta = {
+                    k: v - self._baseline.get(k, 0)
+                    for k, v in counters.items()
+                    if v != self._baseline.get(k, 0)
+                }
+                bundle["metrics_delta"] = delta
+                self._baseline = dict(counters)
+            self._ring.append(bundle)
+            return bundle
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=capacity)

@@ -76,6 +76,7 @@ class TenantManager:
             audit=db.audit, plan_monitor=db.plan_monitor, ash=db.ash,
             config=db.config, plan_cache=db.plan_cache,
             lock_mgr=db.lock_mgr,
+            tracer=db.tracer, flight=db.flight, long_ops=db.long_ops,
         )
         self.tenants[name] = t
         return t

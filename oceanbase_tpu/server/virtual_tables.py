@@ -71,6 +71,14 @@ def _sql_audit(db) -> Table:
         ("is_hit_plan", DataType.int32(),
          [int(r.plan_cache_hit) for r in recs]),
         ("error", DataType.varchar(), [r.error for r in recs]),
+        # per-query TPU resource profile (QueryProfile): the accelerator
+        # analog of the reference's rpc/io cost columns
+        ("compile_time_us", DataType.int64(),
+         [int(r.compile_s * 1e6) for r in recs]),
+        ("device_bytes", DataType.int64(), [r.device_bytes for r in recs]),
+        ("transfer_bytes", DataType.int64(),
+         [r.transfer_bytes for r in recs]),
+        ("peak_bytes", DataType.int64(), [r.peak_bytes for r in recs]),
     ])
 
 
@@ -86,6 +94,11 @@ def _plan_monitor(db) -> Table:
         ("avg_exec_us", DataType.int64(), [int(e.avg_exec_s * 1e6) for e in es]),
         ("last_rows", DataType.int64(), [e.last_rows for e in es]),
         ("overflow_retries", DataType.int64(), [e.overflow_retries for e in es]),
+        ("total_transfer_bytes", DataType.int64(),
+         [e.total_transfer_bytes for e in es]),
+        ("last_device_bytes", DataType.int64(),
+         [e.last_device_bytes for e in es]),
+        ("peak_bytes", DataType.int64(), [e.peak_bytes for e in es]),
     ])
 
 
@@ -108,8 +121,29 @@ def _trace(db) -> Table:
         ("parent_id", DataType.int64(), [s.parent_id for s in sp]),
         ("span_name", DataType.varchar(), [s.name for s in sp]),
         ("elapsed_us", DataType.int64(), [int(s.elapsed * 1e6) for s in sp]),
+        ("node", DataType.varchar(),
+         [str(s.tags.get("node", "")) for s in sp]),
+        ("tags", DataType.varchar(),
+         [",".join(f"{k}={v}" for k, v in sorted(s.tags.items())
+                   if k != "node") for s in sp]),
         ("error", DataType.varchar(),
          [str(s.tags.get("error", "")) for s in sp]),
+    ])
+
+
+def _long_ops(db) -> Table:
+    """__all_virtual_long_ops analog: background-job progress tracking."""
+    ops = db.long_ops.ops()
+    return _t("__all_virtual_long_ops", [
+        ("op_id", DataType.int64(), [o.op_id for o in ops]),
+        ("op_name", DataType.varchar(), [o.name for o in ops]),
+        ("target", DataType.varchar(), [o.target for o in ops]),
+        ("total", DataType.int64(), [o.total for o in ops]),
+        ("done", DataType.int64(), [o.done for o in ops]),
+        ("percent", DataType.int64(), [int(o.percent) for o in ops]),
+        ("status", DataType.varchar(), [o.status for o in ops]),
+        ("trace_id", DataType.int64(), [o.trace_id for o in ops]),
+        ("message", DataType.varchar(), [o.message for o in ops]),
     ])
 
 
@@ -367,6 +401,7 @@ PROVIDERS = {
     "__all_virtual_sql_plan_monitor": _plan_monitor,
     "__all_virtual_ash": _ash,
     "__all_virtual_trace_span": _trace,
+    "__all_virtual_long_ops": _long_ops,
     "__all_virtual_sysstat": _sysstat,
     "__all_virtual_system_event": _system_event,
     "__all_virtual_query_response_time": _query_response_time,

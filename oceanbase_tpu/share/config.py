@@ -135,6 +135,13 @@ def default_params() -> list[Param]:
               "ring-buffer budget for sql_audit"),
         Param("enable_perf_event", "bool", True,
               "per-operator plan monitor collection"),
+        Param("enable_query_profile", "bool", True,
+              "per-query TPU resource profiling: compile cache hit/miss, "
+              "host<->device transfer bytes, device working set"),
+        Param("trace_log_slow_query_watermark", "time", 1.0,
+              "statements slower than this get a flight-recorder "
+              "diagnostic bundle (span tree, plan, metrics delta)",
+              min=0.0),
         Param("syslog_level", "str", "INFO", "server log level",
               choices=("DEBUG", "TRACE", "INFO", "WARN", "ERROR")),
         # storage

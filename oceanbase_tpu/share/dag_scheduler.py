@@ -55,6 +55,12 @@ class Dag:
     tasks: list[DagTask] = field(default_factory=list)
     dag_id: int = 0
     failed: bool = False
+    # full-link tracing: (trace_id, parent_span_id) of the statement that
+    # queued this dag — captured at add_dag so each task's span lands in
+    # the initiating statement's trace tree even though it runs later
+    trace_ctx: tuple | None = None
+    # progress row in the tenant LongOps registry (set by the scheduler)
+    long_op: object = None
 
     def add_task(self, fn, name: str = "", deps: list[DagTask] | None = None) -> DagTask:
         t = DagTask(fn, name or f"task{len(self.tasks)}", list(deps or []))
@@ -75,7 +81,12 @@ class DagWarning:
 
 
 class TenantDagScheduler:
-    def __init__(self, warning_capacity: int = 512):
+    def __init__(self, warning_capacity: int = 512, tracer=None, long_ops=None):
+        # observability hooks (server/diag.Tracer + LongOps): when wired,
+        # every task runs under a "dag task" span in the queueing
+        # statement's trace, and each dag gets a __all_virtual_long_ops row
+        self.tracer = tracer
+        self.long_ops = long_ops
         self._queues: dict[DagPriority, deque[Dag]] = {
             p: deque() for p in DagPriority
         }
@@ -99,6 +110,14 @@ class TenantDagScheduler:
             dag.dag_id = next(self._ids)
             if dag.key:
                 self._keys.add(dag.key)
+            if dag.trace_ctx is None and self.tracer is not None:
+                dag.trace_ctx = self.tracer.current_ctx()
+            if self.long_ops is not None and dag.long_op is None:
+                dag.long_op = self.long_ops.start(
+                    dag.dag_type, target=str(dag.key) if dag.key else "",
+                    total=len(dag.tasks),
+                    trace_id=dag.trace_ctx[0] if dag.trace_ctx else 0,
+                )
             self._queues[dag.priority].append(dag)
             self.scheduled += 1
             self._work.notify_all()
@@ -122,6 +141,8 @@ class TenantDagScheduler:
         self._queues[dag.priority].remove(dag)
         self._keys.discard(dag.key)
         self.completed += 1
+        if self.long_ops is not None and dag.long_op is not None:
+            self.long_ops.finish(dag.long_op, ok=not dag.failed)
 
     def _run_one(self) -> bool:
         with self._lock:
@@ -134,8 +155,21 @@ class TenantDagScheduler:
                 return False
             dag, task = nxt
         try:
-            task.fn()
+            if self.tracer is not None:
+                with self.tracer.span(
+                    "dag task", ctx=dag.trace_ctx,
+                    dag_type=dag.dag_type, task=task.name, dag_id=dag.dag_id,
+                ):
+                    task.fn()
+            else:
+                task.fn()
             task.done = True
+            if self.long_ops is not None and dag.long_op is not None:
+                self.long_ops.update(
+                    dag.long_op,
+                    done=sum(1 for t in dag.tasks if t.done),
+                    message=task.name,
+                )
         except Exception as e:  # noqa: BLE001 - background task boundary
             task.error = f"{type(e).__name__}: {e}"
             with self._lock:

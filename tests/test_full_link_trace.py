@@ -1,0 +1,371 @@
+"""Full-link SHOW TRACE, per-query TPU profiling, slow-query flight recorder.
+
+Reference: ObTrace flt_trace_id propagation over obrpc, SHOW TRACE
+(sql/session/ob_sql_session_info), GV$SQL_AUDIT cost columns, obdiag
+gather. Everything here runs on the bus virtual clock — no sleeps.
+"""
+
+import json
+import re
+
+import pytest
+
+from oceanbase_tpu.log.transport import LocalBus
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.diag import (
+    AshSampler,
+    FlightRecorder,
+    LongOps,
+    SqlAudit,
+    Tracer,
+)
+from oceanbase_tpu.server.database import SqlError
+from oceanbase_tpu.share.dag_scheduler import Dag, DagPriority, TenantDagScheduler
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = Database(n_nodes=3, n_ls=2)
+    d.config.set("trace_log_slow_query_watermark", "0")  # record every stmt
+    s = d.session()
+    s.sql("set ob_enable_show_trace = 1")
+    s.sql("set ob_px_dop = 8")
+    s.sql("create table flt_src (k bigint primary key, v bigint not null)")
+    s.sql("insert into flt_src values " + ", ".join(
+        f"({i}, {i * 3})" for i in range(1, 33)
+    ))
+    s.sql("create table flt_dst (k bigint primary key, v bigint not null)")
+    # the deliberately heavyweight statement: its SELECT half fans out
+    # through PX (8 shard lanes) and its INSERT half replicates through
+    # palf — both must land in ONE trace tree
+    s.sql("insert into flt_dst select k, v from flt_src where v > 10")
+    d._flt_session = s
+    d._flt_trace_id = s._last_trace_id  # later statements move the cursor
+    return d
+
+
+# ---- tentpole: one statement, one trace, every layer ----------------------
+
+
+def test_show_trace_has_palf_and_px_spans_with_nodes(db):
+    rows = db._flt_session.sql("show trace").rows()
+    names = [r[0].strip() for r in rows]
+    assert any(n == "palf replication" for n in names)
+    assert any(n == "px worker" for n in names)
+    # node attribution: palf spans carry replica node ids, px workers
+    # carry shard lane indices
+    palf_nodes = {r[1] for r in rows if r[0].strip() == "palf replication"}
+    px_nodes = {r[1] for r in rows if r[0].strip() == "px worker"}
+    assert palf_nodes and all(n != "" for n in palf_nodes)
+    assert px_nodes == {str(i) for i in range(8)}
+    # it is ONE tree: everything except the root is indented under it
+    assert not rows[0][0].startswith(" ")
+    assert all(r[0].startswith(" ") for r in rows[1:])
+
+
+def test_trace_spans_share_statement_trace_id(db):
+    tid = db._flt_trace_id
+    assert tid != 0
+    spans = [s for s in db.tracer.spans() if s.trace_id == tid]
+    kinds = {s.name for s in spans}
+    assert "palf replication" in kinds and "px worker" in kinds
+    assert "palf append" in kinds  # follower-side, via bus envelope ctx
+    # follower appends ran on OTHER nodes than the leader's replication span
+    rep = [s for s in spans if s.name == "palf replication"]
+    app = [s for s in spans if s.name == "palf append"]
+    assert {a.tags["node"] for a in app} != {r.tags["node"] for r in rep}
+
+
+def test_audit_profiler_columns_nonzero(db):
+    s = db._flt_session
+    rec = next(
+        r for r in reversed(db.audit.records())
+        if r.sql.startswith("insert into flt_dst select")
+    )
+    assert rec.compile_s > 0
+    assert rec.device_bytes > 0
+    assert rec.transfer_bytes > 0
+    assert rec.peak_bytes >= rec.device_bytes
+    # same columns through the virtual table
+    rows = s.sql(
+        "select query_sql, compile_time_us, device_bytes, transfer_bytes,"
+        " peak_bytes from __all_virtual_sql_audit"
+    ).rows()
+    vt = next(r for r in rows if str(r[0]).startswith("insert into flt_dst"))
+    assert int(vt[1]) > 0 and int(vt[2]) > 0 and int(vt[3]) > 0
+
+
+def test_plan_monitor_accumulates_profile(db):
+    # monitor entries key on the normalized plan, so match the insert's
+    # "$ins:<table>:" normalization prefix
+    es = [e for e in db.plan_monitor.entries()
+          if e.sql.startswith("$ins:flt_dst:")]
+    assert es
+    assert es[-1].total_transfer_bytes > 0
+    assert es[-1].last_device_bytes > 0
+    assert es[-1].peak_bytes > 0
+
+
+def test_flight_recorder_bundle_and_obdiag_dump(db, tmp_path):
+    bundles = db.flight.records()
+    assert bundles
+    b = next(
+        b for b in reversed(bundles)
+        if b["sql"].startswith("insert into flt_dst select")
+    )
+    assert b["trace_id"] == db._flt_trace_id
+    assert {s["name"] for s in b["spans"]} >= {"palf replication", "px worker"}
+    assert b["profile"]["transfer_bytes"] > 0
+    assert "trace_log_slow_query_watermark" in b["config"]
+    assert "plan" in b and b["plan"]
+    # metrics delta only contains counters that moved since the last bundle
+    assert all(v > 0 for v in b["metrics_delta"].values())
+
+    from tools.obdiag_dump import dump
+
+    out = tmp_path / "bundle.json"
+    dumped = dump(db, str(out))
+    on_disk = json.loads(out.read_text())
+    assert len(on_disk["flight_recorder"]) == len(dumped["flight_recorder"])
+    assert on_disk["sysstat"]["counters"]
+    assert on_disk["trace_spans"]
+    assert on_disk["config"]["trace_log_slow_query_watermark"] == 0.0
+
+
+def test_show_trace_requires_session_flag(db):
+    s = db.session()  # fresh session: flag defaults off
+    with pytest.raises(SqlError):
+        s.sql("show trace")
+
+
+def test_set_unknown_session_var_rejected(db):
+    s = db.session()
+    with pytest.raises(SqlError):
+        s.sql("set ob_no_such_var = 1")
+
+
+def test_px_watermark_zero_not_required(db):
+    # watermark gating: a high watermark records nothing new
+    db.config.set("trace_log_slow_query_watermark", "3600")
+    n0 = len(db.flight.records())
+    db._flt_session.sql("select count(*) as n from flt_src")
+    assert len(db.flight.records()) == n0
+    db.config.set("trace_log_slow_query_watermark", "0")
+
+
+# ---- long ops VT ----------------------------------------------------------
+
+
+def test_long_ops_virtual_table_tracks_dag_progress(db):
+    done = []
+    d = Dag("MINI_MERGE", DagPriority.MINI_MERGE, key=(99, "flt"))
+    d.add_task(lambda: done.append(1))
+    d.add_task(lambda: done.append(2))
+    db.dag_scheduler.add_dag(d)
+    db.dag_scheduler.run_until_idle()
+    rows = db._flt_session.sql(
+        "select op_name, total, done, percent, status, trace_id"
+        " from __all_virtual_long_ops"
+    ).rows()
+    row = next(r for r in rows if r[0] == "MINI_MERGE")
+    assert (int(row[1]), int(row[2]), int(row[3])) == (2, 2, 100)
+    assert row[4] == "DONE"
+
+
+# ---- satellite: tracer correlation across the enabled flag ----------------
+
+
+def test_disabled_tracer_still_correlates_nested_spans():
+    tr = Tracer()
+    tr.enabled = False
+    with tr.span("outer") as outer:
+        assert tr.current_trace_id() == outer.trace_id
+        assert tr.current_ctx() == (outer.trace_id, outer.span_id)
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tr.spans() == []  # ring write is what the flag gates
+    tr.enabled = True
+    with tr.span("recorded"):
+        pass
+    assert [s.name for s in tr.spans()] == ["recorded"]
+
+
+def test_record_span_stitches_remote_work():
+    tr = Tracer()
+    with tr.span("stmt") as root:
+        ctx = tr.current_ctx()
+    s = tr.record_span("palf replication", ctx, 1.0, 3.5, node=2)
+    assert s.trace_id == root.trace_id and s.parent_id == root.span_id
+    assert s.elapsed == 2.5
+    tree = tr.trace_tree(root.trace_id)
+    assert [(d, sp.name) for d, sp in tree] == [
+        (0, "stmt"), (1, "palf replication"),
+    ]
+    # disabled tracer records nothing and returns None
+    tr.enabled = False
+    assert tr.record_span("x", ctx, 0.0, 1.0) is None
+
+
+# ---- satellite-adjacent: bus / dag propagation units ----------------------
+
+
+def test_bus_envelope_carries_and_redelivers_trace_ctx():
+    tr = Tracer()
+    bus = LocalBus(tracer=tr)
+    seen = []
+
+    def follower(src, msg):
+        seen.append(bus.delivery_ctx())
+        bus.send(2, 1, "ack")  # reply sent INSIDE delivery inherits ctx
+
+    acks = []
+    bus.register(2, follower)
+    bus.register(1, lambda src, msg: acks.append(bus.delivery_ctx()))
+    with tr.span("stmt") as root:
+        bus.send(1, 2, "append")
+        expected = (root.trace_id, root.span_id)
+    bus.advance(0.01)  # deliver append (outside the span — ctx travelled)
+    bus.advance(0.01)  # deliver ack
+    assert seen == [expected]
+    assert acks == [expected]  # two hops, same originating ctx
+
+
+def test_dag_tasks_span_under_statement_ctx_and_update_long_ops():
+    tr = Tracer()
+    lo = LongOps()
+    sched = TenantDagScheduler(tracer=tr, long_ops=lo)
+    with tr.span("stmt") as root:
+        d = Dag("COMPACT", DagPriority.MINI_MERGE)
+        d.add_task(lambda: None, name="step_a")
+        d.add_task(lambda: None, name="step_b")
+        sched.add_dag(d)
+    sched.run_until_idle()  # runs OUTSIDE the statement span
+    task_spans = [s for s in tr.spans() if s.name == "dag task"]
+    assert len(task_spans) == 2
+    assert all(s.trace_id == root.trace_id for s in task_spans)
+    ops = lo.ops()
+    assert len(ops) == 1
+    op = ops[0]
+    assert (op.done, op.total, op.status) == (2, 2, "DONE")
+    assert op.trace_id == root.trace_id
+    assert op.percent == 100.0
+
+
+# ---- satellite: injectable clocks -----------------------------------------
+
+
+def test_sql_audit_injectable_clock():
+    t = [100.0]
+    a = SqlAudit(capacity=8, clock=lambda: t[0])
+    a.record(session_id=1, trace_id=0, sql="s1", stmt_type="Select",
+             elapsed_s=0.0, rows=0, affected=0, plan_cache_hit=False,
+             error="")
+    t[0] = 107.0
+    a.record(session_id=1, trace_id=0, sql="s2", stmt_type="Select",
+             elapsed_s=0.0, rows=0, affected=0, plan_cache_hit=False,
+             error="")
+    ts = [r.ts for r in a.records()]
+    assert ts == [100.0, 107.0]
+
+
+def test_ash_sampler_injectable_clock():
+    t = [50.0]
+    ash = AshSampler(capacity=16, clock=lambda: t[0])
+    with ash.activity(7, "executing", sql="select 1", trace_id=3):
+        assert ash.sample_once() == 1
+        t[0] = 55.0
+        assert ash.sample_once() == 1
+    assert ash.sample_once() == 0  # guard exited: nothing active
+    assert [s.ts for s in ash.samples()] == [50.0, 55.0]
+    assert all(s.session_id == 7 and s.trace_id == 3 for s in ash.samples())
+
+
+# ---- satellite: flight recorder unit behaviour ----------------------------
+
+
+def test_flight_recorder_ring_and_metrics_delta():
+    fr = FlightRecorder(capacity=2, watermark_s=1.0)
+    assert not fr.should_record(0.5)
+    assert fr.should_record(1.5)
+    fr.record({"sql": "a"}, counters={"x": 1})
+    fr.record({"sql": "b"}, counters={"x": 4, "y": 2})
+    assert [b["sql"] for b in fr.records()] == ["a", "b"]
+    assert fr.records()[1]["metrics_delta"] == {"x": 3, "y": 2}
+    fr.record({"sql": "c"}, counters={"x": 4, "y": 2})
+    # bounded ring: oldest evicted; unchanged counters -> empty delta
+    assert [b["sql"] for b in fr.records()] == ["b", "c"]
+    assert fr.records()[1]["metrics_delta"] == {}
+    fr.enabled = False
+    assert not fr.should_record(99.0)
+
+
+# ---- satellite: Prometheus exposition format ------------------------------
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?"
+    r" (?P<value>[0-9.eE+\-]+|NaN|[+\-]Inf)$"
+)
+
+
+def _exposition_lines(db):
+    db._flt_session.sql("select count(*) as n from flt_src")
+    text = db.metrics_text()
+    assert text.endswith("\n")
+    return text.splitlines()
+
+
+def test_metrics_text_is_valid_exposition_format(db):
+    lines = _exposition_lines(db)
+    assert lines
+    seen_samples = set()
+    typed: dict[str, str] = {}
+    for ln in lines:
+        if not ln:
+            continue
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            parts = ln.split(" ", 3)
+            assert len(parts) == 4, ln
+            assert _NAME_RE.match(parts[2]), ln
+            if parts[1] == "TYPE":
+                assert parts[3] in (
+                    "counter", "gauge", "summary", "histogram", "untyped"
+                ), ln
+                typed[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        key = (m.group("name"), m.group("labels"))
+        assert key not in seen_samples, f"duplicate sample: {ln!r}"
+        seen_samples.add(key)
+        float(m.group("value"))  # must parse
+    assert typed, "no TYPE lines emitted"
+    # counters follow the _total convention
+    for name, kind in typed.items():
+        if kind == "counter":
+            assert name.endswith("_total"), name
+
+
+def test_metrics_text_histogram_buckets_monotone(db):
+    lines = _exposition_lines(db)
+    buckets: dict[str, list[tuple[float, float]]] = {}
+    for ln in lines:
+        m = _SAMPLE_RE.match(ln)
+        if not m or not m.group("labels") or "_bucket" not in m.group("name"):
+            continue
+        lm = re.search(r'le="([^"]+)"', m.group("labels"))
+        if not lm:
+            continue
+        le = float("inf") if lm.group(1) == "+Inf" else float(lm.group(1))
+        buckets.setdefault(m.group("name"), []).append(
+            (le, float(m.group("value")))
+        )
+    assert buckets, "no histogram buckets in exposition output"
+    for name, bs in buckets.items():
+        bs.sort(key=lambda p: p[0])
+        assert bs[-1][0] == float("inf"), f"{name} missing +Inf bucket"
+        counts = [c for _, c in bs]
+        assert counts == sorted(counts), f"{name} buckets not cumulative"

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""obdiag analog: collect one JSON support bundle from a live Database.
+
+OceanBase ships `obdiag gather` to pull sql_audit, system stats, trace
+logs and slow-query evidence off a cluster into a single archive a
+support engineer can read offline. This tool is the in-process analog:
+given a Database it collects
+
+  - every flight-recorder bundle (slow statements over the
+    trace_log_slow_query_watermark, with span tree / plan / profile /
+    metrics delta / config already attached),
+  - the sysstat counters and gauges,
+  - the system_event wait classes,
+  - the trace-span ring,
+  - the active config snapshot,
+
+and writes them as one JSON document.
+
+    JAX_PLATFORMS=cpu python tools/obdiag_dump.py [out.json]
+
+Standalone invocation spins up a demo Database, runs a deliberately
+slow statement mix and dumps the evidence — mostly useful as a smoke
+test. The real entry point is `dump(db, path)`, importable from tests
+or an operator shell next to an already-running instance.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def collect(db) -> dict:
+    """Assemble the support bundle for one tenant Database."""
+    waits = sorted(db.metrics.waits_snapshot(), key=lambda w: w.event)
+    spans = db.tracer.spans()
+    return {
+        "flight_recorder": db.flight.records(),
+        "sysstat": {
+            "counters": dict(sorted(db.metrics.counters_snapshot().items())),
+            "gauges": dict(sorted(db.metrics.gauges_snapshot().items())),
+        },
+        "system_event": [
+            {
+                "event": w.event,
+                "total_waits": w.count,
+                "total_wait_s": w.total_s,
+                "max_wait_s": w.max_s,
+            }
+            for w in waits
+        ],
+        "trace_spans": [
+            {
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "name": s.name,
+                "elapsed_us": int(s.elapsed * 1e6),
+                "tags": {k: repr(v) for k, v in sorted(s.tags.items())},
+            }
+            for s in spans
+        ],
+        "config": {n: v for n, v, _p in db.config.snapshot()},
+        "long_ops": [
+            {
+                "op_id": o.op_id,
+                "name": o.name,
+                "target": o.target,
+                "done": o.done,
+                "total": o.total,
+                "status": o.status,
+            }
+            for o in db.long_ops.ops()
+        ],
+    }
+
+
+def dump(db, path: str) -> dict:
+    """Collect the bundle and write it to `path` as JSON. Returns it."""
+    bundle = collect(db)
+    with open(path, "w") as f:
+        json.dump(bundle, f, indent=2, default=repr)
+    return bundle
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "obdiag_bundle.json"
+
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=3, n_ls=2)
+    db.config.set("trace_log_slow_query_watermark", "0")
+    s = db.session()
+    s.sql("set ob_enable_show_trace = 1")
+    s.sql("create table diag_t (k bigint primary key, v bigint not null)")
+    s.sql("insert into diag_t values " + ", ".join(
+        f"({i}, {i * 7})" for i in range(1, 33)
+    ))
+    s.sql("select count(*) as n, sum(v) as sv from diag_t")
+    bundle = dump(db, out)
+    print(json.dumps({
+        "out": out,
+        "flight_bundles": len(bundle["flight_recorder"]),
+        "trace_spans": len(bundle["trace_spans"]),
+        "counters": len(bundle["sysstat"]["counters"]),
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,10 +4,11 @@
 The metrics/trace/audit fabric rides the host-side statement path, so
 its cost must stay a small fraction of statement latency. This driver
 runs a fixed statement mix (point select on a warm plan-cache entry,
-a small aggregate, an autocommit UPDATE) twice through the SAME
-Database — once with every recorder enabled, once with the registry,
-tracer, audit ring and plan monitor all disabled — and reports the
-per-statement medians and the overhead percentage.
+a small aggregate, an autocommit UPDATE) three times through the SAME
+Database — everything off, only the per-query resource profiler on,
+and every recorder enabled — and reports the per-statement medians
+plus the overhead percentage of each instrumented pass over the
+all-off baseline.
 
     JAX_PLATFORMS=cpu python tools/obs_overhead_bench.py [iters]
 
@@ -37,6 +38,11 @@ def set_observability(db, on: bool) -> None:
     db.tracer.enabled = on
     db.audit.enabled = on
     db.plan_monitor.enabled = on
+    set_profiler(db, on)
+
+
+def set_profiler(db, on: bool) -> None:
+    db.config.set("enable_query_profile", "true" if on else "false")
 
 
 def timed_pass(session, iters: int) -> dict:
@@ -66,18 +72,26 @@ def main():
 
     set_observability(db, False)
     off = timed_pass(s, iters)
-    set_observability(db, True)
+    set_profiler(db, True)          # profiler only, recorders still off
+    prof = timed_pass(s, iters)
+    set_observability(db, True)     # everything on
     on = timed_pass(s, iters)
 
     report = {"iters": iters, "statements": {}}
     for stmt in STATEMENTS:
-        overhead = (on[stmt] - off[stmt]) / off[stmt] * 100.0
         report["statements"][stmt] = {
             "off_median_us": round(off[stmt] * 1e6, 1),
+            "profiler_median_us": round(prof[stmt] * 1e6, 1),
             "on_median_us": round(on[stmt] * 1e6, 1),
-            "overhead_pct": round(overhead, 2),
+            "profiler_overhead_pct": round(
+                (prof[stmt] - off[stmt]) / off[stmt] * 100.0, 2),
+            "overhead_pct": round(
+                (on[stmt] - off[stmt]) / off[stmt] * 100.0, 2),
         }
-    tot_on, tot_off = sum(on.values()), sum(off.values())
+    tot_on, tot_prof, tot_off = sum(on.values()), sum(prof.values()), sum(off.values())
+    report["profiler_overhead_pct"] = round(
+        (tot_prof - tot_off) / tot_off * 100.0, 2
+    )
     report["total_overhead_pct"] = round(
         (tot_on - tot_off) / tot_off * 100.0, 2
     )

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 test gate: the exact invocation from ROADMAP.md, wrapped so CI
+# and humans run the same thing. Forces the CPU backend (the suite uses
+# 8 virtual devices via conftest.py), skips slow-marked tests, and
+# bounds the whole run with a timeout so a hung test can't wedge CI.
+#
+#   tools/run_tier1.sh [extra pytest args...]
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
