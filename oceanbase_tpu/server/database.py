@@ -36,6 +36,7 @@ from ..core.table import Table
 from ..engine.session import ResultSet, Session
 from ..rootserver import RootService
 from ..share import Config, LocationService
+from ..share import retry as _R
 from ..share.schema_service import SchemaError
 from ..sql import ast as A
 from ..sql import parser as P
@@ -52,6 +53,13 @@ class SqlError(Exception):
     def __init__(self, msg: str, code: int = 1064):
         super().__init__(msg)
         self.code = code
+
+
+class WorkerQueueTimeout(SqlError):
+    """The statement never got a tenant worker inside its wait bound
+    (ObThWorker queue overflow analog). A distinct class so the retry
+    taxonomy and chaos harness can tell admission pressure from SQL
+    errors; still a SqlError for wire/compat purposes."""
 
 
 @dataclass
@@ -541,6 +549,9 @@ class Database:
         # session routes with ob_px_dop — mesh construction touches every
         # device, so tenants that never use PX never pay for it
         self._px_executor_obj = None
+        # PX admission quota (built lazily with the executor): bounds the
+        # cluster-wide worker grant before a PX statement may run
+        self._px_admission_obj = None
         self._ddl_lock = threading.RLock()
         # re-materialize restored mviews against the recovered base data
         # (failures keep the registration: REFRESH can retry once the
@@ -799,6 +810,23 @@ class Database:
                 metrics=self.metrics,
             )
         return self._px_executor_obj
+
+    def _px_admission(self):
+        """Cluster-wide DOP quota (ObPxAdmission / ObPxTargetMgr): every PX
+        statement acquires its worker grant here before executing, so a
+        burst queues instead of oversubscribing the mesh. Sized from the
+        parallel_servers_target config parameter (live-updatable)."""
+        if self._px_admission_obj is None:
+            from ..parallel.px import PxAdmission
+
+            self._px_admission_obj = PxAdmission(
+                target=self.config["parallel_servers_target"]
+            )
+            self.config.on_change(
+                "parallel_servers_target",
+                lambda _n, _o, v: setattr(self._px_admission_obj, "target", v),
+            )
+        return self._px_admission_obj
 
     def _key_extra(self, table_names: tuple[str, ...]) -> tuple:
         """Plan-cache key material: schema + dictionary versions of the
@@ -1299,16 +1327,45 @@ class Database:
             self._save_node_meta()
 
     # ---------------------------------------------------------- snapshots
+    #: bound on per-call location refreshes before the stale entry is
+    #: surfaced to the statement retry layer as a classified error
+    _LOCATION_RETRY_LIMIT = 8
+
     def _leader_replica_ls(self, ls_id: int):
-        """Route through the location cache; one retry on a stale entry
-        (the NOT_MASTER feedback loop of the reference's DAS routing)."""
-        node = self.location.leader(ls_id)
-        rep = self.cluster.ls_groups[ls_id][node]
-        if not rep.is_ready:
+        """Route through the location cache; stale entries retry under the
+        STALE_LOCATION policy — bounded, backed off on the virtual clock so
+        an in-flight election can settle between probes (the NOT_MASTER
+        feedback loop of the reference's DAS routing). Exhausting the bound
+        raises StaleLocation, which the statement retry controller treats
+        as retryable-after-refresh."""
+        from ..share.interrupt import checkpoint
+
+        policy = _R.STALE_LOCATION
+        attempt = 0
+        while True:
+            try:
+                node = self.location.leader(ls_id)
+            except RuntimeError:
+                # the resolver itself found no ready leader (election still
+                # in flight): same retry treatment as a stale cache entry
+                rep = None
+            else:
+                rep = self.cluster.ls_groups[ls_id][node]
+            if rep is not None and rep.is_ready:
+                return rep
+            attempt += 1
+            if attempt > self._LOCATION_RETRY_LIMIT:
+                self.metrics.add("location retries exhausted")
+                raise _R.StaleLocation(
+                    f"ls {ls_id}: no ready leader after "
+                    f"{self._LOCATION_RETRY_LIMIT} location refreshes"
+                )
+            self.metrics.add("location cache refreshes")
             self.location.invalidate(ls_id)
-            node = self.location.leader(ls_id)
-            rep = self.cluster.ls_groups[ls_id][node]
-        return rep
+            checkpoint()  # deadline / KILL QUERY unwind between probes
+            wait = min(policy.base_wait * attempt, policy.max_wait)
+            with self.metrics.waiting("location cache refresh"):
+                self.cluster.settle(wait)
 
     def _leader_replica(self, ti: TableInfo):
         return self._leader_replica_ls(ti.ls_id)
@@ -1488,8 +1545,12 @@ _XA_PREPARING = object()
 class _OpenTx:
     """Client-side state of an open transaction."""
 
-    def __init__(self, db: Database):
+    def __init__(self, db: Database, deadline: "_R.Deadline | None" = None):
         self.db = db
+        # ob_trx_timeout deadline, fixed at BEGIN on the virtual clock:
+        # every statement of the tx runs under min(its own query deadline,
+        # this) — an expired tx surfaces TrxTimeout at the next checkpoint
+        self.deadline = deadline
         # home the tx where leadership currently lives (location cache):
         # after a failover/demotion new txs follow the leaders instead of
         # dragging leadership back to a fixed node
@@ -1514,7 +1575,13 @@ class _OpenTx:
         rep = self.svc.replicas[ls_id]
         if rep.is_ready:
             return
-        self.db.cluster.transfer_leader(ls_id, self.svc.node_id)
+        try:
+            self.db.cluster.transfer_leader(ls_id, self.svc.node_id)
+        except TimeoutError as e:
+            # the drag failed (home node dead/partitioned, or no leader to
+            # hand off yet): OB_NOT_MASTER — the statement retry layer
+            # re-homes the tx after a location refresh
+            raise NotMaster(f"ls {ls_id}: {e}") from e
         if not self.db.cluster.drive_until(lambda: rep.is_ready):
             raise NotMaster(f"ls {ls_id} leadership did not settle")
         self.db.location.invalidate(ls_id)
@@ -1531,10 +1598,17 @@ class DbSession:
         self._last_stmt_type = ""
         self._stmt_cache_hit = False
         # session variables (SET <name> = <value>): full-link trace
-        # collection flag + PX degree-of-parallelism routing
+        # collection flag, PX degree-of-parallelism routing, and the
+        # statement/transaction deadlines in MICROSECONDS of virtual time
+        # (the reference's ob_query_timeout / ob_trx_timeout units).
+        # Defaults are wider than the reference's 10s/100s because test
+        # drives legitimately burn tens of virtual seconds (commit waits
+        # and elections cap at 30s each)
         self._vars: dict[str, int] = {
             "ob_enable_show_trace": 0,
             "ob_px_dop": 0,
+            "ob_query_timeout": 100_000_000,
+            "ob_trx_timeout": 500_000_000,
         }
         # trace_id of the last traced NON-meta statement — what SHOW TRACE
         # renders (meta statements: SHOW/SET themselves, so the flag and
@@ -1552,16 +1626,36 @@ class DbSession:
         err, rs = "", None
         self._last_stmt_type = ""  # "": did not parse
         self._stmt_cache_hit = False  # set by any inner _select
+        # statement deadline: min(ob_query_timeout from now, the open tx's
+        # ob_trx_timeout deadline) on the bus virtual clock — one Deadline
+        # object bounds the worker wait, PX admission, DAS routing retries,
+        # commit waits and every engine checkpoint below
+        clock = lambda: db.cluster.bus.now  # noqa: E731
+        deadline = _R.Deadline.earliest(
+            _R.Deadline.after(
+                clock, self._vars["ob_query_timeout"] / 1e6,
+                label="ob_query_timeout",
+            ),
+            self._tx.deadline if self._tx is not None else None,
+        )
         # tenant worker quota (ObThWorker queue analog): bound concurrent
-        # statements; waiting beyond the queue timeout fails the statement
+        # statements; waiting beyond the queue timeout (or the statement
+        # deadline, when that is nearer) fails the statement
         sem = db._worker_sem
         if sem is not None:
+            wait_s = db.unit.queue_timeout_s
+            bounded = deadline is not None and deadline.tighter_than(wait_s)
+            if bounded:
+                wait_s = max(deadline.remaining(), 0.0)
             tq = _time.perf_counter()
-            ok = sem.acquire(timeout=db.unit.queue_timeout_s)
+            ok = sem.acquire(timeout=wait_s)
             db.metrics.wait("tenant worker queue", _time.perf_counter() - tq)
             if not ok:
                 db.metrics.add("worker queue timeouts")
-                raise SqlError(
+                if bounded:
+                    db.metrics.add("statement timeouts")
+                    raise deadline._error()
+                raise WorkerQueueTimeout(
                     f"tenant {db.tenant_name}: worker queue timeout "
                     f"({db.unit.max_workers} workers busy)"
                 )
@@ -1573,7 +1667,8 @@ class DbSession:
         db._active_stmts[self.session_id] = iid
         prev = _I.set_current(checker)
         try:
-            return self._sql_inner(text, t0)
+            with _R.deadline_scope(deadline):
+                return self._sql_inner(text, t0)
         finally:
             _I.set_current(prev)
             db._active_stmts.pop(self.session_id, None)
@@ -1589,13 +1684,19 @@ class DbSession:
         # last_profile is per-run_ast; statements that never reach run_ast
         # (pure DDL, SHOW) must not inherit the previous statement's
         db.engine.last_profile = None
+        # retry bookkeeping spans attempts but the statement keeps ONE
+        # span tree, ASH activity and audit record — retries are an
+        # internal redrive, not new statements
+        ctrl = _R.RetryController(deadline=_R.current_deadline())
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
                 try:
-                    rs = self._dispatch(text)
+                    rs = self._run_with_retries(text, ctrl)
                 except Exception as e:
                     err = f"{type(e).__name__}: {e}"
+                    if isinstance(e, _R.StatementTimeout):
+                        db.metrics.add("statement timeouts")
                     raise
                 finally:
                     elapsed_s = _time.perf_counter() - t0
@@ -1626,6 +1727,8 @@ class DbSession:
                         device_bytes=pd.get("device_bytes", 0),
                         transfer_bytes=pd.get("transfer_bytes", 0),
                         peak_bytes=pd.get("peak_bytes", 0),
+                        retry_cnt=ctrl.retry_cnt,
+                        retry_info=ctrl.retry_info,
                     )
                     if stype not in ("Show", "SetVar", ""):
                         if self._vars.get("ob_enable_show_trace"):
@@ -1633,6 +1736,66 @@ class DbSession:
                         self._maybe_flight_record(
                             text, sp, elapsed_s, rs, err, prof)
         return rs
+
+    def _stmt_retryable(self) -> bool:
+        """Whole-statement redrive is safe only when nothing of the failed
+        attempt outlives it: reads always (the snapshot re-resolves);
+        DML only in autocommit, where _dml aborted the auto-tx with the
+        failure — a DML inside an explicit transaction keeps its partial
+        stages and must surface the error to the client instead."""
+        st = self._last_stmt_type
+        if st in ("Select", "SetSelect"):
+            return True
+        if st in ("Insert", "Update", "Delete"):
+            return self._tx is None
+        return False
+
+    def _run_with_retries(self, text: str, ctrl: "_R.RetryController"):
+        """ObQueryRetryCtrl's loop: classify each failure, re-resolve
+        locations/routing, back off on the bus virtual clock (driving the
+        cluster so elections settle during the wait), and redrive until
+        success, a non-retryable error, or the statement deadline — which
+        surfaces as a timeout chaining the last transient, never as a raw
+        NotMaster/InjectedError."""
+        db = self.db
+        schema_v = db.schema_service.version
+        while True:
+            try:
+                return self._dispatch(text)
+            except Exception as e:
+                policy = ctrl.decide(e, stmt_retryable=self._stmt_retryable())
+                if policy is None:
+                    # a DDL racing this statement invalidated any cached
+                    # plan it compiled against: reclassify once per version
+                    # move as OB_SCHEMA_EAGAIN and redrive fresh
+                    cur_v = db.schema_service.version
+                    if (cur_v != schema_v and self._stmt_retryable()
+                            and not isinstance(e, _R.StatementTimeout)):
+                        schema_v = cur_v
+                        policy = ctrl.decide(
+                            _R.SchemaVersionMismatch(
+                                f"schema version moved under the statement "
+                                f"({type(e).__name__}: {e})"),
+                            stmt_retryable=True,
+                        )
+                    if policy is None:
+                        raise
+                d = ctrl.deadline
+                if d is not None and d.expired:
+                    raise ctrl.timeout_error(e) from e
+                wait = ctrl.record(policy, e)
+                m = db.metrics
+                m.add("statement retries")
+                m.add(f"statement retries: {policy.reason}")
+                if policy.flush_plan_cache:
+                    db.plan_cache.flush()
+                if policy.refresh_location:
+                    db.location.clear()
+                if wait > 0:
+                    with m.waiting("statement retry backoff"):
+                        db.cluster.settle(wait)
+                if d is not None and d.expired:
+                    raise ctrl.timeout_error(e) from e
 
     def _maybe_flight_record(self, text, sp, elapsed_s, rs, err,
                              prof) -> None:
@@ -1981,7 +2144,7 @@ class DbSession:
         if isinstance(stmt, A.Begin):
             if self._tx is not None:
                 raise SqlError("transaction already open")
-            self._tx = _OpenTx(self.db)
+            self._tx = _OpenTx(self.db, deadline=self._new_trx_deadline())
             return ResultSet((), {})
         if isinstance(stmt, A.Commit):
             self._end_tx(commit=True)
@@ -2129,7 +2292,7 @@ class DbSession:
         if verb in ("start", "begin"):
             if self._tx is not None:
                 raise SqlError("transaction already open", code=1399)
-            self._tx = _OpenTx(self.db)
+            self._tx = _OpenTx(self.db, deadline=self._new_trx_deadline())
             self._xa_id = xid
             return ResultSet((), {})
         if verb == "end":
@@ -2710,7 +2873,11 @@ class DbSession:
         # the PX executor bypasses its shared input cache for tx-private
         # views (is_private), mirroring the single-chip isolation contract.
         px = None
+        px_granted = 0
         if self._vars.get("ob_px_dop", 0) > 0 and not any_vt:
+            # admission first (ObPxAdmission): hold a worker grant for the
+            # whole distributed execution, released in the finally below
+            px_granted = self._px_admit(self._vars["ob_px_dop"])
             px = self.db._px_executor()
         try:
             with self.db.catalog.tx_scope(views):
@@ -2736,6 +2903,8 @@ class DbSession:
             self._stmt_cache_hit = rs.plan_cache_hit
             return rs
         finally:
+            if px_granted:
+                self.db._px_admission().release(px_granted)
             if any_vt:
                 # virtual snapshots are per-statement: release them so they
                 # neither pin memory nor appear as tables afterwards
@@ -2746,11 +2915,46 @@ class DbSession:
                         self.db.catalog.pop(n, None)
                         self.db._invalidate(n)
 
+    def _new_trx_deadline(self) -> "_R.Deadline":
+        """ob_trx_timeout deadline for a transaction opened now (BEGIN,
+        XA START, or an autocommit DML's implicit tx)."""
+        db = self.db
+        return _R.Deadline.after(
+            lambda: db.cluster.bus.now,
+            self._vars["ob_trx_timeout"] / 1e6,
+            label="ob_trx_timeout",
+        )
+
+    def _px_admit(self, dop: int) -> int:
+        """Deadline-bounded PX admission: queue for a worker grant no
+        longer than the statement deadline allows. An admission timeout is
+        retryable (quota frees as peers finish) unless the deadline was
+        the tighter bound, which surfaces as the statement's timeout."""
+        adm = self.db._px_admission()
+        wait_s = adm.queue_timeout_s
+        d = _R.current_deadline()
+        bounded = d is not None and d.tighter_than(wait_s)
+        if bounded:
+            wait_s = max(d.remaining(), 0.0)
+        try:
+            with self.db.metrics.waiting("px admission queue"):
+                return adm.acquire(dop, timeout=wait_s)
+        except RuntimeError as e:
+            self.db.metrics.add("px admission timeouts")
+            if bounded:
+                self.db.metrics.add("statement timeouts")
+                raise d._error() from e
+            raise _R.PxAdmissionTimeout(str(e)) from e
+
     # --------------------------------------------------------------- tx
     def _dml(self, body) -> ResultSet:
+        # an expired deadline (ob_trx_timeout on an idle explicit tx) must
+        # refuse new work up front — the session can still ROLLBACK, which
+        # doesn't come through here
+        _R.checkpoint_deadline()
         auto = self._tx is None
         if auto:
-            self._tx = _OpenTx(self.db)
+            self._tx = _OpenTx(self.db, deadline=self._new_trx_deadline())
         try:
             affected = body(self._tx)
         except Exception:
@@ -2784,8 +2988,23 @@ class DbSession:
             if commit:
                 try:
                     if touched:
+                        # bound the palf commit wait by the statement
+                        # deadline; an expired wait means the decision is
+                        # in flight but unobserved -> CommitUnknown (the
+                        # reference's OB_TRANS_UNKNOWN), never retried
+                        max_wait = 30.0
+                        d = _R.current_deadline()
+                        if d is not None:
+                            d.check()  # unwind before staging the decision
+                            max_wait = min(max_wait, d.remaining())
                         with m.waiting("tx commit log sync"):
-                            self.db.cluster.commit_sync(tx.svc, tx.ctx)
+                            try:
+                                self.db.cluster.commit_sync(
+                                    tx.svc, tx.ctx, max_time=max_wait)
+                            except TimeoutError as te:
+                                raise _R.CommitUnknown(
+                                    f"commit wait timed out: {te}"
+                                ) from te
                     else:
                         tx.svc.commit(tx.ctx)  # empty tx: finishes immediately
                 except Exception:

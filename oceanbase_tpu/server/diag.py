@@ -202,6 +202,10 @@ class AuditRecord:
     device_bytes: int = 0
     transfer_bytes: int = 0
     peak_bytes: int = 0
+    # statement retry controller (ObQueryRetryCtrl): how many times the
+    # statement was transparently redriven and why ("reason xN; ...")
+    retry_cnt: int = 0
+    retry_info: str = ""
 
 
 class SqlAudit:

@@ -79,6 +79,9 @@ def _sql_audit(db) -> Table:
         ("transfer_bytes", DataType.int64(),
          [r.transfer_bytes for r in recs]),
         ("peak_bytes", DataType.int64(), [r.peak_bytes for r in recs]),
+        # statement retry controller: redrive count + classified reasons
+        ("retry_cnt", DataType.int64(), [r.retry_cnt for r in recs]),
+        ("retry_info", DataType.varchar(), [r.retry_info for r in recs]),
     ])
 
 

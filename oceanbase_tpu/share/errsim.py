@@ -34,11 +34,23 @@ class _Arm:
     fired: int = 0
 
 
+#: default seed for probabilistic arms; reseed() replays a chaos schedule
+DEFAULT_SEED = 0xE5
+
+
 class ErrsimRegistry:
-    def __init__(self):
+    def __init__(self, seed: int = DEFAULT_SEED):
         self._arms: dict[str, _Arm] = {}
         self._lock = threading.Lock()
-        self._rng = random.Random(0xE5)
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    def reseed(self, seed: int) -> None:
+        """Reset the probabilistic-arm RNG so a logged chaos seed replays
+        the exact same firing sequence."""
+        with self._lock:
+            self.seed = seed
+            self._rng = random.Random(seed)
 
     def arm(self, name: str, error: Exception | None = None,
             prob: float = 1.0, count: int = -1) -> None:

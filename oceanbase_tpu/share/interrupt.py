@@ -21,6 +21,8 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from oceanbase_tpu.share.retry import checkpoint_deadline
+
 
 class QueryInterrupted(Exception):
     """Raised at a statement checkpoint after an interrupt arrived."""
@@ -117,11 +119,13 @@ def current_checker() -> InterruptChecker | None:
 
 def checkpoint() -> None:
     """Host-side interrupt checkpoint: raises QueryInterrupted if the
-    current statement was killed. Engines call this between device
-    programs (chunks, retries, staging batches)."""
+    current statement was killed, or a StatementTimeout if its deadline
+    (SET ob_query_timeout / ob_trx_timeout) expired. Engines call this
+    between device programs (chunks, retries, staging batches)."""
     c = current_checker()
     if c is not None:
         c.check()
+    checkpoint_deadline()
 
 
 # address space for interrupt managers on the LocalBus (disjoint from

@@ -171,7 +171,16 @@ class TransService:
             # must find the ctx in COMMITTING to finish it
             ctx.commit_version = rec.commit_version
             ctx.state = TxState.COMMITTING
-            if self.replicas[ls].submit_record(rec) is None:
+            try:
+                accepted = self.replicas[ls].submit_record(rec)
+            except Exception:
+                # submit-path failure (EN_LOG_SUBMIT injection, IO error)
+                # before anything reached the log: roll back locally so the
+                # staged rows don't stay locked by a tx that can never
+                # decide — the orphan would block every later writer
+                self._rollback(ctx, logged_ls=())
+                raise
+            if accepted is None:
                 # nothing reached the log: local rollback suffices
                 self._rollback(ctx, logged_ls=())
                 raise NotMaster(f"ls {ls} rejected submit")
@@ -184,7 +193,14 @@ class TransService:
             rec = TxRecord(RecordType.PREPARE, ctx.tx_id,
                            tuple(ctx.mutations[ls]), 0, coord, tuple(parts),
                            dict_appends=tuple(ctx.dict_appends))
-            if self.replicas[ls].submit_record(rec) is None:
+            try:
+                accepted = self.replicas[ls].submit_record(rec)
+            except Exception:
+                # submit-path failure mid-prepare: log ABORT where a
+                # PREPARE already landed, release everything staged
+                self._rollback(ctx, logged_ls=tuple(logged))
+                raise
+            if accepted is None:
                 # some participants have a PREPARE in their log: log ABORT
                 # there so replicas clean pending redo + tx tables
                 self._rollback(ctx, logged_ls=tuple(logged))

@@ -60,6 +60,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "multidevice: needs >=4 devices (virtual CPU mesh or slice)"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long chaos/workload drives, excluded from tier-1 "
+        "(opt in with tools/run_tier1.sh --chaos or -m slow)",
+    )
 
 
 @pytest.fixture

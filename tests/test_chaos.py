@@ -1,0 +1,190 @@
+"""Chaos harness: statement retry + deadlines under injected faults.
+
+The tentpole acceptance tests: a mixed read/write workload keeps
+completing through leader kills, partitions, packet drops and armed
+errsim tracepoints — every statement succeeds via transparent retry
+(retry_cnt lands in __all_virtual_sql_audit) or fails with a CLASSIFIED
+error, replicas converge afterwards, and a statement under a tight
+SET ob_query_timeout dies with a timeout error, never a raw
+NotMaster/InjectedError.
+
+The full workload runs are marked `slow` (tools/run_tier1.sh --chaos
+opts in); the short deterministic scenarios stay in tier-1.
+"""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.share import retry as R
+from oceanbase_tpu.share.errsim import ERRSIM
+from tools.chaos_bench import run_chaos
+
+CHAOS_SEED = 7  # fixed: any failure replays from this seed
+
+
+@pytest.fixture(autouse=True)
+def _clean_errsim():
+    yield
+    ERRSIM.clear()
+
+
+# ------------------------------------------------------------ full workload
+
+
+@pytest.mark.slow
+def test_chaos_mixed_workload_completes_and_converges():
+    rep = run_chaos(seed=CHAOS_SEED, statements=60,
+                    query_timeout_us=300_000_000)
+    detail = rep.format_schedule() + "\n" + rep.summary()
+    # no raw transient may leak past the retry layer
+    assert not rep.raw_failures, detail
+    # with a generous deadline every statement completes via retry
+    assert rep.ok == rep.statements, detail
+    assert not rep.classified, detail
+    # faults really fired and retries really happened ...
+    assert any(e.action == "kill" for e in rep.schedule), detail
+    assert rep.retried_statements > 0 and rep.total_retries > 0, detail
+    # ... and are visible to operators through sql_audit
+    assert rep.audit_max_retry_cnt > 0, detail
+    # committed state is exactly the model and replicas agree
+    assert not rep.model_mismatches, detail
+    assert rep.converged, detail
+
+
+@pytest.mark.slow
+def test_chaos_errsim_only_no_structural_faults():
+    rep = run_chaos(seed=CHAOS_SEED + 1, statements=40, structural=False,
+                    query_timeout_us=300_000_000)
+    detail = rep.format_schedule() + "\n" + rep.summary()
+    assert not rep.raw_failures, detail
+    assert rep.ok == rep.statements, detail
+    assert rep.converged, detail
+
+
+@pytest.mark.slow
+def test_chaos_schedule_replays_deterministically():
+    a = run_chaos(seed=CHAOS_SEED, statements=30,
+                  query_timeout_us=300_000_000)
+    b = run_chaos(seed=CHAOS_SEED, statements=30,
+                  query_timeout_us=300_000_000)
+    assert [str(e) for e in a.schedule] == [str(e) for e in b.schedule]
+    assert a.ok == b.ok and a.total_retries == b.total_retries
+
+
+# ----------------------------------------------------- short deterministic
+
+
+def _db_with_table():
+    db = Database(n_nodes=3, n_ls=2)
+    s = db.session()
+    s.sql("create table t (id bigint primary key, v bigint not null)")
+    s.sql("insert into t values (1, 10)")
+    return db, s
+
+
+def test_injected_commit_errors_retry_transparently():
+    """EN_TX_COMMIT armed for two fires: the INSERT redrives twice and
+    succeeds; retry_cnt/retry_info land in the audit record and the
+    virtual table; the retry counters move."""
+    db, s = _db_with_table()
+    before = db.metrics.counters_snapshot().get("statement retries", 0)
+    ERRSIM.arm("EN_TX_COMMIT", count=2)
+    s.sql("insert into t values (2, 20)")
+    assert ERRSIM.fired("EN_TX_COMMIT") == 2
+    rec = db.audit.records()[-1]
+    assert rec.retry_cnt == 2
+    assert "injected transient" in rec.retry_info
+    rs = s.sql(
+        "select retry_cnt, retry_info from __all_virtual_sql_audit "
+        "where retry_cnt > 0"
+    )
+    assert rs.nrows >= 1 and max(r[0] for r in rs.rows()) == 2
+    after = db.metrics.counters_snapshot().get("statement retries", 0)
+    assert after - before >= 2
+    # the row really committed exactly once
+    assert s.sql("select v from t where id = 2").rows() == [(20,)]
+
+
+def test_leader_kill_mid_workload_transparent_retry():
+    """Kill the leader with a majority surviving: the next statements
+    fail over via location refresh + retry, never surfacing NotMaster."""
+    db, s = _db_with_table()
+    ls_id = min(db.cluster.ls_groups)
+    victim = db.cluster.leader_node(ls_id)
+    db.cluster.kill_node(victim, settle=0.5)
+    s.sql("insert into t values (3, 30)")
+    rows = s.sql("select id, v from t order by id").rows()
+    assert (3, 30) in rows
+    # at least one statement needed the retry layer
+    assert any(r.retry_cnt > 0 for r in db.audit.records())
+
+
+def test_query_timeout_classified_never_raw():
+    """Majority lost: no election can succeed, so a write must expire as
+    a StatementTimeout (ob_query_timeout) — not NotMaster/StaleLocation."""
+    db, s = _db_with_table()
+    alive = db.cluster.leader_node(min(db.cluster.ls_groups))
+    for n in range(db.cluster.n_nodes):
+        if n != alive:
+            db.cluster.kill_node(n, settle=0.2)
+    # burn the survivor's zombie lease so it demotes before the statement:
+    # otherwise the write stages on it and dies as CommitUnknown instead
+    db.cluster.settle(1.0)
+    s.sql("set ob_query_timeout = 2000000")  # 2s on the virtual clock
+    with pytest.raises(R.StatementTimeout):
+        s.sql("insert into t values (4, 40)")
+    rec = db.audit.records()[-1]
+    assert "Timeout" in rec.error
+    assert "NotMaster" not in rec.error and "InjectedError" not in rec.error
+
+
+def test_trx_timeout_expires_open_transaction():
+    db, s = _db_with_table()
+    s.sql("set ob_trx_timeout = 3000000")  # 3s virtual
+    s.sql("begin")
+    s.sql("insert into t values (5, 50)")
+    db.cluster.settle(5.0)  # burn past the trx deadline
+    with pytest.raises(R.TrxTimeout):
+        s.sql("insert into t values (6, 60)")
+    # ROLLBACK must still work on an expired transaction
+    s.sql("rollback")
+    rows = s.sql("select id from t order by id").rows()
+    assert (5,) not in rows and (6,) not in rows
+
+
+def test_session_var_rejects_garbage():
+    db, s = _db_with_table()
+    from oceanbase_tpu.server.database import SqlError
+
+    with pytest.raises(SqlError):
+        s.sql("set ob_query_timeout = banana")
+
+
+def test_px_admission_timeout_is_classified():
+    """Quota exhausted by a holder that never releases: the PX statement
+    fails with the classified admission error (retryable class), and the
+    wait is bounded (no hang)."""
+    db, s = _db_with_table()
+    adm = db._px_admission()
+    adm.queue_timeout_s = 0.05
+    granted = adm.acquire(adm.target)  # hog the whole quota
+    try:
+        s.sql("set ob_px_dop = 2")
+        with pytest.raises(R.PxAdmissionTimeout):
+            s.sql("select count(*) as n from t")
+        assert db.metrics.counters_snapshot().get(
+            "px admission timeouts", 0) >= 1
+    finally:
+        adm.release(granted)
+    # quota back: the same statement runs
+    s.sql("select count(*) as n from t")
+
+
+def test_stale_location_bounded_retry_exhaustion():
+    """With every node dead the location loop must give up with the
+    classified StaleLocation (not spin forever, not KeyError)."""
+    db, _s = _db_with_table()
+    for n in range(db.cluster.n_nodes):
+        db.cluster.kill_node(n, settle=0.1)
+    with pytest.raises(R.StaleLocation):
+        db._leader_replica_ls(min(db.cluster.ls_groups))

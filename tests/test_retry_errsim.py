@@ -1,0 +1,242 @@
+"""Unit coverage for the retry taxonomy (share/retry.py) and the errsim
+registry arms (share/errsim.py): probabilistic firing, count limits,
+reseed determinism, and debug_sync interleavings driven through real
+statements."""
+
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.share import retry as R
+from oceanbase_tpu.share.errsim import (
+    DEBUG_SYNC,
+    ERRSIM,
+    DEFAULT_SEED,
+    ErrsimRegistry,
+    InjectedError,
+)
+from oceanbase_tpu.tx.txn import NotMaster
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    ERRSIM.clear()
+    ERRSIM.reseed(DEFAULT_SEED)
+    DEBUG_SYNC.deactivate()
+
+
+# ------------------------------------------------------------------ errsim
+
+
+def test_count_limited_arm_fires_exactly_n_times():
+    reg = ErrsimRegistry(seed=1)
+    reg.arm("EN_X", count=3)
+    hits = 0
+    for _ in range(10):
+        try:
+            reg.check("EN_X")
+        except InjectedError:
+            hits += 1
+    assert hits == 3
+    assert reg.fired("EN_X") == 3
+
+
+def test_probabilistic_arm_fires_roughly_at_rate():
+    reg = ErrsimRegistry(seed=42)
+    reg.arm("EN_P", prob=0.3)
+    hits = sum(
+        1 for _ in range(2000)
+        if _raises(lambda: reg.check("EN_P"))
+    )
+    # binomial(2000, 0.3): anything wildly off means prob is ignored
+    assert 450 < hits < 750
+
+
+def test_probabilistic_and_count_limited_combine():
+    reg = ErrsimRegistry(seed=7)
+    reg.arm("EN_PC", prob=0.5, count=4)
+    hits = sum(
+        1 for _ in range(1000)
+        if _raises(lambda: reg.check("EN_PC"))
+    )
+    assert hits == 4  # prob thins the firings, count still caps them
+
+
+def test_reseed_replays_identical_firing_sequence():
+    def drive(reg):
+        reg.arm("EN_R", prob=0.4)
+        return [
+            _raises(lambda: reg.check("EN_R")) for _ in range(64)
+        ]
+
+    a = ErrsimRegistry(seed=99)
+    seq1 = drive(a)
+    a.clear()
+    a.reseed(99)
+    seq2 = drive(a)
+    assert seq1 == seq2
+    b = ErrsimRegistry(seed=100)
+    assert drive(b) != seq1  # a different seed gives a different schedule
+
+
+def test_custom_error_object_is_raised():
+    reg = ErrsimRegistry()
+    reg.arm("EN_C", error=NotMaster("ls 1: injected"))
+    with pytest.raises(NotMaster, match="injected"):
+        reg.check("EN_C")
+
+
+def test_clear_disarms():
+    reg = ErrsimRegistry()
+    reg.arm("EN_D")
+    reg.clear("EN_D")
+    reg.check("EN_D")  # no raise
+    assert reg.fired("EN_D") == 0
+
+
+def _raises(fn) -> bool:
+    try:
+        fn()
+    except Exception:
+        return True
+    return False
+
+
+# -------------------------------------------------------------- debug_sync
+
+
+def test_debug_sync_interleaves_a_kill_before_commit():
+    """Park an action at BEFORE_COMMIT that kills the tx's leader mid-commit
+    on its first reach: the statement-retry layer must absorb the resulting
+    failover and the INSERT still lands exactly once."""
+    db = Database(n_nodes=3, n_ls=1)
+    s = db.session()
+    s.sql("create table t (id bigint primary key, v bigint not null)")
+    ls_id = min(db.cluster.ls_groups)
+    state = {"fired": False}
+
+    def kill_leader_once():
+        if state["fired"]:
+            return
+        state["fired"] = True
+        victim = db.cluster.leader_node(ls_id)
+        db.cluster.kill_node(victim, settle=0.5)
+
+    DEBUG_SYNC.activate("BEFORE_COMMIT", kill_leader_once)
+    s.sql("insert into t values (1, 10)")
+    assert state["fired"]
+    assert s.sql("select v from t where id = 1").rows() == [(10,)]
+
+
+def test_debug_sync_observes_mini_merge_order():
+    """BEFORE_MINI_DUMP fires inside the freeze/mini-merge path — the
+    interleaving hook sees the point before any frozen memtable is dumped."""
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table t (id bigint primary key, v bigint not null)")
+    s.sql("insert into t values (1, 10)")
+    tab = next(t for t in db._all_tablets() if t.active.nkeys > 0)
+    frozen_at_reach = []
+    DEBUG_SYNC.activate(
+        "BEFORE_MINI_DUMP", lambda: frozen_at_reach.append(len(tab.frozen)))
+    tab.freeze()
+    tab.dump_mini()
+    assert frozen_at_reach == [1]  # reached before the dump consumed it
+    assert not tab.frozen
+
+
+def test_errsim_blocks_mini_merge_then_clears():
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table t (id bigint primary key, v bigint not null)")
+    s.sql("insert into t values (1, 10)")
+    tab = next(t for t in db._all_tablets() if t.active.nkeys > 0)
+    tab.freeze()
+    ERRSIM.arm("EN_MINI_MERGE", count=1)
+    with pytest.raises(InjectedError):
+        tab.dump_mini()
+    assert tab.frozen  # the frozen memtable survived the failed dump
+    tab.dump_mini()  # arm exhausted: the retried dump succeeds
+    assert not tab.frozen
+
+
+# ------------------------------------------------------- retry.py taxonomy
+
+
+def test_classify_policies():
+    assert R.classify(R.StaleLocation("x")).reason == "stale location cache"
+    assert R.classify(R.PxAdmissionTimeout("x")).retryable
+    assert R.classify(R.SchemaVersionMismatch("x")).flush_plan_cache
+    assert R.classify(InjectedError("EN_X")).retryable
+    assert R.classify(NotMaster("ls 1")).refresh_location
+    assert not R.classify(R.QueryTimeout("t")).retryable
+    assert not R.classify(R.CommitUnknown("c")).retryable
+    assert not R.classify(ValueError("nope")).retryable
+
+
+def test_deadline_expiry_and_labeled_errors():
+    t = [0.0]
+    d = R.Deadline.after(lambda: t[0], 5.0, label="ob_query_timeout")
+    assert not d.expired and d.remaining() == 5.0
+    t[0] = 6.0
+    assert d.expired
+    with pytest.raises(R.QueryTimeout):
+        d.check()
+    trx = R.Deadline.after(lambda: t[0], -1.0, label="ob_trx_timeout")
+    with pytest.raises(R.TrxTimeout):
+        trx.check()
+
+
+def test_deadline_earliest_keeps_tighter_label():
+    t = [0.0]
+    q = R.Deadline.after(lambda: t[0], 10.0, label="ob_query_timeout")
+    trx = R.Deadline.after(lambda: t[0], 3.0, label="ob_trx_timeout")
+    assert R.Deadline.earliest(q, trx) is trx
+    assert R.Deadline.earliest(q, None) is q
+    assert R.Deadline.earliest(None, None) is None
+
+
+def test_controller_backoff_grows_and_is_capped():
+    t = [0.0]
+    d = R.Deadline.after(lambda: t[0], 100.0)
+    ctrl = R.RetryController(deadline=d)
+    err = NotMaster("ls 1")
+    waits = []
+    for _ in range(40):
+        policy = ctrl.decide(err, stmt_retryable=True)
+        assert policy is not None
+        waits.append(ctrl.record(policy, err))
+    assert waits[0] < waits[1] <= waits[-1]
+    assert max(waits) <= R.LOCATION_REFRESH.max_wait
+    assert ctrl.retry_cnt == 40
+    assert "not master" in ctrl.retry_info
+
+
+def test_controller_per_policy_cap_exhausts():
+    t = [0.0]
+    ctrl = R.RetryController(deadline=R.Deadline.after(lambda: t[0], 1e9))
+    err = InjectedError("EN_X")
+    cap = R.INJECTED_TRANSIENT.max_retries
+    for _ in range(cap):
+        policy = ctrl.decide(err, stmt_retryable=True)
+        assert policy is not None
+        ctrl.record(policy, err)
+    assert ctrl.decide(err, stmt_retryable=True) is None
+
+
+def test_controller_respects_stmt_retryable():
+    ctrl = R.RetryController(
+        deadline=R.Deadline.after(lambda: 0.0, 100.0))
+    # DML inside an explicit tx: not retryable even for a retryable class
+    assert ctrl.decide(NotMaster("ls 1"), stmt_retryable=False) is None
+
+
+def test_controller_timeout_error_carries_cause():
+    t = [0.0]
+    d = R.Deadline.after(lambda: t[0], 1.0, label="ob_query_timeout")
+    ctrl = R.RetryController(deadline=d)
+    last = NotMaster("ls 2")
+    t[0] = 2.0
+    e = ctrl.timeout_error(last)
+    assert isinstance(e, R.QueryTimeout)
+    assert e.__cause__ is last

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""Chaos harness: a mixed read/write workload under seeded fault schedules.
+
+Drives a 3-node Database while a seeded FaultScheduler injects faults on
+the virtual-clock bus — packet-drop pulses, minority partitions, leader
+kills/revives — and arms errsim tracepoints (probabilistic transient
+commit/log-append errors). The point is to prove the statement retry +
+deadline layer (share/retry.py) absorbs every transient: each statement
+either succeeds (possibly after transparent retries, visible as
+retry_cnt in __all_virtual_sql_audit) or fails with a CLASSIFIED error —
+never a raw NotMaster/InjectedError — and the replicas converge once the
+faults heal.
+
+Everything is deterministic from one seed: the workload RNG, the fault
+schedule, the errsim registry RNG and the bus drop RNG all derive from
+it, so any failure replays exactly from its logged seed.
+
+CLI:
+    python tools/chaos_bench.py --seed 7 --statements 120
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from dataclasses import dataclass, field
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from oceanbase_tpu.share import retry as R  # noqa: E402
+from oceanbase_tpu.share.errsim import ERRSIM  # noqa: E402
+
+
+def classified_errors() -> tuple:
+    """Failure classes a chaos statement is ALLOWED to surface: everything
+    in the retry taxonomy plus SqlError (genuine statement errors). A raw
+    NotMaster / InjectedError / KeyError escaping means the retry layer
+    leaked a transient."""
+    from oceanbase_tpu.server.database import SqlError
+    from oceanbase_tpu.share.interrupt import QueryInterrupted
+
+    return (
+        SqlError,
+        R.StatementTimeout,
+        R.CommitUnknown,
+        R.StaleLocation,
+        R.PxAdmissionTimeout,
+        QueryInterrupted,
+    )
+
+
+@dataclass
+class FaultEvent:
+    step: int
+    action: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[step {self.step:4d}] {self.action}: {self.detail}"
+
+
+class FaultScheduler:
+    """Seeded, replayable fault schedule over a Database's cluster.
+
+    tick(step) is called before each workload statement: it first expires
+    faults whose window ended (heal/revive/reset), then rolls the dice for
+    new ones. At most one STRUCTURAL fault (kill or partition) is active
+    at a time so a 3-node cluster always keeps a majority; drop pulses and
+    errsim arms overlay freely."""
+
+    KILL_P = 0.10
+    PARTITION_P = 0.08
+    DROP_P = 0.12
+    ERRSIM_P = 0.15
+
+    def __init__(self, db, seed: int, structural: bool = True,
+                 errsim_arms: bool = True):
+        self.db = db
+        self.cluster = db.cluster
+        self.rng = random.Random(seed)
+        self.structural = structural
+        self.errsim_arms = errsim_arms
+        self.log: list[FaultEvent] = []
+        # active fault windows: kind -> (end_step, undo)
+        self._active: dict[str, tuple[int, object]] = {}
+
+    # ------------------------------------------------------------- utils
+    def _note(self, step: int, action: str, detail: str) -> None:
+        self.log.append(FaultEvent(step, action, detail))
+
+    def _palf_ids(self, node: int) -> list[int]:
+        return [g[node].palf.node_id for g in self.cluster.ls_groups.values()]
+
+    # ------------------------------------------------------------- drive
+    def tick(self, step: int) -> None:
+        for kind in [k for k, (end, _u) in self._active.items() if step >= end]:
+            _end, undo = self._active.pop(kind)
+            undo(step)
+        self._maybe_inject(step)
+
+    def _maybe_inject(self, step: int) -> None:
+        roll = self.rng.random
+        if self.structural and "struct" not in self._active:
+            if roll() < self.KILL_P:
+                self._kill_leader(step)
+            elif roll() < self.PARTITION_P:
+                self._partition_minority(step)
+        if "drop" not in self._active and roll() < self.DROP_P:
+            self._drop_pulse(step)
+        if self.errsim_arms and "errsim" not in self._active \
+                and roll() < self.ERRSIM_P:
+            self._arm_errsim(step)
+
+    # ------------------------------------------------------------ faults
+    def _kill_leader(self, step: int) -> None:
+        ls_id = self.rng.choice(sorted(self.cluster.ls_groups))
+        try:
+            victim = self.cluster.leader_node(ls_id)
+        except RuntimeError:
+            # no ready leader right now (previous fault still settling):
+            # skip the event, the schedule stays deterministic
+            self._note(step, "kill-skip", f"ls {ls_id} has no ready leader")
+            return
+        self._note(step, "kill", f"node {victim} (leader of ls {ls_id})")
+        self.cluster.kill_node(victim, settle=0.5)
+        window = self.rng.randint(3, 7)
+
+        def undo(at: int, victim=victim) -> None:
+            self._note(at, "revive", f"node {victim}")
+            for pid in self._palf_ids(victim):
+                self.cluster.bus.revive(pid)
+            self.cluster.settle(0.5)
+
+        self._active["struct"] = (step + window, undo)
+
+    def _partition_minority(self, step: int) -> None:
+        node = self.rng.randrange(self.cluster.n_nodes)
+        mine = set(self._palf_ids(node))
+        others = {
+            pid for n in range(self.cluster.n_nodes) if n != node
+            for pid in self._palf_ids(n)
+        }
+        self._note(step, "partition", f"node {node} vs rest")
+        self.cluster.bus.partition(mine, others)
+        self.cluster.settle(0.5)
+        window = self.rng.randint(2, 6)
+
+        def undo(at: int, node=node) -> None:
+            self._note(at, "heal", f"partition of node {node}")
+            self.cluster.bus.heal()
+            self.cluster.settle(0.5)
+
+        self._active["struct"] = (step + window, undo)
+
+    def _drop_pulse(self, step: int) -> None:
+        p = round(self.rng.uniform(0.05, 0.25), 3)
+        self._note(step, "drop", f"drop_prob={p}")
+        self.cluster.bus.drop_prob = p
+        window = self.rng.randint(2, 5)
+
+        def undo(at: int) -> None:
+            self._note(at, "drop-end", "drop_prob=0")
+            self.cluster.bus.drop_prob = 0.0
+
+        self._active["drop"] = (step + window, undo)
+
+    def _arm_errsim(self, step: int) -> None:
+        name = self.rng.choice(["EN_TX_COMMIT", "EN_LOG_SUBMIT"])
+        prob = round(self.rng.uniform(0.2, 0.6), 2)
+        count = self.rng.randint(2, 8)
+        self._note(step, "errsim", f"{name} prob={prob} count={count}")
+        ERRSIM.arm(name, prob=prob, count=count)
+        window = self.rng.randint(3, 8)
+
+        def undo(at: int, name=name) -> None:
+            self._note(at, "errsim-clear", name)
+            ERRSIM.clear(name)
+
+        self._active["errsim"] = (step + window, undo)
+
+    def heal_all(self, step: int) -> None:
+        """End of run: expire every open window, heal the bus, disarm."""
+        for kind in list(self._active):
+            _end, undo = self._active.pop(kind)
+            undo(step)
+        self.cluster.bus.heal()
+        self.cluster.bus.drop_prob = 0.0
+        ERRSIM.clear()
+        self.cluster.settle(2.0)
+
+
+# ------------------------------------------------------------------ report
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    statements: int = 0
+    ok: int = 0
+    retried_statements: int = 0   # audit records with retry_cnt > 0
+    total_retries: int = 0
+    classified: dict = field(default_factory=dict)
+    raw_failures: list = field(default_factory=list)  # (step, sql, repr)
+    model_mismatches: list = field(default_factory=list)
+    converged: bool = False
+    convergence_detail: str = ""
+    schedule: list = field(default_factory=list)
+    audit_max_retry_cnt: int = 0
+
+    @property
+    def failed(self) -> int:
+        return sum(self.classified.values()) + len(self.raw_failures)
+
+    def format_schedule(self) -> str:
+        head = f"chaos seed={self.seed} fault schedule " \
+               f"({len(self.schedule)} events):"
+        return "\n".join([head] + [f"  {e}" for e in self.schedule])
+
+    def summary(self) -> str:
+        lines = [
+            f"chaos seed={self.seed}: {self.ok}/{self.statements} ok, "
+            f"{self.retried_statements} statements retried "
+            f"({self.total_retries} redrives), "
+            f"{sum(self.classified.values())} classified failures, "
+            f"{len(self.raw_failures)} RAW failures, "
+            f"converged={self.converged}",
+        ]
+        for name, n in sorted(self.classified.items()):
+            lines.append(f"  classified {name}: {n}")
+        for step, sql, err in self.raw_failures:
+            lines.append(f"  RAW at step {step}: {sql!r} -> {err}")
+        if self.model_mismatches:
+            lines.append(f"  model mismatches: {self.model_mismatches[:5]}")
+        if not self.converged:
+            lines.append(f"  divergence: {self.convergence_detail}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- workload
+
+
+def _sorted_rows(scan: dict) -> list[tuple]:
+    cols = sorted(scan)
+    n = len(scan[cols[0]]) if cols else 0
+    return sorted(tuple(scan[c][i] for c in cols) for i in range(n))
+
+
+def check_convergence(db) -> tuple[bool, str]:
+    """All replicas of every log stream apply to the leader's LSN and hold
+    identical tablet contents (the post-chaos safety bar)."""
+    c = db.cluster
+    for ls_id, group in c.ls_groups.items():
+        lead = c.leader_node(ls_id)
+        leader_rep = group[lead]
+        ok = c.drive_until(lambda: all(
+            r.palf.applied_lsn == leader_rep.palf.applied_lsn
+            for r in group.values()
+        ))
+        if not ok:
+            lsns = {n: r.palf.applied_lsn for n, r in group.items()}
+            return False, f"ls {ls_id}: applied_lsn did not converge {lsns}"
+        snap = c.gts.next_ts()
+        for tab_id, tab in leader_rep.tablets.items():
+            want = _sorted_rows(tab.scan(snap))
+            for n, r in group.items():
+                if n == lead or tab_id not in r.tablets:
+                    continue
+                got = _sorted_rows(r.tablets[tab_id].scan(snap))
+                if got != want:
+                    return False, (f"ls {ls_id} tablet {tab_id}: node {n} "
+                                   f"diverges from leader {lead}")
+    return True, ""
+
+
+def run_chaos(seed: int = 7, statements: int = 120,
+              structural: bool = True, errsim_arms: bool = True,
+              query_timeout_us: int | None = None,
+              verbose: bool = False) -> ChaosReport:
+    """Run the chaos workload; returns a ChaosReport (no asserts — the
+    test layer decides what is acceptable)."""
+    from oceanbase_tpu.server import Database
+
+    ERRSIM.reseed(seed ^ 0x5EED)
+    db = Database(n_nodes=3, n_ls=2)
+    s = db.session()
+    s.sql("create table chaos_kv (id bigint primary key, v bigint not null)")
+    if query_timeout_us is not None:
+        s.sql(f"set ob_query_timeout = {query_timeout_us}")
+    sched = FaultScheduler(db, seed, structural=structural,
+                           errsim_arms=errsim_arms)
+    wl = random.Random(seed * 7919 + 1)
+    report = ChaosReport(seed=seed, statements=statements)
+    CLASSIFIED = classified_errors()
+
+    model: dict[int, int] = {}
+    uncertain: set[int] = set()
+    next_id = 1
+
+    try:
+        for step in range(statements):
+            sched.tick(step)
+            roll = wl.random()
+            if roll < 0.40 or not model:
+                sid, val = next_id, wl.randrange(1_000_000)
+                next_id += 1
+                sql = f"insert into chaos_kv values ({sid}, {val})"
+                effect = ("put", sid, val)
+            elif roll < 0.65:
+                sid = wl.choice(sorted(model))
+                val = wl.randrange(1_000_000)
+                sql = f"update chaos_kv set v = {val} where id = {sid}"
+                effect = ("put", sid, val)
+            elif roll < 0.75:
+                sid = wl.choice(sorted(model))
+                sql = f"delete from chaos_kv where id = {sid}"
+                effect = ("del", sid, None)
+            else:
+                sql = "select count(*) as n, sum(v) as s from chaos_kv"
+                effect = None
+            try:
+                s.sql(sql)
+                report.ok += 1
+                if effect is not None:
+                    op, sid, val = effect
+                    uncertain.discard(sid)
+                    if op == "put":
+                        model[sid] = val
+                    else:
+                        model.pop(sid, None)
+            except CLASSIFIED as e:
+                name = type(e).__name__
+                report.classified[name] = report.classified.get(name, 0) + 1
+                if effect is not None:
+                    # outcome of a failed write is only certain when the tx
+                    # aborted; CommitUnknown means exactly what it says
+                    op, sid, _val = effect
+                    uncertain.add(sid)
+                    model.pop(sid, None)
+                if verbose:
+                    print(f"[step {step:4d}] classified {name}: {sql!r}")
+            except Exception as e:  # raw leak: the retry layer failed
+                report.raw_failures.append((step, sql, repr(e)))
+                if verbose:
+                    print(f"[step {step:4d}] RAW {e!r}: {sql!r}")
+    finally:
+        sched.heal_all(statements)
+        report.schedule = sched.log
+
+    report.converged, report.convergence_detail = check_convergence(db)
+
+    # model check: every id with a certain outcome must read back exactly
+    rs = s.sql("select id, v from chaos_kv order by id")
+    got = dict(rs.rows())
+    for sid, val in model.items():
+        if got.get(sid) != val:
+            report.model_mismatches.append((sid, val, got.get(sid)))
+    for sid in got:
+        if sid not in model and sid not in uncertain:
+            report.model_mismatches.append((sid, None, got[sid]))
+
+    for rec in db.audit.records():
+        if rec.retry_cnt > 0:
+            report.retried_statements += 1
+            report.total_retries += rec.retry_cnt
+    # the operator-facing proof: retry_cnt surfaces through SQL
+    rs = s.sql("select max(retry_cnt) as m from __all_virtual_sql_audit")
+    report.audit_max_retry_cnt = rs.rows()[0][0] or 0
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--statements", type=int, default=120)
+    ap.add_argument("--no-structural", action="store_true",
+                    help="no kills/partitions (drop pulses + errsim only)")
+    ap.add_argument("--no-errsim", action="store_true")
+    ap.add_argument("--query-timeout-us", type=int, default=None)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    rep = run_chaos(
+        seed=args.seed, statements=args.statements,
+        structural=not args.no_structural,
+        errsim_arms=not args.no_errsim,
+        query_timeout_us=args.query_timeout_us,
+        verbose=args.verbose,
+    )
+    print(rep.format_schedule())
+    print(rep.summary())
+    bad = (rep.raw_failures or rep.model_mismatches or not rep.converged)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,11 +4,22 @@
 # 8 virtual devices via conftest.py), skips slow-marked tests, and
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
-#   tools/run_tier1.sh [extra pytest args...]
+#   tools/run_tier1.sh [--chaos] [extra pytest args...]
+#
+# --chaos additionally runs the slow-marked chaos workload drives
+# (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
+# on failure the fault schedule is in the assertion detail (replay with
+# tools/chaos_bench.py --seed N).
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
+
+chaos=0
+if [ "$1" = "--chaos" ]; then
+    chaos=1
+    shift
+fi
 
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
@@ -16,4 +27,11 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     "$@" 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+if [ "$chaos" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_chaos.py -q -m slow \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+fi
 exit $rc
