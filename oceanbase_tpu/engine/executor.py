@@ -375,6 +375,11 @@ class Executor:
         # around one execution: cache hits upload nothing, which is the
         # point of the per-column device cache)
         self.h2d_bytes = 0
+        # assembled-ColumnBatch memo over the per-column cache: a warm
+        # statement's _inputs() otherwise rebuilds the batch wrapper —
+        # including a jnp.sum dispatch for nrows — on EVERY dispatch
+        # (serving-path profile: ~80us/stmt). Validated by table version.
+        self._assembled: dict[tuple, tuple[int, ColumnBatch]] = {}
 
     # ---- input preparation -------------------------------------------
     def _collect_scans(self, plan: LogicalOp) -> list[Scan]:
@@ -443,6 +448,8 @@ class Executor:
         self._table_version[name] = self._table_version.get(name, 0) + 1
         for key in [k for k in self._batch_cache if k[0] == name]:
             del self._batch_cache[key]
+        for key in [k for k in self._assembled if k[0] == name]:
+            del self._assembled[key]
 
     def input_device_bytes(self, input_spec) -> int:
         """Device-resident footprint of a prepared plan's inputs (array
@@ -599,6 +606,10 @@ class Executor:
         # overlapping needs share one H2D upload per column (uploads over
         # the network-attached chip cost ~seconds/GB and dominated the
         # bench when q1/q6/q3/q14 each re-shipped lineitem)
+        ver = self._table_version.get(name, 0)
+        memo = self._assembled.get((name, cols))
+        if memo is not None and memo[0] == ver:
+            return memo[1]
         t = self.catalog[name]
         sub_schema = Schema(
             tuple(f for f in t.schema.fields if f.name in cols)
@@ -642,7 +653,7 @@ class Executor:
             sel = jnp.asarray(s)
             self._batch_cache[skey] = sel
             self.h2d_bytes += int(sel.nbytes)
-        return ColumnBatch(
+        batch = ColumnBatch(
             cols=dcols,
             valid=dvalid,
             sel=sel,
@@ -650,6 +661,8 @@ class Executor:
             schema=sub_schema,
             dicts={c: d for c, d in t.dicts.items() if c in cols},
         )
+        self._assembled[(name, cols)] = (ver, batch)
+        return batch
 
     def _build_batch(self, name: str, cols: tuple[str, ...]) -> ColumnBatch:
         t = self.catalog[name]
@@ -3151,6 +3164,195 @@ class PreparedPlan:
                 self.executor.compile(self.plan, self.params)
             )
         raise AssertionError
+
+    def run_device(self, qparams: tuple = ()):
+        """Dispatch WITHOUT any host sync: returns device references
+        (out ColumnBatch, overflow vector). JAX async dispatch returns as
+        soon as the program is enqueued, so the caller's host work
+        (audit, metrics, trace assembly) overlaps device compute; the
+        overflow check moves to the first fetch (DeviceResult._sync)."""
+        from ..share.interrupt import checkpoint
+
+        checkpoint()
+        return self.jitted(self._inputs(), qparams)
+
+
+class DeviceResult:
+    """Lazy device-resident result cursor (the serving-path half of the
+    fast path: `SELECT ... LIMIT 10` over a 60M-row result must transfer
+    KB, not GB).
+
+    The first host access fetches ONLY the overflow counters and the live
+    row count (two scalars — this is the async-dispatch sync point; a
+    capacity overflow redrives the recompile loop here, exactly as
+    run_host's eager loop would have). Column data transfers on demand:
+    per touched column, or LIMIT-bounded via a device-side compaction
+    gather when the caller wants the first k rows of a large result."""
+
+    def __init__(self, prepared, qparams, out, ovf_vec, max_retries: int = 3,
+                 profile=None, phases=None):
+        self.prepared = prepared
+        self._qparams = qparams
+        self._out = out
+        self._ovf = ovf_vec
+        self._max_retries = max_retries
+        # observability hooks, updated in place as transfers happen:
+        # server/diag.QueryProfile (fetch_s / d2h_bytes) and the session's
+        # last_phases dict for this statement
+        self.profile = profile
+        self.phases = phases
+        self._nrows: int | None = None
+        self._hcols: dict = {}
+        self._hvalid: dict = {}
+        self._hsel = None
+
+    def _observe(self, seconds: float, nbytes: int) -> None:
+        if self.profile is not None:
+            self.profile.fetch_s += seconds
+            self.profile.d2h_bytes += nbytes
+        if self.phases is not None:
+            self.phases["fetch_s"] = self.phases.get("fetch_s", 0.0) + seconds
+
+    def _sync(self) -> None:
+        """Overflow check + row count: the deferred tail of the dispatch.
+        Runs the same bump/recompile/redrive loop as PreparedPlan.run."""
+        if self._nrows is not None:
+            return
+        import time as _time
+
+        from ..share.interrupt import checkpoint
+
+        p = self.prepared
+        # serving-latency fold: when the whole result footprint is small
+        # (known from the per-executable memo), piggyback the column data
+        # on the completion sync — ONE host roundtrip instead of a second
+        # device_get when the client fetches. Big results keep the lazy
+        # contract (transfer only what's touched).
+        rmemo = getattr(p, "_result_bytes_memo", None)
+        small = (rmemo is not None and rmemo[0] == getattr(p, "retries", 0)
+                 and rmemo[1] <= 65536 and not self._hcols
+                 and self._hsel is None)
+        for attempt in range(self._max_retries + 1):
+            t0 = _time.perf_counter()
+            if small:
+                # per-leaf np.asarray: same blocking semantics, none of
+                # device_get's pytree + async-batching overhead (~16us a
+                # statement for a handful of KB-sized leaves). The device
+                # nrows scalar is sum(sel); with sel crossing anyway the
+                # sum runs host-side — one fewer transfer leaf.
+                hovf = np.asarray(self._ovf)
+                harrs = {n: np.asarray(a)
+                         for n, a in self._out.cols.items()}
+                hvals = {n: np.asarray(a)
+                         for n, a in self._out.valid.items()}
+                hsel = np.asarray(self._out.sel)
+                hn = int(hsel.sum())
+            else:
+                hovf = np.asarray(self._ovf)
+                hn = int(np.asarray(self._out.nrows))
+            self._observe(_time.perf_counter() - t0,
+                          int(getattr(hovf, "nbytes", 0)) + 8)
+            overflows = p._overflows(np.asarray(hovf))
+            if not overflows:
+                self._nrows = int(hn)
+                if small:
+                    # commit ONLY on a clean run: an overflowed attempt's
+                    # arrays are garbage and must not seed the host cache
+                    self._hcols.update(harrs)
+                    self._hvalid.update(hvals)
+                    self._hsel = np.asarray(hsel)
+                    self._observe(0.0, sum(
+                        int(getattr(a, "nbytes", 0))
+                        for d in (harrs, hvals) for a in d.values()
+                    ) + int(self._hsel.nbytes))
+                return
+            if attempt == self._max_retries:
+                raise RuntimeError(
+                    f"capacity overflow after {self._max_retries} retries: "
+                    f"{overflows}")
+            p.retries += 1
+            p.params.bump(overflows)
+            p.jitted, p.input_spec, p.overflow_nodes = (
+                p.executor.compile(p.plan, p.params)
+            )
+            checkpoint()
+            self._out, self._ovf = p.jitted(p._inputs(), self._qparams)
+
+    @property
+    def nrows(self) -> int:
+        self._sync()
+        return self._nrows
+
+    @property
+    def schema(self):
+        return self._out.schema
+
+    @property
+    def dicts(self):
+        return self._out.dicts
+
+    def fetch_columns(self, names=None) -> dict:
+        """Host rows (sel-compacted, dict-decoded) for the requested
+        columns — all of them when names is None. Each column transfers
+        at most once; repeats serve from the host cache."""
+        import time as _time
+
+        from ..core.column import host_rows
+
+        self._sync()
+        fields = [f for f in self._out.schema.fields
+                  if names is None or f.name in names]
+        need = [f.name for f in fields if f.name not in self._hcols]
+        if need or self._hsel is None:
+            arrs = {n: self._out.cols[n] for n in need}
+            vals = {n: self._out.valid[n] for n in need
+                    if n in self._out.valid}
+            t0 = _time.perf_counter()
+            sel_fetched = self._hsel is None
+            if sel_fetched:
+                harrs, hvals, hsel = jax.device_get(
+                    (arrs, vals, self._out.sel))
+                self._hsel = np.asarray(hsel)
+            else:
+                harrs, hvals = jax.device_get((arrs, vals))
+            nbytes = sum(int(getattr(a, "nbytes", 0))
+                         for d in (harrs, hvals) for a in d.values())
+            if sel_fetched:
+                nbytes += int(self._hsel.nbytes)
+            self._observe(_time.perf_counter() - t0, nbytes)
+            self._hcols.update(harrs)
+            self._hvalid.update(hvals)
+        sub = Schema(tuple(fields))
+        return host_rows(sub, self._out.dicts, self._hcols, self._hvalid,
+                         self._hsel)
+
+    def fetch_head(self, limit: int) -> dict:
+        """First `limit` live rows via a device-side compaction gather:
+        k rows per column cross the link instead of the full static
+        capacity. Serves from the host cache when a full fetch already
+        happened."""
+        import time as _time
+
+        from ..core.column import host_rows
+
+        self._sync()
+        k = min(int(limit), self._nrows)
+        if self._hsel is not None and not (
+            set(f.name for f in self._out.schema.fields) - set(self._hcols)
+        ):
+            host = host_rows(self._out.schema, self._out.dicts, self._hcols,
+                             self._hvalid, self._hsel)
+            return {n: v[:k] for n, v in host.items()}
+        idx = jnp.nonzero(self._out.sel, size=k, fill_value=0)[0]
+        arrs = {n: jnp.take(c, idx) for n, c in self._out.cols.items()}
+        vals = {n: jnp.take(v, idx) for n, v in self._out.valid.items()}
+        t0 = _time.perf_counter()
+        harrs, hvals = jax.device_get((arrs, vals))
+        nbytes = sum(int(getattr(a, "nbytes", 0))
+                     for d in (harrs, hvals) for a in d.values())
+        self._observe(_time.perf_counter() - t0, nbytes)
+        return host_rows(self._out.schema, self._out.dicts, harrs, hvals,
+                         np.ones(k, dtype=np.bool_))
 
 
 def _range_bounds(c: E.Expr, qual: str) -> list:

@@ -20,8 +20,10 @@ from ..core.table import Table
 from ..sql import parser as P
 from ..sql.plan_cache import (
     CacheEntry,
+    FastEntry,
     PlanCache,
     bind,
+    build_slot_map,
     parameterize,
     plan_fingerprint,
 )
@@ -35,6 +37,7 @@ class ResultSet:
     columns: dict[str, object]  # name -> np.ndarray | list
     affected: int = 0  # DML-affected row count (0 for queries)
     plan_cache_hit: bool = False  # this statement reused a compiled plan
+    fast_path_hit: bool = False  # served by the text-keyed fast tier
 
     @property
     def nrows(self) -> int:
@@ -43,9 +46,68 @@ class ResultSet:
         c = self.columns[self.names[0]]
         return len(c)
 
-    def rows(self) -> list[tuple]:
+    def rows(self, limit: int | None = None) -> list[tuple]:
         cols = [self.columns[n] for n in self.names]
+        out = list(zip(*cols)) if cols else []
+        return out[:limit] if limit is not None else out
+
+
+class LazyResultSet:
+    """Device-resident ResultSet: same read surface as ResultSet, but
+    column data stays on the TPU behind a DeviceResult cursor until a
+    host access touches it. `nrows` costs two scalars (the async-dispatch
+    sync point — overflow redrive happens there); `.columns` fetches
+    everything once; `column(name)` transfers only that column;
+    `rows(limit=k)` transfers only k compacted rows per column."""
+
+    def __init__(self, names: tuple[str, ...], cursor, affected: int = 0,
+                 plan_cache_hit: bool = False, fast_path_hit: bool = False):
+        self.names = names
+        self.affected = affected
+        self.plan_cache_hit = plan_cache_hit
+        self.fast_path_hit = fast_path_hit
+        self._cursor = cursor
+        self._columns_cache: dict | None = None
+
+    @property
+    def nrows(self) -> int:
+        if not self.names:
+            return 0
+        return self._cursor.nrows
+
+    @property
+    def columns(self) -> dict[str, object]:
+        # memoized: callers index rs.columns[...] in per-row loops, and
+        # host_rows decode must not re-run per access
+        if self._columns_cache is None:
+            host = self._cursor.fetch_columns()
+            self._columns_cache = {n: host[n] for n in self.names}
+        return self._columns_cache
+
+    def column(self, name: str):
+        """One column's host values — transfers only this column (plus
+        the shared sel mask once)."""
+        return self._cursor.fetch_columns((name,))[name]
+
+    def rows(self, limit: int | None = None) -> list[tuple]:
+        if limit is not None:
+            host = self._cursor.fetch_head(limit)
+        else:
+            host = self._cursor.fetch_columns()
+        cols = [host[n] for n in self.names]
         return list(zip(*cols)) if cols else []
+
+
+@dataclass
+class _FastHit:
+    """A resolved fast-tier lookup: the text entry, the re-bound slot
+    values for THIS statement's literals, and the logical entry holding
+    the compiled executable."""
+
+    text_key: str
+    fe: FastEntry
+    values: list
+    entry: CacheEntry
 
 
 class Session:
@@ -138,11 +200,75 @@ class Session:
         )
 
     def sql(self, text: str) -> ResultSet:
-        norm_key, _ = P.normalize_for_cache(text)
-        # parse + logical plan always run (host-cheap, the fast-parser
-        # analog); the cache skips trace + XLA compile (the expensive part)
+        # fast-parser front end: one tokenize pass both normalizes the
+        # text-tier key and extracts the literal tokens. A warm repeat
+        # skips parse + resolve + rewrite + plan + parameterize entirely
+        # and goes straight to binding the cached executable.
+        t0 = time.perf_counter()
+        fkey, params, kinds = P.fast_normalize(text)
+        use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
+        if use_cache:
+            hit = self.fast_lookup(fkey, params)
+            if hit is not None:
+                return self.fast_execute(
+                    hit, fastparse_s=time.perf_counter() - t0)
+        fastparse_s = time.perf_counter() - t0
+        # the plain plan-cache key is the fast key with kind markers
+        # collapsed (the tokenizer never emits a bare '?')
+        norm_key = fkey.replace("?n", "?").replace("?s", "?")
         ast = P.parse(text)
-        return self.run_ast(ast, norm_key)
+        return self.run_ast(
+            ast, norm_key,
+            fast_reg=(fkey, params, kinds) if use_cache else None,
+            fastparse_s=fastparse_s,
+        )
+
+    def fast_lookup(self, text_key: str, params: tuple):
+        """Text-tier lookup + literal re-bind + logical-tier fetch.
+        Returns a _FastHit ready for fast_execute, or None (counted as a
+        fast miss) when any stage rejects: unknown text, a baked token
+        changed, a converter refused the new literal (dtype widening), or
+        the logical entry is gone (evicted / flushed / schema version
+        moved the key_extra) — that last case also drops the text entry."""
+        pc = self.plan_cache
+        fe = pc.fast_peek(text_key)
+        if fe is None:
+            pc.note_fast_miss()
+            return None
+        vals = fe.bind_tokens(params)
+        if vals is None:
+            pc.note_fast_miss()
+            return None
+        extra = (self.key_extra_fn(fe.tables)
+                 if self.key_extra_fn is not None else ())
+        key = (id(self.catalog), fe.norm_key, fe.sig, fe.baked,
+               fe.fingerprint, extra)
+        entry = pc.get(key, count_miss=False)
+        if entry is None:
+            pc.fast_invalidate(text_key)
+            pc.note_fast_miss()
+            return None
+        pc.note_fast_hit()
+        return _FastHit(text_key, fe, vals, entry)
+
+    def fast_execute(self, hit: "_FastHit", fastparse_s: float = 0.0
+                     ) -> ResultSet:
+        """Execute a fast-tier hit: bind + dispatch the cached executable.
+        Any failure drops the text entry (the next occurrence re-registers
+        through the full path) and re-raises for the retry controller."""
+        profiling = (self.profile_enabled_fn() if self.profile_enabled_fn
+                     else True)
+        h2d0 = self.executor.h2d_bytes if profiling else 0
+        try:
+            return self._execute_entry(
+                hit.entry, hit.values, ex=self.executor, was_hit=True,
+                fast=True, plan_s=0.0, compile_s=0.0,
+                fastparse_s=fastparse_s, profiling=profiling, h2d0=h2d0,
+                plan_obj=getattr(hit.entry.prepared, "plan", None),
+            )
+        except Exception:
+            self.plan_cache.fast_invalidate(hit.text_key)
+            raise
 
     def cached_entry(self, text: str):
         """(CacheEntry, bound qparams) for a statement already run through
@@ -164,22 +290,29 @@ class Session:
         return entry, bind(pz.values, entry.dtypes)
 
     def _cache_key(self, norm_key: str, pz, executor=None) -> tuple:
-        extra = ()
-        if self.key_extra_fn is not None:
-            tables = tuple(sorted(
-                {s.table for s in self.executor._collect_scans(pz.plan)}
-            ))
-            extra = self.key_extra_fn(tables)
+        return self._key_parts(norm_key, pz, executor)[0]
+
+    def _key_parts(self, norm_key: str, pz, executor=None
+                   ) -> tuple[tuple, tuple, str]:
+        """(logical cache key, referenced table names, plan fingerprint).
+        The tables and fingerprint also seed fast-tier registration — a
+        fast hit rebuilds this key from them without planning."""
+        tables = tuple(sorted(
+            {s.table for s in self.executor._collect_scans(pz.plan)}
+        ))
+        extra = self.key_extra_fn(tables) if self.key_extra_fn is not None \
+            else ()
         # an executor override (PX routing) compiles a DIFFERENT program
         # for the same text: the entry must not collide with single-chip
         if executor is not None and executor is not self.executor:
             extra = (*extra, "#exec", id(executor))
+        fp = plan_fingerprint(pz.plan)
         # id(catalog) scopes entries to one table set (cache sharing is per
         # tenant = per catalog; entries pin their executor -> catalog, so the
         # id cannot be recycled while the entry lives); the plan fingerprint
         # catches literals consumed at plan time (ORDER BY ordinals etc.)
-        return (id(self.catalog), norm_key, pz.sig, pz.baked,
-                plan_fingerprint(pz.plan), extra)
+        key = (id(self.catalog), norm_key, pz.sig, pz.baked, fp, extra)
+        return key, tables, fp
 
     def _emit_px_spans(self, prepared, start: float, end: float) -> None:
         """Per-DFO / per-shard worker spans for a PX execution, stitched
@@ -208,7 +341,8 @@ class Session:
                                dfo=0)
 
     def run_ast(self, ast, norm_key: str, use_cache: bool | None = None,
-                executor=None) -> ResultSet:
+                executor=None, fast_reg=None,
+                fastparse_s: float = 0.0) -> ResultSet:
         """Plan + execute an already-parsed SELECT under the plan cache.
 
         Shared by text queries and internal consumers (the DML layer's
@@ -218,7 +352,10 @@ class Session:
         never reusable, and caching them would evict user plans).
         `executor` overrides the compiling/executing backend for this
         statement (PX routing: the server layer passes its PxExecutor when
-        the session's DOP variable asks for distributed execution)."""
+        the session's DOP variable asks for distributed execution).
+        `fast_reg` = (text_key, raw_params, kinds) from fast_normalize
+        registers this statement in the text-keyed fast tier on success —
+        callers pass it only for plain cacheable single-chip statements."""
         if getattr(ast, "ctes", None):
             from .recursive import recursive_cte_of, run_recursive
 
@@ -231,7 +368,7 @@ class Session:
         # (sql/json_host.py); the spec joins the cache key — same
         # normalized text with different constructor literals must not
         # share an entry
-        from ..sql.json_host import apply_host_json, split_host_json
+        from ..sql.json_host import split_host_json
 
         try:
             ast, jspecs, jhidden = split_host_json(ast)
@@ -245,7 +382,7 @@ class Session:
         t0 = time.perf_counter()
         planned = self.planner.plan(ast)
         pz = parameterize(planned.plan)
-        key = self._cache_key(norm_key, pz, executor)
+        key, tables, fp = self._key_parts(norm_key, pz, executor)
         plan_s = time.perf_counter() - t0
         if use_cache is None:
             use_cache = self.cache_enabled_fn() if self.cache_enabled_fn else True
@@ -265,21 +402,75 @@ class Session:
                 entry.monitor = self.plan_monitor.register(norm_key, compile_s)
             if use_cache:
                 self.plan_cache.put(key, entry)
-        retries0 = getattr(entry.prepared, "retries", 0)
+        rs = self._execute_entry(
+            entry, pz.values, ex=ex, was_hit=was_hit, fast=False,
+            plan_s=plan_s, compile_s=compile_s, fastparse_s=fastparse_s,
+            profiling=profiling, h2d0=h2d0, plan_obj=pz.plan,
+        )
+        # text-tier registration AFTER a successful execution: one entry
+        # per kind-marked normalized text, carrying the logical key parts
+        # + token->slot accounting. PX overrides, JSON-split statements
+        # and cache-bypassed (virtual-table) statements never register.
+        if fast_reg is not None and use_cache and executor is None \
+                and not jspecs:
+            fkey, params, kinds = fast_reg
+            self.plan_cache.fast_put(fkey, FastEntry(
+                norm_key=norm_key, sig=pz.sig, baked=pz.baked,
+                fingerprint=fp, tables=tables,
+                slot_map=build_slot_map(params, kinds, pz.values),
+                base_values=tuple(pz.values),
+                stmt_type=type(ast).__name__,
+            ))
+        return rs
+
+    def _execute_entry(self, entry, values, *, ex, was_hit, fast, plan_s,
+                       compile_s, fastparse_s, profiling, h2d0,
+                       plan_obj) -> ResultSet:
+        """Bind + dispatch a cached/compiled entry and assemble the
+        ResultSet, profile, monitor row, phase breakdown and metrics.
+        Shared by the full path (run_ast) and the fast path
+        (fast_execute) — the fast path arrives with plan_s=compile_s=0.
+
+        Single-chip plans take the LAZY route: dispatch is async
+        (PreparedPlan.run_device returns device references immediately),
+        sql_audit/metrics/trace host work overlaps device compute, and the
+        only in-statement sync is the overflow-counter + row-count fetch.
+        Column data stays device-resident behind the DeviceResult cursor
+        until the caller touches it."""
+        from ..sql.json_host import apply_host_json
+
+        jn = getattr(entry, "json_specs", ())
+        prepared = entry.prepared
+        retries0 = getattr(prepared, "retries", 0)
+        t0 = time.perf_counter()
+        if hasattr(prepared, "run_host"):
+            # packed parameter upload: ONE host->device transfer for the
+            # whole parameter set
+            qparams = prepared.bind(values, entry.dtypes)
+        else:
+            # chunked / PX prepared plans: legacy tuple contract
+            qparams = bind(values, entry.dtypes)
+        bind_s = time.perf_counter() - t0
         d2h_bytes = 0
+        fetch_s = 0.0
         exec_t0 = time.perf_counter()
-        if hasattr(entry.prepared, "run_host"):
-            # packed parameter upload + single-device_get dispatch: ONE
-            # host->device transfer for the whole parameter set, ONE
-            # device->host fetch for results + validity + sel + overflow
-            # counters (per-array fetches each cost a tunnel roundtrip)
+        lazy = hasattr(prepared, "run_device") and not jn
+        if lazy:
+            from .executor import DeviceResult
+
+            out, ovf_vec = prepared.run_device(qparams=qparams)
+            dispatch_s = time.perf_counter() - exec_t0
+            cursor = DeviceResult(prepared, qparams, out, ovf_vec)
+            rs = LazyResultSet(entry.output_names, cursor,
+                               plan_cache_hit=was_hit, fast_path_hit=fast)
+        elif hasattr(prepared, "run_host"):
+            # eager single-device_get dispatch (kept for JSON-split
+            # statements whose host formatting needs every column anyway)
             from ..core.column import host_rows
 
-            qparams = entry.prepared.bind(pz.values, entry.dtypes)
-            t0 = time.perf_counter()
-            hcols, hvalid, hsel, oschema, odicts = entry.prepared.run_host(
+            hcols, hvalid, hsel, oschema, odicts = prepared.run_host(
                 qparams=qparams)
-            exec_s = time.perf_counter() - t0
+            dispatch_s = time.perf_counter() - exec_t0
             if profiling:
                 d2h_bytes = sum(
                     int(getattr(a, "nbytes", 0))
@@ -287,41 +478,67 @@ class Session:
                     for a in d.values()
                 ) + int(getattr(hsel, "nbytes", 0))
             host = host_rows(oschema, odicts, hcols, hvalid, hsel)
+            rs = None
         else:
             # chunked / PX prepared plans: device-batch contract
-            qparams = bind(pz.values, entry.dtypes)
-            t0 = time.perf_counter()
-            out_batch = entry.prepared.run(qparams=qparams)
-            exec_s = time.perf_counter() - t0
+            out_batch = prepared.run(qparams=qparams)
+            dispatch_s = time.perf_counter() - exec_t0
             host = batch_to_host(out_batch)
             if profiling:
                 d2h_bytes = sum(
                     int(getattr(a, "nbytes", 0)) for a in host.values()
                 )
-        self._emit_px_spans(entry.prepared, exec_t0, time.perf_counter())
-        # order columns per select list
-        cols = {n: host[n] for n in entry.output_names}
-        out_names = entry.output_names
-        jn = getattr(entry, "json_specs", ())
-        if jn:
-            out_names, cols = apply_host_json(
-                jn, entry.json_hidden, out_names, cols)
-        rs = ResultSet(out_names, cols, plan_cache_hit=was_hit)
+            rs = None
+        self._emit_px_spans(prepared, exec_t0, time.perf_counter())
+        if rs is None:
+            # order columns per select list
+            cols = {n: host[n] for n in entry.output_names}
+            out_names = entry.output_names
+            if jn:
+                out_names, cols = apply_host_json(
+                    jn, entry.json_hidden, out_names, cols)
+            rs = ResultSet(out_names, cols, plan_cache_hit=was_hit,
+                           fast_path_hit=fast)
         profile = None
         if profiling:
             from ..server.diag import QueryProfile
 
             device_bytes = 0
-            input_spec = getattr(entry.prepared, "input_spec", None)
+            input_spec = getattr(prepared, "input_spec", None)
             if input_spec is not None:
-                device_bytes = ex.input_device_bytes(input_spec)
+                # warm statements reuse the footprint walk: device inputs
+                # only change via an upload, and every upload moves the
+                # executor's lifetime h2d counter (serving-path diet)
+                memo = getattr(prepared, "_dev_bytes_memo", None)
+                if (memo is not None and memo[0] == ex.h2d_bytes
+                        and memo[1] is ex):
+                    device_bytes = memo[2]
+                else:
+                    device_bytes = ex.input_device_bytes(input_spec)
+                    prepared._dev_bytes_memo = (
+                        ex.h2d_bytes, ex, device_bytes)
+            if lazy:
+                # result footprint measured on-device (no transfer): the
+                # cursor adds actual d2h bytes as fetches happen. Output
+                # shapes are static per compiled executable, so warm
+                # statements reuse the walk (invalidated by a recompile)
+                rmemo = getattr(prepared, "_result_bytes_memo", None)
+                if rmemo is not None and rmemo[0] == retries0:
+                    result_bytes = rmemo[1]
+                else:
+                    result_bytes = sum(
+                        int(getattr(a, "nbytes", 0))
+                        for d in (out.cols, out.valid) for a in d.values()
+                    ) + int(getattr(out.sel, "nbytes", 0))
+                    prepared._result_bytes_memo = (retries0, result_bytes)
+            else:
+                result_bytes = d2h_bytes
             # peak working set: device-resident inputs + the result's
             # footprint + PX exchange lane capacity (the collective's
             # buffers are live simultaneously with both)
-            peak = device_bytes + d2h_bytes
-            for _kind, ncols, cap in getattr(entry.prepared, "px_exchanges",
-                                             ()):
-                nsh = getattr(entry.prepared, "px_nsh", 1)
+            peak = device_bytes + result_bytes
+            for _kind, ncols, cap in getattr(prepared, "px_exchanges", ()):
+                nsh = getattr(prepared, "px_nsh", 1)
                 lanes = nsh if _kind == "broadcast" else nsh * nsh
                 peak += ncols * cap * lanes * 8
             profile = QueryProfile(
@@ -331,31 +548,51 @@ class Session:
                 d2h_bytes=d2h_bytes,
                 device_bytes=device_bytes,
                 peak_bytes=peak,
+                fastparse_s=fastparse_s,
+                bind_s=bind_s,
+                dispatch_s=dispatch_s,
+                fetch_s=fetch_s,
+                fast_path_hit=fast,
             )
         self.last_profile = profile
-        self.last_plan = pz.plan
+        self.last_plan = plan_obj
+        phases = {
+            "plan_s": plan_s, "compile_s": compile_s,
+            "fastparse_s": fastparse_s, "bind_s": bind_s,
+            "dispatch_s": dispatch_s, "fetch_s": fetch_s,
+            "cache_hit": was_hit, "fast_hit": fast,
+        }
+        self.last_phases = phases
+        if lazy:
+            # wire the in-place observability sinks, THEN force the sync
+            # point: the overflow check + row count (two scalars). All the
+            # host work above overlapped device compute.
+            cursor.profile = profile
+            cursor.phases = phases
+            nrows = rs.nrows
+        else:
+            nrows = rs.nrows
+        exec_s = time.perf_counter() - exec_t0
+        phases["exec_s"] = exec_s
+        phases["rows"] = nrows
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1
             mon.total_exec_s += exec_s
-            mon.last_rows = rs.nrows
-            mon.overflow_retries = entry.prepared.retries
+            mon.last_rows = nrows
+            mon.overflow_retries = getattr(prepared, "retries", 0)
             if profile is not None:
                 mon.total_transfer_bytes += profile.transfer_bytes
                 mon.last_device_bytes = profile.device_bytes
                 mon.peak_bytes = max(mon.peak_bytes, profile.peak_bytes)
-        self.last_phases = {
-            "plan_s": plan_s, "compile_s": compile_s, "exec_s": exec_s,
-            "cache_hit": was_hit, "rows": rs.nrows,
-        }
         m = self.metrics
         if m is not None and m.enabled:
             m.observe("sql plan", plan_s)
             if not was_hit:
                 m.observe("sql compile", compile_s)
             m.observe("sql execute", exec_s)
-            m.add("result rows returned", rs.nrows)
-            retries = getattr(entry.prepared, "retries", 0) - retries0
+            m.add("result rows returned", nrows)
+            retries = getattr(prepared, "retries", 0) - retries0
             if retries > 0:
                 m.add("overflow recompiles", retries)
         return rs

@@ -1700,36 +1700,47 @@ class DbSession:
                     raise
                 finally:
                     elapsed_s = _time.perf_counter() - t0
-                    m = db.metrics
-                    m.add("sql statements")
                     stype = self._last_stmt_type or "Unknown"
-                    if stype in ("Select", "SetSelect"):
-                        m.add("sql select count")
-                    elif stype in ("Insert", "Update", "Delete"):
-                        m.add("sql dml count")
-                    if err:
-                        m.add("sql fail count")
-                    m.observe("sql response time", elapsed_s)
+                    m = db.metrics
+                    # hot-path diet: when metrics/audit are disabled, skip
+                    # even the counter lookups and kwargs construction —
+                    # the serving path pays zero for observability it
+                    # isn't using
+                    if m.enabled:
+                        m.add("sql statements")
+                        if stype in ("Select", "SetSelect"):
+                            m.add("sql select count")
+                        elif stype in ("Insert", "Update", "Delete"):
+                            m.add("sql dml count")
+                        if err:
+                            m.add("sql fail count")
+                        m.observe("sql response time", elapsed_s)
                     prof = db.engine.last_profile
-                    pd = prof.as_dict() if prof is not None else {}
-                    db.audit.record(
-                        session_id=self.session_id,
-                        trace_id=sp.trace_id,
-                        sql=text,
-                        stmt_type=self._last_stmt_type,
-                        elapsed_s=elapsed_s,
-                        rows=rs.nrows if rs is not None else 0,
-                        affected=rs.affected if rs is not None else 0,
-                        plan_cache_hit=(rs.plan_cache_hit
-                                        if rs is not None else False),
-                        error=err,
-                        compile_s=prof.compile_s if prof else 0.0,
-                        device_bytes=pd.get("device_bytes", 0),
-                        transfer_bytes=pd.get("transfer_bytes", 0),
-                        peak_bytes=pd.get("peak_bytes", 0),
-                        retry_cnt=ctrl.retry_cnt,
-                        retry_info=ctrl.retry_info,
-                    )
+                    if db.audit.enabled:
+                        p = prof
+                        db.audit.record(
+                            session_id=self.session_id,
+                            trace_id=sp.trace_id,
+                            sql=text,
+                            stmt_type=self._last_stmt_type,
+                            elapsed_s=elapsed_s,
+                            rows=rs.nrows if rs is not None else 0,
+                            affected=rs.affected if rs is not None else 0,
+                            plan_cache_hit=(rs.plan_cache_hit
+                                            if rs is not None else False),
+                            error=err,
+                            compile_s=p.compile_s if p else 0.0,
+                            device_bytes=p.device_bytes if p else 0,
+                            transfer_bytes=p.transfer_bytes if p else 0,
+                            peak_bytes=p.peak_bytes if p else 0,
+                            retry_cnt=ctrl.retry_cnt,
+                            retry_info=ctrl.retry_info,
+                            fastparse_us=int(p.fastparse_s * 1e6) if p else 0,
+                            bind_us=int(p.bind_s * 1e6) if p else 0,
+                            dispatch_us=int(p.dispatch_s * 1e6) if p else 0,
+                            fetch_us=int(p.fetch_s * 1e6) if p else 0,
+                            is_fast_path=bool(p.fast_path_hit) if p else False,
+                        )
                     if stype not in ("Show", "SetVar", ""):
                         if self._vars.get("ob_enable_show_trace"):
                             self._last_trace_id = sp.trace_id
@@ -1989,6 +2000,16 @@ class DbSession:
             return self._explain(text.lstrip()[len("explain"):].lstrip())
         import time as _time
 
+        # statement fast path: a warm SELECT whose kind-marked text key is
+        # registered skips parse/resolve/rewrite/plan entirely — one
+        # tokenize pass, re-bind the literals, dispatch the cached
+        # executable. Any rejection falls through to the full path (and
+        # leaves self._fast_reg set so the full path registers the text).
+        self._fast_reg = None
+        if low.startswith("select"):
+            rs = self._fast_select(text)
+            if rs is not None:
+                return rs
         tp = _time.perf_counter()
         stmt = P.parse_statement(text)
         self.db.metrics.observe("sql parse", _time.perf_counter() - tp)
@@ -1997,7 +2018,61 @@ class DbSession:
         # values or write node meta
         self._check_privs(stmt)
         stmt = self._bind_sequences(stmt)
-        return self._dispatch_stmt(stmt, P.normalize_for_cache(text)[0])
+        if self._fast_reg is not None:
+            # the plain plan-cache key is the fast key with kind markers
+            # collapsed (the tokenizer never emits a bare '?')
+            norm_key = self._fast_reg[0].replace("?n", "?").replace("?s", "?")
+        else:
+            norm_key = P.normalize_for_cache(text)[0]
+        return self._dispatch_stmt(stmt, norm_key, fast_reg=self._fast_reg)
+
+    def _fast_select(self, text: str) -> "ResultSet | None":
+        """Server half of the statement fast path. Eligibility mirrors the
+        plain single-chip _select route: autocommit (no open tx), no PX
+        DOP, and — via registration-side guards — no virtual tables, views
+        or index routing. Privileges re-check against the registered scan
+        tables on EVERY hit (a REVOKE between repeats must bite), and the
+        per-table catalog refresh runs as usual (it no-ops per table while
+        data_version is unchanged, which is what makes the path cheap).
+        Returns None to fall through to the full parse path."""
+        import time as _time
+
+        db = self.db
+        if self._tx is not None or self._vars.get("ob_px_dop", 0) > 0:
+            return None
+        t0 = _time.perf_counter()
+        try:
+            fkey, params, kinds = P.fast_normalize(text)
+        except Exception:
+            return None  # tokenizer rejects: the full parser owns the error
+        if "nextval" in fkey or "currval" in fkey:
+            # sequence draws are side-effecting: _bind_sequences rewrites
+            # them into fresh literals pre-resolution, which a text-keyed
+            # replay would freeze. Never serve OR register these.
+            return None
+        self._fast_reg = (fkey, params, kinds)
+        fe = db.plan_cache.fast_peek(fkey)
+        if fe is None:
+            db.plan_cache.note_fast_miss()
+            return None
+        if self.user != "root":
+            from ..share.privilege import AccessDenied
+
+            try:
+                db.privileges.check(self.user, "select", set(fe.tables))
+            except AccessDenied as e:
+                raise SqlError(str(e), code=e.code) from None
+        db.refresh_catalog(fe.tables, tx=None)
+        hit = db.engine.fast_lookup(fkey, params)
+        if hit is None:
+            return None
+        # set BEFORE execute: the audit record and the retry controller's
+        # retryability decision both read it if dispatch raises
+        self._last_stmt_type = fe.stmt_type
+        rs = db.engine.fast_execute(
+            hit, fastparse_s=_time.perf_counter() - t0)
+        self._stmt_cache_hit = True
+        return rs
 
     def _sequence_ddl(self, text: str) -> ResultSet:
         from ..share.privilege import AccessDenied
@@ -2094,11 +2169,11 @@ class DbSession:
 
         return rw(stmt)
 
-    def _dispatch_stmt(self, stmt, norm_key: str) -> ResultSet:
+    def _dispatch_stmt(self, stmt, norm_key: str, fast_reg=None) -> ResultSet:
         if isinstance(stmt, (A.CreateUser, A.DropUser, A.Grant, A.Revoke)):
             return self._dcl(stmt)
         if isinstance(stmt, (A.Select, A.SetSelect)):
-            return self._select(stmt, norm_key)
+            return self._select(stmt, norm_key, fast_reg=fast_reg)
         if isinstance(stmt, A.CreateTable):
             self.db.create_table(stmt)
             return ResultSet((), {})
@@ -2848,11 +2923,13 @@ class DbSession:
             used_idx.reads += 1
         return {tref.name: Table(tref.name, ti.schema, data, dicts)}
 
-    def _select(self, ast: A.Select, norm_key: str) -> ResultSet:
+    def _select(self, ast: A.Select, norm_key: str, fast_reg=None
+                ) -> ResultSet:
         fb = _flashback_refs(ast)
         if fb:
             return self._select_flashback(ast, fb)
-        names = self.db.expand_views(_tables_in_ast(ast))
+        raw_names = _tables_in_ast(ast)
+        names = self.db.expand_views(set(raw_names))
         any_vt = self.db.refresh_virtual(names)
         route = None
         if self._tx is None and not any_vt and isinstance(ast, A.Select):
@@ -2879,6 +2956,13 @@ class DbSession:
             # whole distributed execution, released in the finally below
             px_granted = self._px_admit(self._vars["ob_px_dop"])
             px = self.db._px_executor()
+        # fast-tier registration only from the plain route: no virtual
+        # tables (use_cache is off anyway), no open tx (tx-private views
+        # would leak across sessions), no PX (the compiled plan differs),
+        # and no view expansion (the scan tables a fast hit privilege-
+        # checks would diverge from what the user was granted)
+        reg = (fast_reg if px is None and not any_vt and not in_tx
+               and names == raw_names else None)
         try:
             with self.db.catalog.tx_scope(views):
                 try:
@@ -2886,6 +2970,7 @@ class DbSession:
                         ast, norm_key,
                         use_cache=False if any_vt else None,
                         executor=px,
+                        fast_reg=reg,
                     )
                 except Exception:
                     if px is None:

@@ -89,20 +89,22 @@ class Tracer:
             trace_id, parent_id = int(ctx[0]), int(ctx[1])
         else:
             trace_id, parent_id = next(self._ids), 0
+        # the span goes on the stack even when disabled: nested spans must
+        # inherit the parent's trace_id either way, or callers that stash
+        # current_trace_id() get ids that differ by flag state. Only the
+        # RING write (the allocation that costs memory) is gated — and on
+        # the disabled path the clock reads and the tag-dict copy go too
+        # (hot-path overhead diet: a disabled span is id bookkeeping only).
+        record = self.enabled
         s = Span(
             trace_id=trace_id,
             span_id=next(self._ids),
             parent_id=parent_id,
             name=name,
-            start=self._clock(),
-            tags=dict(tags),
+            start=self._clock() if record else 0.0,
+            tags=dict(tags) if record else tags,
             clock=self._clock,
         )
-        # the span goes on the stack even when disabled: nested spans must
-        # inherit the parent's trace_id either way, or callers that stash
-        # current_trace_id() get ids that differ by flag state. Only the
-        # RING write (the allocation that costs memory) is gated.
-        record = self.enabled
         st.append(s)
         try:
             yield s
@@ -112,7 +114,8 @@ class Tracer:
             s.tags["error"] = repr(exc)
             raise
         finally:
-            s.end = self._clock()
+            if record:
+                s.end = self._clock()
             st.pop()
             if record:
                 with self._lock:
@@ -206,6 +209,15 @@ class AuditRecord:
     # statement was transparently redriven and why ("reason xN; ...")
     retry_cnt: int = 0
     retry_info: str = ""
+    # statement fast path: serving-phase breakdown at record time. For a
+    # lazy result set fetch_us covers only the completion sync (ovf+nrows);
+    # column transfers the client performs later accrue to the in-place
+    # QueryProfile, not to this snapshot.
+    fastparse_us: int = 0
+    bind_us: int = 0
+    dispatch_us: int = 0
+    fetch_us: int = 0
+    is_fast_path: bool = False
 
 
 class SqlAudit:
@@ -371,9 +383,17 @@ class QueryProfile:
     compile_hit: bool = False  # plan cache served the XLA executable
     compile_s: float = 0.0  # trace + XLA compile seconds (0 on hit)
     h2d_bytes: int = 0  # host->device: new batch uploads + parameters
-    d2h_bytes: int = 0  # device->host: result columns/validity/sel fetch
+    d2h_bytes: int = 0  # device->host: bytes ACTUALLY fetched (lazy
+    # results grow this in place as the cursor transfers columns)
     device_bytes: int = 0  # device-resident input + output footprint
     peak_bytes: int = 0  # working-set estimate (inputs+outputs+exchanges)
+    # serving-path phase breakdown (statement fast path): where the host
+    # microseconds go once the kernel is no longer the bottleneck
+    fastparse_s: float = 0.0  # tokenize + text-tier lookup + literal bind
+    bind_s: float = 0.0  # parameter pack (one int64 vector upload)
+    dispatch_s: float = 0.0  # async XLA dispatch (enqueue, no sync)
+    fetch_s: float = 0.0  # device->host syncs: ovf/nrows + column fetches
+    fast_path_hit: bool = False  # statement skipped parse/resolve/plan
 
     @property
     def transfer_bytes(self) -> int:
@@ -388,6 +408,11 @@ class QueryProfile:
             "transfer_bytes": self.transfer_bytes,
             "device_bytes": self.device_bytes,
             "peak_bytes": self.peak_bytes,
+            "fastparse_us": int(self.fastparse_s * 1e6),
+            "bind_us": int(self.bind_s * 1e6),
+            "dispatch_us": int(self.dispatch_s * 1e6),
+            "fetch_us": int(self.fetch_s * 1e6),
+            "is_fast_path": self.fast_path_hit,
         }
 
 

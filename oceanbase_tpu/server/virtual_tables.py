@@ -82,6 +82,15 @@ def _sql_audit(db) -> Table:
         # statement retry controller: redrive count + classified reasons
         ("retry_cnt", DataType.int64(), [r.retry_cnt for r in recs]),
         ("retry_info", DataType.varchar(), [r.retry_info for r in recs]),
+        # statement fast path: serving-phase breakdown (fastparse = the
+        # literal-extracting tokenizer, bind = literal re-bind + qparam
+        # pack, dispatch = async XLA enqueue, fetch = completion sync)
+        ("fastparse_us", DataType.int64(), [r.fastparse_us for r in recs]),
+        ("bind_us", DataType.int64(), [r.bind_us for r in recs]),
+        ("dispatch_us", DataType.int64(), [r.dispatch_us for r in recs]),
+        ("fetch_us", DataType.int64(), [r.fetch_us for r in recs]),
+        ("is_fast_path", DataType.int32(),
+         [int(r.is_fast_path) for r in recs]),
     ])
 
 

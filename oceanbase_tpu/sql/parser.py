@@ -11,6 +11,7 @@ normalize_for_cache) — the fast-parser analog.
 from __future__ import annotations
 
 import re
+from collections import OrderedDict
 
 from . import ast as A
 
@@ -101,6 +102,49 @@ def normalize_for_cache(sql: str) -> tuple[str, tuple]:
         else:
             parts.append(t.value)
     return " ".join(parts), tuple(params)
+
+
+# raw-text memo in front of the tokenizer: serving workloads repeat EXACT
+# statement texts (the reference's plan cache is keyed on raw text first),
+# and the result is a pure function of the text. Bounded LRU.
+_FAST_NORM_MEMO: "OrderedDict[str, tuple]" = OrderedDict()
+_FAST_NORM_CAP = 4096
+
+
+def fast_normalize(sql: str) -> tuple[str, tuple, tuple]:
+    """One tokenize pass producing everything the text-keyed fast tier
+    needs: a KIND-marked normalized text (?n for numbers, ?s for strings
+    — `a = 5` and `a = '5'` plan differently and must not share a text
+    entry), the raw literal token texts in order, and their kinds.
+
+    The plain plan-cache key is recoverable without re-tokenizing:
+    normalize_for_cache's text is this text with ?n/?s collapsed to ?
+    (the tokenizer never emits a bare '?', so the rewrite is unambiguous).
+    """
+    hit = _FAST_NORM_MEMO.get(sql)
+    if hit is not None:
+        _FAST_NORM_MEMO.move_to_end(sql)
+        return hit
+    toks = tokenize(sql)
+    parts, params, kinds = [], [], []
+    for t in toks:
+        if t.kind == "num":
+            parts.append("?n")
+            params.append(t.value)
+            kinds.append("num")
+        elif t.kind == "str":
+            parts.append("?s")
+            params.append(t.value)
+            kinds.append("str")
+        elif t.kind == "eof":
+            break
+        else:
+            parts.append(t.value)
+    out = (" ".join(parts), tuple(params), tuple(kinds))
+    _FAST_NORM_MEMO[sql] = out
+    if len(_FAST_NORM_MEMO) > _FAST_NORM_CAP:
+        _FAST_NORM_MEMO.popitem(last=False)
+    return out
 
 
 class Parser:

@@ -26,8 +26,11 @@ because the dominant cost is one XLA executable per entry).
 
 from __future__ import annotations
 
+import re
 from collections import OrderedDict
 from dataclasses import dataclass, field, replace as dc_replace
+
+import numpy as np
 
 from ..core.dtypes import TypeKind
 from ..expr import ir as E
@@ -274,6 +277,137 @@ def bind(values, dtypes) -> tuple:
     )
 
 
+# ---- text-keyed fast tier (the ObPlanCache fast-parser front end) ----------
+#
+# The logical cache above still pays parse + resolve + rewrite + plan +
+# parameterize on every statement just to COMPUTE its key. The fast tier
+# keys on the kind-marked normalized text alone (parser.fast_normalize, one
+# regex pass) and stores everything needed to rebuild the logical key
+# without planning: the parameter signature, baked literals, plan
+# fingerprint and referenced tables. A fast hit therefore still goes
+# through PlanCache.get() with a freshly computed key_extra — schema-version
+# bumps, flush() and LRU eviction of the logical entry all invalidate the
+# fast path with no extra bookkeeping.
+#
+# Correctness of literal re-binding rests on token accounting built at
+# registration time: every literal token of the statement is either
+#   - mapped to exactly one parameter slot whose registered value provably
+#     round-trips from the token text through one recorded converter
+#     (int / float / date), with the slot matched by no other token, or
+#   - marked BAKED: the raw token text must match the registration text
+#     exactly on every fast hit (strings, IN-list members, LIMIT counts,
+#     planner-folded literals like date + interval — anything whose value
+#     the planner consumed rather than slotted).
+# Any ambiguity (duplicate values, a token matching two slots, a folded
+# slot colliding with a token) degrades to BAKED, never to a guess: a
+# mismatch falls back to the full parse path, which is always correct.
+
+_DATE_TOK_RE = re.compile(r"\d{4}-\d{2}-\d{2}$")
+
+
+def _tok_candidate(tok: str, kind: str):
+    """The (converter_tag, value) the slow path would produce for this
+    literal token, or None. Mirrors sql/logical.py exactly: a num token
+    types int unless it contains '.', a quoted YYYY-MM-DD behind DATE
+    becomes epoch days."""
+    try:
+        if kind == "num":
+            if "." in tok:
+                return ("float", float(tok))
+            return ("int", int(tok))
+        if _DATE_TOK_RE.match(tok):
+            return ("date", int(np.datetime64(tok, "D").astype(np.int64)))
+    except ValueError:
+        pass
+    return None
+
+
+def _convert_token(tok: str, tag: str):
+    """Re-apply a recorded converter to a NEW token text. Returns the
+    bound value or None when the token no longer fits the registered
+    typing (dtype widening '5' -> '5.5', malformed dates) — the caller
+    falls back to the full parse path and a separate plan entry."""
+    try:
+        if tag == "int":
+            return int(tok)  # raises on '5.5': widening is a fast miss
+        if tag == "float":
+            if "." not in tok:
+                return None  # would have typed int: different signature
+            return float(tok)
+        if tag == "date":
+            if not _DATE_TOK_RE.match(tok):
+                return None
+            return int(np.datetime64(tok, "D").astype(np.int64))
+    except ValueError:
+        return None
+    return None
+
+
+def build_slot_map(params: tuple, kinds: tuple, values: list) -> tuple:
+    """Token accounting for one registered statement: per literal token,
+    ("slot", slot_idx, converter_tag) when the token<->slot correspondence
+    is unambiguous, else ("baked", raw_token_text)."""
+    cands = [_tok_candidate(t, k) for t, k in zip(params, kinds)]
+    tok_edges: list[list[int]] = [[] for _ in params]
+    slot_edges: list[list[tuple[int, str]]] = [[] for _ in values]
+    for i, c in enumerate(cands):
+        if c is None:
+            continue
+        tag, cv = c
+        for j, v in enumerate(values):
+            # exact-type equality: an int token must not cross-bind a
+            # float slot (or epoch-day ints a same-valued INT slot — the
+            # bipartite uniqueness check below catches that collision)
+            if type(cv) is type(v) and cv == v:
+                tok_edges[i].append(j)
+                slot_edges[j].append((i, tag))
+    out = []
+    for i, tok in enumerate(params):
+        es = tok_edges[i]
+        if len(es) == 1 and len(slot_edges[es[0]]) == 1:
+            out.append(("slot", es[0], slot_edges[es[0]][0][1]))
+        else:
+            out.append(("baked", tok))
+    return tuple(out)
+
+
+@dataclass
+class FastEntry:
+    """One text-tier entry: the material to rebuild the LOGICAL cache key
+    (norm_key/sig/baked/fingerprint + referenced tables for key_extra)
+    plus the token->slot accounting that re-binds literals without
+    parsing. Holds no compiled artifact — the executable stays owned by
+    the logical tier, so eviction/flush there invalidates here for free."""
+
+    norm_key: str
+    sig: tuple
+    baked: tuple
+    fingerprint: str
+    tables: tuple[str, ...]
+    slot_map: tuple
+    base_values: tuple  # registration-time slot values (fixed slots replay)
+    stmt_type: str = "Select"
+    hits: int = 0
+
+    def bind_tokens(self, params: tuple) -> list | None:
+        """Slot values for a repeat statement's raw literal tokens, or
+        None when any baked token differs / any converter rejects —
+        the caller takes the full parse path."""
+        if len(params) != len(self.slot_map):
+            return None
+        vals = list(self.base_values)
+        for tok, m in zip(params, self.slot_map):
+            if m[0] == "baked":
+                if tok != m[1]:
+                    return None
+            else:
+                v = _convert_token(tok, m[2])
+                if v is None:
+                    return None
+                vals[m[1]] = v
+        return vals
+
+
 @dataclass
 class CacheEntry:
     prepared: object  # engine.executor.PreparedPlan
@@ -288,11 +422,21 @@ class PlanCacheStats:
     hits: int = 0
     misses: int = 0
     evictions: int = 0
+    # text-keyed fast tier (fast-parser front end)
+    fast_hits: int = 0
+    fast_misses: int = 0
+    fast_evictions: int = 0
+    fast_invalidations: int = 0
 
     @property
     def hit_rate(self) -> float:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
+
+    @property
+    def fast_hit_rate(self) -> float:
+        total = self.fast_hits + self.fast_misses
+        return self.fast_hits / total if total else 0.0
 
 
 class PlanCache:
@@ -302,6 +446,15 @@ class PlanCache:
     def __init__(self, capacity: int = 128, metrics=None):
         self.capacity = capacity
         self._entries: OrderedDict[tuple, CacheEntry] = OrderedDict()
+        # text tier: kind-marked normalized text -> FastEntry. Same
+        # capacity: a FastEntry is tiny next to the XLA executable its
+        # logical entry holds, and a text entry whose logical entry was
+        # evicted self-invalidates on its next hit anyway.
+        self._fast: OrderedDict[str, FastEntry] = OrderedDict()
+        # A/B switch (latency_bench --no-fastpath, tests): disabled means
+        # lookups miss and registrations drop; the logical tier is
+        # untouched so only the text tier's contribution is isolated
+        self.fast_enabled = True
         self.stats = PlanCacheStats()
         # tenant metrics registry (share/metrics): mirrors hit/miss/evict
         # into __all_virtual_sysstat next to every other engine stat
@@ -310,7 +463,7 @@ class PlanCache:
     def __len__(self):
         return len(self._entries)
 
-    def get(self, key: tuple) -> CacheEntry | None:
+    def get(self, key: tuple, count_miss: bool = True) -> CacheEntry | None:
         ent = self._entries.get(key)
         if ent is not None:
             self._entries.move_to_end(key)
@@ -318,7 +471,7 @@ class PlanCache:
             self.stats.hits += 1
             if self.metrics is not None:
                 self.metrics.add("plan cache hit")
-        else:
+        elif count_miss:
             self.stats.misses += 1
             if self.metrics is not None:
                 self.metrics.add("plan cache miss")
@@ -333,5 +486,57 @@ class PlanCache:
             if self.metrics is not None:
                 self.metrics.add("plan cache eviction")
 
+    # ---- text tier -------------------------------------------------------
+    def fast_peek(self, text_key: str) -> FastEntry | None:
+        """Text-tier lookup WITHOUT hit/miss accounting: a peeked entry
+        still has to survive literal re-binding and the logical-tier get
+        before it counts as a hit (Session.fast_lookup does the counting,
+        so a bind mismatch is honestly a miss)."""
+        if not self.fast_enabled:
+            return None
+        ent = self._fast.get(text_key)
+        if ent is not None:
+            self._fast.move_to_end(text_key)
+        return ent
+
+    def note_fast_hit(self) -> None:
+        self.stats.fast_hits += 1
+        if self.metrics is not None:
+            self.metrics.add("plan cache fast hit")
+
+    def note_fast_miss(self) -> None:
+        self.stats.fast_misses += 1
+        if self.metrics is not None:
+            self.metrics.add("plan cache fast miss")
+
+    def fast_put(self, text_key: str, entry: FastEntry) -> None:
+        if not self.fast_enabled:
+            return
+        self._fast[text_key] = entry
+        self._fast.move_to_end(text_key)
+        while len(self._fast) > self.capacity:
+            self._fast.popitem(last=False)
+            self.stats.fast_evictions += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache fast eviction")
+
+    def fast_invalidate(self, text_key: str) -> None:
+        """Drop one stale text entry (its logical entry vanished, or a
+        fast execution failed) — the next occurrence re-registers."""
+        if self._fast.pop(text_key, None) is not None:
+            self.stats.fast_invalidations += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache fast invalidation")
+
     def flush(self):
+        """Flush BOTH tiers. Retry policies with flush_plan_cache
+        (OB_SCHEMA_EAGAIN), DDL-driven invalidation and ALTER SYSTEM all
+        land here — a text entry surviving a flush would replay a plan
+        compiled against a dead schema."""
         self._entries.clear()
+        if self._fast:
+            self.stats.fast_invalidations += len(self._fast)
+            if self.metrics is not None:
+                self.metrics.add(
+                    "plan cache fast invalidation", len(self._fast))
+            self._fast.clear()

@@ -1,0 +1,338 @@
+"""Statement fast path: text-keyed plan-cache tier + lazy device results.
+
+Covers the serving-path contract end to end:
+- literal re-binding across repeats (ints, floats, dates, strings,
+  dtype widening, NULL-bearing statements, escaped strings);
+- non-cacheable statements (DDL, SET, virtual tables, transactions, PX)
+  bypass the tier;
+- capacity eviction, flush() clearing BOTH tiers, DDL invalidation;
+- the retry-policy regression: a flush_plan_cache retry (schema version
+  mismatch) must never replay a stale text entry on the redrive;
+- privileges re-checked on every fast hit (REVOKE bites a warm entry);
+- lazy results: correct rows under LIMIT, correct full materialization.
+
+NOTE tests/test_fastpath.py covers JOIN algorithm fast paths (unrelated).
+"""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.engine.session import Session
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.share import retry as R
+from oceanbase_tpu.sql.plan_cache import PlanCache, build_slot_map
+from oceanbase_tpu.sql import parser as P
+
+
+# ------------------------------------------------------------ unit: slot map
+
+
+def test_fast_normalize_kind_markers():
+    k1, p1, t1 = P.fast_normalize("select a from t where a = 5")
+    k2, p2, t2 = P.fast_normalize("select a from t where a = '5'")
+    assert k1 != k2  # a=5 and a='5' must never share a text entry
+    assert "?n" in k1 and "?s" in k2
+    assert p1 == ("5",) and p2 == ("5",)
+    assert t1 == ("num",) and t2 == ("str",)
+    # plain plan-cache key is recoverable by collapsing the markers
+    assert k1.replace("?n", "?").replace("?s", "?") == \
+        P.normalize_for_cache("select a from t where a = 5")[0]
+
+
+def test_slot_map_unique_values_map_to_slots():
+    # registration values: exactly the parameterized literals, distinct
+    slot_map = build_slot_map(("5", "1.5"), ("num", "num"), [5, 1.5])
+    assert slot_map[0][0] == "slot" and slot_map[1][0] == "slot"
+
+
+def test_slot_map_ambiguous_values_bake():
+    # the same value appears in two slots: exact-text match required
+    slot_map = build_slot_map(("5", "5"), ("num", "num"), [5, 5])
+    assert all(s[0] == "baked" for s in slot_map)
+
+
+def test_slot_map_int_converter_refuses_float_token():
+    from oceanbase_tpu.sql.plan_cache import _convert_token
+
+    assert _convert_token("7", "int") == 7
+    assert _convert_token("7.5", "int") is None  # widening: fast miss
+    assert _convert_token("7.5", "float") == 7.5
+    assert _convert_token("7", "float") is None  # would narrow the plan
+
+
+# --------------------------------------------------------- engine level
+
+
+@pytest.fixture(scope="module")
+def esession():
+    rng = np.random.default_rng(11)
+    orders, lineitem = datagen.gen_orders_lineitem(0.01, rng, 1500, 2000, 100)
+    return Session({"orders": orders, "lineitem": lineitem})
+
+
+def _q6(d1, d2, lo, hi, qty):
+    return (
+        "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+        f"where l_shipdate >= date '{d1}' and l_shipdate < date '{d2}' "
+        f"and l_discount between {lo} and {hi} and l_quantity < {qty}"
+    )
+
+
+def _q6_numpy(li, d1, d2, lo, hi, qty):
+    ship, disc = li.data["l_shipdate"], li.data["l_discount"]
+    qtyc, ep = li.data["l_quantity"], li.data["l_extendedprice"]
+    m = (
+        (ship >= int(np.datetime64(d1, "D").astype(np.int64)))
+        & (ship < int(np.datetime64(d2, "D").astype(np.int64)))
+        & (disc >= round(lo * 100)) & (disc <= round(hi * 100))
+        & (qtyc < qty * 100)
+    )
+    return float(np.sum(ep[m].astype(np.int64) * disc[m].astype(np.int64))) / 1e4
+
+
+def test_fast_hit_rebinds_dates_floats_ints(esession):
+    li = esession.catalog["lineitem"]
+    r1 = esession.sql(_q6("1994-01-01", "1995-01-01", 0.05, 0.07, 24))
+    assert not r1.fast_path_hit
+    h0 = esession.plan_cache.stats.fast_hits
+    # different dates, different float bounds, different int threshold
+    r2 = esession.sql(_q6("1995-01-01", "1996-01-01", 0.02, 0.09, 30))
+    assert esession.plan_cache.stats.fast_hits == h0 + 1
+    assert r2.fast_path_hit
+    got = float(r2.rows()[0][0])
+    want = _q6_numpy(li, "1995-01-01", "1996-01-01", 0.02, 0.09, 30)
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+def test_fast_widening_falls_back_then_reregisters(esession):
+    q = "select count(*) from lineitem where l_quantity < {}"
+    esession.sql(q.format(20))
+    r_int = esession.sql(q.format(25))
+    assert r_int.fast_path_hit
+    # widening: '25.5' refuses the int converter -> honest fast miss,
+    # slow path plans the float variant and re-registers it
+    m0 = esession.plan_cache.stats.fast_misses
+    r_f = esession.sql(q.format(25.5))
+    assert not r_f.fast_path_hit
+    assert esession.plan_cache.stats.fast_misses == m0 + 1
+    li = esession.catalog["lineitem"]
+    assert r_f.rows()[0][0] == int((li.data["l_quantity"] < 2550).sum())
+    r_f2 = esession.sql(q.format(30.5))
+    assert r_f2.fast_path_hit
+    assert r_f2.rows()[0][0] == int((li.data["l_quantity"] < 3050).sum())
+
+
+def test_lazy_rows_limit_and_full(esession):
+    q = "select l_orderkey, l_quantity from lineitem where l_discount >= 0.05"
+    esession.sql(q)
+    rs = esession.sql(q)
+    assert rs.fast_path_hit
+    li = esession.catalog["lineitem"]
+    mask = li.data["l_discount"] >= 5  # stored scaled x100
+    want_n = int(mask.sum())
+    assert rs.nrows == want_n
+    head = rs.rows(limit=3)
+    assert len(head) == min(3, want_n)
+    full = rs.rows()
+    assert len(full) == want_n
+    assert full[:3] == head
+    want_keys = li.data["l_orderkey"][mask]
+    assert [r[0] for r in full] == list(want_keys)
+
+
+# --------------------------------------------------------- server level
+
+
+@pytest.fixture()
+def sdb():
+    from oceanbase_tpu.server.database import Database
+
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table kv (id int primary key, k int, v int, "
+          "name varchar(20))")
+    s.sql("insert into kv values (1, 10, 100, 'aa'), (2, 20, 200, 'bb'), "
+          "(3, 30, 300, 'it''s'), (4, 40, 400, 'dd')")
+    return db, s
+
+
+def test_server_fast_hit_and_audit(sdb):
+    db, s = sdb
+    q = "select v from kv where k = {}"
+    assert s.sql(q.format(10)).rows() == [(100,)]
+    r = s.sql(q.format(30))
+    assert r.fast_path_hit and r.rows() == [(300,)]
+    rec = [a for a in db.audit.records() if a.stmt_type == "Select"][-1]
+    assert rec.is_fast_path
+    assert rec.plan_cache_hit
+    # breakdown recorded (dispatch always happens; parse/plan did not)
+    assert rec.dispatch_us >= 0 and rec.compile_s == 0.0
+
+
+def test_server_string_escape_and_null_literals(sdb):
+    db, s = sdb
+    q = "select id from kv where name = '{}'"
+    assert s.sql(q.format("aa")).rows() == [(1,)]
+    # string literals are BAKED (dictionary lookups trace-time baked):
+    # a different string is an honest fast miss that re-registers...
+    r = s.sql(q.format("it''s"))
+    assert not r.fast_path_hit
+    assert r.rows() == [(3,)]  # escaped quote parses correctly
+    # ...and an exact repeat (same escapes) is a fast hit
+    r2 = s.sql(q.format("it''s"))
+    assert r2.fast_path_hit and r2.rows() == [(3,)]
+    # NULL keyword statements ride the tier (null is text, not a param)
+    qn = "select count(*) from kv where name is not null and k >= {}"
+    assert s.sql(qn.format(0)).rows() == [(4,)]
+    rn = s.sql(qn.format(35))
+    assert rn.fast_path_hit and rn.rows() == [(1,)]
+
+
+def test_server_ddl_set_and_vt_bypass(sdb):
+    db, s = sdb
+    st = db.plan_cache.stats
+    h0 = st.fast_hits
+    s.sql("set ob_px_dop = 0")
+    s.sql("create table other (a int primary key)")
+    s.sql("drop table other")
+    assert st.fast_hits == h0  # none of those touched the tier
+    # virtual-table selects are never registered: two runs, zero hits
+    s.sql("select name, value from __all_virtual_sysstat where value > 0")
+    s.sql("select name, value from __all_virtual_sysstat where value > 1")
+    assert st.fast_hits == h0
+
+
+def test_server_tx_bypasses_fast_path(sdb):
+    db, s = sdb
+    q = "select v from kv where k = {}"
+    s.sql(q.format(10))
+    assert s.sql(q.format(10)).fast_path_hit
+    s.sql("begin")
+    try:
+        r = s.sql(q.format(10))
+        assert not r.fast_path_hit  # in-tx reads keep the snapshot path
+        assert r.rows() == [(100,)]
+    finally:
+        s.sql("rollback")
+
+
+def test_flush_clears_both_tiers(sdb):
+    db, s = sdb
+    q = "select v from kv where k = {}"
+    s.sql(q.format(10))
+    assert s.sql(q.format(20)).fast_path_hit
+    inv0 = db.plan_cache.stats.fast_invalidations
+    db.plan_cache.flush()
+    assert len(db.plan_cache._fast) == 0
+    assert db.plan_cache.stats.fast_invalidations > inv0
+    r = s.sql(q.format(30))  # miss, re-register, correct
+    assert not r.fast_path_hit and r.rows() == [(300,)]
+    assert s.sql(q.format(40)).fast_path_hit
+
+
+def test_fast_capacity_eviction(sdb):
+    db, s = sdb
+    cap0 = db.plan_cache.capacity
+    db.plan_cache.capacity = 2
+    try:
+        qs = ["select v from kv where k = 10 and id < {}",
+              "select k from kv where v = 100 and id < {}",
+              "select id from kv where k > 0 and id < {}"]
+        ev0 = db.plan_cache.stats.fast_evictions
+        for q in qs:
+            s.sql(q.format(99))
+        assert len(db.plan_cache._fast) <= 2
+        assert db.plan_cache.stats.fast_evictions > ev0
+        # evicted statement is a miss, still correct, re-registers
+        r = s.sql(qs[0].format(98))
+        assert r.rows() == [(100,)]
+    finally:
+        db.plan_cache.capacity = cap0
+
+
+def test_ddl_invalidates_stale_text_entry(sdb):
+    db, s = sdb
+    q = "select sum(v) from kv where k < {}"
+    s.sql(q.format(100))
+    assert s.sql(q.format(100)).fast_path_hit
+    # drop + recreate with DIFFERENT data: a stale replay would return
+    # the old sums
+    s.sql("drop table kv")
+    s.sql("create table kv (id int primary key, k int, v int, "
+          "name varchar(20))")
+    s.sql("insert into kv values (1, 10, 7, 'x')")
+    r = s.sql(q.format(100))
+    assert r.rows() == [(7,)]
+
+
+def test_retry_flush_never_replays_stale_text_entry(sdb):
+    """The server/database.py retry-policy hole: a flush_plan_cache
+    policy (OB_SCHEMA_EAGAIN) must flush the TEXT tier too — the redrive
+    must re-resolve through the full path, not replay the text entry
+    compiled against the dead schema."""
+    db, s = sdb
+    q = "select v from kv where k = {}"
+    s.sql(q.format(10))
+    assert s.sql(q.format(20)).fast_path_hit
+
+    orig = db.engine.fast_execute
+    fired = {"n": 0}
+
+    def boom(hit, **kw):
+        fired["n"] += 1
+        raise R.SchemaVersionMismatch("injected: schema moved")
+
+    db.engine.fast_execute = boom
+    try:
+        h0 = db.plan_cache.stats.fast_hits
+        r = s.sql(q.format(30))  # fast hit raises -> retry flushes -> slow
+        assert r.rows() == [(300,)]
+        assert fired["n"] == 1  # the redrive did NOT re-enter the fast path
+        assert db.plan_cache.stats.fast_hits == h0 + 1  # only the poisoned hit
+    finally:
+        db.engine.fast_execute = orig
+    rec = [a for a in db.audit.records() if a.stmt_type == "Select"][-1]
+    assert rec.retry_cnt == 1
+    assert not rec.is_fast_path  # the statement that SUCCEEDED was slow-path
+    # and the tier warms again afterwards
+    s.sql(q.format(10))
+    assert s.sql(q.format(40)).fast_path_hit
+
+
+def test_privileges_bite_on_warm_fast_hits(sdb):
+    db, s = sdb
+    s.sql("create user bob")
+    s.sql("grant select on kv to bob")
+    sb = db.session(user="bob")
+    q = "select v from kv where k = {}"
+    sb.sql(q.format(10))
+    assert sb.sql(q.format(20)).fast_path_hit  # warm under bob's grant
+    s.sql("revoke select on kv from bob")
+    from oceanbase_tpu.server.database import SqlError
+
+    with pytest.raises(SqlError):
+        sb.sql(q.format(30))  # warm text entry must NOT bypass the revoke
+
+
+def test_sequence_draws_never_served_from_fast_tier(sdb):
+    # nextval is side-effecting: _bind_sequences rewrites it into a fresh
+    # literal pre-resolution; a text-keyed replay would freeze the value
+    db, s = sdb
+    s.sql("create sequence sq_fp")
+    q = "select nextval('sq_fp') as v"
+    vals = [int(s.sql(q).rows()[0][0]) for _ in range(4)]
+    assert vals == [1, 2, 3, 4]
+    assert db.plan_cache.fast_peek(
+        P.fast_normalize(q)[0]) is None  # never registered
+
+
+def test_sysstat_exposes_fast_counters(sdb):
+    db, s = sdb
+    q = "select v from kv where k = {}"
+    s.sql(q.format(10))
+    s.sql(q.format(20))
+    rows = dict(s.sql(
+        "select name, value from __all_virtual_sysstat "
+        "where name like 'plan cache fast%'").rows())
+    assert rows.get("plan cache fast hit", 0) >= 1
+    assert rows.get("plan cache fast miss", 0) >= 1

@@ -151,3 +151,45 @@ def test_lru_eviction():
     assert pc.stats.evictions == 1
     assert pc.get(("k0",)) is None  # oldest evicted
     assert pc.get(("k2",)) is not None
+
+
+def _fe(norm_key="select ? from t"):
+    from oceanbase_tpu.sql.plan_cache import FastEntry
+
+    return FastEntry(norm_key=norm_key, sig=(), baked=(), fingerprint="f",
+                     tables=("t",), slot_map=(("slot", 0, "int"),),
+                     base_values=(0,))
+
+
+def test_fast_tier_lru_eviction():
+    pc = PlanCache(capacity=2)
+    for i in range(3):
+        pc.fast_put(f"t{i}", _fe())
+    assert len(pc._fast) == 2
+    assert pc.stats.fast_evictions == 1
+    assert pc.fast_peek("t0") is None  # oldest evicted
+    assert pc.fast_peek("t2") is not None
+
+
+def test_fast_tier_flush_and_disable():
+    pc = PlanCache(capacity=4)
+    pc.fast_put("ta", _fe())
+    assert pc.fast_peek("ta") is not None
+    pc.flush()  # flush clears BOTH tiers (retry policies depend on this)
+    assert pc.fast_peek("ta") is None
+    assert pc.stats.fast_invalidations == 1
+    pc.fast_enabled = False  # the A/B switch turns the tier fully off
+    pc.fast_put("tb", _fe())
+    assert pc.fast_peek("tb") is None
+
+
+def test_fast_tier_holds_no_executable():
+    # the text tier stores rebinding material only — eviction of the
+    # LOGICAL entry must invalidate the fast entry at lookup time, which
+    # only works because FastEntry carries keys, not compiled plans
+    fe = _fe()
+    assert not hasattr(fe, "prepared")
+    vals = fe.bind_tokens(("7",))
+    assert vals == [7]
+    assert fe.bind_tokens(("7.5",)) is None  # converter refusal
+    assert fe.bind_tokens(("7", "8")) is None  # arity mismatch

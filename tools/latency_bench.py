@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Serving-latency benchmark: warm statement throughput through the server.
+
+The headline bench (bench.py) measures device throughput on analytic scans;
+this one measures the OTHER limiter BENCH_r05 surfaced — per-statement host
+overhead (Q6: 720x CPU on-device, 31x end-to-end). It drives repeated
+parameterized statements through a real DbSession and reports:
+
+  - warm statements/sec and p50/p99 latency per workload;
+  - the serving-phase breakdown (fastparse / bind / dispatch / fetch) from
+    the sql_audit ring, i.e. exactly what `select ... from
+    __all_virtual_sql_audit` shows a DBA;
+  - the fast-path hit rate over the timed (warm) window;
+  - an A/B against the same statements with the text tier disabled
+    (plan_cache.fast_enabled = False): the full tokenize/parse/plan path
+    with a warm LOGICAL plan cache, isolating the fast tier's contribution.
+
+Workloads:
+  point  - `select v from kv where k = ?` cycling K values: a parameterized
+           point read on a non-indexed column (an indexed predicate takes
+           the DAS route, which serves cold statements host-side);
+  agg    - `select sum(v), count(*) from kv where k < ?` cycling bounds:
+           parameterized cached aggregate;
+  repeat - one identical group-by repeated verbatim: the pure text-hit case.
+
+One-line JSON contract (last stdout line is always complete, exit 0):
+  {"metric": "serving_stmts_per_sec", "value": <point warm stmts/s>,
+   "vs_baseline": <speedup vs no-fastpath>, "detail": {...}}
+
+Env/flags: --rows (table size, default 20000), --stmts (timed statements
+per workload, default 300), --warmup (default 20), --strict (exit 1 unless
+the warm window's fast-path hit rate is 100%), LATENCY_BUDGET_S (default
+300; stops starting new workloads near the budget, partial results still
+emit).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = time.monotonic()
+
+
+def elapsed() -> float:
+    return time.monotonic() - START
+
+
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def build_db(rows: int):
+    from oceanbase_tpu.server.database import Database
+
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table kv (id int primary key, k int, v int, grp int)")
+    rng = np.random.default_rng(7)
+    vals = rng.integers(0, 1000, size=rows)
+    chunk = 500
+    for lo in range(0, rows, chunk):
+        hi = min(lo + chunk, rows)
+        tuples = ", ".join(
+            f"({i + 1}, {i}, {int(vals[i])}, {i % 16})" for i in range(lo, hi)
+        )
+        s.sql(f"insert into kv values {tuples}")
+    return db, s
+
+
+def percentiles(lat_s: np.ndarray) -> dict:
+    return {
+        "p50_us": round(float(np.percentile(lat_s, 50)) * 1e6, 1),
+        "p99_us": round(float(np.percentile(lat_s, 99)) * 1e6, 1),
+        "mean_us": round(float(lat_s.mean()) * 1e6, 1),
+    }
+
+
+def run_stmts(sess, stmts) -> np.ndarray:
+    lat = np.empty(len(stmts))
+    for i, q in enumerate(stmts):
+        t0 = time.perf_counter()
+        rs = sess.sql(q)
+        rs.rows()  # client consumes the result: lazy fetch cost included
+        lat[i] = time.perf_counter() - t0
+    return lat
+
+
+def phase_breakdown(db, n: int) -> dict:
+    """Mean serving-phase times over the last n fast-path audit records —
+    read directly from the ring (a SELECT on the virtual table would
+    itself audit)."""
+    recs = [r for r in db.audit.records() if r.is_fast_path][-n:]
+    if not recs:
+        return {}
+    m = len(recs)
+    return {
+        "fastparse_us": round(sum(r.fastparse_us for r in recs) / m, 1),
+        "bind_us": round(sum(r.bind_us for r in recs) / m, 1),
+        "dispatch_us": round(sum(r.dispatch_us for r in recs) / m, 1),
+        "fetch_us": round(sum(r.fetch_us for r in recs) / m, 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20000)
+    ap.add_argument("--stmts", type=int, default=300)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 unless warm fast-path hit rate is 100%")
+    args = ap.parse_args()
+    budget = float(os.environ.get("LATENCY_BUDGET_S", "300"))
+
+    t0 = time.perf_counter()
+    db, sess = build_db(args.rows)
+    detail = {
+        "rows": args.rows,
+        "stmts": args.stmts,
+        "setup_s": round(time.perf_counter() - t0, 2),
+    }
+
+    k_cycle = list(range(0, min(args.rows, 50)))
+    workloads = {
+        "point": [f"select v from kv where k = {k_cycle[i % len(k_cycle)]}"
+                  for i in range(args.stmts)],
+        "agg": [f"select sum(v), count(*) from kv where k < {100 + i % 50}"
+                for i in range(args.stmts)],
+        "repeat": ["select grp, sum(v), count(*) from kv group by grp"]
+                  * args.stmts,
+    }
+
+    strict_ok = True
+    point_fast = point_slow = None
+    for name, stmts in workloads.items():
+        if elapsed() > budget - 20:
+            detail[f"{name}_skipped"] = "budget"
+            continue
+        # fast path ON: warm, then measure with hit-rate accounting
+        db.plan_cache.fast_enabled = True
+        run_stmts(sess, stmts[:args.warmup])
+        st = db.plan_cache.stats
+        h0, m0 = st.fast_hits, st.fast_misses
+        lat = run_stmts(sess, stmts)
+        hits, misses = st.fast_hits - h0, st.fast_misses - m0
+        rate = hits / max(hits + misses, 1)
+        sps = len(stmts) / lat.sum()
+        detail[name] = {
+            "stmts_per_sec": round(sps, 1),
+            **percentiles(lat),
+            "warm_fast_hit_rate": round(rate, 4),
+            "phases": phase_breakdown(db, len(stmts)),
+        }
+        if rate < 1.0:
+            strict_ok = False
+        # fast path OFF: same statements, warm logical cache (A/B)
+        db.plan_cache.fast_enabled = False
+        run_stmts(sess, stmts[:args.warmup])
+        lat_off = run_stmts(sess, stmts)
+        db.plan_cache.fast_enabled = True
+        sps_off = len(stmts) / lat_off.sum()
+        detail[name]["no_fastpath_stmts_per_sec"] = round(sps_off, 1)
+        detail[name]["no_fastpath_p50_us"] = round(
+            float(np.percentile(lat_off, 50)) * 1e6, 1)
+        detail[name]["fastpath_speedup"] = round(sps / sps_off, 3)
+        if name == "point":
+            point_fast, point_slow = sps, sps_off
+
+    detail["total_s"] = round(elapsed(), 1)
+    emit({
+        "metric": "serving_stmts_per_sec",
+        "value": round(point_fast, 1) if point_fast else 0.0,
+        "unit": "stmts/s",
+        "vs_baseline": (round(point_fast / point_slow, 3)
+                        if point_fast and point_slow else 0.0),
+        "detail": detail,
+    })
+    if args.strict and not strict_ok:
+        print("STRICT: warm fast-path hit rate below 100%", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except BaseException as e:
+        emit({
+            "metric": "serving_stmts_per_sec", "value": 0.0,
+            "unit": "stmts/s",
+            "detail": {"error": f"{type(e).__name__}: {e}",
+                       "total_s": round(elapsed(), 1)},
+        })
+        rc = 0
+    sys.exit(rc)

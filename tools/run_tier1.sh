@@ -4,22 +4,30 @@
 # 8 virtual devices via conftest.py), skips slow-marked tests, and
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
-#   tools/run_tier1.sh [--chaos] [extra pytest args...]
+#   tools/run_tier1.sh [--chaos] [--latency] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
 # on failure the fault schedule is in the assertion detail (replay with
 # tools/chaos_bench.py --seed N).
+#
+# --latency additionally runs a small serving-latency smoke
+# (tools/latency_bench.py --strict): warm repeated statements must hit
+# the text-keyed fast path 100% of the time, else the smoke fails.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
 
 chaos=0
-if [ "$1" = "--chaos" ]; then
-    chaos=1
-    shift
-fi
+latency=0
+while true; do
+    case "$1" in
+        --chaos) chaos=1; shift ;;
+        --latency) latency=1; shift ;;
+        *) break ;;
+    esac
+done
 
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
@@ -32,6 +40,12 @@ if [ "$chaos" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos.py -q -m slow \
         -p no:cacheprovider -p no:xdist -p no:randomly
+    rc=$?
+fi
+
+if [ "$latency" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
+        --rows 2000 --stmts 80 --warmup 10 --strict
     rc=$?
 fi
 exit $rc
