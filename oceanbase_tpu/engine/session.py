@@ -223,18 +223,24 @@ class Session:
             fastparse_s=fastparse_s,
         )
 
-    def fast_lookup(self, text_key: str, params: tuple):
+    def fast_lookup(self, text_key: str, params: tuple, fe=None,
+                    defer_adds=None):
         """Text-tier lookup + literal re-bind + logical-tier fetch.
         Returns a _FastHit ready for fast_execute, or None (counted as a
         fast miss) when any stage rejects: unknown text, a baked token
         changed, a converter refused the new literal (dtype widening), or
         the logical entry is gone (evicted / flushed / schema version
-        moved the key_extra) — that last case also drops the text entry."""
+        moved the key_extra) — that last case also drops the text entry.
+        Callers that already peeked the text tier (the server fast path
+        peeks to run privilege checks first) pass the FastEntry via `fe`
+        so the lookup isn't paid twice per statement; `defer_adds` is
+        forwarded to fast_hit_get (statement-end counter batching)."""
         pc = self.plan_cache
-        fe = pc.fast_peek(text_key)
         if fe is None:
-            pc.note_fast_miss()
-            return None
+            fe = pc.fast_peek(text_key)
+            if fe is None:
+                pc.note_fast_miss()
+                return None
         vals = fe.bind_tokens(params)
         if vals is None:
             pc.note_fast_miss()
@@ -243,12 +249,11 @@ class Session:
                  if self.key_extra_fn is not None else ())
         key = (id(self.catalog), fe.norm_key, fe.sig, fe.baked,
                fe.fingerprint, extra)
-        entry = pc.get(key, count_miss=False)
+        entry = pc.fast_hit_get(key, defer_adds=defer_adds)
         if entry is None:
             pc.fast_invalidate(text_key)
             pc.note_fast_miss()
             return None
-        pc.note_fast_hit()
         return _FastHit(text_key, fe, vals, entry)
 
     def fast_execute(self, hit: "_FastHit", fastparse_s: float = 0.0

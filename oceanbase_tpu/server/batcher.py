@@ -1,0 +1,214 @@
+"""Cross-session statement micro-batcher.
+
+PR 4's fast path made ONE session cheap; under concurrent traffic every
+statement still paid its own device dispatch — 64 concurrent point reads
+over the same cached plan cost 64 XLA launches. This module amortizes
+them the way palf amortizes fsyncs (group commit) and inference stacks
+amortize forward passes (continuous batching): concurrent fast-path hits
+that rebind the SAME FastEntry (same plan, same param slots — different
+literal values) stack their packed parameter vectors into a [B, nslots]
+block and ride ONE batched device execution
+(engine.executor.PreparedPlan.run_batched_host), whose per-lane results
+scatter back to the waiting sessions.
+
+Window protocol (group-commit style): the first session to arrive for a
+(text_key, entry) key becomes the batch LEADER and holds the window open
+for `ob_batch_max_wait_us`; followers join until `ob_batch_max_size`
+lanes fill (which cuts the window short) or the leader's timer fires.
+The leader dispatches, scatters, and wakes the followers. Every
+degradation is graceful and counted: a non-batchable plan (no parameter
+slots / legacy tuple ABI) bypasses, a leader left alone after the window
+runs the plain solo fast path, a follower that outwaits a wedged leader
+re-executes solo, and a batch whose dispatch raised sends every lane
+back to the solo path — which surfaces the real error and invalidates
+the text entry exactly as before.
+
+Privilege re-checks stay PER SESSION in DbSession._fast_select, before
+the batcher is ever consulted — a REVOKE between repeats bites batched
+entries the same as solo ones.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ..ops.hashing import next_pow2
+
+
+class _Batch:
+    """One forming / in-flight group of same-entry fast-path hits."""
+
+    __slots__ = ("key", "entry", "rows", "max_size", "batch_id", "closed",
+                 "full", "done", "results", "error", "dispatch_s",
+                 "d2h_bytes")
+
+    def __init__(self, key, entry, batch_id: int, max_size: int):
+        self.key = key
+        self.entry = entry  # sql.plan_cache.CacheEntry (pins the plan)
+        self.rows: list[np.ndarray] = []  # packed qparam vector per lane
+        self.max_size = max_size  # the LEADER's clamp governs the batch
+        self.batch_id = batch_id
+        self.closed = False  # no more joiners (filled or window expired)
+        self.full = threading.Event()  # wakes the leader early on fill
+        self.done = threading.Event()  # results scattered (or error set)
+        self.results: list | None = None  # ResultSet per lane
+        self.error: Exception | None = None
+        self.dispatch_s = 0.0
+        self.d2h_bytes = 0
+
+
+class StatementBatcher:
+    """Collects concurrent same-plan fast-path hits into batched device
+    dispatches. One instance per Database (tenant); safe for any number
+    of session threads."""
+
+    def __init__(self, metrics=None):
+        self._lock = threading.Lock()
+        self._forming: dict[tuple, _Batch] = {}
+        self._ids = itertools.count(1)
+        self.metrics = metrics
+        # A/B switch (latency_bench --sessions: batching on vs off)
+        self.enabled = True
+
+    # ------------------------------------------------------------ public
+    def execute(self, hit, max_size: int, wait_us: int):
+        """Run one fast-path hit through the batching window.
+
+        Returns the lane's ResultSet — with `rs.batch_info = (batch_id,
+        batch_size, wait_us, dispatch_s, d2h_share)` attached for the
+        audit/profile plumbing — or None when the statement should
+        degrade to the plain solo fast path (ineligible plan, leader left
+        alone, follower timeout, dispatch error)."""
+        m = self.metrics
+        entry = hit.entry
+        prepared = entry.prepared
+        if not self.enabled or max_size <= 1:
+            return None
+        if not getattr(prepared, "batchable", False):
+            if m is not None and m.enabled:
+                m.bulk(adds=(("stmt batch bypass", 1),
+                             ("stmt batch bypass: not batchable", 1)))
+            return None
+        qrow = prepared.bind(hit.values, entry.dtypes)
+        if not isinstance(qrow, np.ndarray):
+            # legacy tuple ABI (should not happen when batchable): bypass
+            if m is not None and m.enabled:
+                m.bulk(adds=(("stmt batch bypass", 1),
+                             ("stmt batch bypass: unpacked params", 1)))
+            return None
+
+        key = (hit.text_key, id(entry))
+        t0 = time.perf_counter()
+        with self._lock:
+            b = self._forming.get(key)
+            if b is not None and not b.closed:
+                lane = len(b.rows)
+                b.rows.append(qrow)
+                if len(b.rows) >= b.max_size:
+                    # this joiner filled the batch: cut the window short
+                    b.closed = True
+                    self._forming.pop(key, None)
+                    b.full.set()
+                leader = False
+            else:
+                b = _Batch(key, entry, next(self._ids), max_size)
+                b.rows.append(qrow)
+                lane = 0
+                self._forming[key] = b
+                leader = True
+
+        if leader:
+            if wait_us > 0 and b.max_size > 1:
+                if m is not None and m.enabled:
+                    with m.waiting("stmt batch window"):
+                        b.full.wait(wait_us / 1e6)
+                else:
+                    b.full.wait(wait_us / 1e6)
+            with self._lock:
+                b.closed = True
+                if self._forming.get(key) is b:
+                    del self._forming[key]
+            if len(b.rows) == 1:
+                # nobody joined: the solo fast path is strictly cheaper
+                # than a padded 2-lane batch (and compiles nothing new)
+                b.error = RuntimeError("solo")
+                b.done.set()
+                if m is not None and m.enabled:
+                    m.add("stmt batch solo")
+                return None
+            self._dispatch(b)
+        else:
+            # generous upper bound: the leader dispatches at most one
+            # window + one batched execution after we joined; a miss here
+            # means it died mid-flight and we re-execute solo
+            ok = b.done.wait(wait_us / 1e6 + 30.0)
+            if not ok:
+                if m is not None and m.enabled:
+                    m.add("stmt batch follower timeouts")
+                return None
+        if b.error is not None:
+            return None
+        rs = b.results[lane]
+        rs.batch_info = (
+            b.batch_id,
+            len(b.rows),
+            int((time.perf_counter() - t0 - (b.dispatch_s if leader else 0.0))
+                * 1e6),
+            b.dispatch_s,
+            b.d2h_bytes // max(len(b.rows), 1),
+        )
+        return rs
+
+    # ----------------------------------------------------------- private
+    def _dispatch(self, b: _Batch) -> None:
+        """Leader half: stack lanes, ONE batched device execution,
+        scatter per-lane ResultSets. Any failure parks the error and
+        sends every lane back to the solo path."""
+        from ..core.column import host_rows_batched
+        from ..engine.session import ResultSet
+
+        m = self.metrics
+        t0 = time.perf_counter()
+        try:
+            qblock = np.stack(b.rows)
+            prepared = b.entry.prepared
+            hcols, hvalid, hsel, schema, dicts = (
+                prepared.run_batched_host(qblock))
+            b.dispatch_s = time.perf_counter() - t0
+            b.d2h_bytes = sum(
+                int(getattr(a, "nbytes", 0))
+                for d in (hcols, hvalid) for a in d.values()
+            ) + int(getattr(hsel, "nbytes", 0))
+            names = b.entry.output_names
+            nb = len(b.rows)
+            # one vectorized scatter for the whole batch (pad lanes
+            # sliced off first) instead of nb per-lane gathers
+            lanes = host_rows_batched(
+                schema, dicts,
+                {n: a[:nb] for n, a in hcols.items()},
+                {n: a[:nb] for n, a in hvalid.items()},
+                hsel[:nb],
+            )
+            b.results = [
+                ResultSet(names, {n: lane[n] for n in names},
+                          plan_cache_hit=True, fast_path_hit=True)
+                for lane in lanes
+            ]
+            if m is not None and m.enabled:
+                # batch-size histogram as per-pow2-bucket counters (the
+                # latency Histogram's bounds are seconds, not lanes)
+                m.bulk(adds=(
+                    ("stmt batched dispatches", 1),
+                    ("stmt batched statements", nb),
+                    (f"stmt batch size {next_pow2(nb)}", 1),
+                ))
+        except Exception as e:  # noqa: BLE001 — lanes degrade to solo
+            b.error = e
+            if m is not None and m.enabled:
+                m.add("stmt batch dispatch errors")
+        finally:
+            b.done.set()

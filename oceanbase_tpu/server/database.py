@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import threading
+import time as _time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -36,8 +37,10 @@ from ..core.table import Table
 from ..engine.session import ResultSet, Session
 from ..rootserver import RootService
 from ..share import Config, LocationService
+from ..share import interrupt as _I
 from ..share import retry as _R
 from ..share.schema_service import SchemaError
+from .diag import QueryProfile
 from ..sql import ast as A
 from ..sql import parser as P
 from ..sql.logical import _parse_type
@@ -246,6 +249,8 @@ class Database:
         self.data_dir = data_dir
         self._fsync = fsync
         self.tenant_name = tenant_name
+        # (schema version, name->TableInfo map) for the .tables property
+        self._tables_cache: tuple | None = None
         # XA branch registry rebuilt from the LOG (ob_trans_part_ctx.h:154
         # logs prepare state): XA_PREPARE records add entries, the
         # decision records remove them — populated during boot replay and
@@ -545,6 +550,15 @@ class Database:
             tracer=self.tracer,
             profile_enabled_fn=lambda: self.config["enable_query_profile"],
         )
+        # cross-session statement micro-batcher: concurrent fast-path
+        # hits on the same plan fold into one batched device dispatch
+        # (server/batcher.py; knobs ob_batch_max_size/ob_batch_max_wait_us)
+        from .batcher import StatementBatcher
+
+        self.batcher = StatementBatcher(metrics=self.metrics)
+        # one shared virtual-clock closure: sql() builds a statement
+        # Deadline from it on every call — no per-statement lambda
+        self._bus_clock = lambda: self.cluster.bus.now
         # distributed (PX) executor, built lazily on the first statement a
         # session routes with ob_px_dop — mesh construction touches every
         # device, so tenants that never use PX never pay for it
@@ -564,8 +578,18 @@ class Database:
 
     @property
     def tables(self):
-        """Current-version schema view (name -> TableInfo)."""
-        return self.schema_service.guard().tables
+        """Current-version schema view (name -> TableInfo). Cached per
+        schema version: the serving path reads this 2x per statement and
+        the guard only changes on DDL. The (version, map) tuple swaps
+        atomically under the GIL; a stale version check just re-guards."""
+        ss = self.schema_service
+        v = ss.version
+        c = self._tables_cache
+        if c is not None and c[0] == v:
+            return c[1]
+        t = ss.guard(v).tables
+        self._tables_cache = (v, t)
+        return t
 
     def _own_tablet_ids(self) -> set[int]:
         ids = set()
@@ -1201,8 +1225,6 @@ class Database:
            replicas) — concurrent post-registration DML lands at HIGHER
            commit versions, so MVCC ordering resolves every interleaving;
         3. flip to ready."""
-        import time as _time
-
         from ..tx.tablelock import LockMode, WouldBlock
 
         with self._ddl_lock:
@@ -1597,6 +1619,8 @@ class DbSession:
         self.session_id = next(db._session_ids)
         self._last_stmt_type = ""
         self._stmt_cache_hit = False
+        self._retry_ctrl = None
+        self._stmt_adds: list = []
         # session variables (SET <name> = <value>): full-link trace
         # collection flag, PX degree-of-parallelism routing, and the
         # statement/transaction deadlines in MICROSECONDS of virtual time
@@ -1609,6 +1633,11 @@ class DbSession:
             "ob_px_dop": 0,
             "ob_query_timeout": 100_000_000,
             "ob_trx_timeout": 500_000_000,
+            # cross-session micro-batching (server/batcher.py), seeded
+            # from the tenant config so ALTER SYSTEM moves the default
+            # for new sessions while SET overrides per session
+            "ob_batch_max_size": int(db.config["ob_batch_max_size"]),
+            "ob_batch_max_wait_us": int(db.config["ob_batch_max_wait_us"]),
         }
         # trace_id of the last traced NON-meta statement — what SHOW TRACE
         # renders (meta statements: SHOW/SET themselves, so the flag and
@@ -1619,8 +1648,6 @@ class DbSession:
     def sql(self, text: str) -> ResultSet:
         """Execute one statement, instrumented: trace span + ASH activity
         around execution, one sql_audit record at completion."""
-        import time as _time
-
         db = self.db
         t0 = _time.perf_counter()
         err, rs = "", None
@@ -1630,14 +1657,14 @@ class DbSession:
         # ob_trx_timeout deadline) on the bus virtual clock — one Deadline
         # object bounds the worker wait, PX admission, DAS routing retries,
         # commit waits and every engine checkpoint below
-        clock = lambda: db.cluster.bus.now  # noqa: E731
-        deadline = _R.Deadline.earliest(
-            _R.Deadline.after(
-                clock, self._vars["ob_query_timeout"] / 1e6,
-                label="ob_query_timeout",
-            ),
-            self._tx.deadline if self._tx is not None else None,
+        clock = db._bus_clock
+        deadline = _R.Deadline(
+            clock=clock,
+            at=clock() + self._vars["ob_query_timeout"] / 1e6,
+            label="ob_query_timeout",
         )
+        if self._tx is not None and self._tx.deadline is not None:
+            deadline = _R.Deadline.earliest(deadline, self._tx.deadline)
         # tenant worker quota (ObThWorker queue analog): bound concurrent
         # statements; waiting beyond the queue timeout (or the statement
         # deadline, when that is nearer) fails the statement
@@ -1660,16 +1687,18 @@ class DbSession:
                     f"({db.unit.max_workers} workers busy)"
                 )
         # per-statement interrupt registration (KILL QUERY target)
-        from ..share import interrupt as _I
-
         iid = ("stmt", db.tenant_name, self.session_id, next(db._stmt_seq))
         checker = db.interrupts[0].register(iid)
         db._active_stmts[self.session_id] = iid
         prev = _I.set_current(checker)
+        # inlined _R.deadline_scope: this frame already owns a finally,
+        # and the generator contextmanager is measurable per-statement
+        prev_dl = _R.current_deadline()
+        _R.set_current_deadline(deadline)
         try:
-            with _R.deadline_scope(deadline):
-                return self._sql_inner(text, t0)
+            return self._sql_inner(text, t0)
         finally:
+            _R.set_current_deadline(prev_dl)
             _I.set_current(prev)
             db._active_stmts.pop(self.session_id, None)
             db.interrupts[0].unregister(iid)
@@ -1677,8 +1706,6 @@ class DbSession:
                 sem.release()
 
     def _sql_inner(self, text: str, t0) -> ResultSet:
-        import time as _time
-
         db = self.db
         err, rs = "", None
         # last_profile is per-run_ast; statements that never reach run_ast
@@ -1686,13 +1713,19 @@ class DbSession:
         db.engine.last_profile = None
         # retry bookkeeping spans attempts but the statement keeps ONE
         # span tree, ASH activity and audit record — retries are an
-        # internal redrive, not new statements
-        ctrl = _R.RetryController(deadline=_R.current_deadline())
+        # internal redrive, not new statements. The controller is built
+        # lazily by _run_with_retries on the FIRST failure: the serving
+        # hot path never pays for bookkeeping it doesn't use.
+        self._retry_ctrl = None
+        # per-statement counter batch: the fast path appends its plan
+        # cache hit bumps here so the whole statement flushes through
+        # ONE metrics.bulk() below
+        self._stmt_adds = []
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
                 try:
-                    rs = self._run_with_retries(text, ctrl)
+                    rs = self._run_with_retries(text)
                 except Exception as e:
                     err = f"{type(e).__name__}: {e}"
                     if isinstance(e, _R.StatementTimeout):
@@ -1707,15 +1740,25 @@ class DbSession:
                     # the serving path pays zero for observability it
                     # isn't using
                     if m.enabled:
-                        m.add("sql statements")
+                        adds = self._stmt_adds
+                        adds.append(("sql statements", 1))
                         if stype in ("Select", "SetSelect"):
-                            m.add("sql select count")
+                            adds.append(("sql select count", 1))
                         elif stype in ("Insert", "Update", "Delete"):
-                            m.add("sql dml count")
+                            adds.append(("sql dml count", 1))
                         if err:
-                            m.add("sql fail count")
-                        m.observe("sql response time", elapsed_s)
+                            adds.append(("sql fail count", 1))
+                        m.bulk(adds=adds,
+                               observes=(("sql response time", elapsed_s),))
                     prof = db.engine.last_profile
+                    if rs is not None \
+                            and getattr(rs, "profile", None) is not None:
+                        # batched fast path: the per-lane profile rides
+                        # the ResultSet (engine.last_profile is shared
+                        # across sessions and races under concurrency)
+                        prof = rs.profile
+                    bi = (getattr(rs, "batch_info", None)
+                          if rs is not None else None)
                     if db.audit.enabled:
                         p = prof
                         db.audit.record(
@@ -1733,13 +1776,18 @@ class DbSession:
                             device_bytes=p.device_bytes if p else 0,
                             transfer_bytes=p.transfer_bytes if p else 0,
                             peak_bytes=p.peak_bytes if p else 0,
-                            retry_cnt=ctrl.retry_cnt,
-                            retry_info=ctrl.retry_info,
+                            retry_cnt=(self._retry_ctrl.retry_cnt
+                                       if self._retry_ctrl else 0),
+                            retry_info=(self._retry_ctrl.retry_info
+                                        if self._retry_ctrl else ""),
                             fastparse_us=int(p.fastparse_s * 1e6) if p else 0,
                             bind_us=int(p.bind_s * 1e6) if p else 0,
                             dispatch_us=int(p.dispatch_s * 1e6) if p else 0,
                             fetch_us=int(p.fetch_s * 1e6) if p else 0,
                             is_fast_path=bool(p.fast_path_hit) if p else False,
+                            is_batched=bi is not None,
+                            batch_id=bi[0] if bi is not None else 0,
+                            batch_wait_us=bi[2] if bi is not None else 0,
                         )
                     if stype not in ("Show", "SetVar", ""):
                         if self._vars.get("ob_enable_show_trace"):
@@ -1761,19 +1809,27 @@ class DbSession:
             return self._tx is None
         return False
 
-    def _run_with_retries(self, text: str, ctrl: "_R.RetryController"):
+    def _run_with_retries(self, text: str):
         """ObQueryRetryCtrl's loop: classify each failure, re-resolve
         locations/routing, back off on the bus virtual clock (driving the
         cluster so elections settle during the wait), and redrive until
         success, a non-retryable error, or the statement deadline — which
         surfaces as a timeout chaining the last transient, never as a raw
-        NotMaster/InjectedError."""
+        NotMaster/InjectedError.
+
+        The RetryController is built on the first failure only (stored on
+        ``self._retry_ctrl`` so the audit record can read retry_cnt /
+        retry_info after the loop returns)."""
         db = self.db
         schema_v = db.schema_service.version
+        ctrl = None
         while True:
             try:
                 return self._dispatch(text)
             except Exception as e:
+                if ctrl is None:
+                    ctrl = _R.RetryController(deadline=_R.current_deadline())
+                    self._retry_ctrl = ctrl
                 policy = ctrl.decide(e, stmt_retryable=self._stmt_retryable())
                 if policy is None:
                     # a DDL racing this statement invalidated any cached
@@ -1998,8 +2054,6 @@ class DbSession:
         if low.split(None, 1)[:1] == ["explain"]:
             self._last_stmt_type = "Explain"
             return self._explain(text.lstrip()[len("explain"):].lstrip())
-        import time as _time
-
         # statement fast path: a warm SELECT whose kind-marked text key is
         # registered skips parse/resolve/rewrite/plan entirely — one
         # tokenize pass, re-bind the literals, dispatch the cached
@@ -2035,8 +2089,6 @@ class DbSession:
         per-table catalog refresh runs as usual (it no-ops per table while
         data_version is unchanged, which is what makes the path cheap).
         Returns None to fall through to the full parse path."""
-        import time as _time
-
         db = self.db
         if self._tx is not None or self._vars.get("ob_px_dop", 0) > 0:
             return None
@@ -2063,14 +2115,37 @@ class DbSession:
             except AccessDenied as e:
                 raise SqlError(str(e), code=e.code) from None
         db.refresh_catalog(fe.tables, tx=None)
-        hit = db.engine.fast_lookup(fkey, params)
+        hit = db.engine.fast_lookup(fkey, params, fe=fe,
+                                    defer_adds=self._stmt_adds)
         if hit is None:
             return None
         # set BEFORE execute: the audit record and the retry controller's
         # retryability decision both read it if dispatch raises
         self._last_stmt_type = fe.stmt_type
-        rs = db.engine.fast_execute(
-            hit, fastparse_s=_time.perf_counter() - t0)
+        fastparse_s = _time.perf_counter() - t0
+        # cross-session micro-batching: concurrent hits on the SAME entry
+        # fold into one batched device dispatch. Admission honors the
+        # tenant unit — a batch wider than max_workers could never form
+        # (each lane holds a worker permit while it waits). None from the
+        # batcher = graceful degradation to the solo fast path below.
+        bmax = self._vars.get("ob_batch_max_size", 1)
+        if db.unit.max_workers is not None:
+            bmax = min(bmax, db.unit.max_workers)
+        if bmax > 1 and db.batcher.enabled:
+            rs = db.batcher.execute(
+                hit, bmax, self._vars.get("ob_batch_max_wait_us", 0))
+            if rs is not None:
+                if db.config["enable_query_profile"]:
+                    rs.profile = QueryProfile(
+                        compile_hit=True,
+                        d2h_bytes=rs.batch_info[4],
+                        fastparse_s=fastparse_s,
+                        dispatch_s=rs.batch_info[3],
+                        fast_path_hit=True,
+                    )
+                self._stmt_cache_hit = True
+                return rs
+        rs = db.engine.fast_execute(hit, fastparse_s=fastparse_s)
         self._stmt_cache_hit = True
         return rs
 
@@ -2262,8 +2337,6 @@ class DbSession:
         through the normal dispatch path and appends the measured phase
         breakdown (parse/plan/compile/execute) and actual row count —
         the per-plan analog of GV$SQL_PLAN_MONITOR's timing columns."""
-        import time as _time
-
         from ..sql.explain import explain_plan
 
         head = text.split(None, 1)
@@ -3063,8 +3136,6 @@ class DbSession:
         PREPARED by a different session)."""
         if tx is None or tx.ctx is None:
             return
-        import time as _time
-
         touched = tx.touched_tables
         committed_ok = False
         m = self.db.metrics

@@ -22,14 +22,13 @@ import itertools
 import threading
 import time
 from collections import deque
-from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 
 # ---- full-link tracing ------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Span:
     trace_id: int
     span_id: int
@@ -46,6 +45,38 @@ class Span:
     def elapsed(self) -> float:
         end = self.end or (self.clock or time.perf_counter)()
         return end - self.start
+
+
+class _SpanGuard:
+    """Hand-rolled context manager for Tracer.span. The serving hot path
+    enters two spans per statement; a generator-based contextmanager
+    costs several times as much per enter/exit, and the finished-span
+    ring is a deque whose append is atomic under the GIL — no lock."""
+
+    __slots__ = ("_tracer", "_stack", "_span", "_record")
+
+    def __init__(self, tracer, stack, span, record):
+        self._tracer = tracer
+        self._stack = stack
+        self._span = span
+        self._record = record
+
+    def __enter__(self):
+        self._stack.append(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        s = self._span
+        if exc is not None:
+            # failed statements must stay findable in the span ring
+            # (__all_virtual_trace_span filters on error != '')
+            s.tags["error"] = repr(exc)
+        if self._record:
+            s.end = self._tracer._clock()
+        self._stack.pop()
+        if self._record:
+            self._tracer._done.append(s)
+        return False
 
 
 class Tracer:
@@ -76,7 +107,6 @@ class Tracer:
             st = self._local.stack = []
         return st
 
-    @contextmanager
     def span(self, name: str, ctx: tuple | None = None, **tags):
         st = self._stack()
         parent = st[-1] if st else None
@@ -105,21 +135,7 @@ class Tracer:
             tags=dict(tags) if record else tags,
             clock=self._clock,
         )
-        st.append(s)
-        try:
-            yield s
-        except BaseException as exc:
-            # failed statements must stay findable in the span ring
-            # (__all_virtual_trace_span filters on error != '')
-            s.tags["error"] = repr(exc)
-            raise
-        finally:
-            if record:
-                s.end = self._clock()
-            st.pop()
-            if record:
-                with self._lock:
-                    self._done.append(s)
+        return _SpanGuard(self, st, s, record)
 
     def current_trace_id(self) -> int:
         st = self._stack()
@@ -186,7 +202,7 @@ class Tracer:
 # ---- sql_audit --------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class AuditRecord:
     request_id: int
     session_id: int
@@ -218,6 +234,12 @@ class AuditRecord:
     dispatch_us: int = 0
     fetch_us: int = 0
     is_fast_path: bool = False
+    # cross-session micro-batching (server/batcher.py): statements that
+    # rode a shared batched dispatch carry the batch id (join lanes of
+    # one launch) and the time spent in the group-commit window
+    is_batched: bool = False
+    batch_id: int = 0
+    batch_wait_us: int = 0
 
 
 class SqlAudit:
@@ -236,10 +258,13 @@ class SqlAudit:
     def record(self, **kw) -> None:
         if not self.enabled:
             return
-        with self._lock:
-            self._ring.append(
-                AuditRecord(request_id=next(self._ids), ts=self._clock(), **kw)
-            )
+        # itertools.count and deque.append are both atomic under the GIL:
+        # one audit record per statement appends lock-free. (A record
+        # racing set_capacity's ring swap may land in the retired ring —
+        # an accepted loss, capacity changes are a rare admin action.)
+        self._ring.append(
+            AuditRecord(request_id=next(self._ids), ts=self._clock(), **kw)
+        )
 
     def records(self) -> list[AuditRecord]:
         with self._lock:
@@ -296,13 +321,31 @@ class PlanMonitor:
 # ---- ASH (active session history) ------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class AshSample:
     ts: float
     session_id: int
     activity: str
     sql: str
     trace_id: int
+
+
+class _ActivityGuard:
+    """Hand-rolled context manager for AshSampler.activity — one per
+    statement on the serving hot path."""
+
+    __slots__ = ("_active", "_sid")
+
+    def __init__(self, active, sid):
+        self._active = active
+        self._sid = sid
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, exc_type, exc, tb):
+        self._active.pop(self._sid, None)
+        return False
 
 
 class AshSampler:
@@ -322,23 +365,23 @@ class AshSampler:
         self._clock = clock
         self._timer: threading.Timer | None = None
 
-    @contextmanager
     def activity(self, session_id: int, activity: str, sql: str = "",
                  trace_id: int = 0):
-        with self._lock:
-            self._active[session_id] = (activity, sql, trace_id)
-        try:
-            yield
-        finally:
-            with self._lock:
-                self._active.pop(session_id, None)
+        # dict store/pop on a per-session key are atomic under the GIL;
+        # taking the sampler lock twice per statement made this the most
+        # contended point of the serving hot path under many sessions.
+        # sample_once snapshots via list(...) so it never iterates a
+        # dict being mutated by session threads.
+        self._active[session_id] = (activity, sql, trace_id)
+        return _ActivityGuard(self._active, session_id)
 
     def sample_once(self, now: float | None = None) -> int:
         ts = self._clock() if now is None else now
+        snap = list(self._active.items())
         with self._lock:
-            for sid, (act, sql, tid) in self._active.items():
+            for sid, (act, sql, tid) in snap:
                 self._ring.append(AshSample(ts, sid, act, sql, tid))
-            return len(self._active)
+        return len(snap)
 
     def start(self) -> None:
         def tick():
@@ -369,7 +412,7 @@ class AshSampler:
 # ---- per-query resource profile ---------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class QueryProfile:
     """TPU cost attribution for ONE statement execution.
 

@@ -91,6 +91,13 @@ def _sql_audit(db) -> Table:
         ("fetch_us", DataType.int64(), [r.fetch_us for r in recs]),
         ("is_fast_path", DataType.int32(),
          [int(r.is_fast_path) for r in recs]),
+        # cross-session micro-batching: lanes of one batched dispatch
+        # share a batch_id; batch_wait_us is the group-commit window time
+        ("is_batched", DataType.int32(),
+         [int(r.is_batched) for r in recs]),
+        ("batch_id", DataType.int64(), [r.batch_id for r in recs]),
+        ("batch_wait_us", DataType.int64(),
+         [r.batch_wait_us for r in recs]),
     ])
 
 

@@ -110,6 +110,15 @@ def default_params() -> list[Param]:
               min=0),
         Param("ob_sql_parallel_degree", "int", 8,
               "default DOP for PX plans", min=1, max=4096),
+        Param("ob_batch_max_size", "int", 16,
+              "cross-session micro-batching: max fast-path statements "
+              "folded into one batched device dispatch (1 disables "
+              "batching); clamped by the tenant unit's max_workers",
+              min=1, max=1024),
+        Param("ob_batch_max_wait_us", "int", 200,
+              "cross-session micro-batching: group-commit window (us) a "
+              "batch leader holds open for followers before dispatching",
+              min=0, max=1_000_000),
         # memory / freeze / compaction
         Param("memstore_limit", "capacity", 256 << 20,
               "per-tenant active+frozen memtable budget"),

@@ -28,7 +28,7 @@ class QueryInterrupted(Exception):
     """Raised at a statement checkpoint after an interrupt arrived."""
 
 
-@dataclass
+@dataclass(slots=True)
 class InterruptChecker:
     interrupt_id: tuple
     _mgr: "InterruptManager"
@@ -65,15 +65,17 @@ class InterruptManager:
     _addr: int | None = None
 
     def register(self, interrupt_id: tuple) -> InterruptChecker:
-        with self._lock:
-            self._live.add(interrupt_id)
-            self._fired.pop(interrupt_id, None)
+        # set.add / dict.pop are atomic under the GIL, and interrupt ids
+        # carry a per-statement sequence number (never reused), so there
+        # is no stale state that needs clearing atomically — the serving
+        # hot path registers and unregisters lock-free.
+        self._live.add(interrupt_id)
+        self._fired.pop(interrupt_id, None)
         return InterruptChecker(interrupt_id, self)
 
     def unregister(self, interrupt_id: tuple) -> None:
-        with self._lock:
-            self._live.discard(interrupt_id)
-            self._fired.pop(interrupt_id, None)
+        self._live.discard(interrupt_id)
+        self._fired.pop(interrupt_id, None)
 
     def interrupt(self, interrupt_id: tuple, reason: str = "killed") -> None:
         """Fire locally and broadcast to every peer node."""

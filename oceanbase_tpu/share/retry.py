@@ -161,7 +161,7 @@ def classify(err: BaseException) -> RetryPolicy:
 # ---------------------------------------------------------------- deadline
 
 
-@dataclass
+@dataclass(slots=True)
 class Deadline:
     """An absolute point on the bus virtual clock.
 
@@ -254,7 +254,7 @@ class Attempt:
     error: str
 
 
-@dataclass
+@dataclass(slots=True)
 class RetryController:
     """Per-statement retry bookkeeping (ObQueryRetryCtrl's retry_cnt /
     retry_info). The session loop owns location refresh and the actual

@@ -56,6 +56,14 @@ class SchemaService:
         return self._version
 
     def guard(self, version: int | None = None) -> SchemaGuard:
+        if version is None:
+            # lock-free current-version read: dict.get is GIL-atomic, and
+            # a publish race (version bumped before its map lands) simply
+            # misses and falls through to the locked path below
+            v = self._version
+            m = self._maps.get(v)
+            if m is not None:
+                return SchemaGuard(v, m)
         with self._lock:
             v = self._version if version is None else version
             m = self._maps.get(v)

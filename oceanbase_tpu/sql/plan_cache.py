@@ -27,6 +27,7 @@ because the dominant cost is one XLA executable per entry).
 from __future__ import annotations
 
 import re
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field, replace as dc_replace
 
@@ -445,6 +446,12 @@ class PlanCache:
 
     def __init__(self, capacity: int = 128, metrics=None):
         self.capacity = capacity
+        # one lock over both tiers: every public method mutates shared
+        # OrderedDicts (move_to_end reorders even on reads) and the
+        # server's ThreadingTCPServer drives them from one thread per
+        # connection. RLock because metrics callbacks stay inside the
+        # critical section and a re-entrant flush must not self-deadlock.
+        self._lock = threading.RLock()
         self._entries: OrderedDict[tuple, CacheEntry] = OrderedDict()
         # text tier: kind-marked normalized text -> FastEntry. Same
         # capacity: a FastEntry is tiny next to the XLA executable its
@@ -461,30 +468,33 @@ class PlanCache:
         self.metrics = metrics
 
     def __len__(self):
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def get(self, key: tuple, count_miss: bool = True) -> CacheEntry | None:
-        ent = self._entries.get(key)
-        if ent is not None:
-            self._entries.move_to_end(key)
-            ent.hits += 1
-            self.stats.hits += 1
-            if self.metrics is not None:
-                self.metrics.add("plan cache hit")
-        elif count_miss:
-            self.stats.misses += 1
-            if self.metrics is not None:
-                self.metrics.add("plan cache miss")
-        return ent
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                ent.hits += 1
+                self.stats.hits += 1
+                if self.metrics is not None:
+                    self.metrics.add("plan cache hit")
+            elif count_miss:
+                self.stats.misses += 1
+                if self.metrics is not None:
+                    self.metrics.add("plan cache miss")
+            return ent
 
     def put(self, key: tuple, entry: CacheEntry):
-        self._entries[key] = entry
-        self._entries.move_to_end(key)
-        while len(self._entries) > self.capacity:
-            self._entries.popitem(last=False)
-            self.stats.evictions += 1
-            if self.metrics is not None:
-                self.metrics.add("plan cache eviction")
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.stats.evictions += 1
+                if self.metrics is not None:
+                    self.metrics.add("plan cache eviction")
 
     # ---- text tier -------------------------------------------------------
     def fast_peek(self, text_key: str) -> FastEntry | None:
@@ -494,49 +504,83 @@ class PlanCache:
         so a bind mismatch is honestly a miss)."""
         if not self.fast_enabled:
             return None
-        ent = self._fast.get(text_key)
-        if ent is not None:
-            self._fast.move_to_end(text_key)
+        with self._lock:
+            ent = self._fast.get(text_key)
+            if ent is not None:
+                self._fast.move_to_end(text_key)
+            return ent
+
+    def fast_hit_get(self, key: tuple,
+                     defer_adds: list | None = None) -> CacheEntry | None:
+        """Logical-tier get + hit accounting for a VALIDATED fast hit,
+        under one lock acquisition — the serving hot path runs this once
+        per statement, where get() + note_fast_hit() would take the cache
+        lock twice and the metrics lock twice (nested, at that). Metric
+        bumps move after the cache lock releases; a caller that flushes a
+        per-statement counter batch at statement end (the server session)
+        passes `defer_adds` and the bumps ride its one bulk() instead of
+        taking the metrics lock here. A None return means the logical
+        entry is gone; the caller notes the miss and drops the text entry
+        exactly as with get(count_miss=False)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                ent.hits += 1
+                self.stats.hits += 1
+                self.stats.fast_hits += 1
+        if ent is not None and self.metrics is not None:
+            if defer_adds is not None:
+                defer_adds.append(("plan cache hit", 1))
+                defer_adds.append(("plan cache fast hit", 1))
+            else:
+                self.metrics.bulk(adds=(("plan cache hit", 1),
+                                        ("plan cache fast hit", 1)))
         return ent
 
     def note_fast_hit(self) -> None:
-        self.stats.fast_hits += 1
-        if self.metrics is not None:
-            self.metrics.add("plan cache fast hit")
+        with self._lock:
+            self.stats.fast_hits += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache fast hit")
 
     def note_fast_miss(self) -> None:
-        self.stats.fast_misses += 1
-        if self.metrics is not None:
-            self.metrics.add("plan cache fast miss")
+        with self._lock:
+            self.stats.fast_misses += 1
+            if self.metrics is not None:
+                self.metrics.add("plan cache fast miss")
 
     def fast_put(self, text_key: str, entry: FastEntry) -> None:
         if not self.fast_enabled:
             return
-        self._fast[text_key] = entry
-        self._fast.move_to_end(text_key)
-        while len(self._fast) > self.capacity:
-            self._fast.popitem(last=False)
-            self.stats.fast_evictions += 1
-            if self.metrics is not None:
-                self.metrics.add("plan cache fast eviction")
+        with self._lock:
+            self._fast[text_key] = entry
+            self._fast.move_to_end(text_key)
+            while len(self._fast) > self.capacity:
+                self._fast.popitem(last=False)
+                self.stats.fast_evictions += 1
+                if self.metrics is not None:
+                    self.metrics.add("plan cache fast eviction")
 
     def fast_invalidate(self, text_key: str) -> None:
         """Drop one stale text entry (its logical entry vanished, or a
         fast execution failed) — the next occurrence re-registers."""
-        if self._fast.pop(text_key, None) is not None:
-            self.stats.fast_invalidations += 1
-            if self.metrics is not None:
-                self.metrics.add("plan cache fast invalidation")
+        with self._lock:
+            if self._fast.pop(text_key, None) is not None:
+                self.stats.fast_invalidations += 1
+                if self.metrics is not None:
+                    self.metrics.add("plan cache fast invalidation")
 
     def flush(self):
         """Flush BOTH tiers. Retry policies with flush_plan_cache
         (OB_SCHEMA_EAGAIN), DDL-driven invalidation and ALTER SYSTEM all
         land here — a text entry surviving a flush would replay a plan
         compiled against a dead schema."""
-        self._entries.clear()
-        if self._fast:
-            self.stats.fast_invalidations += len(self._fast)
-            if self.metrics is not None:
-                self.metrics.add(
-                    "plan cache fast invalidation", len(self._fast))
-            self._fast.clear()
+        with self._lock:
+            self._entries.clear()
+            if self._fast:
+                self.stats.fast_invalidations += len(self._fast)
+                if self.metrics is not None:
+                    self.metrics.add(
+                        "plan cache fast invalidation", len(self._fast))
+                self._fast.clear()

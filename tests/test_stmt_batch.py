@@ -1,0 +1,330 @@
+"""Cross-session statement micro-batching (server/batcher.py).
+
+Covers the PR-5 surface: concurrent fast-path hits on the same FastEntry
+fold into ONE batched device dispatch (vmap over the packed params only);
+results scatter back per lane and must be byte-identical to the solo
+path; privileges re-check per session so REVOKE bites batched entries;
+the fast tier survives an 8-thread hammer; and DeviceResult head fetches
+bucket their gather width to powers of two so a LIMIT sweep cannot
+explode the XLA compile count.
+"""
+
+import threading
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+N_KEYS = 50
+
+
+def _mkdb():
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table kv (id int primary key, k int, v int)")
+    rows = ", ".join(f"({i + 1}, {i}, {i * 7 + 3})" for i in range(N_KEYS))
+    s.sql(f"insert into kv values {rows}")
+    # register the fast entry + trace the solo executable outside the
+    # concurrent phase
+    for k in range(3):
+        s.sql(f"select v from kv where k = {k}").rows()
+    return db
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = _mkdb()
+    yield d
+    d.close()
+
+
+def _run_rounds(db, nthreads: int, rounds: int, wait_us: int = 50_000,
+                max_size: int = 0):
+    """Barrier-synced closed rounds: every thread issues one statement on
+    the SAME entry per round, so each round folds into one batch. Returns
+    {(thread, round): rows}."""
+    sessions = [db.session() for _ in range(nthreads)]
+    for s in sessions:
+        s.sql(f"set ob_batch_max_wait_us = {wait_us}")
+        s.sql(f"set ob_batch_max_size = {max_size or nthreads}")
+    barrier = threading.Barrier(nthreads)
+    results: dict = {}
+    errors: list = []
+
+    def worker(i: int) -> None:
+        s = sessions[i]
+        try:
+            for r in range(rounds):
+                barrier.wait()
+                k = (i + r) % N_KEYS
+                results[(i, r)] = (k, s.sql(
+                    f"select v from kv where k = {k}").rows())
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    return results
+
+
+def test_batched_results_match_solo(db):
+    """The A/B at the heart of the PR: identical statements produce
+    identical rows with the batcher on and off, and the ON leg actually
+    batches (dispatch amortization > 1)."""
+    c0 = db.metrics.counters_snapshot()
+    db.batcher.enabled = True
+    on = _run_rounds(db, nthreads=8, rounds=8)
+    c1 = db.metrics.counters_snapshot()
+    db.batcher.enabled = False
+    try:
+        off = _run_rounds(db, nthreads=8, rounds=8)
+    finally:
+        db.batcher.enabled = True
+
+    for key, (k, rows) in on.items():
+        assert rows == [(k * 7 + 3,)], key
+    assert {k: r for k, r in on.items()} == {k: r for k, r in off.items()}
+
+    batched = c1.get("stmt batched statements", 0) - c0.get(
+        "stmt batched statements", 0)
+    dispatches = c1.get("stmt batched dispatches", 0) - c0.get(
+        "stmt batched dispatches", 0)
+    assert dispatches > 0 and batched / dispatches > 1.0
+    # pow2 padding keeps the compile count bounded: 8-lane rounds touch
+    # bucket 8 (plus smaller buckets for straggler rounds), never more
+    # executables than log2(max bucket) + 1
+    assert db.engine.executor.batched_compiles <= 4
+
+
+def test_batch_observability(db):
+    """Audit rows carry is_batched/batch_id/batch_wait_us; lanes of one
+    dispatch share a batch_id; sysstat grows pow2 size counters and the
+    batcher wait event."""
+    a0 = len(db.audit.records())
+    _run_rounds(db, nthreads=4, rounds=4)
+    recs = [r for r in db.audit.records()[a0:]
+            if r.sql.startswith("select v from kv") and r.is_batched]
+    assert recs, "no batched audit rows"
+    by_batch: dict = {}
+    for r in recs:
+        assert r.batch_id > 0 and r.batch_wait_us >= 0
+        by_batch.setdefault(r.batch_id, []).append(r)
+    assert any(len(v) > 1 for v in by_batch.values())
+    snap = db.metrics.counters_snapshot()
+    assert any(name.startswith("stmt batch size ") for name in snap)
+    assert any(w.event == "stmt batch window"
+               for w in db.metrics.waits_snapshot())
+
+
+def test_solo_leader_degrades(db):
+    """A leader nobody joins falls back to the plain fast path — correct
+    rows, `stmt batch solo` counted, no 1-lane device batch."""
+    s = db.session()
+    s.sql("set ob_batch_max_wait_us = 100")
+    s.sql("set ob_batch_max_size = 8")
+    c0 = db.metrics.counters_snapshot()
+    assert s.sql("select v from kv where k = 11").rows() == [(80,)]
+    c1 = db.metrics.counters_snapshot()
+    assert c1.get("stmt batch solo", 0) > c0.get("stmt batch solo", 0)
+    assert c1.get("stmt batched dispatches", 0) == c0.get(
+        "stmt batched dispatches", 0)
+
+
+def test_tx_scoped_statements_never_batch(db):
+    """An open transaction pins its snapshot — tx statements skip the
+    fast path entirely and so can never ride a cross-session batch."""
+    a0 = len(db.audit.records())
+    s = db.session()
+    s.sql("begin")
+    assert s.sql("select v from kv where k = 5").rows() == [(38,)]
+    s.sql("commit")
+    recs = [r for r in db.audit.records()[a0:]
+            if r.sql.startswith("select v from kv")]
+    assert recs and all(not r.is_batched for r in recs)
+
+
+def test_fast_tier_hammer_8_threads(db):
+    """Satellite 1: 8 threads hammer one FastEntry (rebind + logical get
+    + batcher window) while another thread periodically flushes the plan
+    cache — every statement must still return the right rows (a lost
+    update in the text tier would surface as a wrong bind or a crash)."""
+    nthreads, iters = 8, 40
+    stop = threading.Event()
+    errors: list = []
+
+    def flusher() -> None:
+        while not stop.is_set():
+            db.plan_cache.flush()
+            stop.wait(0.005)
+
+    def worker(i: int) -> None:
+        s = db.session()
+        s.sql("set ob_batch_max_wait_us = 500")
+        try:
+            for j in range(iters):
+                k = (i * 11 + j) % N_KEYS
+                got = s.sql(f"select v from kv where k = {k}").rows()
+                assert got == [(k * 7 + 3,)], (i, j, k, got)
+        except Exception as e:
+            errors.append(e)
+
+    fl = threading.Thread(target=flusher)
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(nthreads)]
+    fl.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    fl.join()
+    assert not errors, errors
+    st = db.plan_cache.stats
+    assert st.fast_hits > 0 and st.fast_misses > 0  # both paths exercised
+
+
+def test_fetch_head_pow2_compile_bound(db):
+    """Satellite 2: sweeping LIMIT k over a device-resident result keeps
+    the head-gather compile count at the pow2 bucket count, not one per
+    distinct k — and a repeat sweep compiles nothing."""
+    from oceanbase_tpu.engine import executor as X
+
+    s = db.session()
+    sweep = list(range(1, 13))  # 12 distinct ks -> buckets {1,2,4,8,16}
+
+    def run_sweep() -> None:
+        for k in sweep:
+            rows = s.sql("select id, v from kv where v > 0").rows(limit=k)
+            assert len(rows) == min(k, N_KEYS)
+
+    t0 = X._head_gather_traces[0]
+    run_sweep()
+    t1 = X._head_gather_traces[0]
+    assert t1 - t0 <= 5, f"{t1 - t0} head-gather traces for 12 ks"
+    run_sweep()
+    assert X._head_gather_traces[0] == t1  # warm sweep: zero new traces
+
+
+# ---------------------------------------------------------------- wire e2e
+
+
+def _wire_worker(port, user, password, keys, out, errors, barrier):
+    from test_mysql_front import MiniMySqlClient
+
+    try:
+        c = MiniMySqlClient(port, user=user, password=password)
+        c.query("set ob_batch_max_wait_us = 20000")
+        barrier.wait()
+        got = []
+        for k in keys:
+            _names, rows = c.query(f"select v from kv where k = {k}")
+            got.append(rows)
+        out.append(got)
+        c.close()
+    except Exception as e:  # pragma: no cover - surfaced by assert
+        errors.append(e)
+
+
+def test_mysql_front_concurrent_on_off_identical():
+    """Satellite 3: N threaded wire connections (one server thread each,
+    exactly the ThreadingTCPServer shape the batcher serves) produce
+    identical result sets with batching on and off."""
+    from oceanbase_tpu.server.mysql_front import MySqlFrontend
+
+    db = _mkdb()
+    front = MySqlFrontend(db).start()
+    try:
+        legs = {}
+        for batching in (True, False):
+            db.batcher.enabled = batching
+            nthreads = 6
+            keys = [[(i * 7 + j) % N_KEYS for j in range(12)]
+                    for i in range(nthreads)]
+            outs = [[] for _ in range(nthreads)]
+            errors: list = []
+            barrier = threading.Barrier(nthreads)
+            threads = [
+                threading.Thread(target=_wire_worker, args=(
+                    front.port, "root", "", keys[i], outs[i], errors,
+                    barrier))
+                for i in range(nthreads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            legs[batching] = outs
+            for i in range(nthreads):
+                assert outs[i], f"thread {i} produced nothing"
+                for j, k in enumerate(keys[i]):
+                    assert outs[i][0][j] == [(str(k * 7 + 3),)]
+        assert legs[True] == legs[False]
+        assert db.metrics.counter("stmt batched statements") > 0
+    finally:
+        db.batcher.enabled = True
+        front.stop()
+        db.close()
+
+
+def test_mysql_front_revoke_bites_batched_entries():
+    """Satellite 3: REVOKE mid-stream — the per-session privilege
+    re-check runs BEFORE batch admission, so a revoked user's next hit
+    on a warm (batched) entry fails with 1142 over the wire."""
+    from oceanbase_tpu.server.mysql_front import MySqlFrontend
+
+    from test_mysql_front import MiniMySqlClient
+
+    db = _mkdb()
+    root = db.session()
+    root.sql("create user alice identified by 'pw'")
+    root.sql("grant select on kv to alice")
+    front = MySqlFrontend(db).start()
+    try:
+        clients = [MiniMySqlClient(front.port, user="alice", password="pw")
+                   for _ in range(4)]
+        barrier = threading.Barrier(5)
+        phase2 = threading.Event()
+        errors: list = []
+        denied = [0] * 4
+
+        def worker(i: int) -> None:
+            c = clients[i]
+            try:
+                barrier.wait()
+                for k in range(8):  # warm stream: grants in place
+                    _n, rows = c.query(f"select v from kv where k = {k}")
+                    assert rows == [(str(k * 7 + 3),)]
+                barrier.wait()   # root revokes here
+                phase2.wait()
+                for k in range(8):
+                    try:
+                        c.query(f"select v from kv where k = {k}")
+                    except RuntimeError as e:
+                        assert "1142" in str(e), e
+                        denied[i] += 1
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        barrier.wait()   # release phase 1
+        barrier.wait()   # all workers idle between phases
+        root.sql("revoke select on kv from alice")
+        phase2.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert all(d == 8 for d in denied), denied
+        for c in clients:
+            c.close()
+    finally:
+        front.stop()
+        db.close()

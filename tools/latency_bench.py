@@ -27,17 +27,29 @@ One-line JSON contract (last stdout line is always complete, exit 0):
   {"metric": "serving_stmts_per_sec", "value": <point warm stmts/s>,
    "vs_baseline": <speedup vs no-fastpath>, "detail": {...}}
 
+Multi-session serving mode (--sessions N): N closed-loop threads, each
+with its own DbSession, hammer the SAME parameterized point read through
+the server concurrently — the cross-session micro-batcher's target
+shape. Reports aggregate stmts/s + p50/p99 per statement + mean batch
+size + batched-executable compile count, as an in-process A/B (batching
+on vs off over identical workloads). --serve-strict gates CI: batches
+must actually form (mean batch size > 1) and the compile count must stay
+within the pow2 bucket bound.
+
 Env/flags: --rows (table size, default 20000), --stmts (timed statements
 per workload, default 300), --warmup (default 20), --strict (exit 1 unless
-the warm window's fast-path hit rate is 100%), LATENCY_BUDGET_S (default
-300; stops starting new workloads near the budget, partial results still
-emit).
+the warm window's fast-path hit rate is 100%), --sessions (enable serving
+mode), --serve-seconds (per A/B leg, default 2.5), --batch-wait-us /
+--batch-max-size (batcher knobs for the ON leg), --serve-strict,
+LATENCY_BUDGET_S (default 300; stops starting new workloads near the
+budget, partial results still emit).
 """
 
 import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -107,6 +119,182 @@ def phase_breakdown(db, n: int) -> dict:
     }
 
 
+def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
+                  max_size: int, batching: bool) -> dict:
+    """One closed-loop leg: N session threads hammer the same warm
+    parameterized point read for `seconds`. Batcher state and metric
+    deltas are scoped to the leg."""
+    db.batcher.enabled = batching
+    sessions = [db.session() for _ in range(nsessions)]
+    for s in sessions:
+        s.sql(f"set ob_batch_max_wait_us = {wait_us}")
+        s.sql(f"set ob_batch_max_size = {max_size}")
+    # warm: entry registered + solo executable traced OUTSIDE the
+    # timed window (the solo leg measures serving, not compiles)
+    for s in sessions[:2]:
+        for k in range(4):
+            s.sql(f"select v from kv where k = {k}").rows()
+    if batching:
+        # pre-trace every pow2 bucket executable the leg can touch: a
+        # straggler lane forms a partial batch whose bucket would
+        # otherwise compile (~100ms) inside the measured window, denting
+        # both throughput and p99 for one arbitrary cohort
+        from oceanbase_tpu.ops.hashing import next_pow2
+        from oceanbase_tpu.sql import parser as P
+
+        fkey, params, _kinds = P.fast_normalize(
+            "select v from kv where k = 0")
+        hit = db.engine.fast_lookup(fkey, params)
+        if hit is not None and getattr(hit.entry.prepared, "batchable",
+                                       False):
+            prepared = hit.entry.prepared
+            qrow = prepared.bind(hit.values, hit.entry.dtypes)
+            bucket = 2
+            while bucket <= next_pow2(max_size):
+                prepared.run_batched_host(np.stack([qrow] * bucket))
+                bucket *= 2
+    lats: list[list[float]] = [[] for _ in range(nsessions)]
+    warm_stop = threading.Event()
+    stop = threading.Event()
+    b_start = threading.Barrier(nsessions + 1)
+    b_warm_done = threading.Barrier(nsessions + 1)
+    b_measure = threading.Barrier(nsessions + 1)
+
+    # statement texts precomputed per session: the timed loop measures
+    # the serving path, not f-string formatting
+    texts = [[f"select v from kv where k = {(i * 17 + j) % 50}"
+              for j in range(50)] for i in range(nsessions)]
+
+    def worker(i: int) -> None:
+        s = sessions[i]
+        lat = lats[i]
+        tx = texts[i]
+        j = 0
+        b_start.wait()
+        # untimed concurrent warm: ramp-up forms partial batches, so the
+        # pow2 bucket executables (and, batching off, the contended solo
+        # path) compile HERE, not inside the measured window
+        while not warm_stop.is_set():
+            s.sql(tx[j % 50]).rows()
+            j += 1
+        b_warm_done.wait()
+        b_measure.wait()
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            s.sql(tx[j % 50]).rows()
+            lat.append(time.perf_counter() - t0)
+            j += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(nsessions)]
+    for t in threads:
+        t.start()
+    b_start.wait()
+    warm_stop.wait(0.75)
+    warm_stop.set()
+    b_warm_done.wait()
+    # every worker is idle between the barriers: snapshot cleanly
+    c0 = db.metrics.counters_snapshot()
+    compiles0 = db.engine.executor.batched_compiles
+    b_measure.wait()
+    t_start = time.perf_counter()
+    stop.wait(seconds)
+    stop.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    c1 = db.metrics.counters_snapshot()
+
+    def delta(name: str) -> int:
+        return int(c1.get(name, 0) - c0.get(name, 0))
+
+    lat = np.array([x for ls in lats for x in ls])
+    total = len(lat)
+    batched = delta("stmt batched statements")
+    dispatches = delta("stmt batched dispatches")
+    solos = delta("stmt batch solo")
+    # mean device-dispatch amortization over the whole leg: every
+    # statement counts, batched ones share a launch, everything else
+    # (solo leaders, bypasses, the OFF leg) launches alone
+    launches = dispatches + (total - batched)
+    out = {
+        "batching": batching,
+        "stmts": total,
+        "stmts_per_sec": round(total / wall, 1),
+        **(percentiles(lat) if total else {}),
+        "batched_stmts": batched,
+        "batched_dispatches": dispatches,
+        "solo_leaders": solos,
+        "batch_bypass": delta("stmt batch bypass"),
+        "mean_batch_size": round(batched / dispatches, 2) if dispatches
+        else 0.0,
+        "mean_stmts_per_launch": round(total / launches, 2) if launches
+        else 0.0,
+        "batched_compiles": (db.engine.executor.batched_compiles
+                             - compiles0),
+    }
+    return out
+
+
+def run_serve(db, args, detail: dict) -> tuple[bool, dict, dict]:
+    """In-process A/B: batching OFF then ON over identical closed-loop
+    workloads. Returns (strict_ok, off_leg, on_leg)."""
+    from oceanbase_tpu.ops.hashing import next_pow2
+
+    # serving tunes applied identically to BOTH legs, the standard
+    # CPython threaded-server pair:
+    #   * a 20ms GIL switch interval — with tens of session threads
+    #     trading sub-ms statements, the default 5ms forces pointless
+    #     preemptions mid-statement (neutral for the solo leg);
+    #   * gc.freeze + 10x gen0 threshold — each statement allocates a few
+    #     dozen short-lived objects, and default thresholds run a gen0
+    #     sweep over the whole warm engine every ~20 statements, all of
+    #     it serialized on the GIL.
+    import gc
+
+    swi0 = sys.getswitchinterval()
+    gc0 = gc.get_threshold()
+    sys.setswitchinterval(0.02)
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(7000, 100, 100)
+    try:
+        off = run_serve_leg(db, args.sessions, args.serve_seconds,
+                            args.batch_wait_us, args.batch_max_size,
+                            batching=False)
+        on = run_serve_leg(db, args.sessions, args.serve_seconds,
+                           args.batch_wait_us, args.batch_max_size,
+                           batching=True)
+    finally:
+        sys.setswitchinterval(swi0)
+        gc.set_threshold(*gc0)
+        gc.unfreeze()
+    db.batcher.enabled = True
+    # XLA compile bound: one batched executable per pow2 bucket in
+    # [2, next_pow2(max_size)], regardless of traffic shape
+    bound = max(int(np.log2(next_pow2(args.batch_max_size))), 1)
+    speedup = (on["stmts_per_sec"] / off["stmts_per_sec"]
+               if off["stmts_per_sec"] else 0.0)
+    serve = {
+        "sessions": args.sessions,
+        "leg_seconds": args.serve_seconds,
+        "batch_wait_us": args.batch_wait_us,
+        "batch_max_size": args.batch_max_size,
+        "off": off,
+        "on": on,
+        "batching_speedup": round(speedup, 3),
+        "p99_on_vs_p50_off": (
+            round(on["p99_us"] / off["p50_us"], 3)
+            if on.get("p99_us") and off.get("p50_us") else 0.0),
+        "compile_bound_pow2": bound,
+        "compiles_within_bound": on["batched_compiles"] <= bound,
+    }
+    detail["serve"] = serve
+    ok = (on.get("mean_batch_size", 0) > 1.0
+          and serve["compiles_within_bound"])
+    return ok, off, on
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=20000)
@@ -114,6 +302,17 @@ def main() -> int:
     ap.add_argument("--warmup", type=int, default=20)
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 unless warm fast-path hit rate is 100%")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="closed-loop serving mode: N concurrent sessions")
+    ap.add_argument("--serve-seconds", type=float, default=2.5,
+                    help="seconds per A/B leg in serving mode")
+    ap.add_argument("--batch-wait-us", type=int, default=1000,
+                    help="batcher window for the ON leg")
+    ap.add_argument("--batch-max-size", type=int, default=16,
+                    help="batcher max lanes for the ON leg")
+    ap.add_argument("--serve-strict", action="store_true",
+                    help="exit 1 unless batches form (mean size > 1) and "
+                         "batched compiles stay within the pow2 bound")
     args = ap.parse_args()
     budget = float(os.environ.get("LATENCY_BUDGET_S", "300"))
 
@@ -124,6 +323,24 @@ def main() -> int:
         "stmts": args.stmts,
         "setup_s": round(time.perf_counter() - t0, 2),
     }
+
+    if args.sessions > 0:
+        serve_ok, off, on = run_serve(db, args, detail)
+        detail["total_s"] = round(elapsed(), 1)
+        emit({
+            "metric": "serving_concurrent_stmts_per_sec",
+            "value": on["stmts_per_sec"],
+            "unit": "stmts/s",
+            "vs_baseline": detail["serve"]["batching_speedup"],
+            "detail": detail,
+        })
+        if args.serve_strict and not serve_ok:
+            print("SERVE-STRICT: batches did not form (mean batch size "
+                  f"{on.get('mean_batch_size')}) or compiles exceeded the "
+                  f"pow2 bound ({on.get('batched_compiles')})",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     k_cycle = list(range(0, min(args.rows, 50)))
     workloads = {

@@ -4,7 +4,7 @@
 # 8 virtual devices via conftest.py), skips slow-marked tests, and
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
-#   tools/run_tier1.sh [--chaos] [--latency] [extra pytest args...]
+#   tools/run_tier1.sh [--chaos] [--latency] [--serve] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -14,6 +14,11 @@
 # --latency additionally runs a small serving-latency smoke
 # (tools/latency_bench.py --strict): warm repeated statements must hit
 # the text-keyed fast path 100% of the time, else the smoke fails.
+#
+# --serve additionally runs the concurrent-serving smoke
+# (tools/latency_bench.py --sessions 16 --serve-strict): the statement
+# micro-batcher must actually form batches (mean batch size > 1) and
+# keep batched XLA compiles within the pow2 bucket bound.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -21,10 +26,12 @@ rm -f /tmp/_t1.log
 
 chaos=0
 latency=0
+serve=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
         --latency) latency=1; shift ;;
+        --serve) serve=1; shift ;;
         *) break ;;
     esac
 done
@@ -46,6 +53,12 @@ fi
 if [ "$latency" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
         --rows 2000 --stmts 80 --warmup 10 --strict
+    rc=$?
+fi
+
+if [ "$serve" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
+        --rows 1000 --sessions 16 --serve-seconds 2 --serve-strict
     rc=$?
 fi
 exit $rc
