@@ -449,6 +449,87 @@ class Executor:
         rec(plan)
         return needed
 
+    def _access_columns(self, plan: LogicalOp) -> dict[str, set]:
+        """alias -> set of (column, role) pairs for the workload access
+        stats: which columns the plan uses as filter predicates, join
+        keys, group keys, or sort keys (server/workload.ROLE_* indices).
+        Same reference walk as _needed_columns, keeping the role."""
+        from ..server.workload import (
+            ROLE_FILTER,
+            ROLE_GROUP,
+            ROLE_JOIN,
+            ROLE_SORT,
+        )
+
+        acc: dict[str, set] = {}
+        # output name -> defining expr across every Project in the plan:
+        # the planner rewrites sort/group keys into synthetic projected
+        # columns ($ordN), so an unqualified ColRef must chase its
+        # definition back to the base columns it computes from
+        defs: dict[str, E.Expr] = {}
+
+        def collect_defs(op):
+            if isinstance(op, Project):
+                for name, e in op.exprs:
+                    defs.setdefault(name, e)
+            for c in _children(op):
+                collect_defs(c)
+
+        collect_defs(plan)
+
+        def note(e: E.Expr, role: int, depth: int = 0):
+            for q in E.referenced_columns(e):
+                if "." in q:
+                    a, c = q.split(".", 1)
+                    acc.setdefault(a, set()).add((c, role))
+                elif depth < 4 and q in defs:
+                    note(defs[q], role, depth + 1)
+
+        def rec(op):
+            if isinstance(op, Scan) and op.pushed_filter is not None:
+                note(op.pushed_filter, ROLE_FILTER)
+            if isinstance(op, Filter):
+                note(op.pred, ROLE_FILTER)
+            if isinstance(op, JoinOp):
+                for e in op.left_keys + op.right_keys:
+                    note(e, ROLE_JOIN)
+            if isinstance(op, Aggregate):
+                for _, e in op.group_keys:
+                    note(e, ROLE_GROUP)
+            if isinstance(op, (Sort, TopN)):
+                for e, _ in op.keys:
+                    note(e, ROLE_SORT)
+            for c in _children(op):
+                rec(c)
+
+        rec(plan)
+        return acc
+
+    def _access_profile(self, scans0: list, routed_plan: LogicalOp,
+                        roles: dict[str, set]) -> tuple:
+        """Static per-compiled-plan access profile: one entry per scan —
+        (base table, row count at compile time, has sorted projections,
+        routed to one, ((column, role), ...)). scans0 are the PRE-routing
+        scans; routing is identity-preserving for plan structure, so the
+        post-routing scan list pairs positionally (projection hits show
+        as a changed scan.table). Virtual tables are excluded — querying
+        the stats must not pollute them."""
+        scans1 = self._collect_scans(routed_plan)
+        out = []
+        cat = self.catalog
+        for s0, s1 in zip(scans0, scans1):
+            if s0.table.startswith(("__all_virtual", "$")):
+                # virtual tables and planner-internal relations (chunked
+                # $partials overlays) are not workload objects
+                continue
+            t = cat[s0.table] if s0.table in cat else None
+            rows = t.nrows if t is not None else 0
+            has_proj = bool(getattr(t, "sorted_projections", None))
+            cols = tuple(sorted(roles.get(s0.alias, ())))
+            out.append((s0.table, rows, has_proj, s1.table != s0.table,
+                        cols))
+        return tuple(out)
+
     def invalidate_table(self, name: str) -> None:
         """Drop cached device batches of one table (its data changed)."""
         self._table_version[name] = self._table_version.get(name, 0) + 1
@@ -2940,7 +3021,12 @@ class Executor:
         (the expensive artifact — this is what the plan cache stores).
         Inputs beyond the device budget return a ChunkedPreparedPlan that
         streams the biggest table through the program (engine/chunked.py)."""
+        scans0 = self._collect_scans(plan)
+        roles = self._access_columns(plan)
         plan = self._route_projections(plan)
+        # workload access heat: computed ONCE at compile time, folded per
+        # execution from the prepared plan (no plan walks on the hot path)
+        access = self._access_profile(scans0, plan, roles)
         if self.chunking_enabled:
             from .chunked import (
                 ChunkedPreparedPlan,
@@ -2953,14 +3039,19 @@ class Executor:
                 try:
                     stream, split, kind = _find_stream_split(
                         self, plan, self.device_budget)
-                    return ChunkedPreparedPlan(
+                    cp = ChunkedPreparedPlan(
                         self, plan, stream, split, kind, self.chunk_rows
                     )
+                    cp.access_profile = access
+                    return cp
                 except NotStreamable:
                     pass  # whole-table upload; may exhaust device memory
         params = self.seed_params(plan)
         jitted, input_spec, overflow_nodes = self.compile(plan, params)
-        return PreparedPlan(self, plan, params, jitted, input_spec, overflow_nodes)
+        prepared = PreparedPlan(
+            self, plan, params, jitted, input_spec, overflow_nodes)
+        prepared.access_profile = access
+        return prepared
 
     def execute(self, plan: LogicalOp, max_retries: int = 3):
         return self.prepare(plan).run(max_retries)

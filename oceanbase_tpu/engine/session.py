@@ -68,12 +68,17 @@ class LazyResultSet:
         self.fast_path_hit = fast_path_hit
         self._cursor = cursor
         self._columns_cache: dict | None = None
+        self._nrows: int | None = None
 
     @property
     def nrows(self) -> int:
-        if not self.names:
-            return 0
-        return self._cursor.nrows
+        # memoized: the completion path reads nrows several times per
+        # statement (engine sync force, audit record, summary fold) and
+        # each uncached read walks two property hops into the cursor
+        n = self._nrows
+        if n is None:
+            n = self._nrows = self._cursor.nrows if self.names else 0
+        return n
 
     @property
     def columns(self) -> dict[str, object]:
@@ -143,6 +148,9 @@ class Session:
         self.tracer = tracer
         # hook: config enable_query_profile (None = always profile)
         self.profile_enabled_fn = profile_enabled_fn
+        # hook: server/workload.TableAccessStats — per-execution fold of
+        # the prepared plan's precomputed table/column access profile
+        self.access = None
         # per-statement phase breakdown of the LAST run_ast call (EXPLAIN
         # ANALYZE reads it right after executing the analyzed statement)
         self.last_phases: dict = {}
@@ -580,6 +588,18 @@ class Session:
         exec_s = time.perf_counter() - exec_t0
         phases["exec_s"] = exec_s
         phases["rows"] = nrows
+        acc = self.access
+        if acc is not None and acc.enabled:
+            # access heat: the profile resolves to live stat objects once
+            # per (prepared, epoch); every execution after that folds
+            # through direct references (no dict lookups)
+            memo = getattr(prepared, "_access_memo", None)
+            if memo is None or memo[0] != acc.epoch:
+                memo = (acc.epoch, acc.resolve(
+                    getattr(prepared, "access_profile", ())))
+                prepared._access_memo = memo
+            if memo[1]:
+                acc.fold_resolved(memo[1])
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1

@@ -464,6 +464,39 @@ class Database:
         self.config.on_change(
             "trace_log_slow_query_watermark",
             lambda _n, _o, v: setattr(self.flight, "watermark_s", v))
+        # workload repository (server/workload.py): digest-keyed statement
+        # summaries + table/column access heat folded at statement
+        # completion, bounded AWR-style snapshots on demand or periodic
+        from .workload import (
+            StatementSummaryRegistry,
+            TableAccessStats,
+            WorkloadRepository,
+        )
+
+        self.stmt_summary = StatementSummaryRegistry(
+            max_digests=self.config["ob_sql_stat_max_digests"],
+            metrics=self.metrics)
+        self.access = TableAccessStats()
+        self.stmt_summary.enabled = self.config["enable_sql_stat"]
+        self.access.enabled = self.config["enable_sql_stat"]
+        self.workload = WorkloadRepository(
+            capacity=self.config["workload_snapshot_capacity"])
+        self.workload.interval_s = self.config["workload_snapshot_interval"]
+
+        def _sql_stat_toggle(_n, _o, v):
+            self.stmt_summary.enabled = v
+            self.access.enabled = v
+
+        self.config.on_change("enable_sql_stat", _sql_stat_toggle)
+        self.config.on_change(
+            "ob_sql_stat_max_digests",
+            lambda _n, _o, v: self.stmt_summary.set_max_digests(v))
+        self.config.on_change(
+            "workload_snapshot_capacity",
+            lambda _n, _o, v: self.workload.set_capacity(v))
+        self.config.on_change(
+            "workload_snapshot_interval",
+            lambda _n, _o, v: setattr(self.workload, "interval_s", v))
         self._session_ids = itertools.count(1)
 
         # storage maintenance: block cache, dag scheduler, freeze loop
@@ -550,6 +583,8 @@ class Database:
             tracer=self.tracer,
             profile_enabled_fn=lambda: self.config["enable_query_profile"],
         )
+        # workload access heat folds per execution inside the engine
+        self.engine.access = self.access
         # cross-session statement micro-batcher: concurrent fast-path
         # hits on the same plan fold into one batched device dispatch
         # (server/batcher.py; knobs ob_batch_max_size/ob_batch_max_wait_us)
@@ -1621,6 +1656,14 @@ class DbSession:
         self._stmt_cache_hit = False
         self._retry_ctrl = None
         self._stmt_adds: list = []
+        # (fkey, params, kinds) from the statement fast path — also the
+        # statement-summary digest source. Reset per statement in
+        # _sql_inner: prefix-dispatched statements (SET/XA/CALL/...)
+        # return before _dispatch clears it, and a stale value would
+        # mis-digest them under the previous SELECT
+        self._fast_reg = None
+        # lazily-created statement-summary accumulator (workload.py)
+        self._ws_acc = None
         # session variables (SET <name> = <value>): full-link trace
         # collection flag, PX degree-of-parallelism routing, and the
         # statement/transaction deadlines in MICROSECONDS of virtual time
@@ -1721,6 +1764,7 @@ class DbSession:
         # cache hit bumps here so the whole statement flushes through
         # ONE metrics.bulk() below
         self._stmt_adds = []
+        self._fast_reg = None
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
@@ -1735,6 +1779,37 @@ class DbSession:
                     elapsed_s = _time.perf_counter() - t0
                     stype = self._last_stmt_type or "Unknown"
                     m = db.metrics
+                    prof = db.engine.last_profile
+                    if rs is not None \
+                            and getattr(rs, "profile", None) is not None:
+                        # batched fast path: the per-lane profile rides
+                        # the ResultSet (engine.last_profile is shared
+                        # across sessions and races under concurrency)
+                        prof = rs.profile
+                    bi = (getattr(rs, "batch_info", None)
+                          if rs is not None else None)
+                    ws = db.stmt_summary
+                    if ws.enabled:
+                        # exactly-once digest fold per statement — here in
+                        # the completion finally, never in the except arm
+                        # or the flight recorder, so a statement that both
+                        # fails AND trips the slow-query watermark counts
+                        # its error once. Fast-path statements reuse the
+                        # already-tokenized key in _fast_reg for free, and
+                        # the fold buffers into this session's own
+                        # accumulator (readers flush before reading) so
+                        # a completing batch cohort takes no shared lock.
+                        acc = self._ws_acc
+                        if acc is None:
+                            acc = self._ws_acc = ws.session_acc()
+                        fr = self._fast_reg
+                        acc.fold(
+                            fr[0] if fr is not None else P.digest_text(text),
+                            stype, elapsed_s, err,
+                            self._retry_ctrl.retry_cnt
+                            if self._retry_ctrl else 0,
+                            rs, bi is not None, prof,
+                        )
                     # hot-path diet: when metrics/audit are disabled, skip
                     # even the counter lookups and kwargs construction —
                     # the serving path pays zero for observability it
@@ -1750,15 +1825,6 @@ class DbSession:
                             adds.append(("sql fail count", 1))
                         m.bulk(adds=adds,
                                observes=(("sql response time", elapsed_s),))
-                    prof = db.engine.last_profile
-                    if rs is not None \
-                            and getattr(rs, "profile", None) is not None:
-                        # batched fast path: the per-lane profile rides
-                        # the ResultSet (engine.last_profile is shared
-                        # across sessions and races under concurrency)
-                        prof = rs.profile
-                    bi = (getattr(rs, "batch_info", None)
-                          if rs is not None else None)
                     if db.audit.enabled:
                         p = prof
                         db.audit.record(
@@ -1794,6 +1860,9 @@ class DbSession:
                             self._last_trace_id = sp.trace_id
                         self._maybe_flight_record(
                             text, sp, elapsed_s, rs, err, prof)
+                    wr = db.workload
+                    if wr.interval_s > 0:
+                        wr.maybe_auto(db)
         return rs
 
     def _stmt_retryable(self) -> bool:
@@ -1887,6 +1956,10 @@ class DbSession:
             "trace_id": sp.trace_id,
             "session_id": self.session_id,
             "sql": text,
+            # same digest the statement summary folded under — a bundle
+            # joins its aggregate without re-normalizing
+            "digest": (self._fast_reg[0] if self._fast_reg is not None
+                       else P.digest_text(text)),
             "stmt_type": self._last_stmt_type,
             "elapsed_s": elapsed_s,
             "rows": rs.nrows if rs is not None else 0,
@@ -2051,6 +2124,16 @@ class DbSession:
         if low.startswith("create sequence") or low.startswith("drop sequence"):
             self._last_stmt_type = "Sequence"
             return self._sequence_ddl(text)
+        if low.startswith("snapshot workload"):
+            # workload repository capture (server/workload.py): freeze the
+            # current summary/access/census/sysstat state into the bounded
+            # snapshot ring; tools/awr_report.py diffs two of them
+            self._last_stmt_type = "SnapshotWorkload"
+            snap = self.db.workload.take(self.db)
+            return ResultSet(
+                ("snap_id", "ts"),
+                {"snap_id": [snap["snap_id"]], "ts": [float(snap["ts"])]},
+            )
         if low.split(None, 1)[:1] == ["explain"]:
             self._last_stmt_type = "Explain"
             return self._explain(text.lstrip()[len("explain"):].lstrip())
@@ -2994,6 +3077,10 @@ class DbSession:
             dicts[col] = sd
         if used_idx is not None:
             used_idx.reads += 1
+        if self.db.access.enabled:
+            # workload heat: host-side DAS lookups are reads the device
+            # scan path never sees
+            self.db.access.record_das(tref.name, len(rows))
         return {tref.name: Table(tref.name, ti.schema, data, dicts)}
 
     def _select(self, ast: A.Select, norm_key: str, fast_reg=None

@@ -403,6 +403,100 @@ def _mviews(db) -> Table:
     ])
 
 
+def _statement_summary(db) -> Table:
+    """Digest-keyed rolling statement aggregates (server/workload.py) —
+    the durable view the sql_audit ring cannot give: per-digest exec/fail
+    counts, latency quantiles and phase sums across every execution."""
+    ss = db.stmt_summary.snapshot()
+    us = 1e6
+    return _t("__all_virtual_statement_summary", [
+        ("digest", DataType.varchar(), [s["digest"] for s in ss]),
+        ("stmt_type", DataType.varchar(), [s["stmt_type"] for s in ss]),
+        ("executions", DataType.int64(), [s["exec_count"] for s in ss]),
+        ("fails", DataType.int64(), [s["fail_count"] for s in ss]),
+        ("retries", DataType.int64(), [s["retry_count"] for s in ss]),
+        ("rows_returned", DataType.int64(),
+         [s["rows_returned"] for s in ss]),
+        ("affected_rows", DataType.int64(),
+         [s["affected_rows"] for s in ss]),
+        ("fast_path_hits", DataType.int64(),
+         [s["fast_path_count"] for s in ss]),
+        ("batched", DataType.int64(), [s["batched_count"] for s in ss]),
+        ("cache_hits", DataType.int64(),
+         [s["cache_hit_count"] for s in ss]),
+        ("total_elapsed_us", DataType.int64(),
+         [int(s["total_elapsed_s"] * us) for s in ss]),
+        ("avg_elapsed_us", DataType.int64(),
+         [int(s["total_elapsed_s"] / s["exec_count"] * us) for s in ss]),
+        ("max_elapsed_us", DataType.int64(),
+         [int(s["max_elapsed_s"] * us) for s in ss]),
+        ("p50_us", DataType.int64(), [int(s["p50_s"] * us) for s in ss]),
+        ("p95_us", DataType.int64(), [int(s["p95_s"] * us) for s in ss]),
+        ("p99_us", DataType.int64(), [int(s["p99_s"] * us) for s in ss]),
+        ("fastparse_us", DataType.int64(),
+         [int(s["fastparse_s"] * us) for s in ss]),
+        ("bind_us", DataType.int64(), [int(s["bind_s"] * us) for s in ss]),
+        ("dispatch_us", DataType.int64(),
+         [int(s["dispatch_s"] * us) for s in ss]),
+        ("fetch_us", DataType.int64(),
+         [int(s["fetch_s"] * us) for s in ss]),
+        ("compile_us", DataType.int64(),
+         [int(s["compile_s"] * us) for s in ss]),
+        ("transfer_bytes", DataType.int64(),
+         [s["transfer_bytes"] for s in ss]),
+        ("max_device_bytes", DataType.int64(),
+         [s["max_device_bytes"] for s in ss]),
+        ("max_peak_bytes", DataType.int64(),
+         [s["max_peak_bytes"] for s in ss]),
+    ])
+
+
+def _table_access_stat(db) -> Table:
+    """Table/column access heat: table-level rows carry scan/DAS/
+    projection counters (column_name = ''), column-level rows carry the
+    per-role reference counts."""
+    rows = []
+    for t in db.access.snapshot():
+        rows.append((t["table"], "", t["scans"], t["rows_read"],
+                     t["das_lookups"], t["das_rows"], t["proj_hits"],
+                     t["proj_misses"], 0, 0, 0, 0))
+        for c in t["columns"]:
+            rows.append((t["table"], c["column"], 0, 0, 0, 0, 0, 0,
+                         c["filter_count"], c["join_count"],
+                         c["group_count"], c["sort_count"]))
+    return _t("__all_virtual_table_access_stat", [
+        ("table_name", DataType.varchar(), [r[0] for r in rows]),
+        ("column_name", DataType.varchar(), [r[1] for r in rows]),
+        ("scans", DataType.int64(), [r[2] for r in rows]),
+        ("rows_read", DataType.int64(), [r[3] for r in rows]),
+        ("das_lookups", DataType.int64(), [r[4] for r in rows]),
+        ("das_rows", DataType.int64(), [r[5] for r in rows]),
+        ("proj_hits", DataType.int64(), [r[6] for r in rows]),
+        ("proj_misses", DataType.int64(), [r[7] for r in rows]),
+        ("filter_count", DataType.int64(), [r[8] for r in rows]),
+        ("join_count", DataType.int64(), [r[9] for r in rows]),
+        ("group_count", DataType.int64(), [r[10] for r in rows]),
+        ("sort_count", DataType.int64(), [r[11] for r in rows]),
+    ])
+
+
+def _device_census(db) -> Table:
+    """Device-residency and compile census: per-table device bytes,
+    compiled-plan entries with hit counts and pow2 batch buckets, the
+    fast text tier, block-cache residency."""
+    from .workload import device_census
+
+    rows = device_census(db)
+    return _t("__all_virtual_device_census", [
+        ("kind", DataType.varchar(), [r["kind"] for r in rows]),
+        ("name", DataType.varchar(), [r["name"] for r in rows]),
+        ("detail", DataType.varchar(), [r["detail"] for r in rows]),
+        ("entries", DataType.int64(), [r["entries"] for r in rows]),
+        ("hits", DataType.int64(), [r["hits"] for r in rows]),
+        ("bytes", DataType.int64(), [r["bytes"] for r in rows]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -440,4 +534,7 @@ PROVIDERS = {
     "__all_virtual_sequence": _sequences,
     "__all_virtual_mview": _mviews,
     "__all_virtual_xa_transaction": _xa,
+    "__all_virtual_statement_summary": _statement_summary,
+    "__all_virtual_table_access_stat": _table_access_stat,
+    "__all_virtual_device_census": _device_census,
 }

@@ -153,6 +153,20 @@ def default_params() -> list[Param]:
               min=0.0),
         Param("syslog_level", "str", "INFO", "server log level",
               choices=("DEBUG", "TRACE", "INFO", "WARN", "ERROR")),
+        # workload repository (server/workload.py)
+        Param("enable_sql_stat", "bool", True,
+              "fold completed statements into the digest-keyed statement "
+              "summary and table/column access stats"),
+        Param("ob_sql_stat_max_digests", "int", 256,
+              "statement-summary digest cap; cold digests evict beyond it",
+              min=8, max=1 << 20),
+        Param("workload_snapshot_capacity", "int", 16,
+              "bounded count of workload snapshots held in memory",
+              min=2, max=4096),
+        Param("workload_snapshot_interval", "time", 0.0,
+              "0 disables periodic workload snapshots; otherwise at most "
+              "one snapshot per interval, checked at statement completion",
+              min=0.0),
         # storage
         Param("block_cache_size", "capacity", 256 << 20,
               "budget for decoded micro-block column cache"),

@@ -149,22 +149,29 @@ class MetricsRegistry:
         if not self.enabled:
             return
         with self._lock:
-            c = self._counters
-            for name, n in adds:
-                c[name] = c.get(name, 0) + n
-            for name, seconds in observes:
-                h = self._hists.get(name)
-                if h is None:
-                    h = self._hists[name] = Histogram(name)
-                h.observe(seconds)
-            for event, seconds in waits:
-                w = self._waits.get(event)
-                if w is None:
-                    w = self._waits[event] = WaitEvent(event)
-                w.count += 1
-                w.total_s += seconds
-                if seconds > w.max_s:
-                    w.max_s = seconds
+            self.bulk_locked(adds, observes, waits)
+
+    def bulk_locked(self, adds=(), observes=(), waits=()) -> None:
+        """bulk() body for callers already holding self._lock — lets a
+        collaborator that shares this lock (the statement-summary
+        registry) fold its own state and apply the statement's metric
+        updates in ONE acquisition."""
+        c = self._counters
+        for name, n in adds:
+            c[name] = c.get(name, 0) + n
+        for name, seconds in observes:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name)
+            h.observe(seconds)
+        for event, seconds in waits:
+            w = self._waits.get(event)
+            if w is None:
+                w = self._waits[event] = WaitEvent(event)
+            w.count += 1
+            w.total_s += seconds
+            if seconds > w.max_s:
+                w.max_s = seconds
 
     # -------------------------------------------------------------- gauges
     def gauge_set(self, name: str, value: float) -> None:

@@ -147,6 +147,18 @@ def fast_normalize(sql: str) -> tuple[str, tuple, tuple]:
     return out
 
 
+def digest_text(sql: str) -> str:
+    """Statement digest for the workload repository: the kind-marked
+    normalized text (identical to the fast tier's key, so fast-path
+    statements and their full-path compiles share one digest). Statements
+    the tokenizer rejects still need SOME stable digest — whitespace
+    collapse keeps repeats folding together without claiming kinds."""
+    try:
+        return fast_normalize(sql)[0]
+    except Exception:  # noqa: BLE001 - any tokenizer error
+        return " ".join(sql.split())
+
+
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql

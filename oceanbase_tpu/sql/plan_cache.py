@@ -571,6 +571,29 @@ class PlanCache:
                 if self.metrics is not None:
                     self.metrics.add("plan cache fast invalidation")
 
+    def census(self) -> tuple[list[dict], list[dict]]:
+        """(logical entries, fast-text entries) for the device census —
+        per-entry hit counts, the pow2 batch-bucket shapes compiled so
+        far, and the memoized device-input bytes. One lock hold; values
+        are plain dicts so the census owns nothing live."""
+        with self._lock:
+            logical = []
+            for k, e in self._entries.items():
+                memo = getattr(e.prepared, "_dev_bytes_memo", None)
+                batched = getattr(e.prepared, "_batched", None)
+                logical.append({
+                    "norm_key": k[1],
+                    "hits": e.hits,
+                    "buckets": tuple(sorted(batched)) if batched else (),
+                    "dev_bytes": int(memo[2]) if memo is not None else 0,
+                })
+            fast = [
+                {"text_key": k, "hits": fe.hits,
+                 "stmt_type": fe.stmt_type, "tables": list(fe.tables)}
+                for k, fe in self._fast.items()
+            ]
+        return logical, fast
+
     def flush(self):
         """Flush BOTH tiers. Retry policies with flush_plan_cache
         (OB_SCHEMA_EAGAIN), DDL-driven invalidation and ALTER SYSTEM all

@@ -1,0 +1,350 @@
+"""Workload repository: digest summaries, access heat, census, snapshots.
+
+Reference: OceanBase's statement-summary tables (digest-keyed, never
+evicted under load the way the sql_audit ring is) + Oracle-AWR-style
+periodic snapshots. Covers the exact-vs-sampled accounting split: exec /
+fail / retry counts and elapsed sums are folded per statement and must
+reconcile EXACTLY with the sysstat counters at every read point; detail
+fields (rows, hit counts, phase sums) come from sampled statements and
+are exact only for fully-sampled digests (short runs).
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.workload import WorkloadRepository, device_census
+from oceanbase_tpu.sql import parser as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the summary folds under the same kind-marked normalized text the fast
+# path tokenizes; compute expected digests instead of hand-writing them
+dig = P.digest_text
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = Database(n_nodes=3, n_ls=2)
+    s = d.session()
+    s.sql("create table wl_t (k bigint primary key, v bigint not null)")
+    s.sql("insert into wl_t values (1, 10), (2, 20), (3, 30)")
+    s.sql("create table wl_h (k bigint primary key, v bigint not null)")
+    s.sql("insert into wl_h values " + ", ".join(
+        f"({i}, {i * 7})" for i in range(1, 17)))
+    return d
+
+
+# ---- digest summaries -----------------------------------------------------
+
+
+def test_summary_counts_reconcile_with_sysstat(db):
+    """Sum of per-digest exec deltas == the `sql statements` counter
+    delta, and the fold self-metering accounts every statement."""
+    db.stmt_summary.reset()
+    c0 = db.metrics.counter("sql statements")
+    f0 = db.metrics.counter("stmt summary folds")
+    s = db.session()
+    for i in range(1, 6):
+        s.sql(f"select v from wl_t where k = {(i % 3) + 1}")
+    for _ in range(3):
+        s.sql("select count(*) as n, sum(v) as sv from wl_t")
+    s.sql("update wl_t set v = v + 0 where k = 1")
+    snap = db.stmt_summary.snapshot()  # flushes accumulators
+    c1 = db.metrics.counter("sql statements")
+    f1 = db.metrics.counter("stmt summary folds")
+    assert c1 - c0 == 9
+    assert sum(d["exec_count"] for d in snap) == 9
+    assert f1 - f0 == 9
+    by_digest = {d["digest"]: d for d in snap}
+    point = by_digest[dig("select v from wl_t where k = 1")]
+    assert point["exec_count"] == 5
+    assert point["stmt_type"] == "Select"
+    assert point["total_elapsed_s"] > 0
+    assert point["max_elapsed_s"] <= point["total_elapsed_s"]
+    upd = next(d for d in snap if d["stmt_type"] == "Update")
+    assert upd["exec_count"] == 1
+    assert upd["affected_rows"] == 1  # single exec -> fully sampled
+
+
+def test_sampled_detail_exact_for_short_runs(db):
+    """Digests executed at most SAMPLE_ALL times in a run are fully
+    sampled, so their detail fields are exact, not estimates."""
+    db.stmt_summary.reset()
+    s = db.session()
+    for _ in range(5):
+        s.sql("select k, v from wl_t")  # 3 rows each
+    (d,) = db.stmt_summary.snapshot()
+    assert d["exec_count"] == 5
+    assert d["sampled_count"] == 5
+    assert d["rows_returned"] == 15
+    assert sum(d["hist_counts"]) == 5
+
+
+def test_sampled_detail_scales_for_long_runs(db):
+    """A long same-digest run samples 1-in-N but read-time ratio scaling
+    recovers the exact total when the per-exec row count is constant."""
+    db.stmt_summary.reset()
+    s = db.session()
+    for _ in range(100):
+        s.sql("select k, v from wl_t")
+    (d,) = db.stmt_summary.snapshot()
+    assert d["exec_count"] == 100  # exact regardless of sampling
+    assert 0 < d["sampled_count"] < 100
+    assert d["rows_returned"] == 300  # constant rows/exec -> scales exactly
+    assert sum(d["hist_counts"]) == d["sampled_count"]
+    assert d["p99_s"] >= d["p50_s"] >= 0
+
+
+def test_fail_plus_watermark_counts_error_once(db):
+    """A statement that both fails AND trips the slow-query watermark
+    records its error exactly once in the summary and exactly one
+    flight bundle, and the two carry the same digest."""
+    old_wm = db.config["trace_log_slow_query_watermark"]
+    db.config.set("trace_log_slow_query_watermark", "0")
+    db.stmt_summary.reset()
+    try:
+        nb0 = len(db.flight.records())
+        fb0 = db.metrics.counter("flight recorder bundles")
+        fc0 = db.metrics.counter("sql fail count")
+        s = db.session()
+        with pytest.raises(Exception):
+            s.sql("select nope from wl_t where k = 1")
+        snap = db.stmt_summary.snapshot()
+        bundles = db.flight.records()
+    finally:
+        db.config.set("trace_log_slow_query_watermark", str(old_wm))
+    (d,) = snap
+    assert d["exec_count"] == 1
+    assert d["fail_count"] == 1
+    assert len(bundles) == nb0 + 1
+    assert db.metrics.counter("flight recorder bundles") == fb0 + 1
+    assert db.metrics.counter("sql fail count") == fc0 + 1
+    b = bundles[-1]
+    assert b["error"] != ""
+    assert b["digest"] == d["digest"]
+
+
+def test_concurrent_sessions_no_lost_updates(db):
+    """8 session threads hammer 3 digests; every per-digest exec count
+    and the cross-digest total must be exact after the join."""
+    db.stmt_summary.reset()
+    c0 = db.metrics.counter("sql statements")
+    n_threads, iters = 8, 40
+    stmts = (
+        "select v from wl_h where k = {i}",
+        "select count(*) as n from wl_h",
+        "select sum(v) as sv from wl_h where k > {i}",
+    )
+    errs = []
+
+    def worker(tid: int) -> None:
+        try:
+            s = db.session()
+            for i in range(iters):
+                for t in stmts:
+                    s.sql(t.format(i=(tid * iters + i) % 16 + 1))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    snap = db.stmt_summary.snapshot()
+    total = n_threads * iters
+    by_digest = {d["digest"]: d for d in snap}
+    for t in stmts:
+        assert by_digest[dig(t.format(i=1))]["exec_count"] == total
+    assert sum(d["exec_count"] for d in snap) == 3 * total
+    assert sum(d["fail_count"] for d in snap) == 0
+    assert db.metrics.counter("sql statements") - c0 == 3 * total
+
+
+def test_cold_digest_eviction_at_cap(db):
+    """The registry is bounded by ob_sql_stat_max_digests; overflow
+    evicts the least-recently-merged digest."""
+    old_cap = db.config["ob_sql_stat_max_digests"]
+    db.stmt_summary.reset()
+    db.config.set("ob_sql_stat_max_digests", "8")  # config floor
+    try:
+        s = db.session()
+        stmts = (
+            "select k from wl_t",
+            "select v from wl_t",
+            "select k, v from wl_t",
+            "select v, k from wl_t",
+            "select min(v) as m from wl_t",
+            "select max(v) as m from wl_t",
+            "select count(*) as n from wl_t",
+            "select sum(v) as sv from wl_t",
+            "select k from wl_t where v > 15",
+            "select v from wl_t where k < 3",
+            "select k from wl_t order by v",
+            "select v from wl_t order by k",
+        )
+        ev0 = db.stmt_summary.evictions
+        for t in stmts:
+            s.sql(t)
+        snap = db.stmt_summary.snapshot()
+        assert len(snap) <= 8
+        assert db.stmt_summary.evictions > ev0
+        # the most recently merged digests survive
+        assert any(d["digest"] == dig("select v from wl_t order by k")
+                   for d in snap)
+    finally:
+        db.config.set("ob_sql_stat_max_digests", str(old_cap))
+
+
+def test_dropped_session_flushes_tail(db):
+    """A garbage-collected session must not lose its buffered folds."""
+    db.stmt_summary.reset()
+
+    def run_and_drop():
+        s = db.session()
+        for _ in range(3):
+            s.sql("select max(k) as mk from wl_t")
+        del s
+
+    run_and_drop()
+    gc.collect()
+    snap = db.stmt_summary.snapshot()
+    (d,) = snap
+    assert d["digest"] == dig("select max(k) as mk from wl_t")
+    assert d["exec_count"] == 3
+
+
+# ---- virtual tables -------------------------------------------------------
+
+
+def test_summary_virtual_table_live(db):
+    db.stmt_summary.reset()
+    s = db.session()
+    for _ in range(4):
+        s.sql("select v from wl_t where k = 2")
+    rs = s.sql("select digest, executions from __all_virtual_statement_summary")
+    cols = rs.columns
+    rows = dict(zip(cols["digest"], cols["executions"]))
+    assert rows[dig("select v from wl_t where k = 2")] == 4
+
+
+def test_table_access_stat_roles_and_das(db):
+    db.access.reset()
+    s = db.session()
+    s.sql("select v from wl_h where v > 50")
+    s.sql("select v, count(*) as n from wl_h group by v")
+    s.sql("select k from wl_h order by v")
+    s.sql("select v from wl_h where k = 3")  # PK point read -> DAS route
+    stats = {t["table"]: t for t in db.access.snapshot()}
+    t = stats["wl_h"]
+    assert t["scans"] + t["das_lookups"] > 0
+    cols = {c["column"]: c for c in t["columns"]}
+    assert cols["v"]["filter_count"] > 0
+    assert cols["v"]["group_count"] > 0
+    assert cols["v"]["sort_count"] > 0
+    rs = s.sql("select count(*) as n from __all_virtual_table_access_stat")
+    assert rs.columns["n"][0] > 0
+
+
+def test_device_census_reports_residency(db):
+    s = db.session()
+    s.sql("select count(*) as n from wl_h")  # materialize something
+    rows = device_census(db)
+    kinds = {r["kind"] for r in rows}
+    assert {"plan_cache", "block_cache"} <= kinds
+    assert "compiled_plan" in kinds or "fast_text" in kinds
+    totals = next(r for r in rows if r["kind"] == "plan_cache")
+    assert totals["entries"] > 0
+    assert any(r["bytes"] > 0 for r in rows)
+    rs = s.sql("select count(*) as n from __all_virtual_device_census")
+    assert rs.columns["n"][0] == len(device_census(db))
+
+
+# ---- snapshot engine ------------------------------------------------------
+
+
+def test_snapshot_statement_and_ring_bound(db):
+    s = db.session()
+    n0 = len(db.workload.snapshots())
+    rs = s.sql("snapshot workload")
+    snap_id = rs.columns["snap_id"][0]
+    snaps = db.workload.snapshots()
+    assert len(snaps) == n0 + 1
+    last = snaps[-1]
+    assert last["snap_id"] == snap_id
+    assert set(last) == {"snap_id", "ts", "summary", "access", "census",
+                         "sysstat"}
+    assert last["sysstat"]["sql statements"] > 0
+
+
+def test_workload_repository_bounded_and_periodic(db):
+    """Injectable clock drives the ring bound and the auto-capture
+    interval without sleeping."""
+    now = [1000.0]
+    wr = WorkloadRepository(capacity=2, clock=lambda: now[0])
+    wr.take(db)
+    wr.take(db)
+    wr.take(db)
+    snaps = wr.snapshots()
+    assert len(snaps) == 2
+    assert [s["snap_id"] for s in snaps] == [2, 3]
+    assert all(s["ts"] == 1000.0 for s in snaps)
+    wr.interval_s = 10.0
+    assert wr.maybe_auto(db) is not None  # first capture always fires
+    assert wr.maybe_auto(db) is None      # same instant: inside interval
+    now[0] += 10.0
+    assert wr.maybe_auto(db) is not None
+    wr.set_capacity(1)
+    assert len(wr.snapshots()) == 1
+
+
+def test_awr_report_end_to_end(db, tmp_path):
+    """Two snapshots around a skewed workload; awr_report exits 0 and its
+    machine-readable advisor line ranks the hammered digest first."""
+    db.stmt_summary.reset()
+    s = db.session()
+    first_id = int(s.sql("snapshot workload").columns["snap_id"][0])
+    for i in range(30):
+        s.sql(f"select v from wl_h where k = {i % 16 + 1}")
+    s.sql("select count(*) as n from wl_h")
+    last_id = int(s.sql("snapshot workload").columns["snap_id"][0])
+    dump = tmp_path / "workload.json"
+    assert db.workload.dump(str(dump)) >= 2
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "awr_report.py"),
+         str(dump), "--first", str(first_id), "--last", str(last_id)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    last_line = r.stdout.strip().splitlines()[-1]
+    doc = json.loads(last_line)
+    assert "advisor" in doc
+    top = doc["top_digests"][0]
+    assert top["digest"] == dig("select v from wl_h where k = 1")
+    assert top["exec_count"] == 30
+    adv = doc["advisor"]
+    assert {"sorted_projections", "residency_priorities",
+            "batching_candidates"} <= set(adv)
+
+
+def test_enable_sql_stat_toggle(db):
+    """enable_sql_stat=false makes the per-statement path fold nothing."""
+    db.stmt_summary.reset()
+    db.config.set("enable_sql_stat", "false")
+    try:
+        s = db.session()
+        s.sql("select v from wl_t where k = 1")
+        assert db.stmt_summary.snapshot() == []
+    finally:
+        db.config.set("enable_sql_stat", "true")
+    s.sql("select v from wl_t where k = 1")
+    assert len(db.stmt_summary.snapshot()) == 1

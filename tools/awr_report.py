@@ -1,0 +1,317 @@
+#!/usr/bin/env python
+"""AWR-style workload report: diff two workload snapshots.
+
+Input is what WorkloadRepository.dump() writes ({"snapshots": [...]}) —
+either one dump file (diffs the first and last held snapshots, or the
+pair picked with --first/--last by snap_id) or two files (a dump's LAST
+snapshot, or a file holding one bare snapshot object). Stdlib only: the
+report runs anywhere the JSON can be copied to.
+
+Output: a human-readable report on stdout — top-K digests by window
+total/p99 time, hottest tables/columns, compile-cache churn, residency
+changes — followed by ONE machine-readable JSON line (the last stdout
+line) whose `advisor` block is the data contract the layout advisor
+(ROADMAP item 3) consumes: recommended sorted projections, residency
+priorities, batching candidates.
+
+    python tools/awr_report.py dump.json
+    python tools/awr_report.py dump.json --first 2 --last 5 --top 10
+    python tools/awr_report.py before.json after.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_snapshots(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "snapshots" in doc:
+        return list(doc["snapshots"])
+    if isinstance(doc, dict) and "summary" in doc:
+        return [doc]  # bare snapshot object
+    raise SystemExit(f"{path}: not a workload snapshot dump")
+
+
+def pick(snaps: list[dict], snap_id: int | None, default_idx: int) -> dict:
+    if snap_id is None:
+        return snaps[default_idx]
+    for s in snaps:
+        if s["snap_id"] == snap_id:
+            return s
+    raise SystemExit(f"snap_id {snap_id} not in dump "
+                     f"(have {[s['snap_id'] for s in snaps]})")
+
+
+def hist_quantile(bounds: list[float], counts: list[int], q: float) -> float:
+    """Bucket-boundary quantile over a (windowed) histogram delta — same
+    estimate share/metrics.Histogram.quantile reports."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= target:
+            return bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1]
+
+
+_SUM_KEYS = (
+    "exec_count", "fail_count", "retry_count", "rows_returned",
+    "affected_rows", "fast_path_count", "batched_count", "cache_hit_count",
+    "total_elapsed_s", "fastparse_s", "bind_s", "dispatch_s", "fetch_s",
+    "compile_s", "transfer_bytes",
+)
+
+
+def diff_summary(first: dict, last: dict) -> list[dict]:
+    """Per-digest window deltas (digest absent from the first snapshot
+    baselines at zero). Digests with no executions in the window drop."""
+    f_by = {s["digest"]: s for s in first.get("summary", ())}
+    out = []
+    for s in last.get("summary", ()):
+        f = f_by.get(s["digest"], {})
+        d = {"digest": s["digest"], "stmt_type": s["stmt_type"]}
+        for k in _SUM_KEYS:
+            # detail fields are sampled estimates scaled by exec/sampled;
+            # a ratio shift between snapshots can produce a small
+            # negative delta — clamp (exact fields are monotone anyway)
+            d[k] = max(0, s.get(k, 0) - f.get(k, 0))
+        if d["exec_count"] <= 0:
+            continue
+        counts = [c - fc for c, fc in zip(
+            s.get("hist_counts", ()),
+            f.get("hist_counts", [0] * len(s.get("hist_counts", ()))))]
+        bounds = s.get("hist_bounds", ())
+        d["p50_s"] = hist_quantile(bounds, counts, 0.50)
+        d["p95_s"] = hist_quantile(bounds, counts, 0.95)
+        d["p99_s"] = hist_quantile(bounds, counts, 0.99)
+        out.append(d)
+    return out
+
+
+_TAB_KEYS = ("scans", "rows_read", "das_lookups", "das_rows",
+             "proj_hits", "proj_misses")
+_COL_KEYS = ("filter_count", "join_count", "group_count", "sort_count")
+
+
+def diff_access(first: dict, last: dict) -> list[dict]:
+    f_by = {t["table"]: t for t in first.get("access", ())}
+    out = []
+    for t in last.get("access", ()):
+        f = f_by.get(t["table"], {})
+        d = {"table": t["table"]}
+        for k in _TAB_KEYS:
+            d[k] = t.get(k, 0) - f.get(k, 0)
+        fcols = {c["column"]: c for c in f.get("columns", ())}
+        cols = []
+        for c in t.get("columns", ()):
+            fc = fcols.get(c["column"], {})
+            cd = {"column": c["column"]}
+            for k in _COL_KEYS:
+                cd[k] = c.get(k, 0) - fc.get(k, 0)
+            if any(cd[k] for k in _COL_KEYS):
+                cols.append(cd)
+        d["columns"] = cols
+        if d["scans"] or d["das_lookups"] or cols:
+            out.append(d)
+    return out
+
+
+def census_rows(snap: dict, kind: str) -> dict:
+    return {r["name"]: r for r in snap.get("census", ()) if r["kind"] == kind}
+
+
+def diff_census(first: dict, last: dict) -> tuple[list[dict], list[dict]]:
+    """(compile churn rows, residency change rows)."""
+    fplan = census_rows(first, "compiled_plan")
+    lplan = census_rows(last, "compiled_plan")
+    churn = []
+    for name, r in lplan.items():
+        f = fplan.get(name)
+        churn.append({
+            "plan": name,
+            "state": "new" if f is None else "kept",
+            "hits_delta": r["hits"] - (f["hits"] if f else 0),
+            "buckets": r.get("detail", ""),
+        })
+    for name, f in fplan.items():
+        if name not in lplan:
+            churn.append({"plan": name, "state": "evicted",
+                          "hits_delta": -f["hits"], "buckets": ""})
+    churn.sort(key=lambda c: -abs(c["hits_delta"]))
+    fdev = census_rows(first, "table_device")
+    ldev = census_rows(last, "table_device")
+    resid = []
+    for name in sorted(set(fdev) | set(ldev)):
+        b0 = fdev.get(name, {}).get("bytes", 0)
+        b1 = ldev.get(name, {}).get("bytes", 0)
+        if b0 != b1 or name in ldev:
+            resid.append({"table": name, "bytes": b1, "bytes_delta": b1 - b0})
+    resid.sort(key=lambda r: -r["bytes"])
+    return churn, resid
+
+
+def build_advisor(digests: list[dict], tables: list[dict],
+                  resid: list[dict]) -> dict:
+    """Machine-readable advisor block — the PR-7+ layout advisor's input
+    contract. Recommendations are ranked suggestions derived from the
+    window, never commands; score units are (references x rows)."""
+    dev_bytes = {r["table"]: r["bytes"] for r in resid}
+    projections = []
+    for t in tables:
+        if t["scans"] <= 0 or t["proj_hits"] > 0:
+            continue  # already routing to a projection, or not scanned
+        best = None
+        for c in t["columns"]:
+            if c["filter_count"] > 0 and (
+                    best is None
+                    or c["filter_count"] > best["filter_count"]):
+                best = c
+        if best is None:
+            continue
+        projections.append({
+            "table": t["table"],
+            "column": best["column"],
+            "score": best["filter_count"] * max(t["rows_read"], 1),
+            "reason": (f"{best['filter_count']} filtered scans in window, "
+                       f"0 projection hits"),
+        })
+    projections.sort(key=lambda p: -p["score"])
+    priorities = sorted(
+        ({"table": t["table"],
+          "score": t["rows_read"] + t["das_rows"],
+          "scans": t["scans"],
+          "device_bytes": dev_bytes.get(t["table"], 0)}
+         for t in tables if t["scans"] or t["das_lookups"]),
+        key=lambda r: -r["score"],
+    )
+    batching = []
+    for d in digests:
+        if d["stmt_type"] != "Select" or d["exec_count"] < 8:
+            continue
+        b_ratio = d["batched_count"] / d["exec_count"]
+        f_ratio = d["fast_path_count"] / d["exec_count"]
+        if b_ratio < 0.5:
+            batching.append({
+                "digest": d["digest"],
+                "executions": d["exec_count"],
+                "batched_ratio": round(b_ratio, 3),
+                "fast_ratio": round(f_ratio, 3),
+            })
+    batching.sort(key=lambda b: -b["executions"])
+    return {
+        "sorted_projections": projections,
+        "residency_priorities": priorities,
+        "batching_candidates": batching,
+    }
+
+
+def _us(s: float) -> int:
+    return int(s * 1e6)
+
+
+def render(first: dict, last: dict, top: int) -> dict:
+    digests = diff_summary(first, last)
+    tables = diff_access(first, last)
+    churn, resid = diff_census(first, last)
+    sys0, sys1 = first.get("sysstat", {}), last.get("sysstat", {})
+    sysd = {k: sys1[k] - sys0.get(k, 0) for k in sys1
+            if sys1[k] != sys0.get(k, 0)}
+
+    interval = last["ts"] - first["ts"]
+    w = print
+    w(f"Workload report: snap {first['snap_id']} -> {last['snap_id']} "
+      f"({interval:.3f}s)")
+    w("")
+    by_total = sorted(digests, key=lambda d: -d["total_elapsed_s"])[:top]
+    w(f"Top {len(by_total)} digests by window total time:")
+    w(f"  {'execs':>7} {'total_us':>10} {'p99_us':>8} {'fail':>5} "
+      f"{'fast%':>6} {'batch%':>6}  digest")
+    for d in by_total:
+        n = d["exec_count"]
+        w(f"  {n:>7} {_us(d['total_elapsed_s']):>10} "
+          f"{_us(d['p99_s']):>8} {d['fail_count']:>5} "
+          f"{100.0 * d['fast_path_count'] / n:>5.0f}% "
+          f"{100.0 * d['batched_count'] / n:>5.0f}%  "
+          f"{d['digest'][:90]}")
+    w("")
+    by_p99 = sorted(digests, key=lambda d: -d["p99_s"])[:top]
+    w(f"Top {len(by_p99)} digests by window p99:")
+    for d in by_p99:
+        w(f"  {_us(d['p99_s']):>8}us x{d['exec_count']:<6} "
+          f"{d['digest'][:90]}")
+    w("")
+    w("Hottest tables (window):")
+    for t in sorted(tables, key=lambda t: -(t["rows_read"] + t["das_rows"])
+                    )[:top]:
+        w(f"  {t['table']:<24} scans={t['scans']} rows={t['rows_read']} "
+          f"das={t['das_lookups']}/{t['das_rows']}r "
+          f"proj={t['proj_hits']}h/{t['proj_misses']}m")
+        for c in sorted(t["columns"],
+                        key=lambda c: -sum(c[k] for k in _COL_KEYS))[:top]:
+            w(f"    {c['column']:<22} filter={c['filter_count']} "
+              f"join={c['join_count']} group={c['group_count']} "
+              f"sort={c['sort_count']}")
+    w("")
+    w("Compile-cache churn:")
+    for c in churn[:top]:
+        w(f"  [{c['state']:<7}] hits{c['hits_delta']:+d} {c['plan'][:80]}")
+    w("")
+    w("Device residency:")
+    for r in resid[:top]:
+        w(f"  {r['table']:<24} {r['bytes']:>12}B ({r['bytes_delta']:+d})")
+    w("")
+    folds = sysd.get("stmt summary folds", 0)
+    if folds:
+        w(f"Repository overhead: {sysd.get('stmt summary fold ns', 0) / folds:.0f}"
+          f" ns/fold over {folds:.0f} folds")
+        w("")
+
+    return {
+        "first_snap_id": first["snap_id"],
+        "last_snap_id": last["snap_id"],
+        "interval_s": interval,
+        "top_digests": by_total,
+        "top_p99_digests": by_p99,
+        "hot_tables": tables,
+        "compile_churn": churn,
+        "residency": resid,
+        "sysstat_delta": sysd,
+        "advisor": build_advisor(digests, tables, resid),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="workload dump (or 'before' snapshot file)")
+    ap.add_argument("dump2", nargs="?",
+                    help="optional 'after' file (else first vs last of dump)")
+    ap.add_argument("--first", type=int, help="first snap_id (single-dump)")
+    ap.add_argument("--last", type=int, help="last snap_id (single-dump)")
+    ap.add_argument("--top", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    if args.dump2 is not None:
+        first = load_snapshots(args.dump)[-1]
+        last = load_snapshots(args.dump2)[-1]
+    else:
+        snaps = load_snapshots(args.dump)
+        if len(snaps) < 2 and (args.first is None or args.last is None):
+            raise SystemExit(
+                f"{args.dump}: need two snapshots to diff (have {len(snaps)})")
+        first = pick(snaps, args.first, 0)
+        last = pick(snaps, args.last, -1)
+    report = render(first, last, args.top)
+    # machine-readable contract: the LAST stdout line is one JSON object
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""End-to-end smoke for the workload repository + AWR report.
+
+Drives a small mixed workload through a real Database, brackets the hot
+phase with two `SNAPSHOT WORKLOAD` statements, dumps the repository to
+JSON, and runs tools/awr_report.py on the dump AS A SUBPROCESS (the
+report must stand alone on a copied JSON file). Asserts:
+
+  - awr_report.py exits 0 and its last stdout line parses as JSON;
+  - the top digest by window total time is the statement we hammered;
+  - the advisor block is present and structurally sound (lists of
+    dicts with the contracted keys);
+  - the window's exec counts reconcile with the sysstat delta.
+
+Exit 0 on success, 1 with a reason on stderr otherwise. Wired into CI
+via `tools/run_tier1.sh --awr`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"AWR-SMOKE FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    from oceanbase_tpu.server.database import Database
+
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table kv (id int primary key, k int, v int, grp int)")
+    s.sql("insert into kv values " + ", ".join(
+        f"({i}, {i % 50}, {i * 3}, {i % 4})" for i in range(200)))
+
+    # warm both statements so the window measures serving, not compiles
+    for k in (1, 2):
+        s.sql(f"select v from kv where k = {k}").rows()
+    s.sql("select grp, sum(v) from kv group by grp").rows()
+
+    s.sql("snapshot workload")
+    # the hot phase: one digest dominates by count...
+    for i in range(40):
+        s.sql(f"select v from kv where k = {i % 50}").rows()
+    # ...plus a sprinkle of an aggregate digest
+    for _ in range(3):
+        s.sql("select grp, sum(v) from kv group by grp").rows()
+    s.sql("snapshot workload")
+
+    with tempfile.TemporaryDirectory() as td:
+        dump = os.path.join(td, "workload.json")
+        n = db.workload.dump(dump)
+        if n < 2:
+            return fail(f"expected >= 2 snapshots in dump, got {n}")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "awr_report.py"), dump],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            return fail(f"awr_report.py exit {proc.returncode}: "
+                        f"{proc.stderr[-500:]}")
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            return fail("awr_report.py produced no output")
+        try:
+            report = json.loads(lines[-1])
+        except json.JSONDecodeError as e:
+            return fail(f"last stdout line is not JSON: {e}")
+
+    top = report.get("top_digests") or []
+    if not top:
+        return fail("report has no top_digests")
+    want = "select v from kv where k = ?n"
+    if top[0]["digest"] != want:
+        return fail(f"top digest is {top[0]['digest']!r}, expected {want!r}")
+    if top[0]["exec_count"] != 40:
+        return fail(f"top digest exec_count {top[0]['exec_count']} != 40")
+
+    adv = report.get("advisor")
+    if not isinstance(adv, dict):
+        return fail("advisor block missing")
+    for key in ("sorted_projections", "residency_priorities",
+                "batching_candidates"):
+        if not isinstance(adv.get(key), list):
+            return fail(f"advisor.{key} missing or not a list")
+    if not adv["residency_priorities"]:
+        return fail("advisor.residency_priorities empty after a hot window")
+    if adv["residency_priorities"][0]["table"] != "kv":
+        return fail("kv should top residency priorities")
+
+    # window reconciliation: digest execs sum to the sysstat delta
+    # (the closing SNAPSHOT WORKLOAD itself folds after the capture,
+    # while the opening one is inside the window)
+    execs = sum(d["exec_count"]
+                for d in report.get("top_digests", ()))
+    sysd = report.get("sysstat_delta", {})
+    if execs != sysd.get("sql statements", -1):
+        return fail(f"digest execs {execs} != sysstat delta "
+                    f"{sysd.get('sql statements')}")
+
+    print(f"AWR-SMOKE OK: top digest {want!r} x{top[0]['exec_count']}, "
+          f"{len(report['hot_tables'])} hot tables, "
+          f"{len(adv['residency_priorities'])} residency priorities")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,8 +63,20 @@ def elapsed() -> float:
     return time.monotonic() - START
 
 
+# BENCH_OUT=<path>: also write each emitted summary as a JSON line to a
+# stable artifact path (truncated on the first emit of a run) so CI can
+# collect results without scraping stdout.
+_BENCH_OUT = os.environ.get("BENCH_OUT")
+_bench_out_started = False
+
+
 def emit(obj) -> None:
     print(json.dumps(obj), flush=True)
+    global _bench_out_started
+    if _BENCH_OUT:
+        with open(_BENCH_OUT, "a" if _bench_out_started else "w") as f:
+            f.write(json.dumps(obj) + "\n")
+        _bench_out_started = True
 
 
 def build_db(rows: int):

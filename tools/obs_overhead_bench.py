@@ -4,19 +4,28 @@
 The metrics/trace/audit fabric rides the host-side statement path, so
 its cost must stay a small fraction of statement latency. This driver
 runs a fixed statement mix (point select on a warm plan-cache entry,
-a small aggregate, an autocommit UPDATE) three times through the SAME
-Database — everything off, only the per-query resource profiler on,
-and every recorder enabled — and reports the per-statement medians
-plus the overhead percentage of each instrumented pass over the
-all-off baseline.
+a small aggregate, an autocommit UPDATE) through the SAME Database —
+everything off, only the digest statement-summary fold on, only the
+per-query resource profiler on, and every recorder enabled — and
+reports the per-statement medians plus the overhead percentage of
+each instrumented pass over the all-off baseline.
 
     JAX_PLATFORMS=cpu python tools/obs_overhead_bench.py [iters]
 
+With --sessions N it additionally runs a concurrent serving A/B
+(reusing latency_bench's closed-loop leg): N session threads hammer a
+warm point read with the statement summary OFF then ON, and the
+report gains `serve.summary_overhead_pct` — the throughput cost of
+the per-statement digest fold under the serving workload the 2%%
+budget is written against (`--sessions 32`). --strict-pct P exits 1
+if that overhead exceeds P.
+
 Prints a small JSON report. The warmup pass compiles every plan first,
-so both timed passes measure pure host dispatch + cached execution —
+so all timed passes measure pure host dispatch + cached execution —
 the path where the instrumentation lives.
 """
 
+import argparse
 import json
 import os
 import statistics
@@ -25,6 +34,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 STATEMENTS = (
     "select v from obench where k = 7",
@@ -39,10 +49,16 @@ def set_observability(db, on: bool) -> None:
     db.audit.enabled = on
     db.plan_monitor.enabled = on
     set_profiler(db, on)
+    set_sql_stat(db, on)
 
 
 def set_profiler(db, on: bool) -> None:
     db.config.set("enable_query_profile", "true" if on else "false")
+
+
+def set_sql_stat(db, on: bool) -> None:
+    # toggles both the digest summary fold and the table-access fold
+    db.config.set("enable_sql_stat", "true" if on else "false")
 
 
 def timed_pass(session, iters: int) -> dict:
@@ -55,8 +71,68 @@ def timed_pass(session, iters: int) -> dict:
     return {s: statistics.median(v) for s, v in per_stmt.items()}
 
 
-def main():
-    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+def serve_summary_ab(sessions: int, seconds: float, reps: int) -> dict:
+    """Concurrent serving throughput with the statement summary OFF vs
+    ON — everything else stays enabled (the production shape). Reuses
+    latency_bench's closed-loop leg and GIL/gc serving tunes; takes the
+    best rep per mode so scheduler noise doesn't masquerade as fold
+    cost."""
+    import gc
+
+    import latency_bench as LB
+
+    db, _ = LB.build_db(2000)
+    best = {"off": 0.0, "on": 0.0}
+    swi0 = sys.getswitchinterval()
+    gc0 = gc.get_threshold()
+    sys.setswitchinterval(0.02)
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(7000, 100, 100)
+    try:
+        for rep in range(reps):
+            # alternate leg order: the process drifts (caches, rings,
+            # allocator) so whichever mode always ran first would win
+            order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+            for mode in order:
+                set_sql_stat(db, mode == "on")
+                leg = LB.run_serve_leg(db, sessions, seconds,
+                                       wait_us=1000, max_size=16,
+                                       batching=True)
+                best[mode] = max(best[mode], leg["stmts_per_sec"])
+    finally:
+        sys.setswitchinterval(swi0)
+        gc.set_threshold(*gc0)
+        gc.unfreeze()
+        set_sql_stat(db, True)
+    digests = len(db.stmt_summary.snapshot())  # flushes accumulators
+    folds = db.metrics.counter("stmt summary folds")
+    fold_ns = db.metrics.counter("stmt summary fold ns")
+    return {
+        "sessions": sessions,
+        "leg_seconds": seconds,
+        "reps": reps,
+        "off_stmts_per_sec": best["off"],
+        "on_stmts_per_sec": best["on"],
+        "summary_overhead_pct": round(
+            (best["off"] - best["on"]) / best["off"] * 100.0, 2)
+        if best["off"] else 0.0,
+        "mean_fold_ns": round(fold_ns / folds, 1) if folds else 0.0,
+        "digests": digests,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("iters", nargs="?", type=int, default=200)
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="also run the serving summary-on/off A/B")
+    ap.add_argument("--serve-seconds", type=float, default=2.0)
+    ap.add_argument("--serve-reps", type=int, default=2)
+    ap.add_argument("--strict-pct", type=float, default=None,
+                    help="exit 1 if serve summary overhead exceeds this")
+    args = ap.parse_args()
+    iters = args.iters
 
     from oceanbase_tpu.server import Database
 
@@ -72,6 +148,9 @@ def main():
 
     set_observability(db, False)
     off = timed_pass(s, iters)
+    set_sql_stat(db, True)          # summary fold only, recorders off
+    summ = timed_pass(s, iters)
+    set_sql_stat(db, False)
     set_profiler(db, True)          # profiler only, recorders still off
     prof = timed_pass(s, iters)
     set_observability(db, True)     # everything on
@@ -81,14 +160,21 @@ def main():
     for stmt in STATEMENTS:
         report["statements"][stmt] = {
             "off_median_us": round(off[stmt] * 1e6, 1),
+            "summary_median_us": round(summ[stmt] * 1e6, 1),
             "profiler_median_us": round(prof[stmt] * 1e6, 1),
             "on_median_us": round(on[stmt] * 1e6, 1),
+            "summary_overhead_pct": round(
+                (summ[stmt] - off[stmt]) / off[stmt] * 100.0, 2),
             "profiler_overhead_pct": round(
                 (prof[stmt] - off[stmt]) / off[stmt] * 100.0, 2),
             "overhead_pct": round(
                 (on[stmt] - off[stmt]) / off[stmt] * 100.0, 2),
         }
     tot_on, tot_prof, tot_off = sum(on.values()), sum(prof.values()), sum(off.values())
+    tot_summ = sum(summ.values())
+    report["summary_overhead_pct"] = round(
+        (tot_summ - tot_off) / tot_off * 100.0, 2
+    )
     report["profiler_overhead_pct"] = round(
         (tot_prof - tot_off) / tot_off * 100.0, 2
     )
@@ -100,9 +186,23 @@ def main():
         "sql statements": db.metrics.counter("sql statements"),
         "spans": len(db.tracer.spans()),
         "audit records": len(db.audit.records()),
+        "summary digests": len(db.stmt_summary.snapshot()),
     }
+
+    rc = 0
+    if args.sessions > 0:
+        serve = serve_summary_ab(args.sessions, args.serve_seconds,
+                                 args.serve_reps)
+        report["serve"] = serve
+        if (args.strict_pct is not None
+                and serve["summary_overhead_pct"] > args.strict_pct):
+            report["strict_fail"] = (
+                f"serve summary overhead {serve['summary_overhead_pct']}% "
+                f"> {args.strict_pct}%")
+            rc = 1
     print(json.dumps(report, indent=2))
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

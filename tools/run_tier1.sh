@@ -19,6 +19,12 @@
 # (tools/latency_bench.py --sessions 16 --serve-strict): the statement
 # micro-batcher must actually form batches (mean batch size > 1) and
 # keep batched XLA compiles within the pow2 bucket bound.
+#
+# --awr additionally runs the workload-repository smoke
+# (tools/awr_smoke.py): mixed workload bracketed by two SNAPSHOT
+# WORKLOAD statements, dumped and diffed by tools/awr_report.py as a
+# subprocess; the top digest must match the driven statement and the
+# advisor block must parse.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -27,11 +33,13 @@ rm -f /tmp/_t1.log
 chaos=0
 latency=0
 serve=0
+awr=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
         --latency) latency=1; shift ;;
         --serve) serve=1; shift ;;
+        --awr) awr=1; shift ;;
         *) break ;;
     esac
 done
@@ -59,6 +67,11 @@ fi
 if [ "$serve" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
         --rows 1000 --sessions 16 --serve-seconds 2 --serve-strict
+    rc=$?
+fi
+
+if [ "$awr" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/awr_smoke.py
     rc=$?
 fi
 exit $rc
