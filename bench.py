@@ -120,9 +120,31 @@ SP_COLS = [
 # collect results without scraping stdout.
 _BENCH_OUT = os.environ.get("BENCH_OUT")
 _bench_out_started = False
+_META = None
+
+
+def _meta() -> dict:
+    """Provenance stamp (tools/bench_meta.py): rev + config fingerprint
+    + active overrides. Lazy — collect() touches the engine package, and
+    nothing heavy may import before the env knobs are read."""
+    global _META
+    if _META is None:
+        try:
+            import sys
+
+            tools = os.path.join(REPO, "tools")
+            if tools not in sys.path:
+                sys.path.insert(0, tools)
+            from bench_meta import collect
+
+            _META = collect()
+        except Exception:
+            _META = {"git_rev": REV}
+    return _META
 
 
 def emit(obj):
+    obj.setdefault("meta", _meta())
     print(json.dumps(obj), flush=True)
     global _bench_out_started
     if _BENCH_OUT:

@@ -377,6 +377,9 @@ class Executor:
         # around one execution: cache hits upload nothing, which is the
         # point of the per-column device cache)
         self.h2d_bytes = 0
+        # hook: share/timeline.ServingTimeline — cold uploads land as
+        # transfer-interference events (the server wires it)
+        self.timeline = None
         # assembled-ColumnBatch memo over the per-column cache: a warm
         # statement's _inputs() otherwise rebuilds the batch wrapper —
         # including a jnp.sum dispatch for nrows — on EVERY dispatch
@@ -726,9 +729,15 @@ class Executor:
                     vdev = jnp.asarray(v)
                 hit = (dev, vdev)
                 self._batch_cache[key] = hit
-                self.h2d_bytes += int(dev.nbytes) + (
+                nb = int(dev.nbytes) + (
                     int(vdev.nbytes) if vdev is not None else 0
                 )
+                self.h2d_bytes += nb
+                tl = self.timeline
+                if tl is not None and tl.enabled:
+                    # a cold-column upload steals device time from the
+                    # serving stream: transfer interference
+                    tl.record_transfer(nb)
             dcols[f.name] = hit[0]
             if hit[1] is not None:
                 dvalid[f.name] = hit[1]
@@ -740,6 +749,9 @@ class Executor:
             sel = jnp.asarray(s)
             self._batch_cache[skey] = sel
             self.h2d_bytes += int(sel.nbytes)
+            tl = self.timeline
+            if tl is not None and tl.enabled:
+                tl.record_transfer(int(sel.nbytes))
         batch = ColumnBatch(
             cols=dcols,
             valid=dvalid,

@@ -151,6 +151,9 @@ class Session:
         # hook: server/workload.TableAccessStats — per-execution fold of
         # the prepared plan's precomputed table/column access profile
         self.access = None
+        # hook: share/timeline.ServingTimeline — per-dispatch device-busy
+        # and compile-interference feed (the server wires it)
+        self.timeline = None
         # per-statement phase breakdown of the LAST run_ast call (EXPLAIN
         # ANALYZE reads it right after executing the analyzed statement)
         self.last_phases: dict = {}
@@ -620,4 +623,11 @@ class Session:
             retries = getattr(prepared, "retries", 0) - retries0
             if retries > 0:
                 m.add("overflow recompiles", retries)
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            # serving timeline: this dispatch's device-busy seconds plus
+            # compile/result-transfer interference. Batched cohorts skip
+            # this path — their ONE shared dispatch is fed by the batcher
+            tl.record_exec(dispatch_s, 0.0 if was_hit else compile_s,
+                           d2h_bytes)
         return rs

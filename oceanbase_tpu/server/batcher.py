@@ -71,6 +71,9 @@ class StatementBatcher:
         self._forming: dict[tuple, _Batch] = {}
         self._ids = itertools.count(1)
         self.metrics = metrics
+        # hook: share/timeline.ServingTimeline — each cohort's ONE device
+        # dispatch plus its lane-occupancy land on the serving timeline
+        self.timeline = None
         # A/B switch (latency_bench --sessions: batching on vs off)
         self.enabled = True
 
@@ -206,6 +209,11 @@ class StatementBatcher:
                     ("stmt batched statements", nb),
                     (f"stmt batch size {next_pow2(nb)}", 1),
                 ))
+            tl = self.timeline
+            if tl is not None and tl.enabled:
+                # the cohort's single dispatch (lanes here never reach
+                # the engine's solo record_exec — no double counting)
+                tl.record_batch(b.dispatch_s, nb)
         except Exception as e:  # noqa: BLE001 — lanes degrade to solo
             b.error = e
             if m is not None and m.enabled:

@@ -497,6 +497,45 @@ class Database:
         self.config.on_change(
             "workload_snapshot_interval",
             lambda _n, _o, v: setattr(self.workload, "interval_s", v))
+        # serving saturation timeline (share/timeline.py): ONE ring per
+        # cluster, shared like bus.metrics — tenant starvation is only
+        # visible when every tenant's QoS lands in the same ledger. The
+        # first tenant's config sizes it; any tenant's toggle gates it.
+        from ..share.timeline import ServingTimeline
+
+        tl = getattr(self.cluster, "_timeline", None)
+        if tl is None:
+            tl = ServingTimeline(
+                bucket_s=self.config["serving_timeline_bucket"],
+                capacity=self.config["serving_timeline_capacity"])
+            self.cluster._timeline = tl
+        self.timeline = tl
+        tl.enabled = self.config["enable_serving_timeline"]
+        tl.register_tenant(self.tenant_name, self.unit.max_workers,
+                           self.unit.queue_timeout_s)
+        self.config.on_change(
+            "enable_serving_timeline",
+            lambda _n, _o, v: setattr(self.timeline, "enabled", v))
+        self.config.on_change(
+            "serving_timeline_bucket",
+            lambda _n, _o, v: self.timeline.set_bucket_s(v))
+        self.config.on_change(
+            "serving_timeline_capacity",
+            lambda _n, _o, v: self.timeline.set_capacity(v))
+        # health sentinel (server/sentinel.py): typed rules over each
+        # snapshot interval, alert ring behind __all_virtual_alert_history
+        from .sentinel import HealthSentinel
+
+        self.sentinel = HealthSentinel(
+            capacity=self.config["health_alert_capacity"])
+        self.sentinel.enabled = self.config["enable_health_sentinel"]
+        self.workload.on_snapshot = self.sentinel.observe
+        self.config.on_change(
+            "enable_health_sentinel",
+            lambda _n, _o, v: setattr(self.sentinel, "enabled", v))
+        self.config.on_change(
+            "health_alert_capacity",
+            lambda _n, _o, v: self.sentinel.set_capacity(v))
         self._session_ids = itertools.count(1)
 
         # storage maintenance: block cache, dag scheduler, freeze loop
@@ -585,12 +624,19 @@ class Database:
         )
         # workload access heat folds per execution inside the engine
         self.engine.access = self.access
+        # serving timeline feeds: engine dispatches (device busy +
+        # compile interference), executor uploads (transfer
+        # interference), batcher dispatches (occupancy) — server-side
+        # feeds (admission, completion) go through db.timeline directly
+        self.engine.timeline = self.timeline
+        self.engine.executor.timeline = self.timeline
         # cross-session statement micro-batcher: concurrent fast-path
         # hits on the same plan fold into one batched device dispatch
         # (server/batcher.py; knobs ob_batch_max_size/ob_batch_max_wait_us)
         from .batcher import StatementBatcher
 
         self.batcher = StatementBatcher(metrics=self.metrics)
+        self.batcher.timeline = self.timeline
         # one shared virtual-clock closure: sql() builds a statement
         # Deadline from it on every call — no per-statement lambda
         self._bus_clock = lambda: self.cluster.bus.now
@@ -1588,6 +1634,10 @@ class Database:
         m.gauge_set("plan cache entries", len(self.plan_cache))
         m.gauge_set("sql audit records", len(self.audit.records()))
         m.gauge_set("active statements", len(self._active_stmts))
+        # serving-timeline self-metering: ring occupancy/bytes/records +
+        # the retained window's device-busy fraction
+        self.timeline.meter(m)
+        m.gauge_set("health alerts", len(self.sentinel.alerts()))
         return m.prometheus_text()
 
     def session(self, user: str = "root") -> "DbSession":
@@ -1719,7 +1769,13 @@ class DbSession:
                 wait_s = max(deadline.remaining(), 0.0)
             tq = _time.perf_counter()
             ok = sem.acquire(timeout=wait_s)
-            db.metrics.wait("tenant worker queue", _time.perf_counter() - tq)
+            waited = _time.perf_counter() - tq
+            db.metrics.wait("tenant worker queue", waited)
+            tl = db.timeline
+            if tl.enabled:
+                # per-tenant QoS ledger: admission wait (and, on a
+                # timeout, the rejection) against the TenantUnit quota
+                tl.record_admission(db.tenant_name, waited, ok)
             if not ok:
                 db.metrics.add("worker queue timeouts")
                 if bounded:
@@ -1825,6 +1881,14 @@ class DbSession:
                             adds.append(("sql fail count", 1))
                         m.bulk(adds=adds,
                                observes=(("sql response time", elapsed_s),))
+                    tl = db.timeline
+                    if tl.enabled:
+                        # timeline completion feed (exactly once per
+                        # statement, beside the summary fold): host wall
+                        # seconds + tenant admitted count + in-flight
+                        # depth sample for the queue histograms
+                        tl.record_stmt(db.tenant_name, elapsed_s,
+                                       bool(err), len(db._active_stmts))
                     if db.audit.enabled:
                         p = prof
                         db.audit.record(

@@ -77,6 +77,7 @@ class TenantManager:
             config=db.config, plan_cache=db.plan_cache,
             lock_mgr=db.lock_mgr,
             tracer=db.tracer, flight=db.flight, long_ops=db.long_ops,
+            timeline=db.timeline, sentinel=db.sentinel,
         )
         self.tenants[name] = t
         return t

@@ -497,6 +497,99 @@ def _device_census(db) -> Table:
     ])
 
 
+def _server_timeline(db) -> Table:
+    """GV$OB_SERVERS-over-time analog: the serving timeline's bucket
+    ring (share/timeline.py) — device/host busy seconds per fixed-width
+    time slice, dispatch + batch-occupancy counts, compile/transfer
+    interference, admission queue pressure."""
+    bs = db.timeline.snapshot()
+    return _t("__all_virtual_server_timeline", [
+        ("bucket_ts", DataType.float64(), [b["ts"] for b in bs]),
+        ("wall_us", DataType.int64(),
+         [int(b["wall_s"] * 1e6) for b in bs]),
+        ("stmts", DataType.int64(), [b["stmts"] for b in bs]),
+        ("errors", DataType.int64(), [b["errors"] for b in bs]),
+        ("host_busy_us", DataType.int64(),
+         [int(b["host_busy_s"] * 1e6) for b in bs]),
+        ("device_busy_us", DataType.int64(),
+         [int(b["device_busy_s"] * 1e6) for b in bs]),
+        ("device_busy_pct", DataType.float64(),
+         [round(100.0 * b["device_busy_frac"], 3) for b in bs]),
+        ("dispatches", DataType.int64(), [b["dispatches"] for b in bs]),
+        ("batch_dispatches", DataType.int64(),
+         [b["batch_dispatches"] for b in bs]),
+        ("batch_lanes", DataType.int64(), [b["batch_lanes"] for b in bs]),
+        ("compile_events", DataType.int64(),
+         [b["compile_events"] for b in bs]),
+        ("compile_us", DataType.int64(),
+         [int(b["compile_s"] * 1e6) for b in bs]),
+        ("transfer_events", DataType.int64(),
+         [b["transfer_events"] for b in bs]),
+        ("transfer_bytes", DataType.int64(),
+         [b["transfer_bytes"] for b in bs]),
+        ("max_in_flight", DataType.int64(),
+         [b["max_in_flight"] for b in bs]),
+        ("admitted", DataType.int64(), [b["admitted"] for b in bs]),
+        ("rejected", DataType.int64(), [b["rejected"] for b in bs]),
+        ("admission_wait_us", DataType.int64(),
+         [int(b["admission_wait_s"] * 1e6) for b in bs]),
+        ("wait_p99_us", DataType.int64(),
+         [int(b["wait_p99_s"] * 1e6) for b in bs]),
+    ])
+
+
+def _tenant_qos(db) -> Table:
+    """Per-tenant QoS ledger: cumulative admission/served/rejected
+    accounting against the TenantUnit limits each tenant was given."""
+    qos = db.timeline.qos_totals()
+    names = list(qos)
+    return _t("__all_virtual_tenant_qos", [
+        ("tenant", DataType.varchar(), names),
+        ("max_workers", DataType.int64(),
+         [qos[n]["max_workers"] for n in names]),
+        ("queue_timeout_us", DataType.int64(),
+         [int(qos[n]["queue_timeout_s"] * 1e6) for n in names]),
+        ("stmts", DataType.int64(), [qos[n]["stmts"] for n in names]),
+        ("errors", DataType.int64(), [qos[n]["errors"] for n in names]),
+        ("admitted", DataType.int64(),
+         [qos[n]["admitted"] for n in names]),
+        ("rejected", DataType.int64(),
+         [qos[n]["rejected"] for n in names]),
+        ("wait_us", DataType.int64(),
+         [int(qos[n]["wait_s"] * 1e6) for n in names]),
+        ("avg_wait_us", DataType.int64(),
+         [int(qos[n]["wait_s"] / max(qos[n]["admitted"]
+                                     + qos[n]["rejected"], 1) * 1e6)
+          for n in names]),
+        ("max_in_flight", DataType.int64(),
+         [qos[n]["max_in_flight"] for n in names]),
+        ("host_busy_us", DataType.int64(),
+         [int(qos[n]["host_busy_s"] * 1e6) for n in names]),
+    ])
+
+
+def _alert_history(db) -> Table:
+    """Health-sentinel alert ring (server/sentinel.py): deduplicated,
+    severity-tagged rule firings with their snapshot window + evidence."""
+    import json
+
+    als = db.sentinel.alerts()
+    return _t("__all_virtual_alert_history", [
+        ("alert_id", DataType.int64(), [a.alert_id for a in als]),
+        ("ts", DataType.float64(), [a.ts for a in als]),
+        ("rule", DataType.varchar(), [a.rule for a in als]),
+        ("severity", DataType.varchar(), [a.severity for a in als]),
+        ("subject", DataType.varchar(), [a.key for a in als]),
+        ("summary", DataType.varchar(), [a.summary for a in als]),
+        ("first_snap_id", DataType.int64(),
+         [a.first_snap_id for a in als]),
+        ("last_snap_id", DataType.int64(),
+         [a.last_snap_id for a in als]),
+        ("evidence", DataType.varchar(),
+         [json.dumps(a.evidence, sort_keys=True)[:400] for a in als]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -537,4 +630,7 @@ PROVIDERS = {
     "__all_virtual_statement_summary": _statement_summary,
     "__all_virtual_table_access_stat": _table_access_stat,
     "__all_virtual_device_census": _device_census,
+    "__all_virtual_server_timeline": _server_timeline,
+    "__all_virtual_tenant_qos": _tenant_qos,
+    "__all_virtual_alert_history": _alert_history,
 }

@@ -680,6 +680,7 @@ def device_census(db) -> list[dict]:
 
 
 def build_snapshot(db, snap_id: int, ts: float) -> dict:
+    tl = getattr(db, "timeline", None)
     return {
         "snap_id": snap_id,
         "ts": ts,
@@ -687,6 +688,12 @@ def build_snapshot(db, snap_id: int, ts: float) -> dict:
         "access": db.access.snapshot(),
         "census": device_census(db),
         "sysstat": db.metrics.counters_snapshot(),
+        # serving saturation view (share/timeline.py): time-sliced device
+        # busy/queue buckets + the cumulative per-tenant QoS ledger — what
+        # awr_report's saturation section and the health sentinel consume
+        "timeline": tl.snapshot() if tl is not None else [],
+        "timeline_meta": tl.meta() if tl is not None else {},
+        "qos": tl.qos_totals() if tl is not None else {},
     }
 
 
@@ -704,6 +711,11 @@ class WorkloadRepository:
         self._last_auto: float | None = None
         self.capacity = capacity
         self.interval_s = 0.0  # 0 = periodic capture off
+        # called with (previous snapshot, new snapshot) after each
+        # capture — the health sentinel's evaluation hook. Exceptions are
+        # swallowed: a watching rule must never fail the statement whose
+        # completion triggered the capture.
+        self.on_snapshot = None
 
     def take(self, db) -> dict:
         with self._lock:
@@ -711,9 +723,16 @@ class WorkloadRepository:
             self._next_id += 1
         snap = build_snapshot(db, snap_id, self._clock())
         with self._lock:
+            prev = self._snaps[-1] if self._snaps else None
             self._snaps.append(snap)
             while len(self._snaps) > self.capacity:
                 self._snaps.pop(0)
+        cb = self.on_snapshot
+        if cb is not None and prev is not None:
+            try:
+                cb(prev, snap)
+            except Exception:  # noqa: BLE001
+                pass
         return snap
 
     def maybe_auto(self, db) -> dict | None:

@@ -167,6 +167,22 @@ def default_params() -> list[Param]:
               "0 disables periodic workload snapshots; otherwise at most "
               "one snapshot per interval, checked at statement completion",
               min=0.0),
+        # serving timeline + health sentinel (share/timeline.py,
+        # server/sentinel.py)
+        Param("enable_serving_timeline", "bool", True,
+              "feed the time-sliced serving telemetry ring (device busy, "
+              "queue depth, per-tenant QoS) from the statement path"),
+        Param("serving_timeline_bucket", "time", 1.0,
+              "width of one serving-timeline bucket", min=0.05),
+        Param("serving_timeline_capacity", "int", 120,
+              "bounded count of timeline buckets held in the ring",
+              min=8, max=1 << 16),
+        Param("enable_health_sentinel", "bool", True,
+              "evaluate health rules (latency regressions, starvation, "
+              "compile storms...) on every workload snapshot"),
+        Param("health_alert_capacity", "int", 256,
+              "bounded count of sentinel alerts held in memory",
+              min=8, max=1 << 16),
         # storage
         Param("block_cache_size", "capacity", 256 << 20,
               "budget for decoded micro-block column cache"),

@@ -294,6 +294,11 @@ class MetricsRegistry:
             lines.append(f"# TYPE {p}_seconds summary")
             lines.append(f"{p}_seconds_count {w.count}")
             lines.append(f"{p}_seconds_sum {w.total_s:g}")
+            # a summary family only owns _count/_sum/quantile samples;
+            # the max rides as its own declared gauge family so every
+            # sample in the scrape belongs to a typed family
+            lines.append(f"# HELP {p}_seconds_max wait event max: {w.event}")
+            lines.append(f"# TYPE {p}_seconds_max gauge")
             lines.append(f"{p}_seconds_max {w.max_s:g}")
         for h in sorted(self.hists_snapshot(), key=lambda x: x.name):
             p = _prom_name(h.name) + "_seconds"

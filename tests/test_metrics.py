@@ -316,3 +316,58 @@ def test_px_spans_share_trace_id_and_metrics():
     assert m.counter("px exchange bytes capacity") > 0
     assert m.histogram("px compile").count == 1
     assert m.wait_event("px dispatch").count == 1
+
+
+def test_exposition_format_conformance(db):
+    """Strict family conformance over the full registry scrape: every
+    sample must belong to a DECLARED (# HELP + # TYPE) family with a
+    suffix its type owns — counter/gauge samples carry the family name
+    itself, histogram families own _bucket/_count/_sum, summary families
+    own only _count/_sum/quantile (the wait-event `_max` must ride as
+    its own gauge family, not as an orphan under the summary)."""
+    db.metrics.wait("tenant worker queue", 0.001)
+    text = db.metrics_text()
+    families: dict[str, str] = {}
+    helped: set[str] = set()
+    blocks: list[str] = []  # family of each sample, in order
+    for ln in text.strip().split("\n"):
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, typ = ln.split()
+            assert name not in families, f"family declared twice: {name}"
+            assert typ in ("counter", "gauge", "summary", "histogram"), ln
+            families[name] = typ
+            continue
+        name = ln.split("{", 1)[0].split(" ", 1)[0]
+        fam = None
+        if name in families and families[name] in ("counter", "gauge"):
+            fam = name
+        elif (name.endswith("_bucket")
+                and families.get(name[:-7]) == "histogram"):
+            assert '{le="' in ln, f"bucket sample without le label: {ln}"
+            fam = name[:-7]
+        elif (name.endswith("_count")
+                and families.get(name[:-6]) in ("histogram", "summary")):
+            fam = name[:-6]
+        elif (name.endswith("_sum")
+                and families.get(name[:-4]) in ("histogram", "summary")):
+            fam = name[:-4]
+        assert fam is not None, f"sample outside any declared family: {ln}"
+        blocks.append(fam)
+    # every declared family has HELP and at least one sample, and its
+    # samples form ONE contiguous block (exposition-format requirement)
+    assert set(families) == helped
+    assert set(blocks) == set(families)
+    seen_done: set[str] = set()
+    prev = None
+    for fam in blocks:
+        if fam != prev:
+            assert fam not in seen_done, f"family split into blocks: {fam}"
+            if prev is not None:
+                seen_done.add(prev)
+            prev = fam
+    # the regression this guards: wait max is a typed gauge family
+    assert families["ob_wait_tenant_worker_queue_seconds_max"] == "gauge"
+    assert families["ob_wait_tenant_worker_queue_seconds"] == "summary"

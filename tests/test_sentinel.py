@@ -1,0 +1,196 @@
+"""Health sentinel: typed rules over AWR snapshot pairs.
+
+Every test drives evaluate_window / HealthSentinel.observe with
+synthetic snapshot dicts shaped exactly like WorkloadRepository
+captures (snap_id/ts/summary/sysstat/timeline/census/qos) — fully
+deterministic, no clocks, no sleeps. The end-to-end wiring (real folds
+through a live Database) is covered by tools/health_smoke.py.
+"""
+
+import json
+
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.sentinel import (
+    HealthSentinel, SentinelConfig, evaluate_window)
+
+BOUNDS = (1e-3, 1e-2, 1e-1)
+
+
+def _snap(snap_id, ts, **kw):
+    base = {"snap_id": snap_id, "ts": ts, "summary": [], "access": [],
+            "census": [], "sysstat": {}, "timeline": [],
+            "timeline_meta": {}, "qos": {}}
+    base.update(kw)
+    return base
+
+
+def _digest(digest, execs, counts, retries=0):
+    return {"digest": digest, "exec_count": execs, "retry_count": retries,
+            "hist_bounds": list(BOUNDS), "hist_counts": list(counts)}
+
+
+def _regression_pair():
+    """20 executions at ~1ms baseline, then 20 more at ~10ms (10x p99,
+    past the 3x critical ratio) while tenant "bg" is starved at the
+    queue (100ms avg wait vs sys's 50us, 8 rejections) — the recorded
+    window the acceptance test replays."""
+    first = _snap(
+        1, 100.0,
+        summary=[_digest("select v from t where k = ?", 20, (20, 0, 0))],
+        qos={"sys": {"admitted": 20, "rejected": 0, "wait_s": 0.001},
+             "bg": {"admitted": 0, "rejected": 0, "wait_s": 0.0}},
+    )
+    last = _snap(
+        2, 160.0,
+        summary=[_digest("select v from t where k = ?", 40, (20, 20, 0))],
+        qos={"sys": {"admitted": 40, "rejected": 0, "wait_s": 0.002},
+             "bg": {"admitted": 2, "rejected": 8, "wait_s": 1.0}},
+    )
+    return first, last
+
+
+def test_recorded_window_raises_exactly_the_expected_alerts():
+    first, last = _regression_pair()
+    alerts = evaluate_window(first, last)
+    got = {(a["rule"], a["severity"], a["key"]) for a in alerts}
+    assert got == {
+        ("digest_latency_regression", "critical",
+         "select v from t where k = ?"),
+        ("tenant_starvation", "critical", "bg"),
+    }, alerts
+    assert len(alerts) == 2  # nothing else fired
+    reg = next(a for a in alerts if a["rule"] == "digest_latency_regression")
+    assert reg["evidence"]["ratio"] == pytest.approx(10.0)
+    assert reg["evidence"]["window_execs"] == 20
+    assert reg["first_snap_id"] == 1 and reg["last_snap_id"] == 2
+    starve = next(a for a in alerts if a["rule"] == "tenant_starvation")
+    assert starve["evidence"]["window_rejected"] == 8
+    assert starve["evidence"]["avg_wait_s"] == pytest.approx(0.1)
+    # pure + deterministic: the same window replays to the same alerts
+    assert evaluate_window(first, last) == alerts
+
+
+def test_regression_below_thresholds_is_silent():
+    first, last = _regression_pair()
+    # 2x p99 is a warn, not critical
+    cfgd = evaluate_window(first, last, SentinelConfig(
+        regress_critical_ratio=20.0))
+    reg = next(a for a in cfgd if a["rule"] == "digest_latency_regression")
+    assert reg["severity"] == "warn"
+    # too few window executions: rule must not fire at all
+    last_thin = _snap(
+        2, 160.0,
+        summary=[_digest("select v from t where k = ?", 24, (20, 4, 0))],
+    )
+    assert evaluate_window(first, last_thin) == []
+
+
+def test_error_and_retry_spikes():
+    first = _snap(1, 0.0, sysstat={"sql statements": 100,
+                                   "sql fail count": 0},
+                  summary=[_digest("q", 50, (50, 0, 0))])
+    last = _snap(2, 60.0, sysstat={"sql statements": 200,
+                                   "sql fail count": 25},
+                 summary=[_digest("q", 80, (80, 0, 0), retries=30)])
+    rules = {a["rule"]: a for a in evaluate_window(first, last)}
+    assert rules["error_spike"]["severity"] == "critical"  # 25% >= 2*10%
+    assert rules["error_spike"]["evidence"]["fail_rate"] == 0.125 * 2
+    assert rules["retry_spike"]["severity"] == "warn"  # 30% >= 25%
+    assert rules["retry_spike"]["evidence"]["window_retries"] == 30
+
+
+def test_compile_storm_from_timeline_and_census_fallback():
+    first = _snap(1, 0.0)
+    last = _snap(2, 60.0, timeline=[
+        {"ts": 10.0, "compile_events": 7, "compile_s": 2.0},
+        {"ts": 11.0, "compile_events": 5, "compile_s": 1.5},
+    ])
+    (a,) = evaluate_window(first, last)
+    assert a["rule"] == "compile_storm" and a["severity"] == "warn"
+    assert a["evidence"] == {"compile_events": 12, "compile_s": 3.5}
+    # dumps captured before the timeline existed: census churn fallback
+    old_last = _snap(2, 60.0, census=[
+        {"kind": "compiled_plan", "name": f"plan{i}"} for i in range(11)
+    ])
+    (a,) = evaluate_window(first, old_last)
+    assert a["rule"] == "compile_storm"
+    assert a["evidence"]["compile_events"] == 11
+
+
+def test_cache_pressure_sums_plan_and_block_evictions():
+    first = _snap(1, 0.0, sysstat={"plan cache eviction": 4},
+                  census=[{"kind": "block_cache",
+                           "detail": "hits=9,evictions=2"}])
+    last = _snap(2, 60.0,
+                 sysstat={"plan cache eviction": 12,
+                          "plan cache fast eviction": 6},
+                 census=[{"kind": "block_cache",
+                          "detail": "hits=9,evictions=6"}])
+    (a,) = evaluate_window(first, last)
+    assert a["rule"] == "device_cache_pressure"
+    assert a["evidence"] == {"plan_evictions": 14, "block_evictions": 4}
+    # 14 + 4 = 18 >= 16; one eviction fewer and it stays silent
+    assert evaluate_window(first, last, SentinelConfig(
+        cache_pressure_evictions=19)) == []
+
+
+def test_fastpath_collapse_needs_healthy_baseline():
+    first = _snap(1, 0.0, sysstat={"plan cache fast hit": 90,
+                                   "plan cache fast miss": 10})
+    last = _snap(2, 60.0, sysstat={"plan cache fast hit": 95,
+                                   "plan cache fast miss": 35})
+    (a,) = evaluate_window(first, last)
+    assert a["rule"] == "fastpath_collapse" and a["severity"] == "warn"
+    assert a["evidence"]["window_rate"] == pytest.approx(5 / 30, abs=1e-4)
+    # a cold baseline (was never hitting) is not a collapse
+    cold = _snap(1, 0.0, sysstat={"plan cache fast hit": 10,
+                                  "plan cache fast miss": 90})
+    cold_last = _snap(2, 60.0, sysstat={"plan cache fast hit": 15,
+                                        "plan cache fast miss": 115})
+    assert evaluate_window(cold, cold_last) == []
+
+
+def test_sentinel_dedups_and_bounds_the_ring():
+    sent = HealthSentinel(capacity=8, clock=lambda: 123.0)
+    first, last = _regression_pair()
+    fresh = sent.observe(first, last)
+    assert {a.rule for a in fresh} == {"digest_latency_regression",
+                                      "tenant_starvation"}
+    assert all(a.ts == 123.0 for a in fresh)
+    # same window again: nothing new, nothing duplicated
+    assert sent.observe(first, last) == []
+    assert len(sent.alerts()) == 2
+    # 30 distinct windows, each raising one error_spike: the ring keeps
+    # only the newest `capacity`, ids stay monotone, dedup memory bounded
+    for i in range(30):
+        a = _snap(10 + i, 100.0 + i,
+                  sysstat={"sql statements": 0, "sql fail count": 0})
+        b = _snap(11 + i, 160.0 + i,
+                  sysstat={"sql statements": 50, "sql fail count": 25})
+        got = sent.observe(a, b)
+        assert [x.rule for x in got] == ["error_spike"]
+    al = sent.alerts()
+    assert len(al) == 8
+    ids = [a.alert_id for a in al]
+    assert ids == sorted(ids) and ids[-1] == 32  # 2 + 30 observations
+    assert len(sent._seen) <= 8 * 4
+    sent.set_capacity(8)  # idempotent
+    assert len(sent.alerts()) == 8
+
+
+def test_alert_history_virtual_table():
+    db = Database(n_nodes=1, n_ls=1)
+    first, last = _regression_pair()
+    assert db.sentinel.observe(first, last)
+    s = db.session()
+    rows = s.sql(
+        "select rule, severity, subject, evidence from "
+        "__all_virtual_alert_history"
+    ).rows()
+    by_rule = {r[0]: r for r in rows}
+    assert by_rule["digest_latency_regression"][1] == "critical"
+    assert by_rule["tenant_starvation"][2] == "bg"
+    ev = json.loads(by_rule["tenant_starvation"][3])
+    assert ev["window_rejected"] == 8

@@ -1,0 +1,247 @@
+"""Serving timeline: bucket ring, QoS ledger, busy-fraction bounds.
+
+Everything runs on an injected clock — no sleeps: busy seconds are real
+perf_counter durations from real dispatches, wall seconds come from the
+fake clock, so saturation tests drive hours of "time" in milliseconds.
+"""
+
+import threading
+
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.share.metrics import DEFAULT_BUCKETS, MetricsRegistry
+from oceanbase_tpu.share.timeline import ServingTimeline, hist_quantile
+
+
+def _tl(bucket_s=1.0, capacity=8):
+    now = [0.0]
+    tl = ServingTimeline(bucket_s=bucket_s, capacity=capacity,
+                         clock=lambda: now[0])
+    return tl, now
+
+
+# ---- ring mechanics -------------------------------------------------------
+
+
+def test_ring_wraps_and_memory_stays_bounded():
+    tl, now = _tl(capacity=8)
+    b0 = tl.stats()["bytes"]
+    for i in range(50):
+        now[0] = i + 0.5
+        tl.record_exec(0.01, 0.0, 0)
+        tl.record_stmt("sys", 0.02, False, 1)
+    snap = tl.snapshot()
+    assert len(snap) <= 8
+    # the ring kept only the newest periods, oldest first
+    assert [b["ts"] for b in snap] == [float(i) for i in range(42, 50)]
+    st = tl.stats()
+    assert st["buckets"] == 8
+    assert st["records"] == 100
+    # wraparound reuses buckets in place: footprint only grew by the
+    # per-tenant ledgers, not with the 50 periods written
+    assert st["bytes"] - b0 < 2048
+
+
+def test_bucket_accounting_and_partial_wall():
+    tl, now = _tl()
+    now[0] = 10.2
+    tl.record_stmt("sys", 0.05, True, 3)
+    tl.record_admission("sys", 0.004, True)
+    tl.record_exec(0.2, 0.1, 64)
+    tl.record_batch(0.3, 5)
+    tl.record_transfer(128)
+    now[0] = 10.5  # still inside bucket 10
+    (b,) = tl.snapshot()
+    assert b["ts"] == 10.0
+    assert b["wall_s"] == pytest.approx(0.5)  # partial bucket: elapsed
+    assert b["stmts"] == 1 and b["errors"] == 1
+    assert b["host_busy_s"] == pytest.approx(0.05)
+    assert b["device_busy_s"] == pytest.approx(0.5)  # exec + batch
+    assert b["device_busy_frac"] == pytest.approx(1.0)  # clamped at 1
+    assert b["dispatches"] == 2 and b["batch_dispatches"] == 1
+    assert b["batch_lanes"] == 5
+    assert b["compile_events"] == 1
+    assert b["compile_s"] == pytest.approx(0.1)
+    assert b["transfer_events"] == 2
+    assert b["transfer_bytes"] == 192
+    assert b["max_in_flight"] == 3
+    assert b["admission_wait_s"] == pytest.approx(0.004)
+    assert sum(b["occ_hist"]) == 1 and sum(b["depth_hist"]) == 1
+    assert b["wait_p99_s"] == hist_quantile(
+        DEFAULT_BUCKETS, b["wait_hist"], 0.99)
+    t = b["tenants"]["sys"]
+    assert t["stmts"] == 1 and t["errors"] == 1
+    assert t["wait_s"] == pytest.approx(0.004)
+    # a full bucket later reports full wall and a lower busy fraction
+    now[0] = 11.0
+    tl.record_exec(0.001, 0.0, 0)
+    now[0] = 12.4
+    first = tl.snapshot()[0]
+    assert first["wall_s"] == pytest.approx(1.0)
+
+
+def test_qos_totals_survive_ring_wraparound():
+    """The cumulative ledger is monotone: two reads diff exactly even
+    after the bucket ring wrapped many times between them."""
+    tl, now = _tl(capacity=4)
+    tl.register_tenant("a", max_workers=4, queue_timeout_s=0.5)
+    tl.register_tenant("b", max_workers=None, queue_timeout_s=0.0)
+    q0 = tl.qos_totals()
+    assert q0["a"]["max_workers"] == 4
+    assert q0["b"]["max_workers"] == -1  # unbounded
+    for i in range(40):  # 10x the ring capacity
+        now[0] = float(i)
+        tl.record_stmt("a", 0.01, False, 2)
+        tl.record_admission("b", 0.002, i % 2 == 0)
+    q1 = tl.qos_totals()
+    assert q1["a"]["stmts"] - q0["a"]["stmts"] == 40
+    assert q1["b"]["rejected"] - q0["b"]["rejected"] == 20
+    assert q1["b"]["wait_s"] - q0["b"]["wait_s"] == pytest.approx(0.08)
+    assert len(tl.snapshot()) <= 4
+
+
+def test_disabled_timeline_records_nothing():
+    tl, now = _tl()
+    tl.enabled = False
+    tl.record_stmt("sys", 1.0, False, 1)
+    tl.record_exec(1.0, 1.0, 1)
+    tl.record_batch(1.0, 4)
+    tl.record_admission("sys", 1.0, False)
+    tl.record_transfer(9)
+    assert tl.snapshot() == []
+    assert tl.records == 0
+
+
+def test_reconfigure_bucket_width_and_capacity():
+    tl, now = _tl(bucket_s=1.0, capacity=8)
+    now[0] = 3.5
+    tl.record_exec(0.1, 0.0, 0)
+    tl.set_bucket_s(0.5)  # re-keys the ring: old periods dropped
+    assert tl.snapshot() == []
+    tl.record_exec(0.2, 0.0, 0)
+    (b,) = tl.snapshot()
+    assert b["ts"] == 3.5  # period 7 * 0.5s
+    tl.set_capacity(16)
+    assert tl.stats()["capacity"] == 16
+    assert tl.snapshot() == []  # reallocated ring starts empty
+
+
+def test_meter_publishes_sysstat_gauges():
+    tl, now = _tl()
+    now[0] = 0.25
+    tl.record_exec(0.05, 0.0, 0)
+    m = MetricsRegistry()
+    tl.meter(m)
+    g = m.gauges_snapshot()
+    assert g["timeline buckets"] == 1
+    assert g["timeline records"] == 1
+    assert g["timeline bytes"] > 0
+    assert g["timeline device busy pct"] == pytest.approx(20.0, rel=0.01)
+
+
+# ---- end-to-end: virtual table busy-fraction bounds -----------------------
+
+
+@pytest.fixture(scope="module")
+def loaded_db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table tlv (k bigint primary key, v bigint not null)")
+    s.sql("insert into tlv values " + ", ".join(
+        f"({i}, {i * 3})" for i in range(1, 33)))
+    # compile + cache every statement text the tests replay, so a cold
+    # compile can never masquerade as device-busy time in a bucket
+    for k in (5, 7, 9, 11, 13):
+        s.sql(f"select v from tlv where k = {k}")
+    return d
+
+
+def test_vt_busy_fraction_trickle_vs_concurrent_load(loaded_db):
+    """__all_virtual_server_timeline must separate a near-idle trickle
+    from saturating load: one statement per 10 fake seconds yields a low
+    device-busy fraction; 8 session threads hammering inside HALF of one
+    frozen bucket yield a strictly higher one — and both stay <= 100%.
+    Real dispatches supply the busy seconds; the fake clock supplies the
+    wall, so no sleeps anywhere."""
+    db = loaded_db
+    now = [1000.0]
+    old_clock = db.timeline._clock
+    db.timeline._clock = lambda: now[0]
+    try:
+        s = db.session()
+        trickle_periods = []
+        for i in range(5):
+            now[0] = 1010.0 + 10.0 * i  # one statement per 10 buckets
+            trickle_periods.append(1010.0 + 10.0 * i)
+            s.sql("select v from tlv where k = 7")
+        now[0] = 1100.25  # trickle buckets are now complete (wall = 1s)
+
+        rows = s.sql(
+            "select bucket_ts, device_busy_pct from "
+            "__all_virtual_server_timeline"
+        ).rows()
+        by_ts = {float(ts): float(pct) for ts, pct in rows}
+        trickle = [by_ts[int(ts // 1.0)] for ts in trickle_periods
+                   if int(ts // 1.0) in by_ts]
+        assert trickle, by_ts
+        assert all(0.0 <= p <= 100.0 for p in by_ts.values())
+
+        # saturate: 8 threads, 12 statements each, all inside the first
+        # half of one frozen bucket
+        now[0] = 1200.5
+        errs = []
+
+        def hammer():
+            try:
+                sess = db.session()
+                for _ in range(12):
+                    sess.sql("select v from tlv where k = 9")
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        rows = s.sql(
+            "select bucket_ts, device_busy_pct, stmts from "
+            "__all_virtual_server_timeline where bucket_ts >= 1200"
+        ).rows()
+        (loaded,) = [(float(p), int(n)) for ts_, p, n in rows
+                     if float(ts_) == 1200.0]
+        loaded_pct, loaded_stmts = loaded
+        assert loaded_stmts >= 96
+        assert loaded_pct <= 100.0
+        assert loaded_pct > max(trickle), (loaded_pct, trickle)
+    finally:
+        db.timeline._clock = old_clock
+
+
+def test_vt_tenant_qos_live(loaded_db):
+    s = loaded_db.session()
+    s.sql("select v from tlv where k = 11")
+    rows = s.sql(
+        "select tenant, stmts, admitted from __all_virtual_tenant_qos"
+    ).rows()
+    by_tenant = {r[0]: r for r in rows}
+    t = by_tenant[loaded_db.tenant_name]
+    assert int(t[1]) > 0 and int(t[2]) > 0
+
+
+def test_timeline_config_toggles(loaded_db):
+    db = loaded_db
+    db.config.set("enable_serving_timeline", "false")
+    try:
+        r0 = db.timeline.records
+        db.session().sql("select v from tlv where k = 13")
+        assert db.timeline.records == r0
+    finally:
+        db.config.set("enable_serving_timeline", "true")
+    db.session().sql("select v from tlv where k = 13")
+    assert db.timeline.records > r0
+    db.config.set("serving_timeline_capacity", "16")
+    assert db.timeline.stats()["capacity"] == 16
+    db.config.set("serving_timeline_capacity", "120")

@@ -282,8 +282,13 @@ def test_snapshot_statement_and_ring_bound(db):
     last = snaps[-1]
     assert last["snap_id"] == snap_id
     assert set(last) == {"snap_id", "ts", "summary", "access", "census",
-                         "sysstat"}
+                         "sysstat", "timeline", "timeline_meta", "qos"}
     assert last["sysstat"]["sql statements"] > 0
+    # the serving-timeline embed is live, not a stub: the statements
+    # above landed in at least one bucket and the QoS ledger
+    assert any(b["stmts"] for b in last["timeline"])
+    assert last["qos"][db.tenant_name]["stmts"] > 0
+    assert last["timeline_meta"]["wait_bounds"]
 
 
 def test_workload_repository_bounded_and_periodic(db):
@@ -348,3 +353,135 @@ def test_enable_sql_stat_toggle(db):
         db.config.set("enable_sql_stat", "true")
     s.sql("select v from wl_t where k = 1")
     assert len(db.stmt_summary.snapshot()) == 1
+
+
+# ---- edge windows + restart clamp (tools/awr_report.py) -------------------
+
+
+def _awr():
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import awr_report
+
+    return awr_report
+
+
+def test_awr_empty_window(db, tmp_path):
+    """Two back-to-back snapshots with nothing between them: the report
+    renders an empty window (no digests, no restart flag) and exits 0."""
+    wr = WorkloadRepository(capacity=4)
+    wr.take(db)
+    wr.take(db)
+    dump = tmp_path / "empty.json"
+    assert wr.dump(str(dump)) == 2
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "awr_report.py"),
+         str(dump)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["top_digests"] == []
+    assert doc["restarted"] is False
+    assert "saturation" in doc
+
+
+def test_awr_single_snapshot_refuses(db, tmp_path):
+    """One snapshot is not a window: a clear error, not a stack trace."""
+    wr = WorkloadRepository(capacity=4)
+    wr.take(db)
+    dump = tmp_path / "single.json"
+    assert wr.dump(str(dump)) == 1
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "awr_report.py"),
+         str(dump)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode != 0
+    assert "need two snapshots" in r.stderr
+
+
+def _restart_snap(snap_id, ts, execs, stmts):
+    hist_counts = [0] * 18
+    hist_counts[2] = execs
+    return {
+        "snap_id": snap_id, "ts": ts,
+        "summary": [{
+            "digest": "select v from r_t where k = ?",
+            "stmt_type": "Select", "exec_count": execs, "fail_count": 0,
+            "retry_count": 0, "rows_returned": execs, "affected_rows": 0,
+            "fast_path_count": execs, "batched_count": 0,
+            "cache_hit_count": execs, "total_elapsed_s": execs * 1e-4,
+            "max_elapsed_s": 1e-3, "fastparse_s": 0.0, "bind_s": 0.0,
+            "dispatch_s": 0.0, "fetch_s": 0.0, "compile_s": 0.0,
+            "transfer_bytes": 0, "max_device_bytes": 0,
+            "max_peak_bytes": 0, "hist_bounds": [1e-4, 1e-3, 1e-2],
+            "hist_counts": hist_counts[:4], "p50_s": 1e-3, "p95_s": 1e-3,
+            "p99_s": 1e-3,
+        }],
+        "access": [], "census": [],
+        "sysstat": {"sql statements": stmts},
+        "timeline": [], "timeline_meta": {}, "qos": {},
+    }
+
+
+def test_awr_restart_clamps_to_new_absolutes():
+    """Counters going BACKWARDS mid-window (server restart) must not
+    produce negative deltas: the window baselines at zero, reports the
+    new absolute values, and flags `restarted`."""
+    awr = _awr()
+    first = _restart_snap(1, 100.0, execs=100, stmts=500)
+    last = _restart_snap(2, 200.0, execs=20, stmts=60)
+    assert awr.detect_restart(first, last) is True
+    report = awr.render(first, last, top=5)
+    assert report["restarted"] is True
+    top = report["top_digests"][0]
+    assert top["exec_count"] == 20  # new absolute, not 20-100
+    assert all(v >= 0 for d in report["top_digests"]
+               for v in d.values() if isinstance(v, (int, float)))
+    assert report["sysstat_delta"]["sql statements"] == 60
+    # a healthy window through the same path stays unflagged and exact
+    healthy = awr.render(_restart_snap(1, 100.0, 100, 500),
+                         _restart_snap(2, 200.0, 130, 560), top=5)
+    assert healthy["restarted"] is False
+    assert healthy["top_digests"][0]["exec_count"] == 30
+
+
+def test_workload_ring_wraparound_during_diff(db):
+    """8 threads hammer take() through a capacity-4 ring while held
+    snapshot references get diffed: the diff works on captured dicts, so
+    a ring that wrapped between the endpoints must not corrupt it."""
+    awr = _awr()
+    wr = WorkloadRepository(capacity=4)
+    first = wr.take(db)
+    s = db.session()
+    s.sql("select v from wl_t where k = 2")
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(12):
+                wr.take(db)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in ts:
+        t.start()
+    # diff concurrently with the hammer: captured dicts are immutable
+    for _ in range(20):
+        snaps = wr.snapshots()
+        assert len(snaps) <= 4
+        if len(snaps) >= 2:
+            awr.diff_summary(snaps[0], snaps[-1])
+    for t in ts:
+        t.join()
+    assert not errs
+    last = wr.take(db)
+    assert len(wr.snapshots()) <= 4
+    assert awr.detect_restart(first, last) is False
+    d = awr.diff_summary(first, last)
+    assert all(x["exec_count"] >= 0 for x in d)
+    report = awr.render(first, last, top=3)
+    assert report["restarted"] is False

@@ -69,6 +69,21 @@ _SUM_KEYS = (
 )
 
 
+def detect_restart(first: dict, last: dict) -> bool:
+    """A server restart inside the window zeroes every in-memory counter,
+    so EXACT monotone counters go backwards: a digest's exec_count, or
+    any sysstat counter (the snapshot's sysstat holds counters only —
+    gauges are excluded at capture). Sampled float fields can drift a
+    hair negative legitimately and are never consulted here."""
+    f_by = {s["digest"]: s.get("exec_count", 0)
+            for s in first.get("summary", ())}
+    for s in last.get("summary", ()):
+        if s.get("exec_count", 0) < f_by.get(s["digest"], 0):
+            return True
+    s0, s1 = first.get("sysstat", {}), last.get("sysstat", {})
+    return any(s1[k] < s0[k] for k in s1.keys() & s0.keys())
+
+
 def diff_summary(first: dict, last: dict) -> list[dict]:
     """Per-digest window deltas (digest absent from the first snapshot
     baselines at zero). Digests with no executions in the window drop."""
@@ -216,18 +231,108 @@ def _us(s: float) -> int:
     return int(s * 1e6)
 
 
+def saturation(first: dict, last: dict, restarted: bool) -> dict:
+    """Window saturation view from the serving timeline + QoS ledger:
+    is the DEVICE the ceiling (busy fraction), is admission the ceiling
+    (queue-wait p99, rejections), and who is consuming the host."""
+    t0, t1 = first.get("ts", 0.0), last.get("ts", 0.0)
+    # a bucket's ts is its floored START: a bucket overlapping the
+    # window start (ts < t0 < ts + bucket_s) belongs to the window too,
+    # else a sub-second workload matches zero buckets
+    bucket_s = last.get("timeline_meta", {}).get("bucket_s", 1.0)
+    buckets = [b for b in last.get("timeline", ())
+               if t0 - bucket_s < b.get("ts", -1.0 - bucket_s) <= t1]
+    wall = sum(b.get("wall_s", 0.0) for b in buckets)
+    dev = sum(b.get("device_busy_s", 0.0) for b in buckets)
+    host = sum(b.get("host_busy_s", 0.0) for b in buckets)
+    bd = sum(b.get("batch_dispatches", 0) for b in buckets)
+    lanes = sum(b.get("batch_lanes", 0) for b in buckets)
+    # merged queue-wait histogram -> one window p99 (bounds shipped in
+    # timeline_meta; dumps predating it fall back to the worst bucket)
+    bounds = last.get("timeline_meta", {}).get("wait_bounds")
+    merged: list | None = None
+    for b in buckets:
+        wh = b.get("wait_hist")
+        if wh:
+            merged = ([m + c for m, c in zip(merged, wh)]
+                      if merged else list(wh))
+    if bounds and merged:
+        wait_p99 = hist_quantile(bounds, merged, 0.99)
+    else:
+        wait_p99 = max((b.get("wait_p99_s", 0.0) for b in buckets),
+                       default=0.0)
+    q0 = {} if restarted else first.get("qos", {})
+    q1 = last.get("qos", {})
+    tenants = []
+    for name in sorted(q1):
+        a, z = q1[name], q0.get(name, {})
+        tw = {
+            "tenant": name,
+            "stmts": a.get("stmts", 0) - z.get("stmts", 0),
+            "errors": a.get("errors", 0) - z.get("errors", 0),
+            "admitted": a.get("admitted", 0) - z.get("admitted", 0),
+            "rejected": a.get("rejected", 0) - z.get("rejected", 0),
+            "wait_s": a.get("wait_s", 0.0) - z.get("wait_s", 0.0),
+            "host_busy_s": (a.get("host_busy_s", 0.0)
+                            - z.get("host_busy_s", 0.0)),
+            "max_workers": a.get("max_workers", -1),
+        }
+        if tw["stmts"] or tw["admitted"] or tw["rejected"]:
+            tenants.append(tw)
+    tot_host = sum(t["host_busy_s"] for t in tenants)
+    for t in tenants:
+        t["host_share"] = round(
+            t["host_busy_s"] / tot_host, 4) if tot_host > 0 else 0.0
+        q = t["admitted"] + t["rejected"]
+        t["avg_wait_s"] = t["wait_s"] / q if q else 0.0
+    tenants.sort(key=lambda t: -t["host_busy_s"])
+    return {
+        "window_buckets": len(buckets),
+        "wall_s": wall,
+        "device_busy_s": dev,
+        "device_busy_frac": dev / wall if wall else 0.0,
+        "host_busy_s": host,
+        "host_busy_frac": host / wall if wall else 0.0,
+        "dispatches": sum(b.get("dispatches", 0) for b in buckets),
+        "batch_dispatches": bd,
+        "batch_lanes": lanes,
+        "avg_batch_occupancy": lanes / bd if bd else 0.0,
+        "compile_events": sum(b.get("compile_events", 0) for b in buckets),
+        "compile_s": sum(b.get("compile_s", 0.0) for b in buckets),
+        "transfer_bytes": sum(b.get("transfer_bytes", 0) for b in buckets),
+        "max_in_flight": max((b.get("max_in_flight", 0) for b in buckets),
+                             default=0),
+        "rejected": sum(b.get("rejected", 0) for b in buckets),
+        "queue_wait_p99_s": wait_p99,
+        "tenants": tenants,
+    }
+
+
 def render(first: dict, last: dict, top: int) -> dict:
-    digests = diff_summary(first, last)
-    tables = diff_access(first, last)
-    churn, resid = diff_census(first, last)
-    sys0, sys1 = first.get("sysstat", {}), last.get("sysstat", {})
+    restarted = detect_restart(first, last)
+    base = first
+    if restarted:
+        # mid-window counter reset (server restart): every monotone
+        # delta would come out negative. Baseline at ZERO instead — the
+        # window reports the new absolute values — and flag the report.
+        base = {"snap_id": first.get("snap_id", 0),
+                "ts": first.get("ts", 0.0), "summary": [], "access": [],
+                "census": [], "sysstat": {}, "qos": {}}
+    digests = diff_summary(base, last)
+    tables = diff_access(base, last)
+    churn, resid = diff_census(base, last)
+    sys0, sys1 = base.get("sysstat", {}), last.get("sysstat", {})
     sysd = {k: sys1[k] - sys0.get(k, 0) for k in sys1
             if sys1[k] != sys0.get(k, 0)}
+    sat = saturation(first, last, restarted)
 
     interval = last["ts"] - first["ts"]
     w = print
     w(f"Workload report: snap {first['snap_id']} -> {last['snap_id']} "
       f"({interval:.3f}s)")
+    if restarted:
+        w("NOTE: counter reset detected mid-window (server restart) — "
+          "window figures are the new absolute values")
     w("")
     by_total = sorted(digests, key=lambda d: -d["total_elapsed_s"])[:top]
     w(f"Top {len(by_total)} digests by window total time:")
@@ -267,6 +372,29 @@ def render(first: dict, last: dict, top: int) -> dict:
     for r in resid[:top]:
         w(f"  {r['table']:<24} {r['bytes']:>12}B ({r['bytes_delta']:+d})")
     w("")
+    w("Serving saturation (window):")
+    if sat["window_buckets"]:
+        w(f"  device busy {100 * sat['device_busy_frac']:.1f}% of "
+          f"{sat['wall_s']:.2f}s wall "
+          f"({sat['device_busy_s'] * 1e3:.1f}ms dispatch, "
+          f"{sat['dispatches']} dispatches, "
+          f"{sat['batch_dispatches']} batched "
+          f"x{sat['avg_batch_occupancy']:.1f} lanes)")
+        w(f"  host busy {100 * sat['host_busy_frac']:.1f}%; "
+          f"peak in-flight {sat['max_in_flight']}; "
+          f"queue wait p99 {_us(sat['queue_wait_p99_s'])}us; "
+          f"{sat['rejected']} admissions rejected")
+        w(f"  interference: {sat['compile_events']} compiles "
+          f"({sat['compile_s'] * 1e3:.1f}ms), "
+          f"{sat['transfer_bytes']}B transfers")
+        for t in sat["tenants"][:top]:
+            w(f"    {t['tenant']:<16} {100 * t['host_share']:>5.1f}% host "
+              f"stmts={t['stmts']} rejected={t['rejected']} "
+              f"avg_wait={_us(t['avg_wait_s'])}us")
+    else:
+        w("  (no timeline buckets in window — serving timeline disabled "
+          "or dump predates it)")
+    w("")
     folds = sysd.get("stmt summary folds", 0)
     if folds:
         w(f"Repository overhead: {sysd.get('stmt summary fold ns', 0) / folds:.0f}"
@@ -277,6 +405,8 @@ def render(first: dict, last: dict, top: int) -> dict:
         "first_snap_id": first["snap_id"],
         "last_snap_id": last["snap_id"],
         "interval_s": interval,
+        "restarted": restarted,
+        "saturation": sat,
         "top_digests": by_total,
         "top_p99_digests": by_p99,
         "hot_tables": tables,

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Health report: replay a workload dump through the sentinel rules.
+
+Input is what WorkloadRepository.dump() writes ({"snapshots": [...]}).
+Every CONSECUTIVE snapshot pair is evaluated with the same pure rule
+pass the live HealthSentinel runs (server/sentinel.py:evaluate_window),
+so an offline replay of a recorded dump reports exactly the alerts the
+live server would have raised — the deterministic path the tier-1
+sentinel test and tools/run_tier1.sh --health lean on.
+
+Output: a human-readable alert listing (worst first) followed by ONE
+machine-readable JSON line (the last stdout line):
+
+  {"alerts": [...], "windows": N, "critical": n, "warn": m}
+
+Exit code is 0 whether or not alerts fired — alerts are a report, not a
+failure; --strict-clean flips that (exit 1 if anything fired) for CI
+jobs that expect a healthy window.
+
+    python tools/health_report.py dump.json
+    python tools/health_report.py dump.json --rule tenant_starvation
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SEV_ORDER = {"critical": 0, "warn": 1}
+
+
+def load_snapshots(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "snapshots" in doc:
+        return list(doc["snapshots"])
+    raise SystemExit(f"{path}: not a workload snapshot dump")
+
+
+def replay(snaps: list[dict]) -> list[dict]:
+    from oceanbase_tpu.server.sentinel import evaluate_window
+
+    alerts: list[dict] = []
+    for first, last in zip(snaps, snaps[1:]):
+        alerts.extend(evaluate_window(first, last))
+    return alerts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="workload dump (WorkloadRepository.dump())")
+    ap.add_argument("--rule", help="only report this rule")
+    ap.add_argument("--strict-clean", action="store_true",
+                    help="exit 1 if any alert fired")
+    args = ap.parse_args(argv)
+
+    snaps = load_snapshots(args.dump)
+    if len(snaps) < 2:
+        print(f"{args.dump}: {len(snaps)} snapshot(s) — no window to "
+              "evaluate")
+        print(json.dumps({"alerts": [], "windows": 0,
+                          "critical": 0, "warn": 0}))
+        return 0
+    alerts = replay(snaps)
+    if args.rule:
+        alerts = [a for a in alerts if a["rule"] == args.rule]
+    alerts.sort(key=lambda a: (_SEV_ORDER.get(a["severity"], 9),
+                               a["rule"], a["key"]))
+
+    nc = sum(1 for a in alerts if a["severity"] == "critical")
+    nw = len(alerts) - nc
+    print(f"Health report: {len(snaps)} snapshots, "
+          f"{len(snaps) - 1} windows, {nc} critical / {nw} warn")
+    for a in alerts:
+        subj = f" [{a['key']}]" if a["key"] else ""
+        print(f"  {a['severity'].upper():<8} {a['rule']}{subj} "
+              f"(snap {a['first_snap_id']} -> {a['last_snap_id']})")
+        print(f"           {a['summary']}")
+        ev = ", ".join(f"{k}={v}" for k, v in sorted(a["evidence"].items()))
+        if ev:
+            print(f"           evidence: {ev[:200]}")
+    if not alerts:
+        print("  no alerts — every window within thresholds")
+    # machine-readable contract: the LAST stdout line is one JSON object
+    print(json.dumps({"alerts": alerts, "windows": len(snaps) - 1,
+                      "critical": nc, "warn": nw}))
+    return 1 if (args.strict_clean and alerts) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Health-sentinel smoke (tools/run_tier1.sh --health).
+
+Boots a tiny cluster, captures a healthy baseline snapshot, injects two
+synthetic faults — a digest whose latency regresses far past the 3x
+critical ratio, and a tenant starved at the admission queue while its
+peer is served instantly — captures the second snapshot, and asserts:
+
+  1. the LIVE sentinel (wired to WorkloadRepository.on_snapshot) raised
+     exactly the expected typed alerts, at the expected severities;
+  2. re-evaluating the same window duplicates nothing;
+  3. tools/health_report.py replays the dumped snapshots offline,
+     reports the same two rules, and exits 0.
+
+Injection goes through the real fold/record APIs (a session-summary
+accumulator and the serving timeline), not by editing snapshot dicts —
+the smoke covers the wiring, not just the rule math. No sleeps; the
+faults are synthetic latencies, not elapsed time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIGEST = "select v from smoke_kv where k = ?"
+
+
+def main() -> int:
+    from oceanbase_tpu.server import Database
+
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table smoke_kv (k bigint primary key, v bigint)")
+    s.sql("insert into smoke_kv values (1, 10), (2, 20)")
+
+    # healthy baseline: 16 fast executions of the target digest
+    acc = db.stmt_summary.session_acc()
+    for _ in range(16):
+        acc.fold(DIGEST, "Select", 0.0005, "", 0, None, False, None)
+    snap1 = db.workload.take(db)
+
+    # fault 1: the same digest now runs 1000x slower (>= 3x critical)
+    for _ in range(16):
+        acc.fold(DIGEST, "Select", 0.5, "", 0, None, False, None)
+    # fault 2: tenant "bg" starved at admission — every pass rejected
+    # after an 80ms wait while "sys" (the real statements above) was
+    # served with microsecond waits
+    db.timeline.register_tenant("bg", max_workers=2, queue_timeout_s=0.08)
+    for _ in range(8):
+        db.timeline.record_admission("bg", 0.08, False)
+    db.timeline.record_admission(db.tenant_name, 1e-5, True)
+    for _ in range(4):
+        db.timeline.record_stmt(db.tenant_name, 0.001, False, 1)
+    snap2 = db.workload.take(db)
+
+    alerts = db.sentinel.alerts()
+    rules = {(a.rule, a.severity) for a in alerts}
+    expect = {("digest_latency_regression", "critical"),
+              ("tenant_starvation", "critical")}
+    assert rules == expect, f"live sentinel raised {rules}, want {expect}"
+    reg = next(a for a in alerts if a.rule == "digest_latency_regression")
+    assert reg.key == DIGEST and reg.evidence["ratio"] >= 3.0, reg
+    assert reg.first_snap_id == snap1["snap_id"], reg
+    assert reg.last_snap_id == snap2["snap_id"], reg
+    starve = next(a for a in alerts if a.rule == "tenant_starvation")
+    assert starve.key == "bg" and starve.evidence["window_rejected"] == 8, \
+        starve
+
+    # re-evaluating the same window must duplicate nothing
+    again = db.sentinel.observe(snap1, snap2)
+    assert again == [], f"re-observe duplicated: {again}"
+    assert len(db.sentinel.alerts()) == len(alerts)
+
+    # offline replay of the dump reports the same rules, rc 0
+    with tempfile.TemporaryDirectory() as td:
+        dump = os.path.join(td, "dump.json")
+        db.workload.dump(dump)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "health_report.py"), dump],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        tail = json.loads(proc.stdout.strip().splitlines()[-1])
+        replay_rules = {a["rule"] for a in tail["alerts"]}
+        assert {"digest_latency_regression",
+                "tenant_starvation"} <= replay_rules, tail
+        assert tail["critical"] >= 2, tail
+
+    print("HEALTH SMOKE PASS: "
+          f"{sorted(r for r, _ in rules)} fired once each; offline "
+          "replay matches; health_report rc 0")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

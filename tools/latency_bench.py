@@ -210,11 +210,15 @@ def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
     compiles0 = db.engine.executor.batched_compiles
     b_measure.wait()
     t_start = time.perf_counter()
+    cpu_start = time.process_time()
     stop.wait(seconds)
     stop.set()
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
+    # process CPU over the measured window only (all threads): the
+    # low-noise numerator obs_overhead_bench's paired A/B gates on
+    cpu_s = time.process_time() - cpu_start
     c1 = db.metrics.counters_snapshot()
 
     def delta(name: str) -> int:
@@ -233,6 +237,8 @@ def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
         "batching": batching,
         "stmts": total,
         "stmts_per_sec": round(total / wall, 1),
+        "cpu_us_per_stmt": round(cpu_s / total * 1e6, 3) if total
+        else 0.0,
         **(percentiles(lat) if total else {}),
         "batched_stmts": batched,
         "batched_dispatches": dispatches,
@@ -330,10 +336,15 @@ def main() -> int:
 
     t0 = time.perf_counter()
     db, sess = build_db(args.rows)
+    from bench_meta import collect as bench_meta
+
     detail = {
         "rows": args.rows,
         "stmts": args.stmts,
         "setup_s": round(time.perf_counter() - t0, 2),
+        # provenance: rev + config fingerprint + active overrides — two
+        # artifacts compare cleanly only when these match
+        "meta": bench_meta(db),
     }
 
     if args.sessions > 0:

@@ -25,6 +25,12 @@
 # WORKLOAD statements, dumped and diffed by tools/awr_report.py as a
 # subprocess; the top digest must match the driven statement and the
 # advisor block must parse.
+#
+# --health additionally runs the health-sentinel smoke
+# (tools/health_smoke.py): a synthetic digest latency regression plus a
+# starved tenant must each raise exactly one typed alert, re-evaluation
+# must not duplicate them, and tools/health_report.py must replay the
+# dump with exit code 0.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -34,12 +40,14 @@ chaos=0
 latency=0
 serve=0
 awr=0
+health=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
         --latency) latency=1; shift ;;
         --serve) serve=1; shift ;;
         --awr) awr=1; shift ;;
+        --health) health=1; shift ;;
         *) break ;;
     esac
 done
@@ -72,6 +80,11 @@ fi
 
 if [ "$awr" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/awr_smoke.py
+    rc=$?
+fi
+
+if [ "$health" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/health_smoke.py
     rc=$?
 fi
 exit $rc
