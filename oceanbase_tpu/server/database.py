@@ -227,6 +227,10 @@ class TenantUnit:
     queue_timeout_s: float = 5.0  # wait for a worker slot
     memory_limit: int | None = None  # bytes of resident catalog snapshots
     px_target: int | None = None  # cluster-parallelism quota
+    # continuous-batching admission share: the dispatch gate's weighted
+    # round-robin picks this tenant's queued cohorts `weight` times per
+    # unit-weight tenant when both have backlog (server/batcher.py)
+    weight: int = 1
 
 
 class Database:
@@ -630,13 +634,37 @@ class Database:
         # feeds (admission, completion) go through db.timeline directly
         self.engine.timeline = self.timeline
         self.engine.executor.timeline = self.timeline
-        # cross-session statement micro-batcher: concurrent fast-path
-        # hits on the same plan fold into one batched device dispatch
-        # (server/batcher.py; knobs ob_batch_max_size/ob_batch_max_wait_us)
-        from .batcher import StatementBatcher
+        # cross-session continuous-batching scheduler: concurrent
+        # fast-path hits fold into batched device dispatches behind ONE
+        # cluster-shared DispatchGate (like cluster._timeline) — the
+        # weighted per-tenant admission only means anything when every
+        # tenant queues at the same gate. Knobs: ob_batch_max_size,
+        # ob_batch_max_wait_us, ob_batch_follower_timeout,
+        # ob_batch_queue_depth, ob_tenant_admission_slots; admission
+        # share: TenantUnit.weight
+        from .batcher import DispatchGate, StatementBatcher
 
-        self.batcher = StatementBatcher(metrics=self.metrics)
+        gate = getattr(self.cluster, "_dispatch_gate", None)
+        if gate is None:
+            gate = DispatchGate()
+            self.cluster._dispatch_gate = gate
+        self.batcher = StatementBatcher(
+            metrics=self.metrics, gate=gate, tenant=self.tenant_name)
+        gate.register(self.tenant_name, self.unit.weight)
         self.batcher.timeline = self.timeline
+        self.batcher.follower_timeout_s = (
+            self.config["ob_batch_follower_timeout"])
+        self.batcher.queue_depth = self.config["ob_batch_queue_depth"]
+        gate.slots = self.config["ob_tenant_admission_slots"]
+        self.config.on_change(
+            "ob_batch_follower_timeout",
+            lambda _n, _o, v: setattr(self.batcher, "follower_timeout_s", v))
+        self.config.on_change(
+            "ob_batch_queue_depth",
+            lambda _n, _o, v: setattr(self.batcher, "queue_depth", v))
+        self.config.on_change(
+            "ob_tenant_admission_slots",
+            lambda _n, _o, v: setattr(gate, "slots", v))
         # one shared virtual-clock closure: sql() builds a statement
         # Deadline from it on every call — no per-statement lambda
         self._bus_clock = lambda: self.cluster.bus.now
@@ -884,7 +912,11 @@ class Database:
         return ok_all
 
     def close(self) -> None:
-        """Flush and release durable resources (log stores)."""
+        """Flush and release durable resources (log stores), failing
+        any forming statement batches to the solo path first."""
+        b = getattr(self, "batcher", None)
+        if b is not None:
+            b.shutdown()
         for group in self.cluster.ls_groups.values():
             for rep in group.values():
                 if rep.palf.store is not None:
@@ -1737,6 +1769,24 @@ class DbSession:
         # the inspection don't overwrite the statement under diagnosis)
         self._last_trace_id = 0
 
+    def close(self) -> None:
+        """Session drop: roll back an open transaction and flush the
+        statement-summary accumulator NOW instead of waiting for GC —
+        the wire front ends call this on client disconnect so workload-
+        repository digest counts reconcile promptly."""
+        if self._tx is not None:
+            try:
+                self.sql("rollback")
+            except Exception:
+                self._tx = None
+        acc = self._ws_acc
+        if acc is not None:
+            self._ws_acc = None
+            try:
+                acc.flush()
+            except Exception:
+                pass
+
     # ------------------------------------------------------------ public
     def sql(self, text: str) -> ResultSet:
         """Execute one statement, instrumented: trace span + ASH activity
@@ -2279,19 +2329,40 @@ class DbSession:
         if db.unit.max_workers is not None:
             bmax = min(bmax, db.unit.max_workers)
         if bmax > 1 and db.batcher.enabled:
-            rs = db.batcher.execute(
-                hit, bmax, self._vars.get("ob_batch_max_wait_us", 0))
-            if rs is not None:
-                if db.config["enable_query_profile"]:
-                    rs.profile = QueryProfile(
-                        compile_hit=True,
-                        d2h_bytes=rs.batch_info[4],
-                        fastparse_s=fastparse_s,
-                        dispatch_s=rs.batch_info[3],
-                        fast_path_hit=True,
-                    )
+            # weighted tenant admission: hold one running permit for the
+            # whole gated execution — dispatch order alone cannot shield
+            # a quiet tenant from a flooding one when the contention is
+            # CPU time across session threads
+            db.batcher.admit()
+            try:
+                rs = db.batcher.execute(
+                    hit, bmax, self._vars.get("ob_batch_max_wait_us", 0))
+                if rs is not None:
+                    if db.config["enable_query_profile"]:
+                        rs.profile = QueryProfile(
+                            compile_hit=True,
+                            d2h_bytes=rs.batch_info[4],
+                            fastparse_s=fastparse_s,
+                            dispatch_s=rs.batch_info[3],
+                            fast_path_hit=True,
+                        )
+                    self._stmt_cache_hit = True
+                    return rs
+                # None = degrade to the solo fast path (idle gate,
+                # bypass, follower timeout, dispatch error, shutdown).
+                # The batcher left ONE dispatch-gate busy token held for
+                # this solo run; solo_done hands it to the next queued
+                # cohort — the release is what keeps the
+                # continuous-batching queue draining.
+                try:
+                    rs = db.engine.fast_execute(
+                        hit, fastparse_s=fastparse_s)
+                finally:
+                    db.batcher.solo_done()
                 self._stmt_cache_hit = True
                 return rs
+            finally:
+                db.batcher.admit_done()
         rs = db.engine.fast_execute(hit, fastparse_s=fastparse_s)
         self._stmt_cache_hit = True
         return rs

@@ -533,6 +533,15 @@ def _server_timeline(db) -> Table:
         ("rejected", DataType.int64(), [b["rejected"] for b in bs]),
         ("admission_wait_us", DataType.int64(),
          [int(b["admission_wait_s"] * 1e6) for b in bs]),
+        # continuous-batching scheduler pressure per slice: queue
+        # high-water mark, gate admissions and the time cohorts spent
+        # queued at the dispatch gate
+        ("sched_queue_max", DataType.int64(),
+         [b["sched_queue_max"] for b in bs]),
+        ("gate_admissions", DataType.int64(),
+         [b["gate_admissions"] for b in bs]),
+        ("gate_wait_us", DataType.int64(),
+         [int(b["gate_wait_s"] * 1e6) for b in bs]),
         ("wait_p99_us", DataType.int64(),
          [int(b["wait_p99_s"] * 1e6) for b in bs]),
     ])

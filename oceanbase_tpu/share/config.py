@@ -119,6 +119,25 @@ def default_params() -> list[Param]:
               "cross-session micro-batching: group-commit window (us) a "
               "batch leader holds open for followers before dispatching",
               min=0, max=1_000_000),
+        Param("ob_batch_follower_timeout", "time", 10.0,
+              "continuous batching: how long a follower lane waits on "
+              "its cohort's dispatch before pulling out and re-executing "
+              "solo (a queued leader waits 2x this for gate admission)",
+              min=0.01, max=600.0),
+        Param("ob_batch_queue_depth", "int", 32,
+              "continuous batching: max forming groups queued per tenant "
+              "at the dispatch gate; arrivals beyond it shed to the solo "
+              "fast path", min=1, max=4096),
+        Param("ob_tenant_admission_slots", "int", 8,
+              "weighted tenant admission: running permits for gated "
+              "fast-path statements, shared cluster-wide and allotted "
+              "by TenantUnit.weight share; a flooding tenant over its "
+              "share waits while other tenants are active (single-"
+              "tenant clusters bypass the permit)", min=1, max=1024),
+        Param("mysql_async_workers", "int", 8,
+              "async MySQL front end: bounded statement-execution worker "
+              "pool size (protocol work stays on the event loop)",
+              min=1, max=256),
         # memory / freeze / compaction
         Param("memstore_limit", "capacity", 256 << 20,
               "per-tenant active+frozen memtable budget"),

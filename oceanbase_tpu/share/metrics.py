@@ -180,6 +180,15 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def gauge_max(self, name: str, value: float) -> None:
+        """High-watermark gauge: keep the largest value ever observed
+        (queue-depth peaks outlive the instant a snapshot is taken)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if value > self._gauges.get(name, float("-inf")):
+                self._gauges[name] = value
+
     def gauge(self, name: str) -> float:
         with self._lock:
             return self._gauges.get(name, 0)

@@ -85,7 +85,8 @@ class _Bucket:
         "period", "stmts", "errors", "host_busy_s", "device_busy_s",
         "dispatches", "batch_dispatches", "batch_lanes", "compile_events",
         "compile_s", "transfer_events", "transfer_bytes", "max_in_flight",
-        "admitted", "rejected", "admission_wait_s", "occ_hist",
+        "admitted", "rejected", "admission_wait_s", "sched_queue_max",
+        "gate_admissions", "gate_wait_s", "occ_hist",
         "depth_hist", "wait_hist", "tenants",
     )
 
@@ -113,6 +114,9 @@ class _Bucket:
         self.admitted = 0
         self.rejected = 0
         self.admission_wait_s = 0.0
+        self.sched_queue_max = 0
+        self.gate_admissions = 0
+        self.gate_wait_s = 0.0
 
     def reset(self, period: int) -> None:
         self.period = period
@@ -252,9 +256,11 @@ class ServingTimeline:
             b.transfer_events += 1
             b.transfer_bytes += d2h_bytes
 
-    def record_batch(self, dispatch_s: float, lanes: int) -> None:
+    def record_batch(self, dispatch_s: float, lanes: int,
+                     queued: int = 0) -> None:
         """One batched device dispatch (StatementBatcher._dispatch):
-        the whole cohort's busy time once + window occupancy."""
+        the whole cohort's busy time once + window occupancy + the
+        dispatch-gate queue depth left behind it."""
         if not self.enabled:
             return
         b = self._bucket(self._clock())
@@ -264,6 +270,21 @@ class ServingTimeline:
         b.batch_dispatches += 1
         b.batch_lanes += lanes
         b.occ_hist[_pow2_slot(max(lanes, 1))] += 1
+        if queued > b.sched_queue_max:
+            b.sched_queue_max = queued
+
+    def record_gate(self, wait_s: float, queued: int = 0) -> None:
+        """One cohort leader through the continuous-batching dispatch
+        gate (StatementBatcher._lead): admission wait seconds + the
+        queue depth it observed — the scheduler's backpressure trace."""
+        if not self.enabled:
+            return
+        b = self._bucket(self._clock())
+        self.records += 1
+        b.gate_admissions += 1
+        b.gate_wait_s += wait_s
+        if queued > b.sched_queue_max:
+            b.sched_queue_max = queued
 
     def record_transfer(self, nbytes: int) -> None:
         """One host->device upload (Executor): transfer interference —
@@ -311,6 +332,9 @@ class ServingTimeline:
                     "admitted": b.admitted,
                     "rejected": b.rejected,
                     "admission_wait_s": b.admission_wait_s,
+                    "sched_queue_max": b.sched_queue_max,
+                    "gate_admissions": b.gate_admissions,
+                    "gate_wait_s": b.gate_wait_s,
                     "wait_p99_s": hist_quantile(
                         DEFAULT_BUCKETS, b.wait_hist, 0.99),
                     "occ_hist": list(b.occ_hist),

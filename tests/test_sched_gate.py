@@ -1,0 +1,395 @@
+"""Continuous-batching dispatch gate (server/batcher.py).
+
+Covers the scheduler semantics the PR-5 window protocol never had:
+an idle gate runs solo immediately; while a dispatch is in flight,
+arrivals coalesce into per-entry groups that queue ACROSS different
+cached plans; a follower that outwaits `ob_batch_follower_timeout`
+pulls its lane out of the batch (neither device-executed nor counted);
+admission across tenants is a weighted deficit round-robin seeded from
+TenantUnit.weight; and every degradation path (dispatch error,
+shutdown) falls back to the solo fast path with the gate quiescing to
+busy == 0.
+
+The deterministic tests steer the gate with a PHANTOM busy token:
+`gate.busy += 1` makes every arrival believe a dispatch is in flight,
+so groups form and queue without racing a real device dispatch;
+releasing the phantom (batcher.solo_done()) is the controlled
+admission trigger.
+"""
+
+import threading
+import time
+
+import pytest
+
+from oceanbase_tpu.server.batcher import DispatchGate, _Batch
+from oceanbase_tpu.server.database import Database, TenantUnit
+
+N_KEYS = 50
+
+
+def _mkdb():
+    db = Database(n_nodes=1, n_ls=1)
+    s = db.session()
+    s.sql("create table kv (id int primary key, k int, v int)")
+    rows = ", ".join(f"({i + 1}, {i}, {i * 7 + 3})" for i in range(N_KEYS))
+    s.sql(f"insert into kv values {rows}")
+    # warm fast entries for TWO distinct statements (two text keys ->
+    # two cache entries, the heterogeneous-plan case)
+    for k in range(3):
+        s.sql(f"select v from kv where k = {k}").rows()
+        s.sql(f"select id from kv where k = {k}").rows()
+    return db
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = _mkdb()
+    yield d
+    d.close()
+
+
+def _session(db):
+    s = db.session()
+    s.sql("set ob_batch_max_size = 8")
+    s.sql("set ob_batch_max_wait_us = 1000")
+    return s
+
+
+def _until(cond, timeout=10.0) -> bool:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+def _seize(gate: DispatchGate) -> None:
+    """Phantom in-flight dispatch: arrivals queue instead of running."""
+    with gate.lock:
+        gate.busy += 1
+
+
+def _spawn(s, sql, out, key):
+    def run():
+        try:
+            out[key] = s.sql(sql).rows()
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            out[key] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+# ------------------------------------------------------- follower timeout
+
+
+def test_follower_timeout_lane_not_dispatched_not_counted(db, monkeypatch):
+    """THE regression the PR fixes: a follower that gives up leaves a
+    DEAD lane — its row must not reach the device and must not count in
+    `stmt batched statements` (PR 5 dispatched and double-counted it).
+    The timed-out lane re-executes solo and still returns right rows."""
+    batcher, gate = db.batcher, db.batcher.gate
+    c0 = db.metrics.counters_snapshot()
+    out: dict = {}
+    threads = []
+    old_timeout = batcher.follower_timeout_s
+    _seize(gate)
+    try:
+        batcher.follower_timeout_s = 30.0
+        threads.append(_spawn(_session(db), "select v from kv where k = 1",
+                              out, "leader"))
+        assert _until(lambda: gate.queued_groups == 1)
+        b = next(iter(batcher._forming.values()))
+        # count the lanes of every device dispatch at the source
+        widths: list = []
+        prepared_cls = type(b.entry.prepared)
+        orig = prepared_cls.run_batched_host
+
+        def spy(self, qblock):
+            widths.append(qblock.shape[0])
+            return orig(self, qblock)
+
+        monkeypatch.setattr(prepared_cls, "run_batched_host", spy)
+        threads.append(_spawn(_session(db), "select v from kv where k = 2",
+                              out, "keeper"))
+        assert _until(lambda: len(b.rows) == 2)
+        # the third lane times out almost immediately...
+        batcher.follower_timeout_s = 0.2
+        threads.append(_spawn(_session(db), "select v from kv where k = 3",
+                              out, "dead"))
+        assert _until(lambda: len(b.rows) == 3)
+        # ...marks its lane dead, re-executes solo, and its solo_done
+        # hands the phantom-held queue its first admission: the leader
+        # dispatches lanes {0, 1} only.
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+    finally:
+        batcher.follower_timeout_s = old_timeout
+        batcher.solo_done()  # release the phantom
+    assert out["leader"] == [(1 * 7 + 3,)]
+    assert out["keeper"] == [(2 * 7 + 3,)]
+    assert out["dead"] == [(3 * 7 + 3,)]
+    assert widths == [2]  # the dead lane never reached the device
+    assert b.dead == {2}
+    c1 = db.metrics.counters_snapshot()
+
+    def delta(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    assert delta("stmt batched statements") == 2  # not 3
+    assert delta("stmt batched dispatches") == 1
+    assert delta("stmt batch follower timeouts") == 1
+    assert gate.busy == 0 and gate.queued_groups == 0
+
+
+# --------------------------------------------------- heterogeneous plans
+
+
+def test_heterogeneous_plans_queue_and_interleave(db):
+    """Two groups on two DIFFERENT cached plans queue behind one
+    in-flight dispatch; each admission dispatches one cohort and hands
+    its token to the next — the queue stays warm across plans."""
+    batcher, gate = db.batcher, db.batcher.gate
+    c0 = db.metrics.counters_snapshot()
+    out: dict = {}
+    threads = []
+    _seize(gate)
+    try:
+        threads.append(_spawn(_session(db), "select v from kv where k = 1",
+                              out, "a-lead"))
+        assert _until(lambda: gate.queued_groups == 1)
+        threads.append(_spawn(_session(db), "select v from kv where k = 2",
+                              out, "a-join"))
+        threads.append(_spawn(_session(db), "select id from kv where k = 3",
+                              out, "b-lead"))
+        assert _until(lambda: gate.queued_groups == 2)
+        threads.append(_spawn(_session(db), "select id from kv where k = 4",
+                              out, "b-join"))
+        assert _until(lambda: sum(
+            len(b.rows) for b in batcher._forming.values()) == 4)
+    finally:
+        batcher.solo_done()  # phantom release = first admission
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert out["a-lead"] == [(10,)] and out["a-join"] == [(17,)]
+    assert out["b-lead"] == [(4,)] and out["b-join"] == [(5,)]
+    c1 = db.metrics.counters_snapshot()
+
+    def delta(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    assert delta("stmt batched dispatches") == 2
+    assert delta("stmt batched statements") == 4
+    assert delta("stmt batch size 2") == 2
+    assert gate.busy == 0 and gate.queued_groups == 0
+
+
+# ------------------------------------------------------- tenant fairness
+
+
+def test_weighted_admission_across_tenants():
+    """Smooth-deficit weighted round-robin: with tenant A at weight 3
+    and a flooding tenant B at weight 1, A's cohorts win ~3 of every 4
+    admissions while both have backlog — B cannot starve A."""
+    gate = DispatchGate()
+    gate.register("A", 3)
+    gate.register("B", 1)
+    gate.admit_log = []
+    with gate.lock:
+        for i in range(8):
+            gate.enqueue(_Batch(("a", i), None, "A", i, 4))
+            gate.enqueue(_Batch(("b", i), None, "B", i, 4))
+        gate.busy = 1
+        while gate.admit_next() is not None:
+            pass
+        gate.busy = 0
+    assert len(gate.admit_log) == 16
+    first8 = gate.admit_log[:8]
+    assert first8.count("A") == 6 and first8.count("B") == 2
+    # no starvation in either direction: B appears early, and the tail
+    # (A's queue drained) flushes B's backlog
+    assert "B" in first8
+    assert gate.admit_log.count("A") == 8 and gate.admit_log.count("B") == 8
+    assert gate.queued_groups == 0
+
+
+def test_tenant_units_share_one_gate_with_weights():
+    """Tenants over one cluster register their TenantUnit.weight on ONE
+    shared DispatchGate — the ledger cross-tenant fairness lives in."""
+    from oceanbase_tpu.server.tenant import TenantManager
+
+    tm = TenantManager(n_nodes=1, n_ls=1)
+    quiet = tm.create_tenant("quiet", unit=TenantUnit(weight=4))
+    noisy = tm.create_tenant("noisy", unit=TenantUnit(weight=1))
+    try:
+        gq, gn = quiet.db.batcher.gate, noisy.db.batcher.gate
+        assert gq is gn
+        assert gq is tm.cluster._dispatch_gate
+        assert gq._weights["quiet"] == 4.0
+        assert gq._weights["noisy"] == 1.0
+        # shared lock domain: both batchers serialize on the gate lock
+        assert quiet.db.batcher._lock is noisy.db.batcher._lock
+    finally:
+        quiet.db.close()
+        noisy.db.close()
+
+
+# ------------------------------------------------------ degradation paths
+
+
+def test_dispatch_error_degrades_every_lane_to_solo(db, monkeypatch):
+    """A batch whose device dispatch raises sends every lane back to
+    the solo fast path: all statements still answer correctly, the
+    error is counted, and the gate quiesces (no leaked tokens)."""
+    batcher, gate = db.batcher, db.batcher.gate
+    c0 = db.metrics.counters_snapshot()
+    out: dict = {}
+    threads = []
+    _seize(gate)
+    try:
+        threads.append(_spawn(_session(db), "select v from kv where k = 5",
+                              out, 0))
+        assert _until(lambda: gate.queued_groups == 1)
+        b = next(iter(batcher._forming.values()))
+        prepared_cls = type(b.entry.prepared)
+
+        def boom(self, qblock):
+            raise RuntimeError("injected dispatch failure")
+
+        monkeypatch.setattr(prepared_cls, "run_batched_host", boom)
+        for i in (6, 7):
+            threads.append(_spawn(
+                _session(db), f"select v from kv where k = {i}", out, i - 5))
+        assert _until(lambda: len(b.rows) == 3)
+    finally:
+        batcher.solo_done()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    for i, k in enumerate((5, 6, 7)):
+        assert out[i] == [(k * 7 + 3,)], out
+    c1 = db.metrics.counters_snapshot()
+    assert c1.get("stmt batch dispatch errors", 0) - c0.get(
+        "stmt batch dispatch errors", 0) == 1
+    assert c1.get("stmt batched statements", 0) == c0.get(
+        "stmt batched statements", 0)  # the failed batch counted nothing
+    assert gate.busy == 0 and gate.queued_groups == 0
+
+
+def test_shutdown_fails_forming_groups_to_solo(db):
+    """shutdown() wakes queued leaders and waiting followers; both
+    re-execute solo and the gate quiesces."""
+    batcher, gate = db.batcher, db.batcher.gate
+    out: dict = {}
+    threads = []
+    _seize(gate)
+    try:
+        threads.append(_spawn(_session(db), "select v from kv where k = 8",
+                              out, "lead"))
+        assert _until(lambda: gate.queued_groups == 1)
+        b = next(iter(batcher._forming.values()))
+        threads.append(_spawn(_session(db), "select v from kv where k = 9",
+                              out, "join"))
+        assert _until(lambda: len(b.rows) == 2)
+        batcher.shutdown()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert out["lead"] == [(8 * 7 + 3,)]
+        assert out["join"] == [(9 * 7 + 3,)]
+        assert not batcher._forming and gate.queued_groups == 0
+    finally:
+        batcher.enabled = True  # re-arm for the rest of the module
+        batcher.solo_done()
+    assert gate.busy == 0
+
+
+def test_queue_depth_bound_sheds_to_solo(db):
+    """Arrivals beyond ob_batch_queue_depth shed to the solo path
+    (counted as a bypass) instead of growing the backlog unboundedly."""
+    batcher, gate = db.batcher, db.batcher.gate
+    c0 = db.metrics.counters_snapshot()
+    old_depth = batcher.queue_depth
+    out: dict = {}
+    threads = []
+    _seize(gate)
+    try:
+        batcher.queue_depth = 1
+        threads.append(_spawn(_session(db), "select v from kv where k = 10",
+                              out, "queued"))
+        assert _until(lambda: gate.queued_groups == 1)
+        # a DIFFERENT plan arrives with the tenant queue at its bound:
+        # it must shed to solo, not enqueue a second group (its own
+        # solo_done then hands the queued cohort its admission)
+        s = _session(db)
+        assert s.sql("select id from kv where k = 11").rows() == [(12,)]
+    finally:
+        batcher.queue_depth = old_depth
+        batcher.solo_done()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert out["queued"] == [(10 * 7 + 3,)]
+    c1 = db.metrics.counters_snapshot()
+    assert c1.get("stmt batch bypass: queue full", 0) - c0.get(
+        "stmt batch bypass: queue full", 0) == 1
+    assert gate.busy == 0 and gate.queued_groups == 0
+
+
+def test_admission_slots_weighted_throttle():
+    """Weighted running permits: a flooding tenant may borrow the whole
+    gate while others are idle, but once the quiet tenant is active the
+    flood is pinned to its weight share; the quiet tenant (within its
+    share) only ever waits for the gate to drain below `slots`."""
+    gate = DispatchGate()
+    gate.slots = 4
+    gate.register("quiet", 4)  # share ceil(4 * 4/5) = 4
+    gate.register("noisy", 1)  # share ceil(4 * 1/5) = 1
+    # noisy alone: borrows every slot, never waits
+    for _ in range(4):
+        assert gate.acquire_slot("noisy") == 0.0
+    # gate full: quiet parks until one permit frees
+    got: list = []
+    t = threading.Thread(
+        target=lambda: got.append(gate.acquire_slot("quiet")), daemon=True)
+    t.start()
+    assert not _until(lambda: len(got) == 1, timeout=0.3)
+    gate.release_slot("noisy")
+    assert _until(lambda: len(got) == 1)
+    assert got[0] > 0.0
+    # noisy is over its share with quiet ACTIVE: blocked even while the
+    # gate has free permits — the reserved share is untouchable
+    got2: list = []
+    t2 = threading.Thread(
+        target=lambda: got2.append(gate.acquire_slot("noisy")), daemon=True)
+    t2.start()
+    gate.release_slot("noisy")  # noisy 3 -> 2, still over share 1
+    assert not _until(lambda: len(got2) == 1, timeout=0.3)
+    gate.release_slot("noisy")  # 1: still at share
+    gate.release_slot("noisy")  # 0: below share -> waiter admits
+    assert _until(lambda: len(got2) == 1)
+    assert got2[0] > 0.0
+    gate.release_slot("noisy")
+    gate.release_slot("quiet")
+    assert sum(gate._running.values()) == 0
+    assert sum(gate._adm_waiting.values()) == 0
+
+
+def test_admission_slots_single_tenant_bypass():
+    """One registered tenant: the permit machinery is bypassed — no
+    waiting regardless of slots, so single-tenant serving (the wire A/B
+    bench) pays nothing."""
+    gate = DispatchGate()
+    gate.slots = 1
+    gate.register("only", 2)
+    for _ in range(5):
+        assert gate.acquire_slot("only") == 0.0
+    assert gate._running["only"] == 5
+    for _ in range(5):
+        gate.release_slot("only")
+    assert gate._running["only"] == 0

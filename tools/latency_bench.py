@@ -36,18 +36,40 @@ on vs off over identical workloads). --serve-strict gates CI: batches
 must actually form (mean batch size > 1) and the compile count must stay
 within the pow2 bucket bound.
 
+Wire serving mode (--wire-sessions N): the front-end A/B. N REAL MySQL
+protocol connections (raw sockets, selector-multiplexed closed-loop
+clients) hammer the same point read through the THREADED MySqlFrontend
+(one server thread per connection) and then through the async
+front end (AsyncMySqlFrontend: one event loop + a bounded worker pool),
+same database and batcher settings for both legs. Reports aggregate
+stmts/s and per-statement p50/p99 per leg plus the async-vs-threaded
+speedup. --wire-strict gates CI: speedup >= --wire-min-speedup and the
+async leg's p99 <= 3x its p50.
+
+Fairness mode (--fairness): two tenants on one shared cluster — quiet
+(TenantUnit.weight 4, few sessions) vs noisy (weight 1, flooding) —
+through the shared continuous-batching dispatch gate. Measures the
+quiet tenant's p99 alone and under the flood; --fairness-strict gates
+the ratio at --fairness-limit (default 2.0) and reports the gate's
+per-tenant admission split.
+
 Env/flags: --rows (table size, default 20000), --stmts (timed statements
 per workload, default 300), --warmup (default 20), --strict (exit 1 unless
 the warm window's fast-path hit rate is 100%), --sessions (enable serving
 mode), --serve-seconds (per A/B leg, default 2.5), --batch-wait-us /
 --batch-max-size (batcher knobs for the ON leg), --serve-strict,
-LATENCY_BUDGET_S (default 300; stops starting new workloads near the
-budget, partial results still emit).
+--wire-sessions / --wire-seconds / --wire-strict / --wire-min-speedup /
+--async-workers, --fairness / --fairness-seconds / --fairness-strict /
+--fairness-limit, LATENCY_BUDGET_S (default 300; stops starting new
+workloads near the budget, partial results still emit).
 """
 
 import argparse
 import json
 import os
+import selectors
+import socket
+import struct
 import sys
 import threading
 import time
@@ -131,6 +153,53 @@ def phase_breakdown(db, n: int) -> dict:
     }
 
 
+def pretrace_buckets(db, max_size: int) -> None:
+    """Pre-trace every pow2 bucket executable a leg can touch: a
+    straggler lane forms a partial batch whose bucket would otherwise
+    compile (~100ms) inside the measured window, denting both
+    throughput and p99 for one arbitrary cohort."""
+    from oceanbase_tpu.ops.hashing import next_pow2
+    from oceanbase_tpu.sql import parser as P
+
+    fkey, params, _kinds = P.fast_normalize("select v from kv where k = 0")
+    hit = db.engine.fast_lookup(fkey, params)
+    if hit is None or not getattr(hit.entry.prepared, "batchable", False):
+        return
+    prepared = hit.entry.prepared
+    qrow = prepared.bind(hit.values, hit.entry.dtypes)
+    bucket = 2
+    while bucket <= next_pow2(max_size):
+        prepared.run_batched_host(np.stack([qrow] * bucket))
+        bucket *= 2
+
+
+class _serving_tunes:
+    """Serving tunes applied identically to every A/B leg, the standard
+    CPython threaded-server pair: a 20ms GIL switch interval (with tens
+    of session threads trading sub-ms statements, the default 5ms
+    forces pointless preemptions mid-statement) and gc.freeze + 10x
+    gen0 threshold (default thresholds run a gen0 sweep over the whole
+    warm engine every ~20 statements, all of it on the GIL)."""
+
+    def __enter__(self):
+        import gc
+
+        self._gc = gc
+        self._swi = sys.getswitchinterval()
+        self._thr = gc.get_threshold()
+        sys.setswitchinterval(0.02)
+        gc.collect()
+        gc.freeze()
+        gc.set_threshold(7000, 100, 100)
+        return self
+
+    def __exit__(self, *exc):
+        self._gc.set_threshold(*self._thr)
+        sys.setswitchinterval(self._swi)
+        self._gc.unfreeze()
+        return False
+
+
 def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
                   max_size: int, batching: bool) -> dict:
     """One closed-loop leg: N session threads hammer the same warm
@@ -147,24 +216,7 @@ def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
         for k in range(4):
             s.sql(f"select v from kv where k = {k}").rows()
     if batching:
-        # pre-trace every pow2 bucket executable the leg can touch: a
-        # straggler lane forms a partial batch whose bucket would
-        # otherwise compile (~100ms) inside the measured window, denting
-        # both throughput and p99 for one arbitrary cohort
-        from oceanbase_tpu.ops.hashing import next_pow2
-        from oceanbase_tpu.sql import parser as P
-
-        fkey, params, _kinds = P.fast_normalize(
-            "select v from kv where k = 0")
-        hit = db.engine.fast_lookup(fkey, params)
-        if hit is not None and getattr(hit.entry.prepared, "batchable",
-                                       False):
-            prepared = hit.entry.prepared
-            qrow = prepared.bind(hit.values, hit.entry.dtypes)
-            bucket = 2
-            while bucket <= next_pow2(max_size):
-                prepared.run_batched_host(np.stack([qrow] * bucket))
-                bucket *= 2
+        pretrace_buckets(db, max_size)
     lats: list[list[float]] = [[] for _ in range(nsessions)]
     warm_stop = threading.Event()
     stop = threading.Event()
@@ -259,34 +311,13 @@ def run_serve(db, args, detail: dict) -> tuple[bool, dict, dict]:
     workloads. Returns (strict_ok, off_leg, on_leg)."""
     from oceanbase_tpu.ops.hashing import next_pow2
 
-    # serving tunes applied identically to BOTH legs, the standard
-    # CPython threaded-server pair:
-    #   * a 20ms GIL switch interval — with tens of session threads
-    #     trading sub-ms statements, the default 5ms forces pointless
-    #     preemptions mid-statement (neutral for the solo leg);
-    #   * gc.freeze + 10x gen0 threshold — each statement allocates a few
-    #     dozen short-lived objects, and default thresholds run a gen0
-    #     sweep over the whole warm engine every ~20 statements, all of
-    #     it serialized on the GIL.
-    import gc
-
-    swi0 = sys.getswitchinterval()
-    gc0 = gc.get_threshold()
-    sys.setswitchinterval(0.02)
-    gc.collect()
-    gc.freeze()
-    gc.set_threshold(7000, 100, 100)
-    try:
+    with _serving_tunes():
         off = run_serve_leg(db, args.sessions, args.serve_seconds,
                             args.batch_wait_us, args.batch_max_size,
                             batching=False)
         on = run_serve_leg(db, args.sessions, args.serve_seconds,
                            args.batch_wait_us, args.batch_max_size,
                            batching=True)
-    finally:
-        sys.setswitchinterval(swi0)
-        gc.set_threshold(*gc0)
-        gc.unfreeze()
     db.batcher.enabled = True
     # XLA compile bound: one batched executable per pow2 bucket in
     # [2, next_pow2(max_size)], regardless of traffic shape
@@ -313,6 +344,398 @@ def run_serve(db, args, detail: dict) -> tuple[bool, dict, dict]:
     return ok, off, on
 
 
+# ---------------------------------------------------------------- wire mode
+
+
+def _wire_handshake(port: int, setup: list) -> socket.socket:
+    """One blocking MySQL handshake as root/"" + setup statements;
+    returns the socket ready for the non-blocking closed loop."""
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def read_n(n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            if not c:
+                raise ConnectionError("closed during handshake")
+            buf += c
+        return buf
+
+    def read_pkt() -> bytes:
+        head = read_n(4)
+        return read_n(int.from_bytes(head[:3], "little"))
+
+    greeting = read_pkt()
+    assert greeting[0] == 10, "not a protocol-10 greeting"
+    caps = 0x0200 | 0x8000  # PROTOCOL_41 | SECURE_CONNECTION
+    login = (struct.pack("<IIB23x", caps, 1 << 24, 33)
+             + b"root\x00" + b"\x00")  # empty-password scramble
+    sock.sendall(len(login).to_bytes(3, "little") + b"\x01" + login)
+    ok = read_pkt()
+    if ok[0] != 0x00:
+        raise PermissionError(ok[9:].decode(errors="replace"))
+
+    def read_response() -> None:
+        first, eofs = True, 0
+        while True:
+            pkt = read_pkt()
+            if first:
+                if pkt[0] in (0x00, 0xFF):
+                    return
+                first = False
+            elif pkt[0] == 0xFE and len(pkt) < 9:
+                eofs += 1
+                if eofs == 2:
+                    return
+
+    for q in setup:
+        p = b"\x03" + q.encode()
+        sock.sendall(len(p).to_bytes(3, "little") + b"\x00" + p)
+        read_response()
+    return sock
+
+
+class _WireConn:
+    """One closed-loop wire session: a tiny non-blocking state machine
+    (send COM_QUERY, parse frames until the response completes, repeat)
+    driven by a shared selector — the client side stays O(drivers)
+    threads no matter how many sessions it simulates."""
+
+    __slots__ = ("sock", "buf", "out", "first", "eofs", "t0", "lat",
+                 "texts", "j")
+
+    def __init__(self, sock: socket.socket, texts: list):
+        self.sock = sock
+        self.buf = b""
+        self.out = b""
+        self.first = True
+        self.eofs = 0
+        self.t0 = 0.0
+        self.lat: list[float] = []
+        self.texts = texts
+        self.j = 0
+
+    def start_next(self) -> None:
+        q = self.texts[self.j % len(self.texts)]
+        self.j += 1
+        p = b"\x03" + q.encode()
+        self.out = len(p).to_bytes(3, "little") + b"\x00" + p
+        self.first = True
+        self.eofs = 0
+        self.t0 = time.perf_counter()
+        self.flush()
+
+    def flush(self) -> None:
+        while self.out:
+            try:
+                n = self.sock.send(self.out)
+            except (BlockingIOError, InterruptedError):
+                return
+            self.out = self.out[n:]
+
+    def parse(self) -> bool:
+        """Consume complete packets from buf; True when one full
+        response (OK/ERR, or coldefs+rows closed by the 2nd EOF) ends."""
+        buf, pos = self.buf, 0
+        done = False
+        while len(buf) - pos >= 4:
+            n = int.from_bytes(buf[pos:pos + 3], "little")
+            if len(buf) - pos < 4 + n:
+                break
+            b0 = buf[pos + 4]
+            pos += 4 + n
+            if self.first:
+                if b0 in (0x00, 0xFF):
+                    done = True
+                    break
+                self.first = False
+            elif b0 == 0xFE and n < 9:
+                self.eofs += 1
+                if self.eofs == 2:
+                    done = True
+                    break
+        self.buf = buf[pos:]
+        return done
+
+
+def _wire_drive(conns: list, stop: threading.Event, record: list) -> None:
+    """One driver thread multiplexing its share of the connections."""
+    sel = selectors.DefaultSelector()
+    for c in conns:
+        c.sock.setblocking(False)
+        c.start_next()
+        ev = selectors.EVENT_READ
+        if c.out:
+            ev |= selectors.EVENT_WRITE
+        sel.register(c.sock, ev, c)
+    active = len(conns)
+    while active:
+        for key, ev in sel.select(0.05):
+            c = key.data
+            if ev & selectors.EVENT_WRITE:
+                c.flush()
+                if not c.out:
+                    sel.modify(c.sock, selectors.EVENT_READ, c)
+            if ev & selectors.EVENT_READ:
+                try:
+                    data = c.sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                if not data:
+                    sel.unregister(c.sock)
+                    active -= 1
+                    continue
+                c.buf += data
+                if c.parse():
+                    if record[0]:
+                        c.lat.append(time.perf_counter() - c.t0)
+                    if stop.is_set():
+                        sel.unregister(c.sock)
+                        active -= 1
+                    else:
+                        c.start_next()
+                        if c.out:
+                            sel.modify(c.sock, selectors.EVENT_READ
+                                       | selectors.EVENT_WRITE, c)
+    sel.close()
+
+
+def run_wire_leg(db, port: int, nsessions: int, seconds: float,
+                 wait_us: int, max_size: int, drivers: int = 4,
+                 warm_s: float = 0.75) -> dict:
+    """One closed-loop wire leg against whichever server owns `port`."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    setup = [f"set ob_batch_max_wait_us = {wait_us}",
+             f"set ob_batch_max_size = {max_size}"]
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        socks = list(pool.map(
+            lambda _i: _wire_handshake(port, setup), range(nsessions)))
+    texts = [[f"select v from kv where k = {(i * 17 + j) % 50}"
+              for j in range(50)] for i in range(nsessions)]
+    conns = [_WireConn(s, t) for s, t in zip(socks, texts)]
+    stop = threading.Event()
+    record = [False]
+    drivers = max(1, min(drivers, nsessions))
+    shards = [conns[i::drivers] for i in range(drivers)]
+    threads = [threading.Thread(target=_wire_drive,
+                                args=(shard, stop, record), daemon=True)
+               for shard in shards]
+    c0 = db.metrics.counters_snapshot()
+    for t in threads:
+        t.start()
+    time.sleep(warm_s)
+    record[0] = True
+    t_start = time.perf_counter()
+    time.sleep(seconds)
+    record[0] = False
+    wall = time.perf_counter() - t_start
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    for s in socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+    c1 = db.metrics.counters_snapshot()
+
+    def delta(name: str) -> int:
+        return int(c1.get(name, 0) - c0.get(name, 0))
+
+    lat = np.array([x for c in conns for x in c.lat])
+    total = len(lat)
+    batched = delta("stmt batched statements")
+    dispatches = delta("stmt batched dispatches")
+    return {
+        "sessions": nsessions,
+        "stmts": total,
+        "stmts_per_sec": round(total / wall, 1) if wall else 0.0,
+        **(percentiles(lat) if total else {}),
+        "batched_stmts": batched,
+        "batched_dispatches": dispatches,
+        "solo_leaders": delta("stmt batch solo"),
+        "mean_batch_size": round(batched / dispatches, 2) if dispatches
+        else 0.0,
+    }
+
+
+def run_wire(db, args, detail: dict) -> tuple[bool, dict]:
+    """Serving-stack A/B over REAL wire sessions. Baseline leg: the
+    threaded thread-per-connection MySqlFrontend on the solo fast path
+    (the pre-async serving stack; the old group-commit batcher no
+    longer exists, and giving the baseline the NEW continuous scheduler
+    would measure front-end framing overhead, not the stack this PR
+    replaces). Measured leg: AsyncMySqlFrontend + continuous batching
+    on the same db. The worker pool auto-scales with the session count
+    (unless --async-workers pins it) — pool width bounds how many
+    statements can coalesce per dispatch."""
+    from oceanbase_tpu.server.async_front import AsyncMySqlFrontend
+    from oceanbase_tpu.server.mysql_front import MySqlFrontend
+
+    workers = args.async_workers or max(8, min(64,
+                                               args.wire_sessions // 8))
+    s = db.session()
+    for k in range(4):
+        s.sql(f"select v from kv where k = {k}").rows()
+    pretrace_buckets(db, args.batch_max_size)
+    with _serving_tunes():
+        db.batcher.enabled = False
+        fe = MySqlFrontend(db).start()
+        try:
+            threaded = run_wire_leg(
+                db, fe.port, args.wire_sessions, args.wire_seconds,
+                args.batch_wait_us, args.batch_max_size,
+                drivers=args.wire_drivers)
+        finally:
+            fe.stop()
+        db.batcher.enabled = True
+        afe = AsyncMySqlFrontend(db, workers=workers).start()
+        try:
+            asynced = run_wire_leg(
+                db, afe.port, args.wire_sessions, args.wire_seconds,
+                args.batch_wait_us, args.batch_max_size,
+                drivers=args.wire_drivers)
+        finally:
+            afe.stop()
+    speedup = (asynced["stmts_per_sec"] / threaded["stmts_per_sec"]
+               if threaded["stmts_per_sec"] else 0.0)
+    p99_vs_p50 = (asynced["p99_us"] / asynced["p50_us"]
+                  if asynced.get("p50_us") else 0.0)
+    # the tail is where thread-per-connection actually collapses at
+    # high session counts (p99 blows out 10x+ while p50 holds); the
+    # async stack's flat p99/p50 is the headline serving win
+    tail_win = (threaded["p99_us"] / asynced["p99_us"]
+                if asynced.get("p99_us") else 0.0)
+    wire = {
+        "sessions": args.wire_sessions,
+        "leg_seconds": args.wire_seconds,
+        "async_workers": workers,
+        "threaded": threaded,
+        "async": asynced,
+        "async_speedup": round(speedup, 3),
+        "async_p99_vs_p50": round(p99_vs_p50, 3),
+        "async_p99_win": round(tail_win, 3),
+    }
+    detail["wire"] = wire
+    ok = (speedup >= args.wire_min_speedup and p99_vs_p50 <= 3.0
+          and tail_win >= args.wire_min_tail_win
+          and asynced["stmts"] > 0)
+    return ok, wire
+
+
+# ------------------------------------------------------------ fairness mode
+
+
+def _closed_loop_leg(groups: dict, seconds: float,
+                     warm_s: float = 0.5) -> dict:
+    """groups: name -> list of (session, texts). Runs every group's
+    threads closed-loop for warm+measure; returns name -> lat array."""
+    stop = threading.Event()
+    rec = threading.Event()
+    buckets = {name: [[] for _ in specs] for name, specs in groups.items()}
+
+    def worker(s, texts, bucket) -> None:
+        j = 0
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            s.sql(texts[j % len(texts)]).rows()
+            dt = time.perf_counter() - t0
+            if rec.is_set():
+                bucket.append(dt)
+            j += 1
+
+    threads = []
+    for name, specs in groups.items():
+        for i, (s, texts) in enumerate(specs):
+            threads.append(threading.Thread(
+                target=worker, args=(s, texts, buckets[name][i]),
+                daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(warm_s)
+    rec.set()
+    time.sleep(seconds)
+    rec.clear()
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    return {name: np.array([x for b in bs for x in b])
+            for name, bs in buckets.items()}
+
+
+def run_fairness(args, detail: dict) -> tuple[bool, dict]:
+    """Two tenants, one shared dispatch gate: quiet (weight 4, 4
+    sessions) vs noisy (weight 1, 12 flooding sessions). The quiet
+    tenant's p99 under the flood must stay within --fairness-limit of
+    its solo run."""
+    from oceanbase_tpu.server.database import TenantUnit
+    from oceanbase_tpu.server.tenant import TenantManager
+
+    tm = TenantManager(n_nodes=1, n_ls=1)
+    quiet = tm.create_tenant("quiet", unit=TenantUnit(weight=4))
+    noisy = tm.create_tenant("noisy", unit=TenantUnit(weight=1))
+    try:
+        for t in (quiet, noisy):
+            s = t.db.session()
+            s.sql("create table kv (id int primary key, k int, v int)")
+            rows = ", ".join(f"({i + 1}, {i}, {i * 7 + 3})"
+                             for i in range(50))
+            s.sql(f"insert into kv values {rows}")
+            for k in range(4):
+                s.sql(f"select v from kv where k = {k}").rows()
+            pretrace_buckets(t.db, args.batch_max_size)
+
+        def specs(tenant, n):
+            out = []
+            for i in range(n):
+                s = tenant.db.session()
+                s.sql(f"set ob_batch_max_wait_us = {args.batch_wait_us}")
+                s.sql(f"set ob_batch_max_size = {args.batch_max_size}")
+                out.append((s, [f"select v from kv where k = "
+                                f"{(i * 17 + j) % 50}" for j in range(50)]))
+            return out
+
+        nq, nn = 4, 12
+        gate = quiet.db.batcher.gate
+        with _serving_tunes():
+            solo = _closed_loop_leg({"quiet": specs(quiet, nq)},
+                                    args.fairness_seconds)
+            gate.admit_log = []
+            loaded = _closed_loop_leg(
+                {"quiet": specs(quiet, nq), "noisy": specs(noisy, nn)},
+                args.fairness_seconds)
+        admits = list(gate.admit_log)
+        gate.admit_log = None
+    finally:
+        quiet.db.close()
+        noisy.db.close()
+    p99_solo = float(np.percentile(solo["quiet"], 99))
+    p99_loaded = float(np.percentile(loaded["quiet"], 99))
+    ratio = p99_loaded / p99_solo if p99_solo else 0.0
+    fair = {
+        "quiet_sessions": nq,
+        "noisy_sessions": nn,
+        "quiet_weight": 4,
+        "noisy_weight": 1,
+        "leg_seconds": args.fairness_seconds,
+        "quiet_solo": {"stmts": len(solo["quiet"]),
+                       **percentiles(solo["quiet"])},
+        "quiet_loaded": {"stmts": len(loaded["quiet"]),
+                         **percentiles(loaded["quiet"])},
+        "noisy_loaded": {"stmts": len(loaded["noisy"]),
+                         **percentiles(loaded["noisy"])},
+        "quiet_p99_ratio": round(ratio, 3),
+        "gate_admissions": {"quiet": admits.count("quiet"),
+                            "noisy": admits.count("noisy")},
+    }
+    detail["fairness"] = fair
+    ok = (ratio <= args.fairness_limit
+          and len(loaded["quiet"]) > 0 and len(loaded["noisy"]) > 0)
+    return ok, fair
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=20000)
@@ -331,12 +754,68 @@ def main() -> int:
     ap.add_argument("--serve-strict", action="store_true",
                     help="exit 1 unless batches form (mean size > 1) and "
                          "batched compiles stay within the pow2 bound")
+    ap.add_argument("--wire-sessions", type=int, default=0,
+                    help="wire A/B mode: N real MySQL connections against "
+                         "the threaded solo-path baseline then the async "
+                         "front end with continuous batching")
+    ap.add_argument("--wire-seconds", type=float, default=3.0,
+                    help="seconds per wire A/B leg")
+    ap.add_argument("--wire-drivers", type=int, default=4,
+                    help="client-side selector driver threads")
+    ap.add_argument("--wire-strict", action="store_true",
+                    help="exit 1 unless async speedup >= --wire-min-speedup "
+                         "and async p99 <= 3x async p50")
+    ap.add_argument("--wire-min-speedup", type=float, default=1.0,
+                    help="CI floor for the async-vs-threaded aggregate "
+                         "throughput ratio (both stacks share one GIL "
+                         "with the in-process clients, so the aggregate "
+                         "is near parity by construction; the tail is "
+                         "where the stacks separate)")
+    ap.add_argument("--wire-min-tail-win", type=float, default=0.0,
+                    help="CI floor for threaded-p99 / async-p99 (0 = "
+                         "don't assert; at 128+ sessions the async "
+                         "stack measures 8-10x)")
+    ap.add_argument("--async-workers", type=int, default=0,
+                    help="async front end worker pool size (0 = scale "
+                         "with --wire-sessions, 8..64)")
+    ap.add_argument("--fairness", action="store_true",
+                    help="two-tenant fairness mode through the shared "
+                         "dispatch gate")
+    ap.add_argument("--fairness-seconds", type=float, default=1.5,
+                    help="seconds per fairness leg")
+    ap.add_argument("--fairness-strict", action="store_true",
+                    help="exit 1 unless the quiet tenant's loaded p99 stays "
+                         "within --fairness-limit of its solo p99")
+    ap.add_argument("--fairness-limit", type=float, default=2.0,
+                    help="max quiet-tenant p99 degradation ratio")
     args = ap.parse_args()
     budget = float(os.environ.get("LATENCY_BUDGET_S", "300"))
 
+    from bench_meta import collect as bench_meta
+
+    rc = 0
+    if args.fairness:
+        # fairness runs on its own two-tenant cluster (no shared kv db)
+        fdetail = {"total_s": None}
+        fair_ok, fair = run_fairness(args, fdetail)
+        fdetail["total_s"] = round(elapsed(), 1)
+        emit({
+            "metric": "serving_fairness_quiet_p99_ratio",
+            "value": fair["quiet_p99_ratio"],
+            "unit": "x",
+            "detail": {"fairness": fair, "meta": bench_meta(None),
+                       "total_s": fdetail["total_s"]},
+        })
+        if args.fairness_strict and not fair_ok:
+            print("FAIRNESS-STRICT: quiet tenant p99 degraded "
+                  f"{fair['quiet_p99_ratio']}x under the noisy flood "
+                  f"(limit {args.fairness_limit}x)", file=sys.stderr)
+            rc = 1
+        if args.wire_sessions <= 0 and args.sessions <= 0:
+            return rc
+
     t0 = time.perf_counter()
     db, sess = build_db(args.rows)
-    from bench_meta import collect as bench_meta
 
     detail = {
         "rows": args.rows,
@@ -346,6 +825,25 @@ def main() -> int:
         # artifacts compare cleanly only when these match
         "meta": bench_meta(db),
     }
+
+    if args.wire_sessions > 0:
+        wire_ok, wire = run_wire(db, args, detail)
+        detail["total_s"] = round(elapsed(), 1)
+        emit({
+            "metric": "serving_wire_stmts_per_sec",
+            "value": wire["async"]["stmts_per_sec"],
+            "unit": "stmts/s",
+            "vs_baseline": wire["async_speedup"],
+            "detail": detail,
+        })
+        if args.wire_strict and not wire_ok:
+            print("WIRE-STRICT: async speedup "
+                  f"{wire['async_speedup']}x < {args.wire_min_speedup}x, "
+                  f"async p99/p50 {wire['async_p99_vs_p50']}x > 3x, or "
+                  f"p99 win {wire['async_p99_win']}x < "
+                  f"{args.wire_min_tail_win}x", file=sys.stderr)
+            rc = 1
+        return rc
 
     if args.sessions > 0:
         serve_ok, off, on = run_serve(db, args, detail)

@@ -15,10 +15,20 @@
 # (tools/latency_bench.py --strict): warm repeated statements must hit
 # the text-keyed fast path 100% of the time, else the smoke fails.
 #
-# --serve additionally runs the concurrent-serving smoke
-# (tools/latency_bench.py --sessions 16 --serve-strict): the statement
-# micro-batcher must actually form batches (mean batch size > 1) and
-# keep batched XLA compiles within the pow2 bucket bound.
+# --serve additionally runs the concurrent-serving smokes:
+#   1. tools/latency_bench.py --sessions 16 --serve-strict: the
+#      statement micro-batcher must actually form batches (mean batch
+#      size > 1) and keep batched XLA compiles within the pow2 bucket
+#      bound.
+#   2. tools/latency_bench.py --wire-sessions 128 --wire-strict: 128
+#      real MySQL connections driven closed-loop against the threaded
+#      solo-path baseline then the async front end with continuous
+#      batching — async aggregate throughput must be no worse, its p99
+#      must stay <= 3x its p50, and its p99 must beat the threaded
+#      stack's blown-out tail by >= 3x.
+#   3. tools/latency_bench.py --fairness --fairness-strict: a weight-4
+#      quiet tenant flooded by a weight-1 tenant through the shared
+#      dispatch gate must keep its p99 within 2x of its solo run.
 #
 # --awr additionally runs the workload-repository smoke
 # (tools/awr_smoke.py): mixed workload bracketed by two SNAPSHOT
@@ -75,6 +85,19 @@ fi
 if [ "$serve" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
         --rows 1000 --sessions 16 --serve-seconds 2 --serve-strict
+    rc=$?
+fi
+
+if [ "$serve" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
+        --rows 1000 --wire-sessions 128 --wire-seconds 2 --wire-strict \
+        --wire-min-speedup 1.0 --wire-min-tail-win 3.0
+    rc=$?
+fi
+
+if [ "$serve" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/latency_bench.py \
+        --fairness --fairness-seconds 1.5 --fairness-strict
     rc=$?
 fi
 
