@@ -484,6 +484,88 @@ def cpu_suite_main(sf: float) -> None:
           "detail": out})
 
 
+def advisor_ab(tables, sf: float, reps: int) -> dict:
+    """Layout-advisor A/B leg: hand-tuned lineitem(l_shipdate) projection
+    vs the advisor's own pick from a COLD catalog (no projection, no
+    hints — only the access evidence a short shipdate-heavy warmup
+    leaves behind). Reports what fraction of the hand-tuned warm-Q6 e2e
+    win the closed loop recovers, and whether the advisor-routed result
+    is bit-identical to the hand-routed one (same stable argsort, same
+    reduction order, so equality is exact, not approximate)."""
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+    from oceanbase_tpu.server.layout_advisor import propose
+    from oceanbase_tpu.server.workload import TableAccessStats
+    from oceanbase_tpu.storage.sorted_projection import (
+        make_sorted_projection,
+        projection_name,
+    )
+
+    q6 = QUERIES[QID["q6"]]
+    q14 = QUERIES[QID["q14"]]
+    pname = projection_name("lineitem", "l_shipdate")
+    d = {}
+
+    def warm(sess):
+        sess.sql(q6)  # compile + route through the current layout
+        t, rs = _best(lambda: sess.sql(q6), max(3, reps))
+        return t, float(rs.columns["revenue"][0])
+
+    # hand-tuned leg: the catalog exactly as ensure_projection left it
+    hand = Session(tables, unique_keys=UNIQUE_KEYS)
+    seed_stats(hand, tables, sf)
+    t_hand, v_hand = warm(hand)
+
+    # cold leg: same column data, fresh lineitem (no projection attached)
+    cold_tables = {n: t for n, t in tables.items() if "#sp:" not in n}
+    li = tables["lineitem"]
+    cold_tables["lineitem"] = Table(
+        "lineitem", li.schema, dict(li.data), dict(li.dicts))
+    cold = Session(cold_tables, unique_keys=UNIQUE_KEYS)
+    seed_stats(cold, cold_tables, sf)
+    cold.access = TableAccessStats()
+    t_cold, v_cold = warm(cold)
+    cold.sql(q14)  # the headline workload is shipdate-heavy; a second
+    cold.sql(q14)  # query breaks the q6 filter-count tie in its favor
+
+    # the advisor's pick from the cold session's evidence alone
+    recs = propose(cold.access.snapshot(), cold_tables)
+    pick = next((r for r in recs if r.action == "create_projection"
+                 and r.table == "lineitem"), None)
+    d["advisor_pick"] = (f"{pick.table}({pick.column})" if pick else "none")
+    if pick is None or pick.column != "l_shipdate":
+        d["advisor_error"] = "advisor did not pick lineitem(l_shipdate)"
+        return d
+    cols = None
+    if pick.detail.startswith("cover=") and pick.detail != "cover=all":
+        cols = pick.detail[len("cover="):].split(",")
+    t0 = time.perf_counter()
+    make_sorted_projection(cold_tables, "lineitem", pick.column, cols)
+    d["advisor_build_s"] = round(time.perf_counter() - t0, 1)
+    cold.plan_cache.flush()  # cached plans predate the new layout
+    t_adv, v_adv = warm(cold)
+    assert cold_tables[pname] is not None
+
+    d["advisor_cover"] = pick.detail
+    d["t_cold_s"] = round(t_cold, 6)
+    d["t_hand_s"] = round(t_hand, 6)
+    d["t_advisor_s"] = round(t_adv, 6)
+    d["bit_identical_vs_hand"] = bool(v_adv == v_hand)
+    d["correct_vs_cold"] = bool(
+        abs(v_adv - v_cold) <= 1e-6 * max(1.0, abs(v_cold)))
+    win_hand = t_cold - t_hand
+    recovered = (t_cold - t_adv) / win_hand if win_hand > 1e-9 else 0.0
+    d["win_recovered"] = round(recovered, 3)
+    emit({
+        "metric": f"layout_advisor_q6_sf{sf:g}_win_recovered",
+        "value": round(recovered, 3),
+        "unit": "fraction",
+        "detail": d,
+    })
+    return d
+
+
 def main():
     # every emitted line is a COMPLETE cumulative summary, so a driver
     # kill mid-run never loses captured results — the self-budget only
@@ -645,6 +727,22 @@ def main():
         except Exception as e:  # pragma: no cover — keep partial results
             detail[f"{qname}_error"] = f"{type(e).__name__}: {e}"
         summary(tpu_t, cpu_t)
+
+    # ---- layout-advisor A/B leg (hand-tuned vs advisor-chosen) --------
+    # the closed loop must recover >= 90% of the hand-tuned projection's
+    # warm-Q6 win starting from a cold catalog (full-cover build over
+    # lineitem: the argsort + gather dominate, hence the budget margin)
+    if (os.environ.get("BENCH_ADVISOR", "1") == "1"
+            and not over_budget(margin=40.0 + 10.0 * sf)):
+        try:
+            for k, v in advisor_ab(tables, sf, reps).items():
+                detail[f"advisor_{k}" if not k.startswith("advisor")
+                       else k] = v
+        except Exception as e:  # pragma: no cover — keep partial results
+            detail["advisor_error"] = f"{type(e).__name__}: {e}"
+        summary(tpu_t, cpu_t)
+    elif os.environ.get("BENCH_ADVISOR", "1") == "1":
+        detail["advisor_skipped"] = "budget"
 
     # ---- full 22-query timed suite (QphH-style composite) -------------
     # Every query times its WARM end-to-end latency through the session;

@@ -1304,11 +1304,16 @@ class Executor:
             cnt = max(hi_i - lo_i, 0)
             if cnt > 0.25 * n:
                 continue  # not selective enough to beat the masked scan
-            if best is None or cnt < best[0]:
-                best = (cnt, pname, _SliceSpec(qual, tuple(lows), tuple(highs)))
+            # tie-break equally selective candidates by covered width: a
+            # narrower column-subset projection uploads fewer device
+            # columns for the same slice
+            width = len(pt.schema.fields)
+            if best is None or (cnt, width) < (best[0], best[3]):
+                best = (cnt, pname,
+                        _SliceSpec(qual, tuple(lows), tuple(highs)), width)
         if best is None:
             return None
-        cnt, pname, spec = best
+        cnt, pname, spec, _width = best
         new_scan = replace(scan, table=pname)
         cap = -(-int(cnt * 1.25 + 1024) // 1024) * 1024
         self._pending_slices[id(new_scan)] = (spec, cap)

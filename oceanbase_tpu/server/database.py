@@ -533,7 +533,28 @@ class Database:
         self.sentinel = HealthSentinel(
             capacity=self.config["health_alert_capacity"])
         self.sentinel.enabled = self.config["enable_health_sentinel"]
-        self.workload.on_snapshot = self.sentinel.observe
+        # closed-loop layout advisor (server/layout_advisor.py): folds the
+        # workload repository's evidence into costed layout actions, and
+        # (auto mode) applies them as background rebuild dags. Chained on
+        # the snapshot hook next to the sentinel; either observer failing
+        # must not starve the other.
+        from .layout_advisor import LayoutAdvisor
+
+        self.layout_advisor = LayoutAdvisor(self)
+        # table -> advisor-set residency priority (higher = evict later);
+        # _enforce_memory and the block cache's eviction consult it
+        self.residency_priority: dict[str, float] = {}
+        self._uid_tables: dict = {}
+
+        def _observe_snapshot(first, last):
+            for cb in (self.sentinel.observe,
+                       self.layout_advisor.on_snapshot):
+                try:
+                    cb(first, last)
+                except Exception:  # noqa: BLE001 - observer boundary
+                    pass
+
+        self.workload.on_snapshot = _observe_snapshot
         self.config.on_change(
             "enable_health_sentinel",
             lambda _n, _o, v: setattr(self.sentinel, "enabled", v))
@@ -548,6 +569,10 @@ class Database:
         from ..storage.freezer import MaintenanceService
 
         self.block_cache = KVCache(self.config["block_cache_size"])
+        # under memory pressure the block cache evicts the coldest entry
+        # of the LOWEST advisor residency priority first (keys are
+        # (sstable uid, block, column); uid -> table resolved lazily)
+        self.block_cache.priority_of = self._block_priority
         self.config.on_change(
             "block_cache_size",
             lambda _n, _o, v: self.block_cache.set_capacity(v))
@@ -1589,9 +1614,21 @@ class Database:
                 # columns)
                 old = self.catalog.get(name)
                 projs = getattr(old, "sorted_projections", None)
+                requeue = None
                 if projs:
                     from ..storage.sorted_projection import drop_projections
 
+                    # DML invalidation is not silent: it counts in sysstat
+                    # and the advisor re-queues a background rebuild (auto
+                    # mode / advisor-managed layouts) instead of losing
+                    # the projection until someone hand-rebuilds it
+                    self.metrics.add(
+                        "sorted projection invalidations", len(projs))
+                    try:
+                        requeue = self.layout_advisor.note_invalidated(
+                            name, projs)
+                    except Exception:  # noqa: BLE001 - advisory path
+                        pass
                     for pname in projs.values():
                         self._invalidate(pname)
                     drop_projections(self.catalog, name)
@@ -1606,6 +1643,14 @@ class Database:
                             self.catalog, name, col, lists, nprobe)
                 self._invalidate(name)
                 ti.cached_data_version = ti.data_version
+                if requeue is not None:
+                    try:
+                        # only now that the refreshed snapshot landed: a
+                        # dag worker starting the rebuild must see the
+                        # current version, not re-enter this refresh
+                        requeue()
+                    except Exception:  # noqa: BLE001 - advisory path
+                        pass
                 self._enforce_memory(keep=name)
 
     def _resident_bytes(self) -> int:
@@ -1629,7 +1674,14 @@ class Database:
             return
         if self._resident_bytes() <= limit:
             return
-        for name, ti in self.tables.items():
+        # advisor residency priorities order the eviction: the lowest-
+        # priority tables lose their snapshots (and, via _invalidate,
+        # their device batches) first; ties keep insertion order
+        order = sorted(
+            self.tables.items(),
+            key=lambda kv: self.residency_priority.get(kv[0], 0.0),
+        )
+        for name, ti in order:
             if name == keep:
                 continue
             t = self.catalog.get(name)
@@ -1648,6 +1700,38 @@ class Database:
                 f"tenant {self.tenant_name}: memory unit exceeded "
                 f"({self._resident_bytes()} > {limit} bytes)"
             )
+
+    _UID_MISS = object()
+
+    def _block_priority(self, key) -> float:
+        """Residency priority of a block-cache key ((sstable uid, block,
+        column)); unknown uids rebuild the uid map once and then cache a
+        negative answer so eviction stays O(1)."""
+        try:
+            uid = key[0]
+        except Exception:
+            return 0.0
+        name = self._uid_tables.get(uid, self._UID_MISS)
+        if name is self._UID_MISS:
+            m = {}
+            for tname, ti in self.tables.items():
+                for ls_id, tablet_id in ti.all_partitions():
+                    for rep in (
+                            self.cluster.ls_groups.get(ls_id) or {}
+                    ).values():
+                        tab = rep.tablets.get(tablet_id)
+                        if tab is None:
+                            continue
+                        for ss in getattr(tab, "deltas", ()):
+                            m[ss.uid] = tname
+                        if getattr(tab, "base", None) is not None:
+                            m[tab.base.uid] = tname
+            m.setdefault(uid, None)
+            self._uid_tables = m
+            name = m[uid]
+        if name is None:
+            return 0.0
+        return float(self.residency_priority.get(name, 0.0))
 
     def kill_query(self, session_id: int, reason: str = "killed by user") -> None:
         """Interrupt a session's running statement cluster-wide (the
@@ -2171,7 +2255,8 @@ class DbSession:
             elif isinstance(stmt, (A.CreateIndex, A.DropIndex,
                                    A.CreateVectorIndex, A.DropVectorIndex)):
                 pm.check(self.user, "index", {stmt.table})
-            elif isinstance(stmt, (A.AlterSystemSet, A.KillQuery)):
+            elif isinstance(stmt, (A.AlterSystemSet, A.RunLayoutAdvisor,
+                                   A.KillQuery)):
                 if self.user != "root":
                     raise AccessDenied(
                         f"'{self.user}' lacks SUPER", 1227)
@@ -2528,6 +2613,21 @@ class DbSession:
             except ConfigError as e:
                 raise SqlError(str(e)) from None
             return ResultSet((), {})
+        if isinstance(stmt, A.RunLayoutAdvisor):
+            recs = self.db.layout_advisor.run()
+            return ResultSet(
+                ("action", "table_name", "column_name", "detail",
+                 "benefit", "cost_bytes", "status"),
+                {
+                    "action": [r.action for r in recs],
+                    "table_name": [r.table for r in recs],
+                    "column_name": [r.column for r in recs],
+                    "detail": [r.detail for r in recs],
+                    "benefit": [float(r.benefit) for r in recs],
+                    "cost_bytes": [int(r.cost_bytes) for r in recs],
+                    "status": [r.status for r in recs],
+                },
+            )
         if isinstance(stmt, A.Show):
             return self._show(stmt)
         if isinstance(stmt, A.LockTable):

@@ -599,6 +599,24 @@ def _alert_history(db) -> Table:
     ])
 
 
+def _layout_advisor(db) -> Table:
+    """Latest layout-advisor pass: each ranked recommendation with its
+    evidence, estimated benefit, byte cost, and what happened to it
+    (dry_run / queued / applied / rejected:budget)."""
+    adv = getattr(db, "layout_advisor", None)
+    recs = list(adv.last) if adv is not None else []
+    return _t("__all_virtual_layout_advisor", [
+        ("action", DataType.varchar(), [r.action for r in recs]),
+        ("table_name", DataType.varchar(), [r.table for r in recs]),
+        ("column_name", DataType.varchar(), [r.column for r in recs]),
+        ("detail", DataType.varchar(), [r.detail for r in recs]),
+        ("benefit", DataType.float64(), [float(r.benefit) for r in recs]),
+        ("cost_bytes", DataType.int64(), [int(r.cost_bytes) for r in recs]),
+        ("status", DataType.varchar(), [r.status for r in recs]),
+        ("evidence", DataType.varchar(), [r.evidence for r in recs]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -642,4 +660,5 @@ PROVIDERS = {
     "__all_virtual_server_timeline": _server_timeline,
     "__all_virtual_tenant_qos": _tenant_qos,
     "__all_virtual_alert_history": _alert_history,
+    "__all_virtual_layout_advisor": _layout_advisor,
 }

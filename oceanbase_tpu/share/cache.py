@@ -27,6 +27,35 @@ class KVCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # optional residency hook (key -> priority, higher = keep
+        # longer): eviction scans a bounded LRU-ordered window and takes
+        # the coldest entry of the lowest priority tier, so the layout
+        # advisor's hot tables survive memory pressure
+        self.priority_of = None
+
+    # bounded so a priority-heavy cache can't turn eviction into a full
+    # scan; within the window the LRU-most entry of the lowest tier goes
+    _EVICT_SCAN = 32
+
+    def _evict_one(self) -> None:
+        if self.priority_of is None:
+            _, ev = self._map.popitem(last=False)
+        else:
+            best_k = best_p = None
+            for i, k in enumerate(self._map):
+                if i >= self._EVICT_SCAN:
+                    break
+                try:
+                    p = float(self.priority_of(k))
+                except Exception:  # noqa: BLE001 - advisory hook
+                    p = 0.0
+                if best_p is None or p < best_p:
+                    best_k, best_p = k, p
+                if p <= 0.0:
+                    break  # default tier: nothing beats evicting it
+            ev = self._map.pop(best_k)
+        self._bytes -= int(ev.nbytes)
+        self.evictions += 1
 
     def get(self, key: tuple):
         with self._lock:
@@ -49,17 +78,13 @@ class KVCache:
             self._map[key] = value
             self._bytes += nbytes
             while self._bytes > self.capacity_bytes and self._map:
-                _, ev = self._map.popitem(last=False)
-                self._bytes -= int(ev.nbytes)
-                self.evictions += 1
+                self._evict_one()
 
     def set_capacity(self, capacity_bytes: int) -> None:
         with self._lock:
             self.capacity_bytes = capacity_bytes
             while self._bytes > self.capacity_bytes and self._map:
-                _, ev = self._map.popitem(last=False)
-                self._bytes -= int(ev.nbytes)
-                self.evictions += 1
+                self._evict_one()
 
     @property
     def bytes_used(self) -> int:

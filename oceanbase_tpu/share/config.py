@@ -202,6 +202,16 @@ def default_params() -> list[Param]:
         Param("health_alert_capacity", "int", 256,
               "bounded count of sentinel alerts held in memory",
               min=8, max=1 << 16),
+        Param("ob_layout_advisor_mode", "str", "off",
+              "closed-loop layout advisor: off (explicit runs only "
+              "propose), dry_run (also proposes on every workload "
+              "snapshot, mutates nothing), auto (applies through "
+              "background rebuild dags)",
+              choices=("off", "dry_run", "auto")),
+        Param("layout_advisor_max_bytes", "capacity", 512 << 20,
+              "budget for advisor-materialized layouts (sorted "
+              "projections); candidates over budget are narrowed to the "
+              "role-referenced columns, then rejected"),
         # storage
         Param("block_cache_size", "capacity", 256 << 20,
               "budget for decoded micro-block column cache"),

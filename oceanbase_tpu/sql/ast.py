@@ -314,6 +314,12 @@ class AlterSystemSet(Node):
 
 
 @dataclass(frozen=True)
+class RunLayoutAdvisor(Node):
+    """ALTER SYSTEM RUN LAYOUT ADVISOR (one advisor pass now; applies
+    only when ob_layout_advisor_mode=auto, else dry-run)."""
+
+
+@dataclass(frozen=True)
 class Show(Node):
     """SHOW PARAMETERS [LIKE 'pat'] | SHOW TABLES."""
 

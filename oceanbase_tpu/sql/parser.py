@@ -219,9 +219,14 @@ class Parser:
             raise SyntaxError(f"trailing tokens at {tk.pos}: {tk.value!r}")
         return stmt
 
-    def _alter(self) -> A.AlterSystemSet:
+    def _alter(self) -> "A.AlterSystemSet | A.RunLayoutAdvisor":
         self.expect("alter")
         self.expect("system")
+        if self.peek().value == "run":
+            self.next()
+            self.expect("layout")
+            self.expect("advisor")
+            return A.RunLayoutAdvisor()
         self.expect("set")
         name = self.next().value
         self.expect("=")

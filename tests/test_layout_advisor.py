@@ -1,0 +1,301 @@
+"""Closed-loop layout advisor: evidence -> ranked costed actions ->
+background apply.
+
+Covers the three control surfaces (ALTER SYSTEM RUN LAYOUT ADVISOR,
+ob_layout_advisor_mode, __all_virtual_layout_advisor), the dry_run
+no-mutation guarantee, hysteresis (stable action sets across snapshots,
+idle-drop + no immediate re-create), the budget knob, DML invalidation
+accounting + background rebuild re-queue, residency-priority-aware
+eviction, and the tools/awr_report.py build_advisor() output contract
+(satellite: the producer/consumer shape is pinned here, not prose).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server.database import Database
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk_db():
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table adv (id int primary key, d int, a int, b int)")
+    s.sql("insert into adv values " + ", ".join(
+        f"({i}, {i % 100}, {i * 2}, {i % 7})" for i in range(400)))
+    return d
+
+
+def _drive(db, lo=0, n=5):
+    s = db.session()
+    for k in range(lo, lo + n):
+        s.sql(f"select sum(a) from adv where d >= {k} and d < {k + 3}").rows()
+
+
+@pytest.fixture()
+def db():
+    d = _mk_db()
+    yield d
+    d.close()
+
+
+# ---- control path ---------------------------------------------------------
+
+
+def test_dry_run_proposes_and_mutates_nothing(db):
+    _drive(db)
+    s = db.session()
+    rs = s.sql("alter system run layout advisor")
+    acts = dict(zip(rs.columns["action"], rs.columns["status"]))
+    assert acts.get("create_projection") == "dry_run"
+    # nothing materialized, nothing queued, no priorities set
+    assert getattr(db.catalog["adv"], "sorted_projections", {}) == {}
+    assert db.dag_scheduler.pending == 0
+    assert db.residency_priority == {}
+    assert db.layout_advisor.created == {}
+
+
+def test_run_requires_super(db):
+    from oceanbase_tpu.server.database import SqlError
+
+    with pytest.raises(SqlError) as ei:
+        db.session(user="alice").sql("alter system run layout advisor")
+    assert ei.value.code == 1227
+
+
+def test_virtual_table_mirrors_last_pass(db):
+    _drive(db)
+    s = db.session()
+    s.sql("alter system run layout advisor")
+    rs = s.sql(
+        "select action, table_name, column_name, status "
+        "from __all_virtual_layout_advisor")
+    rows = set(rs.rows())
+    assert ("create_projection", "adv", "d", "dry_run") in rows
+    assert any(a == "set_residency" and t == "adv"
+               for a, t, _c, _st in rows)
+
+
+def test_mode_param_validates_choices(db):
+    from oceanbase_tpu.server.database import SqlError
+
+    with pytest.raises(SqlError):
+        db.session().sql("alter system set ob_layout_advisor_mode = bogus")
+
+
+# ---- auto apply -----------------------------------------------------------
+
+
+def test_auto_builds_projection_in_background_with_identical_results(db):
+    s = db.session()
+    _drive(db)
+    q = "select sum(a) from adv where d >= 10 and d < 13"
+    before = s.sql(q).rows()
+    s.sql("alter system set ob_layout_advisor_mode = auto")
+    rs = s.sql("alter system run layout advisor")
+    st = dict(zip(rs.columns["action"], rs.columns["status"]))
+    assert st["create_projection"] == "queued"
+    assert db.dag_scheduler.pending == 1  # background, not statement path
+    db.dag_scheduler.run_until_idle()
+    assert getattr(db.catalog["adv"], "sorted_projections", {}) == {
+        "d": "adv#sp:d"}
+    # the rebuild dag surfaced as a long op
+    ops = db.session().sql(
+        "select op_name, status from __all_virtual_long_ops").rows()
+    assert ("layout rebuild", "DONE") in ops
+    # routed AND bit-identical
+    assert s.sql(q).rows() == before
+    hits = [r["proj_hits"] for r in db.access.snapshot()
+            if r["table"] == "adv"]
+    assert hits and hits[0] >= 1
+    # residency priority applied for the hot table
+    assert db.residency_priority.get("adv", 0) > 0
+
+
+def test_dml_invalidation_counts_and_requeues_rebuild(db):
+    s = db.session()
+    _drive(db)
+    s.sql("alter system set ob_layout_advisor_mode = auto")
+    s.sql("alter system run layout advisor")
+    db.dag_scheduler.run_until_idle()
+    q = "select sum(a) from adv where d >= 1 and d < 2"
+    c0 = db.metrics.counters_snapshot().get(
+        "sorted projection invalidations", 0)
+    s.sql("insert into adv values (9000, 1, 11, 0)")
+    expect = s.sql(q).rows()  # DML visible even while layout is rebuilt
+    assert db.metrics.counters_snapshot()[
+        "sorted projection invalidations"] == c0 + 1
+    assert db.dag_scheduler.pending == 1  # re-queued, not silently lost
+    db.dag_scheduler.run_until_idle()
+    assert getattr(db.catalog["adv"], "sorted_projections", {}) == {
+        "d": "adv#sp:d"}
+    assert s.sql(q).rows() == expect
+
+
+# ---- hysteresis -----------------------------------------------------------
+
+
+def test_actions_stable_across_consecutive_snapshots(db):
+    s = db.session()
+    s.sql("alter system set ob_layout_advisor_mode = dry_run")
+    _drive(db)
+    s.sql("snapshot workload")
+    _drive(db)
+    s.sql("snapshot workload")  # first on_snapshot-triggered pass
+    set1 = {(r.action, r.table, r.column) for r in db.layout_advisor.last}
+    _drive(db)
+    s.sql("snapshot workload")  # same workload again
+    set2 = {(r.action, r.table, r.column) for r in db.layout_advisor.last}
+    assert set1 == set2
+    assert ("create_projection", "adv", "d") in set1
+
+
+def test_idle_projection_dropped_then_not_flapped_back(db):
+    s = db.session()
+    s.sql("alter system set ob_layout_advisor_mode = auto")
+    _drive(db)
+    s.sql("alter system run layout advisor")
+    db.dag_scheduler.run_until_idle()
+    assert ("adv", "d") in db.layout_advisor.created
+    s.sql("snapshot workload")
+    # workload shifts: the base table stays hot but never range-filters
+    # on d, so the projection sits idle for DROP_AFTER_WINDOWS windows
+    from oceanbase_tpu.server.layout_advisor import DROP_AFTER_WINDOWS
+
+    for _ in range(DROP_AFTER_WINDOWS):
+        for _k in range(4):
+            s.sql("select sum(b) from adv").rows()
+        s.sql("snapshot workload")
+    db.dag_scheduler.run_until_idle()
+    assert getattr(db.catalog["adv"], "sorted_projections", {}) == {}
+    assert "adv#sp:d" not in db.catalog
+    assert ("adv", "d") not in db.layout_advisor.created
+    # the cumulative filter evidence that justified the build is still
+    # in the counters: another pass must NOT immediately re-create
+    recs = db.layout_advisor.run()
+    assert not any(r.action == "create_projection" and r.table == "adv"
+                   and r.status in ("proposed", "queued") for r in recs)
+    # ...until NEW filtered scans arrive
+    _drive(db, lo=20, n=5)
+    recs = db.layout_advisor.run()
+    assert any(r.action == "create_projection" and r.table == "adv"
+               for r in recs)
+
+
+def test_budget_narrows_then_rejects(db):
+    s = db.session()
+    _drive(db)
+    s.sql("alter system set layout_advisor_max_bytes = 1")
+    recs = db.layout_advisor.run()
+    creates = [r for r in recs if r.action == "create_projection"]
+    assert creates and creates[0].status == "rejected:budget"
+    assert creates[0].detail.startswith("cover=")
+    s.sql("alter system set layout_advisor_max_bytes = 64M")
+    recs = db.layout_advisor.run()
+    creates = [r for r in recs if r.action == "create_projection"]
+    assert creates and creates[0].status == "dry_run"
+    assert creates[0].cost_bytes > 0
+
+
+# ---- encodings + residency ------------------------------------------------
+
+
+def test_encoding_recommendation_from_cost_model():
+    d = Database(n_nodes=1, n_ls=1)
+    try:
+        s = d.session()
+        s.sql("create table enc_t (id int primary key, r bigint, x bigint)")
+        # r has 4 long runs (RLE-friendly, > 4KB savings at 2000 rows)
+        s.sql("insert into enc_t values " + ", ".join(
+            f"({i}, {i // 500}, {i})" for i in range(2000)))
+        for k in range(3):
+            s.sql(f"select sum(x) from enc_t where r >= {k}").rows()
+        recs = d.layout_advisor.run()
+        encs = {(r.table, r.column): r.detail for r in recs
+                if r.action == "set_encoding"}
+        assert encs.get(("enc_t", "r")) == "rle"
+        # auto mode records the hint
+        s.sql("alter system set ob_layout_advisor_mode = auto")
+        d.layout_advisor.run()
+        assert d.layout_advisor.encoding_hints[("enc_t", "r")] == "rle"
+    finally:
+        d.close()
+
+
+def test_kvcache_eviction_respects_priority():
+    from oceanbase_tpu.share.cache import KVCache
+
+    c = KVCache(capacity_bytes=3 * 800)
+    c.priority_of = lambda key: 5.0 if key[0] == "hot" else 0.0
+    c.put(("hot", 0), np.zeros(100))  # 800B, LRU-most
+    c.put(("cold", 0), np.zeros(100))
+    c.put(("cold", 1), np.zeros(100))
+    c.put(("cold", 2), np.zeros(100))  # over budget: one must go
+    assert c.get(("hot", 0)) is not None  # survived despite being LRU
+    assert c.get(("cold", 0)) is None  # coldest zero-priority evicted
+    assert c.evictions == 1
+
+
+def test_enforce_memory_evicts_lowest_priority_first():
+    d = Database(n_nodes=1, n_ls=1)
+    try:
+        s = d.session()
+        for name in ("res_a", "res_b"):
+            s.sql(f"create table {name} (id int primary key, v bigint)")
+            s.sql(f"insert into {name} values " + ", ".join(
+                f"({i}, {i})" for i in range(200)))
+            s.sql(f"select sum(v) from {name}").rows()
+        d.residency_priority["res_a"] = 9.0
+        d.residency_priority["res_b"] = 1.0
+        d.unit.memory_limit = d._resident_bytes() - 1
+        d._enforce_memory(keep="res_a")
+        # res_b (lower priority) lost its snapshot first
+        assert d.tables["res_b"].cached_data_version == -1
+        assert d.tables["res_a"].cached_data_version != -1
+    finally:
+        d.unit.memory_limit = None
+        d.close()
+
+
+# ---- producer/consumer contract (tools/awr_report.py) ---------------------
+
+
+def test_build_advisor_output_contract():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from awr_report import build_advisor
+    finally:
+        sys.path.pop(0)
+
+    digests = [{
+        "digest": "select v from t where k = ?n", "stmt_type": "Select",
+        "exec_count": 20, "total_time_s": 0.4, "avg_time_s": 0.02,
+        "batched_count": 2, "fast_path_count": 18,
+    }]
+    tables = [{
+        "table": "t", "scans": 12, "rows_read": 24000,
+        "das_lookups": 0, "das_rows": 0, "proj_hits": 0, "proj_misses": 3,
+        "columns": [
+            {"column": "k", "filter_count": 12, "join_count": 0,
+             "group_count": 0, "sort_count": 0},
+        ],
+    }]
+    resid = [{"table": "t", "bytes": 4096}]
+    out = build_advisor(digests, tables, resid)
+    assert set(out) == {"sorted_projections", "residency_priorities",
+                        "batching_candidates"}
+    for key in out:
+        assert isinstance(out[key], list)
+    sp = out["sorted_projections"][0]
+    assert set(sp) >= {"table", "column", "score", "reason"}
+    assert (sp["table"], sp["column"]) == ("t", "k")
+    rp = out["residency_priorities"][0]
+    assert set(rp) >= {"table", "score", "scans", "device_bytes"}
+    assert rp["table"] == "t"
+    bc = out["batching_candidates"][0]
+    assert set(bc) >= {"digest", "executions", "batched_ratio", "fast_ratio"}
+    assert bc["batched_ratio"] == 0.1

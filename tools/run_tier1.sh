@@ -41,6 +41,13 @@
 # starved tenant must each raise exactly one typed alert, re-evaluation
 # must not duplicate them, and tools/health_report.py must replay the
 # dump with exit code 0.
+#
+# --advisor additionally runs the layout-advisor smoke
+# (tools/layout_advisor_smoke.py): a skewed workload must make the
+# advisor recommend the known-good sorted projection, dry run must
+# mutate nothing, the auto-mode background rebuild must not blow out
+# serving p99 (<= 1.5x quiescent), and the applied layout must be
+# measurably faster with exactly identical results.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -51,6 +58,7 @@ latency=0
 serve=0
 awr=0
 health=0
+advisor=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -58,6 +66,7 @@ while true; do
         --serve) serve=1; shift ;;
         --awr) awr=1; shift ;;
         --health) health=1; shift ;;
+        --advisor) advisor=1; shift ;;
         *) break ;;
     esac
 done
@@ -108,6 +117,11 @@ fi
 
 if [ "$health" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/health_smoke.py
+    rc=$?
+fi
+
+if [ "$advisor" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/layout_advisor_smoke.py
     rc=$?
 fi
 exit $rc
