@@ -566,6 +566,87 @@ def advisor_ab(tables, sf: float, reps: int) -> dict:
     return d
 
 
+def skew_join_ab(reps: int) -> dict:
+    """Zipfian skew-join leg: one probe-side key value holds 60% of the
+    rows, so plain hash repartition funnels 60% of the probe onto a
+    single shard and every shard's exchange lane pads to that hot lane's
+    capacity. The hybrid hot-key-broadcast route — chosen automatically
+    by PxExecutor._skewed_key from TableAccessStats key evidence
+    (measured NDV / top-value fraction, consulted before the optimizer
+    histograms) — keeps hot probe rows local and broadcasts their build
+    matches. Reports warm e2e for hybrid_hash='auto' with access
+    evidence vs hybrid_hash=False on the same catalog, plus the measured
+    evidence that made the call. Results must be bit-identical: both
+    routes feed the same join kernel, only row placement differs."""
+    import jax
+
+    from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+    from oceanbase_tpu.core.table import Table
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.parallel.mesh import make_mesh
+    from oceanbase_tpu.parallel.px import PxExecutor
+    from oceanbase_tpu.server.workload import TableAccessStats
+    from oceanbase_tpu.sql import parser as P
+
+    d = {}
+    nsh = len(jax.devices())
+    if nsh < 4:
+        d["skipped"] = f"{nsh} device(s): the 2/nsh skew threshold needs >= 4"
+        return d
+    rng = np.random.default_rng(7)
+    n, nkeys, hot_frac = 1 << 18, 1 << 17, 0.6
+    hot = rng.random(n) < hot_frac
+    fk = np.where(hot, 7, rng.integers(0, nkeys, n)).astype(np.int64)
+    i64 = DataType.int64()
+    fact = Table.from_pydict(
+        "skew_fact", Schema((Field("k", i64), Field("v", i64))),
+        {"k": fk, "v": rng.integers(0, 1000, n).astype(np.int64)})
+    # build side big enough that the exchange costing picks hash
+    # repartition (not plain broadcast): > broadcast_threshold rows and
+    # nkeys * (nsh-1) > n
+    dim = Table.from_pydict(
+        "skew_dim", Schema((Field("k", i64), Field("w", i64))),
+        {"k": np.arange(nkeys, dtype=np.int64),
+         "w": rng.integers(0, 1000, nkeys).astype(np.int64)})
+    tables = {"skew_fact": fact, "skew_dim": dim}
+    text = ("SELECT SUM(f.v + d.w) AS s FROM skew_fact f "
+            "JOIN skew_dim d ON f.k = d.k")
+    fkey, _, _ = P.fast_normalize(text)
+    norm = fkey.replace("?n", "?").replace("?s", "?")
+
+    access = TableAccessStats()
+    ev = access.key_evidence("skew_fact", "k", fact)
+    d["evidence_ndv"] = round(ev[0], 1) if ev else None
+    d["evidence_top_frac"] = round(ev[1], 4) if ev else None
+    d["skew_threshold"] = round(2.0 / nsh, 4)
+    d["nsh"] = nsh
+
+    def leg(hybrid, access_obj):
+        sess = Session(tables)
+        px = PxExecutor(sess.catalog, make_mesh(), stats=sess.stats,
+                        hybrid_hash=hybrid, access=access_obj)
+        sess.run_ast(P.parse(text), norm, executor=px)  # compile + run
+        t, rs = _best(
+            lambda: sess.run_ast(P.parse(text), norm, executor=px),
+            max(3, reps))
+        return t, int(rs.columns["s"][0])
+
+    t_hash, v_hash = leg(False, None)
+    t_auto, v_auto = leg("auto", access)
+    d["t_plain_hash_s"] = round(t_hash, 6)
+    d["t_hybrid_auto_s"] = round(t_auto, 6)
+    d["bit_identical"] = bool(v_hash == v_auto)
+    speedup = t_hash / t_auto if t_auto > 0 else 0.0
+    d["hybrid_speedup"] = round(speedup, 3)
+    emit({
+        "metric": "skew_join_zipf_hybrid_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "detail": d,
+    })
+    return d
+
+
 def main():
     # every emitted line is a COMPLETE cumulative summary, so a driver
     # kill mid-run never loses captured results — the self-budget only
@@ -743,6 +824,20 @@ def main():
         summary(tpu_t, cpu_t)
     elif os.environ.get("BENCH_ADVISOR", "1") == "1":
         detail["advisor_skipped"] = "budget"
+
+    # ---- zipfian skew-join leg (hybrid hot-key-broadcast A/B) ---------
+    # the hot-key-broadcast route must beat plain hash repartition when
+    # measured key evidence says one value overloads its hash lane
+    if (os.environ.get("BENCH_SKEW", "1") == "1"
+            and not over_budget(margin=60.0)):
+        try:
+            for k, v in skew_join_ab(reps).items():
+                detail[f"skew_{k}"] = v
+        except Exception as e:  # pragma: no cover — keep partial results
+            detail["skew_error"] = f"{type(e).__name__}: {e}"
+        summary(tpu_t, cpu_t)
+    elif os.environ.get("BENCH_SKEW", "1") == "1":
+        detail["skew_skipped"] = "budget"
 
     # ---- full 22-query timed suite (QphH-style composite) -------------
     # Every query times its WARM end-to-end latency through the session;

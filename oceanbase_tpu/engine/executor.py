@@ -389,6 +389,11 @@ class Executor:
         # executables built (one per (plan, pow2 bucket) — the bench
         # asserts this stays bounded by the bucket count, not traffic)
         self.batched_compiles = 0
+        # lifetime count of Executor.compile invocations (cold compiles +
+        # overflow recompiles). Artifact-hydrated statements never come
+        # through compile(), which is what the warm-boot smoke pins:
+        # compiles + batched_compiles stays 0 across a warm replay
+        self.compiles = 0
 
     # ---- input preparation -------------------------------------------
     def _collect_scans(self, plan: LogicalOp) -> list[Scan]:
@@ -1666,6 +1671,7 @@ class Executor:
 
     # ---- tracing ------------------------------------------------------
     def compile(self, plan: LogicalOp, params: PhysicalParams):
+        self.compiles += 1
         nodes = _number_nodes(plan)
         id_of = {id(op): nid for nid, op in nodes.items()}
         needed = self._needed_columns(plan)
@@ -3204,6 +3210,13 @@ class PreparedPlan:
         # cross-session micro-batching: pow2 bucket -> vmapped executable
         # (cleared by recompile(): a capacity bump makes them stale)
         self._batched: dict[int, object] = {}
+        # persistent-artifact state (engine/plan_artifact.py): True means
+        # jitted is a live traceable jit (vmap-able for batched buckets);
+        # False means it is a deserialized AOT executable that must
+        # recompile before any new trace. artifact_ref = (store, aid)
+        # once this plan has an on-disk artifact.
+        self._traceable = True
+        self.artifact_ref = None
 
     def bind(self, values, dtypes):
         """Values -> the dispatch form (one packed int64 vector when the
@@ -3218,6 +3231,15 @@ class PreparedPlan:
             self.executor.compile(self.plan, self.params)
         )
         self._batched.clear()
+        self._traceable = True
+        if self.artifact_ref is not None:
+            # the executable just changed capacity under a persisted
+            # artifact: re-export at the new capacity, or the overflow
+            # replays on every warm boot
+            try:
+                self.artifact_ref[0].on_recompile(self)
+            except Exception:
+                pass
 
     def _inputs(self):
         try:
@@ -3235,10 +3257,24 @@ class PreparedPlan:
                 for alias, table, cols in self.input_spec
             }
 
+    def jit_call(self, inputs, qparams):
+        """Every dispatch funnels through here. A warm (artifact-loaded)
+        executable validates its input signature per call; any drift (a
+        table's device capacity moved since export) raises ArtifactStale
+        and we recompile from the logical plan — one honest compile,
+        never a stale program over wrong-shaped buffers."""
+        from .plan_artifact import ArtifactStale
+
+        try:
+            return self.jitted(inputs, qparams)
+        except ArtifactStale:
+            self.recompile()
+            return self.jitted(self._inputs(), qparams)
+
     def run_nocheck(self, qparams: tuple = ()):
         """Dispatch one execution WITHOUT the overflow host sync — for
         benchmarking/pipelining after a checked run validated capacities."""
-        out, _ovf = self.jitted(self._inputs(), qparams)
+        out, _ovf = self.jit_call(self._inputs(), qparams)
         return out
 
     def run(self, max_retries: int = 3, qparams: tuple = ()):
@@ -3247,7 +3283,7 @@ class PreparedPlan:
         for attempt in range(max_retries + 1):
             checkpoint()  # between overflow retries (and before the first run)
             inputs = self._inputs()
-            out, ovf_vec = self.jitted(inputs, qparams)
+            out, ovf_vec = self.jit_call(inputs, qparams)
             overflows = self._overflows(np.asarray(ovf_vec))  # ONE fetch
             if not overflows:
                 return out
@@ -3280,7 +3316,7 @@ class PreparedPlan:
         for attempt in range(max_retries + 1):
             checkpoint()
             inputs = self._inputs()
-            out, ovf_vec = self.jitted(inputs, qparams)
+            out, ovf_vec = self.jit_call(inputs, qparams)
             hovf, hcols, hvalid, hsel = _jax.device_get(
                 (ovf_vec, out.cols, out.valid, out.sel))
             overflows = self._overflows(hovf)
@@ -3304,7 +3340,7 @@ class PreparedPlan:
         from ..share.interrupt import checkpoint
 
         checkpoint()
-        return self.jitted(self._inputs(), qparams)
+        return self.jit_call(self._inputs(), qparams)
 
     # ---- cross-session micro-batching ---------------------------------
     @property
@@ -3339,9 +3375,24 @@ class PreparedPlan:
         if bucket > b:
             qblock = np.concatenate(
                 [qblock, np.repeat(qblock[:1], bucket - b, axis=0)])
+        from .plan_artifact import ArtifactStale
+
         for attempt in range(max_retries + 1):
             checkpoint()
             fn = self._batched.get(bucket)
+            if fn is None and not self._traceable:
+                # warm (artifact-loaded) plan: vmap over a deserialized
+                # call is unsupported, so hydrate the persisted bucket
+                # variant if one exists; else restore traceability with
+                # one honest recompile (counted; the backend compile hits
+                # the XLA disk cache) and build below as usual
+                store = self.artifact_ref[0] if self.artifact_ref else None
+                fn = (store.load_bucket(self, bucket)
+                      if store is not None else None)
+                if fn is not None:
+                    self._batched[bucket] = fn
+                else:
+                    self.recompile()
             if fn is None:
                 # build + first-trace under the lock: tracing re-enters
                 # plan emission, which installs the process-global active
@@ -3355,10 +3406,23 @@ class PreparedPlan:
                         self.executor.batched_compiles += 1
                         out, ovf_vec = fn(self._inputs(), qblock)
                         self._batched[bucket] = fn
+                        if self.artifact_ref is not None:
+                            try:
+                                self.artifact_ref[0].export_bucket(
+                                    self, bucket, fn)
+                            except Exception:
+                                pass
                     else:
                         out, ovf_vec = fn(self._inputs(), qblock)
             else:
-                out, ovf_vec = fn(self._inputs(), qblock)
+                try:
+                    out, ovf_vec = fn(self._inputs(), qblock)
+                except ArtifactStale:
+                    # catalog drift under a hydrated bucket executable:
+                    # drop it and redrive through a clean rebuild
+                    self._batched.pop(bucket, None)
+                    self.recompile()
+                    continue
             hovf, hcols, hvalid, hsel = jax.device_get(
                 (ovf_vec, out.cols, out.valid, out.sel))
             overflows = self._overflows(np.asarray(hovf).max(axis=0))
@@ -3496,7 +3560,7 @@ class DeviceResult:
             p.params.bump(overflows)
             p.recompile()
             checkpoint()
-            self._out, self._ovf = p.jitted(p._inputs(), self._qparams)
+            self._out, self._ovf = p.jit_call(p._inputs(), self._qparams)
 
     @property
     def nrows(self) -> int:

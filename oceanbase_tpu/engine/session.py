@@ -330,6 +330,24 @@ class Session:
         key = (id(self.catalog), norm_key, pz.sig, pz.baked, fp, extra)
         return key, tables, fp
 
+    def _artifact_key(self, norm_key: str, pz, fp: str, tables,
+                      executor=None) -> tuple | None:
+        """Restart-stable identity of a compiled artifact: the logical
+        cache key minus process-local ids — id(catalog) drops (the store
+        is scoped per database), and a PX override contributes its shard
+        count instead of its executor's object id. The schema/dict
+        versions in `extra` still invalidate exactly like the in-memory
+        key."""
+        extra = self.key_extra_fn(tables) if self.key_extra_fn is not None \
+            else ()
+        tag: tuple = ()
+        if executor is not None and executor is not self.executor:
+            nsh = getattr(executor, "nsh", 0)
+            if not nsh:
+                return None  # unknown override: don't risk a collision
+            tag = ("#px", int(nsh))
+        return (norm_key, pz.sig, pz.baked, fp, extra, tag)
+
     def _emit_px_spans(self, prepared, start: float, end: float) -> None:
         """Per-DFO / per-shard worker spans for a PX execution, stitched
         under the active statement span. Works for CACHED plans too: the
@@ -408,6 +426,28 @@ class Session:
                      else True)
         h2d0 = ex.h2d_bytes if profiling else 0
         compile_s = 0.0
+        # on-disk artifact tier: a logical miss tries to hydrate the
+        # exported executable before paying a compile. JSON-split
+        # statements stay memory-only (their host formatting spec rides
+        # the entry, not the executable).
+        art_store = getattr(self.plan_cache, "artifact_store", None)
+        art_key = None
+        if art_store is not None and use_cache and not jspecs:
+            art_key = self._artifact_key(norm_key, pz, fp, tables, executor)
+        hydrated = False
+        if entry is None and art_key is not None and art_store.readable:
+            t0 = time.perf_counter()
+            got = art_store.hydrate(art_store.key_id(art_key), ex)
+            if got is not None:
+                _meta, prepared = got
+                compile_s = time.perf_counter() - t0
+                entry = CacheEntry(prepared, planned.output_names, pz.dtypes)
+                entry.json_specs, entry.json_hidden = jspecs, jhidden
+                if self.plan_monitor is not None and self.plan_monitor.enabled:
+                    entry.monitor = self.plan_monitor.register(
+                        norm_key, compile_s)
+                self.plan_cache.put(key, entry)
+                hydrated = True
         if entry is None:
             t0 = time.perf_counter()
             prepared = ex.prepare(pz.plan)
@@ -437,6 +477,30 @@ class Session:
                 base_values=tuple(pz.values),
                 stmt_type=type(ast).__name__,
             ))
+        # artifact export AFTER a successful execution of a FRESH compile
+        # (a hit/hydrate already has its executable on disk). The fast-
+        # tier registration material rides the artifact so a warm boot
+        # restores the text tier too.
+        if art_key is not None and not was_hit and not hydrated \
+                and art_store.writable:
+            art_fast = art_text = None
+            if fast_reg is not None and executor is None:
+                fkey, params, kinds = fast_reg
+                art_text = fkey
+                art_fast = dict(
+                    norm_key=norm_key, sig=pz.sig, baked=pz.baked,
+                    fingerprint=fp, tables=tables,
+                    slot_map=build_slot_map(params, kinds, pz.values),
+                    base_values=tuple(pz.values),
+                    stmt_type=type(ast).__name__,
+                )
+            try:
+                art_store.save(
+                    art_key, entry.prepared,
+                    output_names=planned.output_names, dtypes=pz.dtypes,
+                    tables=tables, fast=art_fast, text_key=art_text)
+            except Exception:
+                pass
         return rs
 
     def _execute_entry(self, entry, values, *, ex, was_hit, fast, plan_s,

@@ -170,6 +170,7 @@ class PxExecutor(Executor):
             join_bloom=self.join_bloom,
             bloom_max_bits=self.bloom_max_bits,
             hybrid_hash=self.hybrid_hash,
+            access=self.access,
         )
 
     def _affine_build_info(self, op):
@@ -185,7 +186,7 @@ class PxExecutor(Executor):
                  bloom_max_bits: int = 1 << 20,
                  hybrid_hash: "bool | str" = "auto", stats=None,
                  device_budget=None, chunk_rows=None,
-                 tracer=None, metrics=None):
+                 tracer=None, metrics=None, access=None):
         if stats is None:
             # histogram-backed cardinalities drive the exchange-method
             # choice (broadcast-vs-hash cost, skew-triggered hybrid hash)
@@ -206,6 +207,11 @@ class PxExecutor(Executor):
         # of the reference's runtime sampling datahub decision,
         # ob_sql_define.h:393); True forces it, False disables
         self.hybrid_hash = hybrid_hash
+        # workload repository (server/workload.TableAccessStats): observed
+        # NDV / heavy-hitter evidence consulted by the skew heuristic
+        # BEFORE the optimizer histograms — measured key frequencies beat
+        # quantile-edge inference (JSPIM's sampled skew detection)
+        self.access = access
         self._dist: dict[int, str] = {}
         # observability hooks (server/diag.Tracer + share/metrics registry).
         # Exchange helpers run INSIDE traced shard_map code, so accounting
@@ -786,6 +792,16 @@ class PxExecutor(Executor):
         alias, col = name.split(".", 1)
         if alias != node.alias:
             return False
+        # runtime evidence first: the workload repository's measured
+        # NDV / heavy-hitter fraction for this key column. One observed
+        # value carrying >= 2/nsh of the rows overloads its shard's fair
+        # lane 2x under plain hash distribution — exactly the condition
+        # the quantile-edge walk below infers, but measured, not inferred
+        if self.access is not None:
+            ev = self.access.key_evidence(
+                node.table, col, self.catalog.get(node.table))
+            if ev is not None and ev[1] >= 2.0 / self.nsh:
+                return True
         ts = self.stats.table_stats(node.table)
         cs = ts.cols.get(col) if ts is not None else None
         if cs is None or cs.edges is None:
@@ -968,6 +984,7 @@ class PxExecutor(Executor):
 
     # ------------------------------------------------------ compilation
     def compile(self, plan, params):
+        self.compiles += 1
         nodes = _number_nodes(plan)
         id_of = {id(o): i for i, o in nodes.items()}
         needed = self._needed_columns(plan)

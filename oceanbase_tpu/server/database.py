@@ -383,6 +383,13 @@ class Database:
         self._active_stmts: dict[int, tuple] = {}
         self._stmt_seq = itertools.count(1)
         self.config = Config()
+        # re-apply persisted parameter values (see _save_node_meta): a
+        # restarted node keeps its ALTER SYSTEM SET state
+        for _cn, _cv in ((restored_meta or {}).get("config") or {}).items():
+            try:
+                self.config.set(_cn, _cv)
+            except Exception:
+                pass
         self.location = LocationService(
             self.cluster.leader_node,
             ttl=10.0,
@@ -541,6 +548,12 @@ class Database:
         from .layout_advisor import LayoutAdvisor
 
         self.layout_advisor = LayoutAdvisor(self)
+        # re-install persisted encoding picks (advisor view + live
+        # tablets): dump-time FOR/RLE/const choices survive a restart
+        for (_ht, _hc), _hv in (
+                (restored_meta or {}).get("enc_hints") or {}).items():
+            self.layout_advisor.encoding_hints[(_ht, _hc)] = _hv
+            self.layout_advisor._push_encoding(_ht, _hc, _hv)
         # table -> advisor-set residency priority (higher = evict later);
         # _enforce_memory and the block cache's eviction consult it
         self.residency_priority: dict[str, float] = {}
@@ -701,6 +714,26 @@ class Database:
         # cluster-wide worker grant before a PX statement may run
         self._px_admission_obj = None
         self._ddl_lock = threading.RLock()
+        # persistent compiled-plan artifacts (engine/plan_artifact.py):
+        # when ob_plan_artifact_mode != off, exported executables live
+        # under plan_artifact_dir (default <data_dir>/plan_artifacts) and
+        # boot warm-loads the hottest digests — ranked by the workload
+        # repository's statement summaries, bounded by
+        # plan_artifact_max_bytes — so a rebooted node serves cached
+        # statements with ZERO engine traces
+        self.plan_artifact = None
+        self.config.on_change(
+            "ob_plan_artifact_mode",
+            lambda _n, _o, _v: self._reconfigure_plan_artifacts())
+        self.config.on_change(
+            "plan_artifact_dir",
+            lambda _n, _o, _v: self._reconfigure_plan_artifacts())
+        self.config.on_change(
+            "plan_artifact_max_bytes",
+            lambda _n, _o, v: setattr(self.plan_artifact, "max_bytes",
+                                      int(v))
+            if self.plan_artifact is not None else None)
+        self._reconfigure_plan_artifacts()
         # re-materialize restored mviews against the recovered base data
         # (failures keep the registration: REFRESH can retry once the
         # base objects are available again)
@@ -798,6 +831,21 @@ class Database:
             "trigger_specs": dict(self._trigger_specs),
             "procedures": dict(self._procedure_texts),
             "sequences": {k: dict(v) for k, v in self._sequences.items()},
+            # non-default parameter values: ObConfigManager persists its
+            # config file (etc/observer.config.bin), so ALTER SYSTEM SET
+            # survives a restart — the plan-artifact warm boot depends on
+            # its mode parameter still being rw after the reboot
+            "config": (
+                {n: v for n, v, p in self.config.snapshot()
+                 if v != p.default}
+                if getattr(self, "config", None) is not None else {}
+            ),
+            # advisor encoding picks: the dump path re-applies them on the
+            # restarted node even before the advisor re-learns the workload
+            "enc_hints": (
+                dict(self.layout_advisor.encoding_hints)
+                if getattr(self, "layout_advisor", None) is not None else {}
+            ),
             # undecided XA branches: belt-and-braces alongside log replay
             # (covers an XA_PREPARE recycled below a later checkpoint)
             "xa_registry": {
@@ -942,6 +990,21 @@ class Database:
         b = getattr(self, "batcher", None)
         if b is not None:
             b.shutdown()
+        pa = getattr(self, "plan_artifact", None)
+        if pa is not None:
+            # fold this boot's statement-summary exec counts into the
+            # artifact ranking index so the NEXT boot warm-loads the
+            # hottest digests first
+            try:
+                pa.sync_exec_counts(self.stmt_summary.snapshot())
+            except Exception:
+                pass
+            # queued XLA-cache primes must land before the next boot
+            # reads them, or the first warm boot re-pays the compile
+            try:
+                pa.drain()
+            except Exception:
+                pass
         for group in self.cluster.ls_groups.values():
             for rep in group.values():
                 if rep.palf.store is not None:
@@ -970,6 +1033,7 @@ class Database:
                 stats=self.engine.stats,
                 tracer=self.tracer,
                 metrics=self.metrics,
+                access=self.access,
             )
         return self._px_executor_obj
 
@@ -1001,6 +1065,92 @@ class Database:
             if ti is not None:
                 out.append((t, ti.schema_version, ti.dict_sig))
         return tuple(out)
+
+    # ---------------------------------------------- plan artifact store
+    def _reconfigure_plan_artifacts(self) -> None:
+        """(Re)wire the on-disk plan-artifact tier from config. Called at
+        boot and on ob_plan_artifact_mode / plan_artifact_dir changes."""
+        import os
+
+        mode = self.config["ob_plan_artifact_mode"]
+        adir = str(self.config["plan_artifact_dir"] or "")
+        if not adir and self.data_dir is not None:
+            adir = os.path.join(self.data_dir, "plan_artifacts")
+        if mode == "off" or not adir:
+            self.plan_artifact = None
+            self.plan_cache.artifact_store = None
+            return
+        store = self.plan_artifact
+        if store is not None and store.root == adir:
+            store.mode = mode
+            self.plan_cache.artifact_store = store
+            return
+        from ..engine.plan_artifact import PlanArtifactStore
+
+        store = PlanArtifactStore(
+            adir, mode=mode,
+            max_bytes=self.config["plan_artifact_max_bytes"],
+            metrics=self.metrics)
+        self.plan_artifact = store
+        self.plan_cache.artifact_store = store
+        self._warm_boot_plan_artifacts()
+
+    def _warm_boot_plan_artifacts(self) -> None:
+        """Boot-time warm load: hydrate the hottest exported executables
+        — ranked by the statement-summary exec counts persisted in the
+        store index — until the byte budget is spent. Each hydrated entry
+        lands in the plan cache under the same logical key the session
+        computes, so the first execution of that statement is a plain
+        cache hit: zero engine traces, and the backend compile of the
+        deserialized program comes out of the XLA persistent cache."""
+        from ..sql.plan_cache import CacheEntry, FastEntry
+
+        store = self.plan_artifact
+        if store is None or not store.readable:
+            return
+        budget = int(store.max_bytes)
+        spent = loaded = 0
+        for aid, info in store.ranked():
+            nbytes = int(info.get("bytes", 0))
+            if spent + nbytes > budget:
+                continue
+            meta = store.read_meta(aid)
+            if meta is None:
+                continue
+            ex = self.engine.executor
+            if meta.px_nsh:
+                try:
+                    ex = self._px_executor()
+                except Exception:
+                    continue
+                if getattr(ex, "nsh", 0) != meta.px_nsh:
+                    continue  # mesh shape moved; entry stays for ro tools
+            got = store.hydrate(aid, ex, key_extra_fn=self._key_extra,
+                                meta=meta)
+            if got is None:
+                continue
+            meta, prepared = got
+            extra = self._key_extra(meta.tables)
+            if meta.px_nsh:
+                extra = (*extra, "#exec", id(ex))
+            key = (id(self.catalog), meta.art_key[0], meta.art_key[1],
+                   meta.art_key[2], meta.art_key[3], extra)
+            if self.plan_cache.get(key, count_miss=False) is None:
+                entry = CacheEntry(prepared, tuple(meta.output_names),
+                                   list(meta.dtypes))
+                entry.json_specs, entry.json_hidden = (), ()
+                self.plan_cache.put(key, entry)
+            if meta.fast and meta.text_key:
+                try:
+                    self.plan_cache.fast_put(
+                        meta.text_key, FastEntry(**meta.fast))
+                except Exception:
+                    pass
+            spent += nbytes
+            loaded += 1
+        if loaded:
+            self.metrics.add("plan artifact warm load", loaded)
+            self.metrics.add("plan artifact warm bytes", spent)
 
     def refresh_virtual(self, names) -> bool:
         """Materialize referenced __all_virtual_* tables for this statement.
@@ -2612,6 +2762,8 @@ class DbSession:
                 self.db.config.set(stmt.name, stmt.value)
             except ConfigError as e:
                 raise SqlError(str(e)) from None
+            if self.db.data_dir is not None:
+                self.db._save_node_meta()  # config survives restart
             return ResultSet((), {})
         if isinstance(stmt, A.RunLayoutAdvisor):
             recs = self.db.layout_advisor.run()

@@ -383,10 +383,26 @@ class LayoutAdvisor:
                 applied += 1
             elif r.action == "set_encoding":
                 self.encoding_hints[(r.table, r.column)] = r.detail
+                self._push_encoding(r.table, r.column, r.detail)
                 r.status = "applied"
                 applied += 1
         if applied:
             self.db.metrics.add("layout advisor actions applied", applied)
+
+    def _push_encoding(self, table: str, column: str, encoding: str) -> None:
+        """Install the hint on every replica tablet of the table: the next
+        dump/compaction writes its blocks with the chosen encoding, which
+        is how the advisor's FOR/RLE/const picks persist on disk (and so
+        across restarts — enc_hints also rides node_meta)."""
+        db = self.db
+        ti = db.tables.get(table)
+        if ti is None:
+            return
+        for pls, ptab in ti.all_partitions():
+            for rep in db.cluster.ls_groups.get(pls, {}).values():
+                t = rep.tablets.get(ptab)
+                if t is not None:
+                    t.enc_hints[column] = encoding
 
     def _queue_rebuild(self, table: str, key_col: str,
                        cols=None) -> bool:

@@ -617,6 +617,30 @@ def _layout_advisor(db) -> Table:
     ])
 
 
+def _plan_artifact(db) -> Table:
+    """On-disk compiled-plan artifact tier (engine/plan_artifact.py):
+    one row per exported executable — identity, byte cost, statement-
+    summary exec ranking, exported batch buckets, and this boot's
+    hydration hit/miss/load-time tallies. `warm` = 1 means the live
+    plan-cache entry is backed by this artifact (hydrated, not
+    compiled)."""
+    store = getattr(db, "plan_artifact", None)
+    rows = store.census() if store is not None else []
+    return _t("__all_virtual_plan_artifact", [
+        ("artifact_id", DataType.varchar(),
+         [r["artifact_id"] for r in rows]),
+        ("statement", DataType.varchar(), [r["statement"] for r in rows]),
+        ("bytes", DataType.int64(), [r["bytes"] for r in rows]),
+        ("execs", DataType.int64(), [r["execs"] for r in rows]),
+        ("buckets", DataType.varchar(),
+         [",".join(str(b) for b in r["buckets"]) for r in rows]),
+        ("hits", DataType.int64(), [r["hits"] for r in rows]),
+        ("misses", DataType.int64(), [r["misses"] for r in rows]),
+        ("load_us", DataType.int64(), [r["load_us"] for r in rows]),
+        ("warm", DataType.int64(), [r["warm"] for r in rows]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -661,4 +685,5 @@ PROVIDERS = {
     "__all_virtual_tenant_qos": _tenant_qos,
     "__all_virtual_alert_history": _alert_history,
     "__all_virtual_layout_advisor": _layout_advisor,
+    "__all_virtual_plan_artifact": _plan_artifact,
 }

@@ -472,11 +472,21 @@ class StatementSummaryRegistry:
 # --------------------------------------------------------------------------
 
 
+# stride-sample cap for key_evidence (same budget as share/stats.py)
+_EVIDENCE_CAP = 1 << 16
+
+
 @dataclass(slots=True)
 class ColumnAccess:
     column: str
     # [filter, join, group, sort] reference counts (ROLE_* indices)
     counts: list = field(default_factory=lambda: [0, 0, 0, 0])
+    # measured key-skew evidence (key_evidence): sampled distinct count and
+    # the sample fraction held by the single heaviest value, cached against
+    # the snapshot Table identity so a memtable flush re-measures
+    ndv: float = 0.0
+    top_frac: float = 0.0
+    evidence_snap: object = None
 
 
 @dataclass(slots=True)
@@ -562,6 +572,44 @@ class TableAccessStats:
                 t = self._tables[table] = TableAccess(table)
             t.das_lookups += 1
             t.das_rows += rows
+
+    def key_evidence(self, table: str, col: str,
+                     table_obj=None) -> tuple[float, float] | None:
+        """Measured join-key skew evidence: (sampled NDV, fraction of the
+        sample held by the single heaviest value) for `col` of `table`,
+        from a stride sample of the live snapshot column. Returns None
+        when the column is absent, non-numeric, or empty. Cached against
+        the snapshot Table identity — a memtable flush installs a new
+        Table object, so evidence re-measures exactly when data moved."""
+        if table_obj is None:
+            return None
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                t = self._tables[table] = TableAccess(table)
+            c = t.cols.get(col)
+            if c is None:
+                c = t.cols[col] = ColumnAccess(col)
+            if c.evidence_snap is table_obj:
+                return (c.ndv, c.top_frac) if c.ndv > 0 else None
+        import numpy as np
+
+        ndv, top_frac = 0.0, 0.0
+        arr = getattr(table_obj, "data", {}).get(col)
+        if arr is not None and arr.dtype.kind in "iufb":
+            nn = np.asarray(arr)
+            valid = getattr(table_obj, "valid", {}).get(col)
+            if valid is not None:
+                nn = nn[np.asarray(valid, dtype=bool)]
+            if len(nn) > _EVIDENCE_CAP:
+                nn = nn[:: len(nn) // _EVIDENCE_CAP]
+            if len(nn):
+                _, counts = np.unique(nn, return_counts=True)
+                ndv = float(len(counts))
+                top_frac = float(counts.max()) / float(len(nn))
+        with self._lock:
+            c.ndv, c.top_frac, c.evidence_snap = ndv, top_frac, table_obj
+        return (ndv, top_frac) if ndv > 0 else None
 
     def snapshot(self) -> list[dict]:
         with self._lock:

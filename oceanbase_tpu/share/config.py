@@ -212,6 +212,21 @@ def default_params() -> list[Param]:
               "budget for advisor-materialized layouts (sorted "
               "projections); candidates over budget are narrowed to the "
               "role-referenced columns, then rejected"),
+        # plan artifact store (engine/plan_artifact.py)
+        Param("ob_plan_artifact_mode", "str", "off",
+              "persistent compiled-plan artifacts: off (memory-only plan "
+              "cache), ro (hydrate executables from disk, never write), "
+              "rw (also export on compile and re-export on overflow "
+              "recompile)",
+              choices=("off", "ro", "rw")),
+        Param("plan_artifact_dir", "str", "",
+              "artifact store directory; empty resolves to "
+              "<data_dir>/plan_artifacts (in-memory clusters need an "
+              "explicit path for warm restarts to mean anything)"),
+        Param("plan_artifact_max_bytes", "capacity", 256 << 20,
+              "byte budget for exported executables on disk and for the "
+              "boot-time warm-load of the hottest digests; coldest "
+              "artifacts evict beyond it"),
         # storage
         Param("block_cache_size", "capacity", 256 << 20,
               "budget for decoded micro-block column cache"),

@@ -466,6 +466,10 @@ class PlanCache:
         # tenant metrics registry (share/metrics): mirrors hit/miss/evict
         # into __all_virtual_sysstat next to every other engine stat
         self.metrics = metrics
+        # on-disk tier (engine/plan_artifact.PlanArtifactStore) wired by
+        # the server when ob_plan_artifact_mode != off: misses hydrate
+        # exported executables from it, flush() covers it
+        self.artifact_store = None
 
     def __len__(self):
         with self._lock:
@@ -581,11 +585,16 @@ class PlanCache:
             for k, e in self._entries.items():
                 memo = getattr(e.prepared, "_dev_bytes_memo", None)
                 batched = getattr(e.prepared, "_batched", None)
+                aref = getattr(e.prepared, "artifact_ref", None)
                 logical.append({
                     "norm_key": k[1],
                     "hits": e.hits,
                     "buckets": tuple(sorted(batched)) if batched else (),
                     "dev_bytes": int(memo[2]) if memo is not None else 0,
+                    # artifact tier: which on-disk executable backs this
+                    # entry, and whether it was hydrated (vs compiled)
+                    "artifact_id": aref[1] if aref is not None else "",
+                    "warm": int(not getattr(e.prepared, "_traceable", True)),
                 })
             fast = [
                 {"text_key": k, "hits": fe.hits,
@@ -607,3 +616,8 @@ class PlanCache:
                     self.metrics.add(
                         "plan cache fast invalidation", len(self._fast))
                 self._fast.clear()
+            # the artifact tier flushes with the in-memory tiers: an
+            # exported executable surviving a schema-driven flush would
+            # hydrate a plan compiled against a dead schema
+            if self.artifact_store is not None:
+                self.artifact_store.flush()

@@ -23,7 +23,8 @@ from .memtable import Memtable
 from .sstable import OP_COL, OP_PUT, VERSION_COL, SSTable, write_sstable
 
 
-def freeze_to_mini(mt: Memtable, block_rows: int = 16384) -> bytes:
+def freeze_to_mini(mt: Memtable, block_rows: int = 16384,
+                   enc_hints: dict | None = None) -> bytes:
     """Dump a frozen memtable into a mini sstable blob."""
     if not mt.frozen:
         raise RuntimeError("memtable must be frozen before dump")
@@ -32,6 +33,7 @@ def freeze_to_mini(mt: Memtable, block_rows: int = 16384) -> bytes:
     return write_sstable(
         mt.schema, mt.key_cols, data, versions, ops,
         base_version=lo, end_version=hi, block_rows=block_rows,
+        enc_hints=enc_hints,
     )
 
 
@@ -84,6 +86,7 @@ def minor_compact(
     sstables: list[SSTable],
     recycle_version: int = 0,
     block_rows: int = 16384,
+    enc_hints: dict | None = None,
 ) -> bytes:
     """Merge delta sstables (oldest -> newest) into one multi-version delta.
 
@@ -102,6 +105,7 @@ def minor_compact(
     return write_sstable(
         schema, key_cols, data, versions, ops,
         base_version=lo, end_version=hi, block_rows=block_rows,
+        enc_hints=enc_hints,
     )
 
 
@@ -111,6 +115,7 @@ def major_compact(
     sstables: list[SSTable],
     snapshot: int,
     block_rows: int = 16384,
+    enc_hints: dict | None = None,
 ) -> bytes:
     """Flatten all sources at `snapshot`: newest committed version per key,
     tombstones dropped. Produces the new base (one version per key)."""
@@ -123,4 +128,5 @@ def major_compact(
     return write_sstable(
         schema, key_cols, data, versions[keep], ops[keep],
         base_version=0, end_version=snapshot, block_rows=block_rows,
+        enc_hints=enc_hints,
     )

@@ -136,6 +136,27 @@ def choose_encoding(a: np.ndarray, stats: ColumnStats) -> tuple[int, dict]:
     return ENC_RAW, {}
 
 
+_HINT_ENCS = {"raw": ENC_RAW, "const": ENC_CONST, "for": ENC_FOR,
+              "rle": ENC_RLE}
+
+
+def hinted_encoding(a: np.ndarray, stats: ColumnStats,
+                    hint: str) -> tuple[int, dict] | None:
+    """Resolve an advisor encoding hint ("for"/"rle"/"const"/"raw") to
+    (enc, params), or None when the hint cannot be honored losslessly on
+    THIS block — a hint is a cost-model preference, never a correctness
+    override (e.g. "const" on a block that stopped being constant)."""
+    e = _HINT_ENCS.get(hint)
+    if e is None or len(a) == 0 or not np.issubdtype(a.dtype, np.integer):
+        return None
+    if e == ENC_CONST:
+        return (ENC_CONST, {}) if stats.vmin == stats.vmax else None
+    if e == ENC_FOR:
+        return ENC_FOR, {"min": stats.vmin,
+                         "width": _for_width(stats.vmax - stats.vmin)}
+    return e, {}
+
+
 # ------------------------------------------------------------- encoders
 
 def encode_column(a: np.ndarray, enc: int, params: dict) -> bytes:

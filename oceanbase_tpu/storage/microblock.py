@@ -65,25 +65,31 @@ class ColumnZone:
 
 def write_block(
     columns: list[np.ndarray], valids: list[np.ndarray | None],
-    compress: bool = True,
+    compress: bool = True, hints: list | None = None,
 ) -> tuple[bytes, list[ColumnZone]]:
-    """Encode one micro block; returns (bytes, per-column zone maps)."""
+    """Encode one micro block; returns (bytes, per-column zone maps).
+    `hints` (aligned to `columns`) carries per-column advisor encoding
+    preferences — honored when lossless for the block, else the cost
+    model decides as usual."""
     nrows = len(columns[0]) if columns else 0
     descs = []
     streams: list[bytes] = []
     zones: list[ColumnZone] = []
     pos = 0
-    for a, valid in zip(columns, valids):
+    for i, (a, valid) in enumerate(zip(columns, valids)):
         a = np.ascontiguousarray(a)
+        hint = hints[i] if hints is not None else None
         if a.dtype == np.bool_:
             a8 = a.astype(np.int8)
             stats = enc.analyze_ints(a8)
-            e, params = enc.choose_encoding(a8, stats)
+            picked = enc.hinted_encoding(a8, stats, hint) if hint else None
+            e, params = picked or enc.choose_encoding(a8, stats)
             data = enc.encode_column(a8, e, params)
             zones.append(ColumnZone(stats.vmin, stats.vmax))
         elif np.issubdtype(a.dtype, np.integer):
             stats = enc.analyze_ints(a)
-            e, params = enc.choose_encoding(a, stats)
+            picked = enc.hinted_encoding(a, stats, hint) if hint else None
+            e, params = picked or enc.choose_encoding(a, stats)
             data = enc.encode_column(a, e, params)
             zones.append(ColumnZone(stats.vmin, stats.vmax))
         else:

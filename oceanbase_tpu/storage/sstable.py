@@ -106,14 +106,20 @@ def write_sstable(
     base_version: int = 0,
     end_version: int = 0,
     block_rows: int = DEFAULT_BLOCK_ROWS,
+    enc_hints: dict | None = None,
 ) -> bytes:
-    """Build an sstable blob. Rows MUST be sorted by (rowkey, -version)."""
+    """Build an sstable blob. Rows MUST be sorted by (rowkey, -version).
+    `enc_hints` maps column name -> advisor encoding preference
+    ("for"/"rle"/"const"/"raw"), applied per block where lossless."""
     names = schema.names()
     cols = [np.ascontiguousarray(data[n]) for n in names]
     cols.append(versions.astype(np.int64))
     cols.append(ops.astype(np.int8))
     valids = valids or {}
     vlist: list[np.ndarray | None] = [valids.get(n) for n in names] + [None, None]
+    # per-column hint list aligned to cols (version/op streams un-hinted)
+    hlist = ([enc_hints.get(n) for n in names] + [None, None]
+             if enc_hints else None)
     n = len(versions)
     key_idx = [schema.index(k) for k in key_cols]
 
@@ -129,7 +135,7 @@ def write_sstable(
         else:
             bcols = [c[start:end] for c in cols]
             bval = [v[start:end] if v is not None else None for v in vlist]
-        blob, zones = write_block(bcols, bval)
+        blob, zones = write_block(bcols, bval, hints=hlist)
         blocks.append(blob)
         # Zone bounds are stored as float64; ints above 2^53 round to nearest,
         # which could wrongly EXCLUDE a boundary value. Round outward so zone

@@ -49,6 +49,10 @@ class Tablet:
     deltas: list[SSTable] = field(default_factory=list)  # oldest -> newest
     base: SSTable | None = None
     cache: object = None  # share/cache.KVCache for decoded blocks
+    # column -> advisor encoding preference ("for"/"rle"/"const"/"raw"),
+    # applied at every dump/compaction so the choice persists on disk;
+    # rides checkpoints through __getstate__ like the rest of the tablet
+    enc_hints: dict = field(default_factory=dict)
     _meta_lock: threading.RLock = field(default_factory=threading.RLock)
     # serializes whole maintenance operations (dump/minor/major) so two dag
     # workers cannot dump the same frozen memtable or compact the same
@@ -70,6 +74,7 @@ class Tablet:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
+        self.__dict__.setdefault("enc_hints", {})  # pre-hint checkpoints
         self._meta_lock = threading.RLock()
         self._maint_lock = threading.RLock()
 
@@ -176,7 +181,7 @@ class Tablet:
                 if not self.frozen:
                     return None
                 mt = self.frozen[0]
-            blob = freeze_to_mini(mt)
+            blob = freeze_to_mini(mt, enc_hints=self.enc_hints or None)
             st = SSTable(blob, self.schema, self.key_cols, cache=self.cache)
             with self._meta_lock:
                 self.deltas.append(st)
@@ -189,7 +194,9 @@ class Tablet:
                 victims = list(self.deltas)
             if len(victims) < 2:
                 return None
-            blob = minor_compact(self.schema, self.key_cols, victims, recycle_version)
+            blob = minor_compact(self.schema, self.key_cols, victims,
+                                 recycle_version,
+                                 enc_hints=self.enc_hints or None)
             st = SSTable(blob, self.schema, self.key_cols, cache=self.cache)
             with self._meta_lock:
                 kept = [d for d in self.deltas if d not in victims]
@@ -201,7 +208,8 @@ class Tablet:
         with self._maint_lock:
             with self._meta_lock:
                 srcs = ([self.base] if self.base else []) + list(self.deltas)
-            blob = major_compact(self.schema, self.key_cols, srcs, snapshot)
+            blob = major_compact(self.schema, self.key_cols, srcs, snapshot,
+                                 enc_hints=self.enc_hints or None)
             st = SSTable(blob, self.schema, self.key_cols, cache=self.cache)
             with self._meta_lock:
                 self.deltas = [d for d in self.deltas if d not in srcs]

@@ -371,6 +371,90 @@ def run_chaos(seed: int = 7, statements: int = 120,
     return report
 
 
+# ------------------------------------------------------- failover warm boot
+
+
+def failover_warmboot_leg(verbose: bool = False) -> dict:
+    """Failover A/B: the serving node dies and restarts from its durable
+    state; measure time-to-first-warm-hit — boot plus the first statement
+    of the pre-crash workload — with the plan artifact store on (rw) vs
+    off. With artifacts on, the restarted node hydrates exported
+    executables (and warm-loads the hottest digests at boot), so the
+    first statement reuses a compiled plan instead of re-tracing; both
+    legs must return the exact pre-crash rows."""
+    import shutil
+    import tempfile
+    import time
+
+    from oceanbase_tpu.server import Database
+
+    queries = [
+        # the pre-crash hot statement is a join + group-by: heavy enough
+        # to trace+compile that re-deriving it dominates a cold restart
+        "select k.v % 7 as g, count(*) as c, sum(k.v + d.w) as s "
+        "from chaos_kv k join chaos_dim d on k.v = d.k "
+        "where k.id > 3 group by g order by s desc",
+        "select count(*) as n, sum(v) as s from chaos_kv",
+        "select id, v from chaos_kv where id > 10 order by id",
+        "select v % 7 as g, count(*) as c from chaos_kv group by g order by g",
+    ]
+    out: dict = {}
+    # off first: the rw leg points the process-global XLA cache into its
+    # (temporary) store directory, which is gone by the other leg's turn
+    for mode in ("off", "rw"):
+        d = tempfile.mkdtemp(prefix=f"chaos_warmboot_{mode}_")
+        try:
+            db = Database(n_nodes=1, n_ls=1, data_dir=d, fsync=False)
+            s = db.session()
+            if mode == "rw":
+                s.sql("alter system set ob_plan_artifact_mode = 'rw'")
+            s.sql("create table chaos_kv "
+                  "(id bigint primary key, v bigint not null)")
+            s.sql("create table chaos_dim "
+                  "(k bigint primary key, w bigint not null)")
+            s.sql("insert into chaos_kv values " + ", ".join(
+                f"({i}, {i * 37 % 1000})" for i in range(1, 257)))
+            s.sql("insert into chaos_dim values " + ", ".join(
+                f"({i}, {i * 3})" for i in range(1000)))
+            rows0 = [s.sql(q).rows() for q in queries]
+            db._save_node_meta()
+            db.close()  # the "crash": serving state is gone, disk survives
+
+            t0 = time.perf_counter()
+            db2 = Database(n_nodes=1, n_ls=1, data_dir=d, fsync=False)
+            boot_s = time.perf_counter() - t0
+            s2 = db2.session()
+            ex = db2.engine.executor
+            c0 = ex.compiles + ex.batched_compiles
+            t1 = time.perf_counter()
+            first_rows = s2.sql(queries[0]).rows()
+            first_s = time.perf_counter() - t1
+            compiles = (ex.compiles + ex.batched_compiles) - c0
+            rows1 = [first_rows] + [s2.sql(q).rows() for q in queries[1:]]
+            snap = db2.metrics.counters_snapshot()
+            out[mode] = {
+                "boot_s": round(boot_s, 4),
+                "first_stmt_s": round(first_s, 4),
+                "time_to_first_warm_hit_s": round(boot_s + first_s, 4),
+                "first_stmt_compiles": compiles,
+                "artifact_hits": int(snap.get("plan artifact hit", 0)),
+                "artifact_warm_loads": int(
+                    snap.get("plan artifact warm load", 0)),
+                "rows_identical": rows1 == rows0,
+            }
+            db2.close()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    on, off = out["rw"], out["off"]
+    out["speedup_x"] = round(
+        off["time_to_first_warm_hit_s"]
+        / max(on["time_to_first_warm_hit_s"], 1e-9), 3)
+    if verbose:
+        for mode in ("rw", "off"):
+            print(f"  artifact={mode}: {out[mode]}")
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -379,8 +463,27 @@ def main() -> int:
                     help="no kills/partitions (drop pulses + errsim only)")
     ap.add_argument("--no-errsim", action="store_true")
     ap.add_argument("--query-timeout-us", type=int, default=None)
+    ap.add_argument("--failover-warmboot", action="store_true",
+                    help="A/B leg: restart time-to-first-warm-hit with the "
+                         "plan artifact store on (rw) vs off")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
+    if args.failover_warmboot:
+        leg = failover_warmboot_leg(verbose=args.verbose)
+        on, off = leg["rw"], leg["off"]
+        print(
+            "failover warm boot: artifact-on "
+            f"ttfwh={on['time_to_first_warm_hit_s']}s "
+            f"(compiles={on['first_stmt_compiles']}, "
+            f"hits={on['artifact_hits']}) vs artifact-off "
+            f"ttfwh={off['time_to_first_warm_hit_s']}s "
+            f"(compiles={off['first_stmt_compiles']}) "
+            f"-> {leg['speedup_x']}x"
+        )
+        ok = (on["rows_identical"] and off["rows_identical"]
+              and on["first_stmt_compiles"] == 0
+              and on["artifact_hits"] > 0)
+        return 0 if ok else 1
     rep = run_chaos(
         seed=args.seed, statements=args.statements,
         structural=not args.no_structural,

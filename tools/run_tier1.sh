@@ -4,7 +4,8 @@
 # 8 virtual devices via conftest.py), skips slow-marked tests, and
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
-#   tools/run_tier1.sh [--chaos] [--latency] [--serve] [extra pytest args...]
+#   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
+#                      [--advisor] [--warmboot] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -42,6 +43,13 @@
 # must not duplicate them, and tools/health_report.py must replay the
 # dump with exit code 0.
 #
+# --warmboot additionally runs the warm-restart smoke
+# (tools/warmboot_smoke.py): cold vs artifact-warm restart on the same
+# data and statement set — the warm replay must perform zero new JIT
+# compiles, return bit-identical rows, and reach warm serving >= 5x
+# faster than the cold leg; the JSON summary (with provenance) lands in
+# $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -59,6 +67,7 @@ serve=0
 awr=0
 health=0
 advisor=0
+warmboot=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -67,6 +76,7 @@ while true; do
         --awr) awr=1; shift ;;
         --health) health=1; shift ;;
         --advisor) advisor=1; shift ;;
+        --warmboot) warmboot=1; shift ;;
         *) break ;;
     esac
 done
@@ -122,6 +132,11 @@ fi
 
 if [ "$advisor" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/layout_advisor_smoke.py
+    rc=$?
+fi
+
+if [ "$warmboot" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/warmboot_smoke.py
     rc=$?
 fi
 exit $rc

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Warm-boot smoke: persistent compiled-plan artifacts end to end.
+
+Two legs on identical data, identical statement sets, separate data
+dirs. Each leg seeds a node (DDL + DML + one serving pass that compiles
+every statement), saves durable state, "crashes" it, then restarts and
+replays the statement set once. Time-to-warm-serving is boot plus that
+first full replay — the moment every pre-crash statement is serving
+from a compiled plan again.
+
+  - artifact-off leg: the restart re-pays every trace + XLA compile.
+  - artifact-rw  leg: the restart hydrates exported executables (the
+    backend compile comes out of the XLA persistent cache primed at
+    save time).
+
+Asserts, exit 1 on any miss:
+  - the warm replay performs ZERO new JIT compiles
+    (executor.compiles + batched_compiles delta == 0);
+  - every leg's replay rows are bit-identical to its pre-crash rows,
+    and the two legs agree with each other;
+  - warm time-to-warm-serving beats cold by >= --min-speedup (5x).
+
+Emits one JSON summary line (stdout, and appended to $BENCH_OUT when
+set) stamped with tools/bench_meta.py provenance. Wired into CI via
+`tools/run_tier1.sh --warmboot`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BENCH_OUT = os.environ.get("BENCH_OUT")
+
+# the pre-crash serving set: shapes heavy enough that re-deriving them
+# (trace + XLA compile) dominates a cold restart
+STATEMENTS = [
+    "select f.g as g, count(*) as c, sum(f.v + d.w) as s, avg(f.v) as a "
+    "from fact f join dim d on f.k = d.k "
+    "where f.v > 5 group by g order by s desc",
+    "select g, count(*) as c, sum(v) as s, min(v) as lo, max(v) as hi "
+    "from fact group by g order by g",
+    "select d.w % 11 as b, count(*) as c from fact f "
+    "join dim d on f.k = d.k group by b order by c desc, b",
+    "select count(*) as n, sum(v) as s from fact where k < 40",
+]
+
+
+def fail(msg: str) -> int:
+    print(f"WARMBOOT-SMOKE FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+    if _BENCH_OUT:
+        with open(_BENCH_OUT, "a") as f:
+            f.write(json.dumps(obj) + "\n")
+
+
+def _seed(db) -> list:
+    s = db.session()
+    s.sql("create table fact (id bigint primary key, k bigint not null, "
+          "g bigint not null, v bigint not null)")
+    s.sql("create table dim (k bigint primary key, w bigint not null)")
+    s.sql("insert into fact values " + ", ".join(
+        f"({i}, {i % 64}, {i % 7}, {i})" for i in range(1024)))
+    s.sql("insert into dim values " + ", ".join(
+        f"({i}, {i * 3})" for i in range(64)))
+    return [s.sql(q).rows() for q in STATEMENTS]
+
+
+def run_leg(mode: str, rows_expect, verbose: bool) -> tuple[dict, list]:
+    from oceanbase_tpu.server.database import Database
+
+    d = tempfile.mkdtemp(prefix=f"warmboot_{mode}_")
+    try:
+        db = Database(n_nodes=1, n_ls=1, data_dir=d, fsync=False)
+        if mode == "rw":
+            db.session().sql("alter system set ob_plan_artifact_mode = 'rw'")
+        rows0 = _seed(db)
+        if rows_expect is not None and rows0 != rows_expect:
+            raise AssertionError("seed rows diverged between legs")
+        db._save_node_meta()
+        db.close()  # the crash: serving state gone, disk survives
+
+        t0 = time.perf_counter()
+        db2 = Database(n_nodes=1, n_ls=1, data_dir=d, fsync=False)
+        boot_s = time.perf_counter() - t0
+        ex = db2.engine.executor
+        c0 = ex.compiles + ex.batched_compiles
+        s2 = db2.session()
+        lat, rows1 = [], []
+        for q in STATEMENTS:
+            t1 = time.perf_counter()
+            rows1.append(s2.sql(q).rows())
+            lat.append(time.perf_counter() - t1)
+        compiles = (ex.compiles + ex.batched_compiles) - c0
+        snap = db2.metrics.counters_snapshot()
+        leg = {
+            "mode": mode,
+            "boot_s": round(boot_s, 4),
+            "replay_s": round(sum(lat), 4),
+            "stmt_s": [round(x, 4) for x in lat],
+            "time_to_warm_serving_s": round(boot_s + sum(lat), 4),
+            "replay_compiles": int(compiles),
+            "artifact_hits": int(snap.get("plan artifact hit", 0)),
+            "artifact_warm_loads": int(
+                snap.get("plan artifact warm load", 0)),
+            "rows_identical": rows1 == rows0,
+        }
+        db2.close()
+        if verbose:
+            print(f"  {mode}: {leg}", file=sys.stderr)
+        return leg, rows0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--min-speedup", type=float, default=5.0,
+                    help="required cold/warm time-to-warm-serving ratio")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    # off first: the rw leg points the process-global XLA compilation
+    # cache into its (temporary) store, gone by the other leg's turn
+    cold, rows_cold = run_leg("off", None, args.verbose)
+    warm, rows_warm = run_leg("rw", rows_cold, args.verbose)
+
+    speedup = cold["time_to_warm_serving_s"] / max(
+        warm["time_to_warm_serving_s"], 1e-9)
+    tools = os.path.dirname(os.path.abspath(__file__))
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    from bench_meta import collect as bench_meta
+
+    emit({
+        "bench": "warmboot_smoke",
+        "metric": "warmboot_time_to_warm_serving_speedup",
+        "value": round(speedup, 3),
+        "detail": {"cold": cold, "warm": warm,
+                   "statements": len(STATEMENTS)},
+        "meta": bench_meta(None),
+    })
+
+    if not cold["rows_identical"] or not warm["rows_identical"]:
+        return fail("restart rows differ from pre-crash rows")
+    if rows_cold != rows_warm:
+        return fail("legs disagree on results")
+    if warm["replay_compiles"] != 0:
+        return fail(f"warm replay performed {warm['replay_compiles']} "
+                    "JIT compiles (want 0)")
+    if warm["artifact_hits"] < len(STATEMENTS):
+        return fail(f"only {warm['artifact_hits']} artifact hits for "
+                    f"{len(STATEMENTS)} statements")
+    if speedup < args.min_speedup:
+        return fail(f"time-to-warm-serving speedup {speedup:.2f}x "
+                    f"< {args.min_speedup}x "
+                    f"(cold {cold['time_to_warm_serving_s']}s, "
+                    f"warm {warm['time_to_warm_serving_s']}s)")
+    print(f"warmboot smoke OK: {speedup:.2f}x "
+          f"(cold {cold['time_to_warm_serving_s']}s -> "
+          f"warm {warm['time_to_warm_serving_s']}s, 0 warm compiles)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
