@@ -187,6 +187,9 @@ class PalfReplica:
     log: LogView = field(default_factory=LogView)
     commit_lsn: int = -1
     applied_lsn: int = -1
+    # scn of the newest applied entry: the replica's apply watermark in
+    # the GTS timestamp domain (tx/ls.py LSReplica.apply_watermark)
+    applied_scn: int = 0
     leader_id: int | None = None
     lease_until: float = 0.0
     next_election_at: float = 0.0
@@ -442,6 +445,14 @@ class PalfReplica:
             and self.applied_lsn == self.commit_lsn
         )
 
+    def reset_election_timer(self) -> None:
+        """Rejoin grace: a replica coming back from a restart/partition
+        waits one full lease window for an incumbent leader's heartbeat
+        before campaigning. Without this its stale next_election_at fires
+        immediately, the term bump NACKs the healthy leader's appends and
+        deposes it (restart disruption — the problem pre-vote solves)."""
+        self.next_election_at = self.bus.now + LEASE_TIMEOUT + self._jitter()
+
     def _step_down(self, term: int, leader: int | None) -> None:
         self.role = Role.FOLLOWER
         if term > self.term:
@@ -490,6 +501,7 @@ class PalfReplica:
         while self.applied_lsn < self.commit_lsn:
             self.applied_lsn += 1
             e = self.log[self.applied_lsn]
+            self.applied_scn = max(self.applied_scn, e.scn)
             # membership entries are consensus-internal: never surfaced
             # to the state machine
             if e.payload.startswith(CONFIG_PREFIX):
@@ -639,7 +651,14 @@ class PalfReplica:
             self.bus.send(self.node_id, src, VoteResp(self.term, False))
             return
         if m.term > self.term:
+            # adopt the term, but do NOT let a denied candidate push our
+            # election timer (only a GRANT defers us, below): a stale
+            # rejoining candidate with deterministically-small jitter
+            # would otherwise re-campaign ahead of every up-to-date
+            # replica forever — a term-inflation livelock with no leader
+            keep = self.next_election_at
             self._step_down(m.term, None)
+            self.next_election_at = keep
         granted = False
         if m.term == self.term and self.voted_for in (None, m.candidate_id):
             last_lsn, last_term = self._last()

@@ -17,6 +17,7 @@ multi-process deployment (cluster services layer).
 from __future__ import annotations
 
 import random
+import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -59,6 +60,12 @@ class LocalBus:
     tracer: Any = None
     stats: dict[str, int] = field(default_factory=lambda: defaultdict(int))
     _delivery_ctx: Any = field(default=None, repr=False)
+    # serializes clock advancement and queue mutation: multiple serving
+    # sessions retry statements concurrently and each retry path may drive
+    # the cluster (settle/leader_node). Reentrant because handlers called
+    # under advance() send replies through the same bus.
+    drive_lock: threading.RLock = field(
+        default_factory=threading.RLock, repr=False)
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
@@ -107,39 +114,43 @@ class LocalBus:
 
     # ---------------------------------------------------------- delivery
     def send(self, src: int, dst: int, msg: Any) -> None:
-        self._bump("sent")
-        if self._blocked(src, dst):
-            self._bump("dropped")
-            return
-        if self.drop_prob and self._rng.random() < self.drop_prob:
-            self._bump("dropped")
-            return
-        self._queue.append(
-            Envelope(src, dst, msg, self.now + self.latency,
-                     trace_ctx=self._current_ctx())
-        )
+        with self.drive_lock:
+            self._bump("sent")
+            if self._blocked(src, dst):
+                self._bump("dropped")
+                return
+            if self.drop_prob and self._rng.random() < self.drop_prob:
+                self._bump("dropped")
+                return
+            self._queue.append(
+                Envelope(src, dst, msg, self.now + self.latency,
+                         trace_ctx=self._current_ctx())
+            )
 
     def advance(self, dt: float) -> int:
         """Advance virtual time, delivering everything due. Returns count."""
-        self.now += dt
-        delivered = 0
-        while True:
-            due = [e for e in self._queue if e.deliver_at <= self.now]
-            if not due:
-                break
-            self._queue = [e for e in self._queue if e.deliver_at > self.now]
-            due.sort(key=lambda e: e.deliver_at)
-            for e in due:
-                if self._blocked(e.src, e.dst):
-                    self._bump("dropped")
-                    continue
-                h = self._handlers.get(e.dst)
-                if h is not None:
-                    self._delivery_ctx = e.trace_ctx
-                    try:
-                        h(e.src, e.msg)
-                    finally:
-                        self._delivery_ctx = None
-                    delivered += 1
-        self._bump("delivered", delivered)
-        return delivered
+        with self.drive_lock:
+            self.now += dt
+            delivered = 0
+            while True:
+                due = [e for e in self._queue if e.deliver_at <= self.now]
+                if not due:
+                    break
+                self._queue = [
+                    e for e in self._queue if e.deliver_at > self.now
+                ]
+                due.sort(key=lambda e: e.deliver_at)
+                for e in due:
+                    if self._blocked(e.src, e.dst):
+                        self._bump("dropped")
+                        continue
+                    h = self._handlers.get(e.dst)
+                    if h is not None:
+                        self._delivery_ctx = e.trace_ctx
+                        try:
+                            h(e.src, e.msg)
+                        finally:
+                            self._delivery_ctx = None
+                        delivered += 1
+            self._bump("delivered", delivered)
+            return delivered

@@ -21,6 +21,73 @@ from ..share.schema_service import SchemaError, SchemaService
 from ..tx.cluster import LocalCluster
 
 
+def plan_leader_moves(leader_map: dict[int, int],
+                      replica_nodes: dict[int, list[int]],
+                      alive: set[int],
+                      spread: bool = False) -> list[tuple[int, int, int]]:
+    """Pure leader-placement decision (the decision side of the
+    reference's rootserver/balance leader coordinator). Returns
+    [(ls_id, from_node, to_node)] such that applying every move leaves:
+
+      * no LS led by a node outside `alive` (evacuation — FailureDetector
+        evidence says the node is dead, don't wait for its lease to buy
+        every client a NotMaster round-trip);
+      * when `spread` (QoS ledger shows serving pressure), leader counts
+        across alive nodes differing by at most 1 (each alive node's
+        worker pool carries its fair share of the strong-read load).
+
+    Deterministic: ties break toward the lowest node id, LS are visited
+    in id order — same inputs, same plan, replayable from a bench log.
+    """
+    moves: list[tuple[int, int, int]] = []
+    counts = {n: 0 for n in sorted(alive)}
+    for _ls, n in leader_map.items():
+        if n in counts:
+            counts[n] += 1
+    if not counts:
+        return moves
+
+    def least_loaded(cands: list[int]) -> int | None:
+        live = [c for c in cands if c in counts]
+        return min(live, key=lambda c: (counts[c], c)) if live else None
+
+    # 1. evacuation: any LS led by a dead node moves to the least-loaded
+    #    alive replica holder
+    for ls_id in sorted(leader_map):
+        frm = leader_map[ls_id]
+        if frm in alive:
+            continue
+        to = least_loaded(replica_nodes.get(ls_id, []))
+        if to is None:
+            continue
+        moves.append((ls_id, frm, to))
+        counts[to] += 1
+
+    # 2. spread under pressure: peel leaders off the most-loaded node
+    #    while the imbalance is observable (diff >= 2)
+    if spread:
+        placed = {ls: to for ls, _f, to in moves}
+        lead_at = {ls: placed.get(ls, n) for ls, n in leader_map.items()}
+        while True:
+            hi = max(counts, key=lambda c: (counts[c], -c))
+            lo = min(counts, key=lambda c: (counts[c], c))
+            if counts[hi] - counts[lo] < 2:
+                break
+            cand = next(
+                (ls for ls in sorted(lead_at)
+                 if lead_at[ls] == hi and ls not in placed
+                 and lo in replica_nodes.get(ls, [])),
+                None)
+            if cand is None:
+                break
+            moves.append((cand, hi, lo))
+            placed[cand] = lo
+            lead_at[cand] = lo
+            counts[hi] -= 1
+            counts[lo] += 1
+    return moves
+
+
 class RootService:
     def __init__(self, cluster: LocalCluster, schema: SchemaService):
         self.cluster = cluster
@@ -63,6 +130,37 @@ class RootService:
     def choose_ls(self) -> int:
         counts = self.tablet_counts()
         return min(sorted(counts), key=lambda ls: counts[ls])
+
+    # ------------------------------------------------------ leader balance
+    def leader_map(self) -> dict[int, int]:
+        """ls_id -> node currently holding palf leadership. LS mid-election
+        (no leader) are omitted — there is nothing to move yet and the
+        election will place one without rootserver help."""
+        from ..log.palf import leader_of
+
+        out: dict[int, int] = {}
+        for ls_id, group in self.cluster.ls_groups.items():
+            lead = leader_of([r.palf for r in group.values()])
+            if lead is None:
+                continue
+            for node, rep in group.items():
+                if rep.palf is lead:
+                    out[ls_id] = node
+                    break
+        return out
+
+    def balance_leaders(self, unreachable: set[int] = frozenset(),
+                        spread: bool = False) -> list[tuple[int, int, int]]:
+        """Decide leader moves from FailureDetector evidence (`unreachable`,
+        the keepalive majority vote) and serving pressure (`spread`, from
+        the tenant QoS ledger). Pure decision — the caller applies the
+        moves (Database queues them as background dags)."""
+        alive = set(range(self.cluster.n_nodes)) - set(unreachable)
+        replica_nodes = {
+            ls: sorted(group) for ls, group in self.cluster.ls_groups.items()
+        }
+        return plan_leader_moves(self.leader_map(), replica_nodes, alive,
+                                 spread=spread)
 
     # ---------------------------------------------------------------- DDL
     def create_table(self, info_factory, n_partitions: int = 1) -> object:

@@ -85,6 +85,15 @@ class AsyncMySqlFrontend:
         self._pool: ThreadPoolExecutor | None = None
         self._thread: threading.Thread | None = None
         self._startup_err: BaseException | None = None
+        # rolling-restart drain state: while _draining is set the
+        # listener is closed and statements on surviving connections are
+        # shed with a retryable ER_SERVER_SHUTDOWN instead of entering
+        # the worker pool; _inflight counts statements already submitted
+        # (those are allowed to finish — drain() waits on them)
+        self._draining = threading.Event()
+        self._flight_lock = threading.Lock()
+        self._inflight = 0
+        self.shed = 0
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "AsyncMySqlFrontend":
@@ -97,6 +106,48 @@ class AsyncMySqlFrontend:
         if self._startup_err is not None:
             raise self._startup_err
         return self
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful drain for a zero-cold-start rolling restart: stop
+        accepting connections (listener closed), let statements already
+        in the worker pool finish, and shed anything newly queued with a
+        retryable ER_SERVER_SHUTDOWN (1053) so the client's router
+        redrives it on a peer. Returns {"inflight", "shed"}; resume()
+        reopens the same port once the node is back."""
+        import time
+
+        self._draining.set()
+        loop, srv = self._loop, self._server
+        if loop is not None and srv is not None:
+            try:
+                loop.call_soon_threadsafe(srv.close)
+            except RuntimeError:
+                pass  # loop already closed
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._flight_lock:
+                n = self._inflight
+            if n == 0:
+                break
+            time.sleep(0.005)
+        with self._flight_lock:
+            n = self._inflight
+        return {"inflight": n, "shed": self.shed}
+
+    def resume(self) -> None:
+        """Reopen the listener on the SAME port after a drain (the
+        restarted node rejoins the serving set at its old address) and
+        lift the statement gate."""
+        loop = self._loop
+        if loop is None or self.port is None:
+            raise RuntimeError("resume() before start()")
+
+        async def _reopen():
+            self._server = await asyncio.start_server(
+                self._serve, self.host, self.port, backlog=512)
+
+        asyncio.run_coroutine_threadsafe(_reopen(), loop).result(timeout=10)
+        self._draining.clear()
 
     def stop(self) -> None:
         loop, thread = self._loop, self._thread
@@ -142,9 +193,25 @@ class AsyncMySqlFrontend:
                 loop.close()
 
     # ------------------------------------------------------------ protocol
+    async def _execute(self, fn, *args):
+        """Worker-pool dispatch behind the drain gate: a draining node
+        sheds the statement (retryable 1053, no worker touched) instead
+        of queueing work it has promised to finish."""
+        if self._draining.is_set():
+            self.shed += 1
+            return [_err_packet(
+                1053, "server shutting down: retry on a peer")]
+        with self._flight_lock:
+            self._inflight += 1
+        try:
+            return await self._loop.run_in_executor(self._pool, fn, *args)
+        finally:
+            with self._flight_lock:
+                self._inflight -= 1
+
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
-        db, loop, pool = self.db, self._loop, self._pool
+        db, loop = self.db, self._loop
         sess = None
         seq = 0
         # id -> [pieces, nparams, last-bound param types]; the command
@@ -208,14 +275,14 @@ class AsyncMySqlFrontend:
                 if cmd in (0x0E, 0x02):  # COM_PING / COM_INIT_DB
                     send([_ok_packet()])
                 elif cmd == 0x03:  # COM_QUERY -> worker pool
-                    send(await loop.run_in_executor(
-                        pool, query_payloads, sess, pkt[1:].decode()))
+                    send(await self._execute(
+                        query_payloads, sess, pkt[1:].decode()))
                 elif cmd == 0x16:  # COM_STMT_PREPARE (protocol-only)
                     send(stmt_prepare_payloads(pkt[1:].decode(), stmts,
                                                next_stmt))
                 elif cmd == 0x17:  # COM_STMT_EXECUTE -> worker pool
-                    send(await loop.run_in_executor(
-                        pool, stmt_execute_payloads, sess, pkt, stmts))
+                    send(await self._execute(
+                        stmt_execute_payloads, sess, pkt, stmts))
                 elif cmd == 0x19:  # COM_STMT_CLOSE (no response)
                     if len(pkt) >= 5:
                         stmts.pop(int.from_bytes(pkt[1:5], "little"),

@@ -34,6 +34,7 @@ import numpy as np
 from ..core.dictionary import Dictionary
 from ..core.dtypes import DataType, Field, Schema, TypeKind
 from ..core.table import Table
+from ..log.palf import leader_of as _leader_of
 from ..engine.session import ResultSet, Session
 from ..rootserver import RootService
 from ..share import Config, LocationService
@@ -575,6 +576,15 @@ class Database:
             "health_alert_capacity",
             lambda _n, _o, v: self.sentinel.set_capacity(v))
         self._session_ids = itertools.count(1)
+        # statement-scoped follower-read Tables, keyed on (table, chosen
+        # replica applied positions, dict signature) — identical replica
+        # state ⇒ identical rows, so read floods over static data reuse
+        # one materialization (see _follower_table)
+        self._follower_views: dict[tuple, Table] = {}
+        # last rootserver rebalance pass (monotonic stamp) + the QoS
+        # rejected-counts already consumed as pressure evidence
+        self._last_rebalance_at: float | None = None
+        self._rebalance_qos_seen: dict[str, int] = {}
 
         # storage maintenance: block cache, dag scheduler, freeze loop
         from ..share.cache import KVCache
@@ -784,8 +794,92 @@ class Database:
         """One deterministic freeze/compaction pass (tests and the
         post-commit hook); live servers call maintenance.start()."""
         out = self.maintenance.tick()
+        self.maybe_rebalance_leaders()
         self.dag_scheduler.run_until_idle()
         return out
+
+    # -------------------------------------------- leader rebalance driver
+    def maybe_rebalance_leaders(self, force: bool = False) -> list:
+        """Rootserver-driven leader rebalancing: feed FailureDetector
+        evidence (the keepalive majority vote) and the tenant QoS ledger
+        into RootService.balance_leaders, and queue each decided move as
+        a background dag that runs cluster.transfer_leader off the
+        statement path. A healthy, unpressured cluster plans no moves, so
+        this is a cheap no-op on every maintenance tick; throttled by
+        leader_rebalance_min_interval regardless."""
+        import time as _time
+
+        try:
+            if not bool(self.config["enable_leader_rebalance"]):
+                return []
+        except Exception:  # noqa: BLE001 — config-less Database stub
+            return []
+        cluster = self.cluster
+        if not getattr(cluster, "keepalives", None) or cluster.n_nodes < 2:
+            return []
+        now = _time.monotonic()
+        min_iv = float(self.config["leader_rebalance_min_interval"])
+        if not force and self._last_rebalance_at is not None \
+                and now - self._last_rebalance_at < min_iv:
+            return []
+        self._last_rebalance_at = now
+        unreachable = cluster.unreachable_nodes()
+        moves = self.rootservice.balance_leaders(
+            unreachable, spread=self._qos_pressure())
+        if not moves:
+            return []
+        from ..share.dag_scheduler import Dag, DagPriority
+
+        for ls_id, frm, to in moves:
+            dag = Dag("leader rebalance", DagPriority.URGENT,
+                      key=("leader rebalance", ls_id))
+
+            def move(ls_id=ls_id, frm=frm, to=to):
+                cluster.transfer_leader(ls_id, to)
+                # the moved LS's cached leader is now wrong everywhere;
+                # targeted invalidation, same as the NotMaster path
+                self.location.invalidate(ls_id)
+                self.metrics.add("leader moved")
+
+            dag.add_task(move, name=f"move ls {ls_id}: {frm} -> {to}")
+            self.dag_scheduler.add_dag(dag)
+        return moves
+
+    def simulate_node_restart(self, node: int, settle: float = 1.0) -> None:
+        """One observer's rolling restart, in-process: take the node's
+        bus endpoints down past the lease window (survivors re-elect and
+        keep serving), drop the host-side memory state a real restart
+        loses — plan-cache memory tiers (NOT the disk artifact store)
+        and follower-read views — then rejoin and warm-boot compiled
+        plans from the artifact store, so the restarted node's first
+        statement is a warm artifact hit, not a cold trace+compile."""
+        self.cluster.kill_node(node, settle=settle)
+        self.plan_cache.flush(memory_only=True)
+        self._follower_views.clear()
+        self.cluster.revive_node(node, settle=settle)
+        if self.plan_artifact is not None:
+            self._warm_boot_plan_artifacts()
+
+    def _qos_pressure(self) -> bool:
+        """Serving-pressure bit from the tenant QoS ledger: True when any
+        tenant accumulated NEW admission rejections since the last check
+        (cumulative totals are diffed against what this driver already
+        consumed, so one historic overload doesn't spread leaders
+        forever)."""
+        tl = getattr(self, "timeline", None)
+        if tl is None:
+            return False
+        try:
+            totals = tl.qos_totals()
+        except Exception:  # noqa: BLE001 — ledger shape is advisory here
+            return False
+        pressure = False
+        for tenant, row in totals.items():
+            rej = int(row.get("rejected", 0))
+            if rej > self._rebalance_qos_seen.get(tenant, 0):
+                pressure = True
+            self._rebalance_qos_seen[tenant] = rej
+        return pressure
 
     # -------------------------------------------------- node durability
     def _meta_path(self) -> str:
@@ -1803,6 +1897,139 @@ class Database:
                         pass
                 self._enforce_memory(keep=name)
 
+    # ------------------------------------------------------ follower reads
+    #: bound on replica-snapshot catch-up waits before a bounded-staleness
+    #: read rejects back to the leader path
+    _FOLLOWER_WAIT_LIMIT = 3
+    _FOLLOWER_VIEW_CACHE_MAX = 128
+
+    def _follower_replica(self, ls_id: int, dead: set[int]):
+        """Serving replica for a follower read of ls_id: the highest-
+        watermark non-leader replica on a reachable node, falling back to
+        the leader itself (a one-survivor cluster keeps serving). None
+        when every replica is unreachable."""
+        group = self.cluster.ls_groups[ls_id]
+        best = None
+        for node, rep in sorted(group.items()):
+            if node in dead or rep.is_leader:
+                continue
+            if best is None or rep.apply_watermark > best.apply_watermark:
+                best = rep
+        if best is not None:
+            return best
+        for node, rep in sorted(group.items()):
+            if node not in dead and rep.is_leader:
+                return rep
+        return None
+
+    def _follower_snapshot(self, reps) -> int:
+        """Largest provably-complete snapshot across the chosen replicas.
+
+        Caught-up fast path: under gts.submit_lock a fresh GTS read is
+        safe when every replica has applied its live leader's last
+        appended entry — the lock excludes any committer between version
+        fetch and log append, so no commit version <= ts can be missing.
+        Otherwise the min apply watermark: submit-lock ordering makes an
+        applied scn dominate every earlier commit version in that log."""
+        gts = self.cluster.gts
+        with gts.submit_lock:
+            ts = gts.current()
+            for rep in reps:
+                group = self.cluster.ls_groups[rep.ls_id]
+                lead = _leader_of([r.palf for r in group.values()])
+                if lead is None or rep.palf.applied_lsn != len(lead.log) - 1:
+                    break
+            else:
+                return ts
+        return min((rep.apply_watermark for rep in reps), default=ts)
+
+    def follower_read_views(self, names, max_stale_us: int,
+                            weak: bool = False):
+        """Statement-scoped follower Tables for the replicated tables
+        among `names`, read at a bounded-staleness snapshot.
+
+        Returns (views, snapshot, stale_us), or None when the bound
+        cannot be met (counted as a staleness reject — the caller falls
+        back to the leader path), when no replicated table is involved,
+        or when an LS has an undecided prepared (2PC/XA) transaction on
+        its chosen replica — the prepare carries no version floor in
+        this rebuild, so a non-weak read cannot prove completeness."""
+        dead = self.cluster.unreachable_nodes()
+        involved: dict[str, TableInfo] = {}
+        chosen: dict[int, "LSReplica"] = {}
+        for name in names:
+            ti = self.tables.get(name)
+            if ti is None:
+                continue
+            involved[name] = ti
+            for ls_id, _tab in ti.all_partitions():
+                if ls_id in chosen:
+                    continue
+                rep = self._follower_replica(ls_id, dead)
+                if rep is None:
+                    return None
+                chosen[ls_id] = rep
+        if not involved:
+            return None
+        reps = list(chosen.values())
+        if not weak and any(rep._pending_redo for rep in reps):
+            self.metrics.add("follower read staleness rejects")
+            return None
+        attempt = 0
+        while True:
+            snap = self._follower_snapshot(reps)
+            stale_us = max(0, self.cluster.gts.current() - snap)
+            if weak or stale_us <= max_stale_us:
+                break
+            attempt += 1
+            if attempt > self._FOLLOWER_WAIT_LIMIT:
+                self.metrics.add("follower read staleness rejects")
+                return None
+            # lagging replication may catch up within the bound: drive
+            # the cluster briefly before rejecting back to the leader
+            with self.metrics.waiting("replica snapshot wait"):
+                self.cluster.settle(0.05 * attempt)
+        views = {
+            name: self._follower_table(name, ti, chosen, snap)
+            for name, ti in involved.items()
+        }
+        return views, snap, stale_us
+
+    def _follower_table(self, name: str, ti: "TableInfo",
+                        chosen: dict, snap: int) -> Table:
+        """Materialize one table from its chosen replicas at `snap`,
+        cached by (replica apply positions, dict signature): an unchanged
+        applied_lsn means no new rows applied, so any snapshot >= the
+        cached one scans to identical rows."""
+        pkey = tuple(
+            (ls_id, chosen[ls_id].node_id, chosen[ls_id].palf.applied_lsn)
+            for ls_id, _tab in ti.all_partitions()
+        )
+        key = (name, pkey, ti.dict_sig)
+        hit = self._follower_views.get(key)
+        if hit is not None:
+            return hit
+        parts = []
+        for ls_id, tablet_id in ti.all_partitions():
+            parts.append(chosen[ls_id].tablets[tablet_id].scan(snap, tx_id=0))
+        if len(parts) == 1:
+            data = parts[0]
+        else:
+            data = {
+                c: np.concatenate([p[c] for p in parts]) for c in parts[0]
+            }
+        dicts = {}
+        for col in ti.dicts:
+            sd, remap = ti.sorted_dict(col)
+            if len(data[col]):
+                data[col] = remap[data[col]]
+            dicts[col] = sd
+        t = Table(name, ti.schema, data, dicts)
+        while len(self._follower_views) >= self._FOLLOWER_VIEW_CACHE_MAX:
+            self._follower_views.pop(next(iter(self._follower_views)))
+        self._follower_views[key] = t
+        return t
+
     def _resident_bytes(self) -> int:
         """Approximate bytes of DML-backed catalog snapshots (the tenant's
         resident analytic memory — the unit's accounting surface)."""
@@ -1954,9 +2181,10 @@ class _OpenTx:
             # the drag failed (home node dead/partitioned, or no leader to
             # hand off yet): OB_NOT_MASTER — the statement retry layer
             # re-homes the tx after a location refresh
-            raise NotMaster(f"ls {ls_id}: {e}") from e
+            raise NotMaster(f"ls {ls_id}: {e}", ls_id=ls_id) from e
         if not self.db.cluster.drive_until(lambda: rep.is_ready):
-            raise NotMaster(f"ls {ls_id} leadership did not settle")
+            raise NotMaster(f"ls {ls_id} leadership did not settle",
+                            ls_id=ls_id)
         self.db.location.invalidate(ls_id)
 
 
@@ -1997,7 +2225,17 @@ class DbSession:
             # for new sessions while SET overrides per session
             "ob_batch_max_size": int(db.config["ob_batch_max_size"]),
             "ob_batch_max_wait_us": int(db.config["ob_batch_max_wait_us"]),
+            # read-consistency routing (0 strong / 1 bounded_staleness /
+            # 2 weak): non-strong SELECTs serve from follower replicas at
+            # a GTS-checked snapshot within ob_max_read_stale_us
+            "ob_read_consistency": self._CONSISTENCY_WORDS.get(
+                str(db.config["ob_read_consistency"]), 0),
+            "ob_max_read_stale_us": int(db.config["ob_max_read_stale_us"]),
         }
+        # (snapshot, stale_us) of the last follower-served SELECT — the
+        # staleness-contract tests and chaos bench read it to re-run the
+        # same statement on the leader AS OF the identical snapshot
+        self.last_follower_read: tuple[int, int] | None = None
         # trace_id of the last traced NON-meta statement — what SHOW TRACE
         # renders (meta statements: SHOW/SET themselves, so the flag and
         # the inspection don't overwrite the statement under diagnosis)
@@ -2274,7 +2512,16 @@ class DbSession:
                 if policy.flush_plan_cache:
                     db.plan_cache.flush()
                 if policy.refresh_location:
-                    db.location.clear()
+                    ls_id = getattr(e, "ls_id", None)
+                    if ls_id is not None:
+                        # NotMaster names the LS whose cached leader went
+                        # stale: invalidate exactly that entry — dropping
+                        # the whole cache forces every OTHER ls through a
+                        # resolver round trip for one node's election
+                        m.add("location targeted invalidations")
+                        db.location.invalidate(ls_id)
+                    else:
+                        db.location.clear()
                 if wait > 0:
                     with m.waiting("statement retry backoff"):
                         db.cluster.settle(wait)
@@ -2523,6 +2770,11 @@ class DbSession:
         Returns None to fall through to the full parse path."""
         db = self.db
         if self._tx is not None or self._vars.get("ob_px_dop", 0) > 0:
+            return None
+        if self._vars.get("ob_read_consistency", 0) != 0:
+            # the fast tier replays against the shared committed catalog
+            # (leader state); non-strong sessions route through the
+            # follower view path in _select instead
             return None
         t0 = _time.perf_counter()
         try:
@@ -3268,6 +3520,9 @@ class DbSession:
 
     # -------------------------------------------------------------- show
     _BOOL_WORDS = {"true": 1, "on": 1, "false": 0, "off": 0}
+    _CONSISTENCY_WORDS = {"strong": 0, "bounded_staleness": 1, "weak": 2}
+    # enum-valued session variables: accepted words -> stored int
+    _ENUM_VARS = {"ob_read_consistency": _CONSISTENCY_WORDS}
 
     def _set_session_var(self, text: str) -> ResultSet:
         """SET <name> = <value> — session-scoped variables (the reference's
@@ -3286,10 +3541,15 @@ class DbSession:
         try:
             iv = int(sval)
         except ValueError:
-            iv = self._BOOL_WORDS.get(sval)
+            iv = self._ENUM_VARS.get(name, {}).get(sval)
+            if iv is None:
+                iv = self._BOOL_WORDS.get(sval)
             if iv is None:
                 raise SqlError(
                     f"bad value {val.strip()!r} for {name}") from None
+        if name in self._ENUM_VARS and iv not in set(
+                self._ENUM_VARS[name].values()):
+            raise SqlError(f"bad value {val.strip()!r} for {name}")
         self._vars[name] = iv
         if name == "ob_enable_show_trace" and iv:
             # collection implies recording: a session asking for SHOW
@@ -3470,6 +3730,31 @@ class DbSession:
             self.db.access.record_das(tref.name, len(rows))
         return {tref.name: Table(tref.name, ti.schema, data, dicts)}
 
+    def _follower_select(self, ast: A.Select, norm_key: str,
+                         names) -> "ResultSet | None":
+        """Serve a non-strong SELECT from follower replicas: statement-
+        scoped views (TxCatalog.tx_scope, so the shared device-batch and
+        fast-path caches never see replica state) at a snapshot provably
+        within the session's staleness bound. None falls back to the
+        leader path — which is also the `strong`-on-follower contract:
+        identical routing, bit-identical rows."""
+        db = self.db
+        weak = self._vars["ob_read_consistency"] == 2
+        fv = db.follower_read_views(
+            names, self._vars.get("ob_max_read_stale_us", 0), weak=weak)
+        if fv is None:
+            return None
+        views, snap, stale_us = fv
+        # non-replicated tables in the statement (preloaded/external)
+        # refresh through the normal shared-catalog path
+        db.refresh_catalog([n for n in names if n not in views], tx=None)
+        with db.catalog.tx_scope(views):
+            rs = db.engine.run_ast(ast, norm_key)
+        self._stmt_cache_hit = rs.plan_cache_hit
+        self.last_follower_read = (snap, stale_us)
+        db.metrics.add("follower read hits")
+        return rs
+
     def _select(self, ast: A.Select, norm_key: str, fast_reg=None
                 ) -> ResultSet:
         fb = _flashback_refs(ast)
@@ -3478,6 +3763,15 @@ class DbSession:
         raw_names = _tables_in_ast(ast)
         names = self.db.expand_views(set(raw_names))
         any_vt = self.db.refresh_virtual(names)
+        self.last_follower_read = None
+        if (self._vars.get("ob_read_consistency", 0) != 0
+                and self._tx is None and not any_vt
+                and self._vars.get("ob_px_dop", 0) == 0
+                and isinstance(ast, A.Select)):
+            rs = self._follower_select(ast, norm_key, names)
+            if rs is not None:
+                return rs
+            # bound unmet / no reachable follower: strong leader path below
         route = None
         if self._tx is None and not any_vt and isinstance(ast, A.Select):
             route = self._index_route(ast)

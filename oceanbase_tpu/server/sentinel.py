@@ -307,6 +307,40 @@ def _rule_fastpath_collapse(first, last, cfg, out) -> None:
     })
 
 
+def _rule_replica_unreachable(first, last, cfg, out) -> None:
+    """A node's replicas went dark inside the window: the keepalive
+    majority vote (cluster.unreachable_nodes, recorded per snapshot as
+    ls_replica rows) flipped to unreachable between the two snapshots.
+    Only the TRANSITION alerts — a node that stays down does not re-fire
+    every window; recovery resets the edge so a flapping node alerts on
+    each new outage."""
+    rep0 = {(r["ls_id"], r["node"]): r for r in first.get("ls_replica", [])}
+    down_nodes: dict[int, list[dict]] = {}
+    for r in last.get("ls_replica", []):
+        if not r.get("unreachable"):
+            continue
+        prev = rep0.get((r["ls_id"], r["node"]))
+        if prev is not None and prev.get("unreachable"):
+            continue  # was already down at the window start
+        down_nodes.setdefault(r["node"], []).append(r)
+    for node, reps in sorted(down_nodes.items()):
+        led = sorted(r["ls_id"] for r in reps if r["role"] == "LEADER")
+        out.append({
+            "rule": "replica_unreachable",
+            "severity": "critical" if led else "warn",
+            "key": f"node{node}",
+            "summary": (f"node {node} unreachable (keepalive majority "
+                        f"vote); {len(reps)} replicas dark"
+                        + (f", was leading ls {led}" if led else "")),
+            "evidence": {
+                "node": node,
+                "ls_ids": sorted(r["ls_id"] for r in reps),
+                "leader_ls_ids": led,
+                "max_lag_us": max(r["lag_us"] for r in reps),
+            },
+        })
+
+
 _RULES = (
     _rule_digest_regression,
     _rule_error_retry,
@@ -314,6 +348,7 @@ _RULES = (
     _rule_cache_pressure,
     _rule_tenant_starvation,
     _rule_fastpath_collapse,
+    _rule_replica_unreachable,
 )
 
 

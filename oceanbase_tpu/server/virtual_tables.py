@@ -230,6 +230,33 @@ def _ls(db) -> Table:
     ])
 
 
+def _ls_replica(db) -> Table:
+    """Per-replica serving health: role, keepalive reachability (majority
+    vote over peers' NetKeepAlive evidence) and the apply watermark with
+    its lag behind GTS — the staleness a follower read of that replica
+    would observe."""
+    cluster = db.cluster
+    dead = cluster.unreachable_nodes() if cluster.keepalives else set()
+    now_ts = cluster.gts.current()
+    rows = []
+    for ls_id, group in sorted(cluster.ls_groups.items()):
+        for node, rep in sorted(group.items()):
+            wm = rep.apply_watermark
+            rows.append((ls_id, node, rep.palf.role.name,
+                         int(rep.is_ready), int(node in dead),
+                         rep.palf.applied_lsn, wm, max(0, now_ts - wm)))
+    return _t("__all_virtual_ls_replica", [
+        ("ls_id", DataType.int64(), [r[0] for r in rows]),
+        ("svr_node", DataType.int64(), [r[1] for r in rows]),
+        ("role", DataType.varchar(), [r[2] for r in rows]),
+        ("is_ready", DataType.int32(), [r[3] for r in rows]),
+        ("unreachable", DataType.int32(), [r[4] for r in rows]),
+        ("applied_lsn", DataType.int64(), [r[5] for r in rows]),
+        ("apply_watermark", DataType.int64(), [r[6] for r in rows]),
+        ("watermark_lag_us", DataType.int64(), [r[7] for r in rows]),
+    ])
+
+
 def _processlist(db) -> Table:
     rows = sorted(db._active_stmts.items())
     return _t("__all_virtual_processlist", [
@@ -663,6 +690,7 @@ PROVIDERS = {
     "__all_virtual_system_event": _system_event,
     "__all_virtual_query_response_time": _query_response_time,
     "__all_virtual_ls": _ls,
+    "__all_virtual_ls_replica": _ls_replica,
     "__all_virtual_processlist": _processlist,
     "__all_virtual_tablet": _tablets,
     "__all_virtual_user": _users,

@@ -727,6 +727,30 @@ def device_census(db) -> list[dict]:
 # --------------------------------------------------------------------------
 
 
+def ls_replica_health(db) -> list[dict]:
+    """Per-replica reachability + apply-watermark lag, from the cluster
+    keepalives (ha/detect.py) — the replica_unreachable sentinel rule's
+    evidence. Empty when the cluster runs without keepalives (pure unit
+    harnesses)."""
+    cluster = getattr(db, "cluster", None)
+    if cluster is None or not getattr(cluster, "keepalives", None):
+        return []
+    dead = cluster.unreachable_nodes()
+    now_ts = cluster.gts.current()
+    rows = []
+    for ls_id, group in sorted(cluster.ls_groups.items()):
+        for node, rep in sorted(group.items()):
+            wm = rep.apply_watermark
+            rows.append({
+                "ls_id": ls_id, "node": node,
+                "role": rep.palf.role.name,
+                "unreachable": int(node in dead),
+                "watermark": wm,
+                "lag_us": max(0, now_ts - wm),
+            })
+    return rows
+
+
 def build_snapshot(db, snap_id: int, ts: float) -> dict:
     tl = getattr(db, "timeline", None)
     return {
@@ -742,6 +766,9 @@ def build_snapshot(db, snap_id: int, ts: float) -> dict:
         "timeline": tl.snapshot() if tl is not None else [],
         "timeline_meta": tl.meta() if tl is not None else {},
         "qos": tl.qos_totals() if tl is not None else {},
+        # replica serving health (keepalive reachability + watermark lag):
+        # the replica_unreachable sentinel rule's input
+        "ls_replica": ls_replica_health(db),
     }
 
 

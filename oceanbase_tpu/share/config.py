@@ -227,6 +227,22 @@ def default_params() -> list[Param]:
               "byte budget for exported executables on disk and for the "
               "boot-time warm-load of the hottest digests; coldest "
               "artifacts evict beyond it"),
+        # elastic serving (follower reads + rootserver rebalancing)
+        Param("ob_read_consistency", "str", "strong",
+              "default read consistency for new sessions: strong (leader "
+              "only), bounded_staleness (follower snapshot within "
+              "ob_max_read_stale_us), weak (any replica watermark)",
+              choices=("strong", "bounded_staleness", "weak")),
+        Param("ob_max_read_stale_us", "int", 5_000_000,
+              "bounded-staleness ceiling in microseconds of GTS time; a "
+              "follower whose apply watermark lags further rejects the "
+              "read back to the leader", min=0),
+        Param("enable_leader_rebalance", "bool", True,
+              "let the rootserver move LS leaders off unreachable or "
+              "QoS-overloaded nodes as background dags"),
+        Param("leader_rebalance_min_interval", "time", 5.0,
+              "floor between rootserver rebalance passes (hysteresis "
+              "against leader ping-pong)"),
         # storage
         Param("block_cache_size", "capacity", 256 << 20,
               "budget for decoded micro-block column cache"),

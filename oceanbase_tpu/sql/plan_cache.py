@@ -603,11 +603,16 @@ class PlanCache:
             ]
         return logical, fast
 
-    def flush(self):
+    def flush(self, memory_only: bool = False):
         """Flush BOTH tiers. Retry policies with flush_plan_cache
         (OB_SCHEMA_EAGAIN), DDL-driven invalidation and ALTER SYSTEM all
         land here — a text entry surviving a flush would replay a plan
-        compiled against a dead schema."""
+        compiled against a dead schema.
+
+        memory_only=True flushes ONLY the in-memory tiers: a process
+        restart loses RAM, not the disk store, and warm boot rehydrates
+        from it. Schema-driven invalidation MUST NOT set it — the schema
+        a disk artifact was compiled against is just as dead."""
         with self._lock:
             self._entries.clear()
             if self._fast:
@@ -619,5 +624,5 @@ class PlanCache:
             # the artifact tier flushes with the in-memory tiers: an
             # exported executable surviving a schema-driven flush would
             # hydrate a plan compiled against a dead schema
-            if self.artifact_store is not None:
+            if not memory_only and self.artifact_store is not None:
                 self.artifact_store.flush()

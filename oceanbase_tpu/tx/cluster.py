@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..core.dtypes import Schema
+from ..ha.detect import KA_BASE, NetKeepAlive
 from ..log import LocalBus, leader_of, run_until
 from .gts import GtsService
 from .ls import LSReplica, make_ls_group
@@ -34,6 +35,10 @@ class LocalCluster:
     # cluster, each registers here and a dispatcher fans records out
     # (each observer ignores tablets it does not own)
     record_observers: list = field(default_factory=list)
+    # per-node keepalive endpoints (ha/detect.NetKeepAlive) riding the
+    # drive loop; dead-peer evidence feeds the ls-replica virtual table,
+    # the health sentinel and rootserver rebalancing
+    keepalives: dict[int, NetKeepAlive] = field(default_factory=dict)
     _next_ls_base: int = 0
 
     def __post_init__(self):
@@ -61,10 +66,13 @@ class LocalCluster:
 
     def finalize(self) -> None:
         """Build per-node TransServices and elect initial leaders."""
-        for n in range(self.n_nodes):
+        nodes = list(range(self.n_nodes))
+        for n in nodes:
             self.services[n] = TransService(
                 n, self.gts, {ls: g[n] for ls, g in self.ls_groups.items()}
             )
+            if n not in self.keepalives:
+                self.keepalives[n] = NetKeepAlive(self.bus, n, nodes)
         self.elect_all()
 
     def add_node(self, node: int) -> None:
@@ -79,8 +87,12 @@ class LocalCluster:
     def _palfs(self):
         return [r.palf for g in self.ls_groups.values() for r in g.values()]
 
+    def _tickables(self):
+        # keepalives share the palf drive loop: run_until only needs .tick()
+        return self._palfs() + list(self.keepalives.values())
+
     def drive_until(self, cond, max_time: float = 30.0) -> bool:
-        return run_until(self.bus, self._palfs(), cond, max_time=max_time)
+        return run_until(self.bus, self._tickables(), cond, max_time=max_time)
 
     def settle(self, t: float = 1.0) -> None:
         self.drive_until(lambda: False, max_time=t)
@@ -114,7 +126,36 @@ class LocalCluster:
         silence; the virtual-clock analog needs the clock to move)."""
         for group in self.ls_groups.values():
             self.bus.kill(group[node].palf.node_id)
+        if node in self.keepalives:
+            self.bus.kill(KA_BASE + node)
         self.settle(settle)
+
+    def revive_node(self, node: int, settle: float = 1.0) -> None:
+        """Reconnect a killed node's replicas + keepalive endpoint and let
+        the cluster settle so they catch up (rolling-restart recovery)."""
+        for group in self.ls_groups.values():
+            self.bus.revive(group[node].palf.node_id)
+            # rejoin grace: wait a lease window for the incumbent's
+            # heartbeat instead of campaigning off the stale timer and
+            # deposing a healthy leader (restart disruption)
+            group[node].palf.reset_election_timer()
+        if node in self.keepalives:
+            self.bus.revive(KA_BASE + node)
+        self.settle(settle)
+
+    def unreachable_nodes(self) -> set[int]:
+        """Majority keepalive vote: node d is unreachable when more than
+        half of the OTHER nodes' keepalives have lost it (a one-link
+        partition never indicts a node; a kill always does)."""
+        out: set[int] = set()
+        for d in self.keepalives:
+            observers = [ka for n, ka in self.keepalives.items() if n != d]
+            if not observers:
+                continue
+            votes = sum(1 for ka in observers if ka.is_dead(d))
+            if votes >= len(observers) // 2 + 1:
+                out.add(d)
+        return out
 
     def transfer_leader(self, ls_id: int, target_node: int,
                         max_time: float = 10.0) -> None:
@@ -130,7 +171,7 @@ class LocalCluster:
                 lead.transfer_leader(target_addr)
             return False
 
-        if not run_until(self.bus, self._palfs(), try_transfer, max_time=max_time):
+        if not run_until(self.bus, self._tickables(), try_transfer, max_time=max_time):
             raise TimeoutError(f"ls {ls_id}: leader transfer to node {target_node} failed")
 
     def service_for(self, *ls_ids: int) -> TransService:

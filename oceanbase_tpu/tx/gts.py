@@ -23,6 +23,13 @@ class GtsService:
     clock: Callable[[], float] = time.time
     _last: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    # Serializes commit-version fetch + log submit (tx/txn.py holds it
+    # around both). With the fetch and the append atomic, commit versions
+    # appear in each LS log in nondecreasing order, so an applied entry's
+    # scn = max(prev_scn+1, commit_version) dominates the commit version
+    # of EVERY earlier decisive record — the invariant that makes a
+    # replica's applied scn a sound follower-read watermark.
+    submit_lock: threading.RLock = field(default_factory=threading.RLock)
 
     def next_ts(self) -> int:
         """Strictly increasing timestamp (µs domain)."""

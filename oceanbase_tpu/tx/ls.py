@@ -84,7 +84,17 @@ class LSReplica:
         self.tx_table.pop(tx_id, None)
 
     def submit_record(self, rec: TxRecord) -> int | None:
-        return self.palf.submit_log(rec.to_bytes())
+        # scn latches to max(prev+1, commit_version): with submits
+        # serialized under GtsService.submit_lock, a replica's applied scn
+        # then dominates every applied commit version — the follower-read
+        # watermark (see apply_watermark)
+        return self.palf.submit_log(rec.to_bytes(), scn=rec.commit_version)
+
+    @property
+    def apply_watermark(self) -> int:
+        """Every tx with commit_version <= this has applied on THIS
+        replica; a snapshot read at any ts <= watermark is complete."""
+        return self.palf.applied_scn
 
     # ------------------------------------------------------- apply/replay
     def _apply(self, entry: LogEntry) -> None:

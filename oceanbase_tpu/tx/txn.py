@@ -49,7 +49,15 @@ class TxState(enum.Enum):
 
 
 class NotMaster(Exception):
-    """The local LS replica is not the leader; retry at the leader."""
+    """The local LS replica is not the leader; retry at the leader.
+
+    Carries the offending ls_id so the statement retry layer can
+    invalidate exactly that location-cache entry instead of dropping the
+    whole cache (share/retry.py LOCATION_REFRESH handling)."""
+
+    def __init__(self, msg: str = "", ls_id: int | None = None):
+        super().__init__(msg)
+        self.ls_id = ls_id
 
 
 @dataclass
@@ -122,7 +130,8 @@ class TransService:
             # is_ready (not just is_leader): a fresh leader that has not yet
             # replayed inherited commits would miss write-write conflicts
             # against versions newer than the tx snapshot (lost update)
-            raise NotMaster(f"ls {ls_id} not a ready leader on node {self.node_id}")
+            raise NotMaster(f"ls {ls_id} not a ready leader on node "
+                            f"{self.node_id}", ls_id=ls_id)
         m = Mutation(tablet_id, key, op, values)
         try:
             r.stage_locally(ctx.tx_id, ctx.read_snapshot, m)
@@ -138,7 +147,8 @@ class TransService:
         if not r.is_ready:
             # a fresh leader must finish replaying inherited committed
             # entries before serving, else reads miss rows
-            raise NotMaster(f"ls {ls_id} replica on node {self.node_id} not a ready leader")
+            raise NotMaster(f"ls {ls_id} replica on node {self.node_id} "
+                            f"not a ready leader", ls_id=ls_id)
         return r.tablets[tablet_id].scan(
             ctx.read_snapshot, columns=columns, ranges=ranges, tx_id=ctx.tx_id
         )
@@ -160,30 +170,35 @@ class TransService:
         for ls in parts:
             if not self.replicas[ls].is_leader:
                 self.abort(ctx)
-                raise NotMaster(f"ls {ls} lost leadership before commit")
+                raise NotMaster(f"ls {ls} lost leadership before commit",
+                                ls_id=ls)
         if len(parts) == 1:
             ls = parts[0]
-            rec = TxRecord(RecordType.REDO_COMMIT, ctx.tx_id,
-                           tuple(ctx.mutations[ls]), self.gts.next_ts(),
-                           dict_appends=tuple(ctx.dict_appends))
-            # state moves BEFORE submit: apply can fire synchronously inside
-            # submit_record (single-replica groups commit immediately) and
-            # must find the ctx in COMMITTING to finish it
-            ctx.commit_version = rec.commit_version
-            ctx.state = TxState.COMMITTING
-            try:
-                accepted = self.replicas[ls].submit_record(rec)
-            except Exception:
-                # submit-path failure (EN_LOG_SUBMIT injection, IO error)
-                # before anything reached the log: roll back locally so the
-                # staged rows don't stay locked by a tx that can never
-                # decide — the orphan would block every later writer
-                self._rollback(ctx, logged_ls=())
-                raise
+            # version fetch + submit under gts.submit_lock: commit versions
+            # land in the log nondecreasing, keeping entry scns a sound
+            # follower-read watermark (see GtsService.submit_lock)
+            with self.gts.submit_lock:
+                rec = TxRecord(RecordType.REDO_COMMIT, ctx.tx_id,
+                               tuple(ctx.mutations[ls]), self.gts.next_ts(),
+                               dict_appends=tuple(ctx.dict_appends))
+                # state moves BEFORE submit: apply can fire synchronously
+                # inside submit_record (single-replica groups commit
+                # immediately) and must find the ctx in COMMITTING
+                ctx.commit_version = rec.commit_version
+                ctx.state = TxState.COMMITTING
+                try:
+                    accepted = self.replicas[ls].submit_record(rec)
+                except Exception:
+                    # submit-path failure (EN_LOG_SUBMIT injection, IO error)
+                    # before anything reached the log: roll back locally so
+                    # the staged rows don't stay locked by a tx that can
+                    # never decide — the orphan would block later writers
+                    self._rollback(ctx, logged_ls=())
+                    raise
             if accepted is None:
                 # nothing reached the log: local rollback suffices
                 self._rollback(ctx, logged_ls=())
-                raise NotMaster(f"ls {ls} rejected submit")
+                raise NotMaster(f"ls {ls} rejected submit", ls_id=ls)
             return
         # ---- 2PC
         ctx.state = TxState.PREPARING
@@ -204,7 +219,7 @@ class TransService:
                 # some participants have a PREPARE in their log: log ABORT
                 # there so replicas clean pending redo + tx tables
                 self._rollback(ctx, logged_ls=tuple(logged))
-                raise NotMaster(f"ls {ls} rejected prepare")
+                raise NotMaster(f"ls {ls} rejected prepare", ls_id=ls)
             logged.append(ls)
 
     # ------------------------------------------------------------- XA
@@ -223,7 +238,8 @@ class TransService:
         for ls in parts:
             if not self.replicas[ls].is_leader:
                 self.abort(ctx)
-                raise NotMaster(f"ls {ls} lost leadership before XA prepare")
+                raise NotMaster(f"ls {ls} lost leadership before XA prepare",
+                                ls_id=ls)
         ctx.xa_parts = tuple(parts)
         ctx.state = TxState.PREPARING
         logged: list[int] = []
@@ -234,7 +250,7 @@ class TransService:
                            xid=xid, owner=owner, tenant=tenant)
             if self.replicas[ls].submit_record(rec) is None:
                 self._rollback(ctx, logged_ls=tuple(logged))
-                raise NotMaster(f"ls {ls} rejected XA prepare")
+                raise NotMaster(f"ls {ls} rejected XA prepare", ls_id=ls)
             logged.append(ls)
 
     def xa_decide(self, ctx: TxContext, commit: bool) -> None:
@@ -255,16 +271,17 @@ class TransService:
         if ctx.state is not TxState.XA_PREPARED:
             raise RuntimeError(f"tx {ctx.tx_id} is {ctx.state.value}")
         ctx.xa_decision = "commit" if commit else "rollback"
-        ctx.commit_version = self.gts.next_ts() if commit else 0
-        ctx.state = TxState.COMMITTING  # decision (either way) in flight
-        if not commit:
-            for ls in ctx.mutations:
-                self.replicas[ls].abort_locally(ctx.tx_id)
-        rtype = RecordType.COMMIT if commit else RecordType.ABORT
-        for ls in ctx.xa_parts:
-            rec = TxRecord(rtype, ctx.tx_id, (), ctx.commit_version)
-            if self.replicas[ls].submit_record(rec) is None:
-                ctx._undelivered[ls] = rec
+        with self.gts.submit_lock:
+            ctx.commit_version = self.gts.next_ts() if commit else 0
+            ctx.state = TxState.COMMITTING  # decision (either way) in flight
+            if not commit:
+                for ls in ctx.mutations:
+                    self.replicas[ls].abort_locally(ctx.tx_id)
+            rtype = RecordType.COMMIT if commit else RecordType.ABORT
+            for ls in ctx.xa_parts:
+                rec = TxRecord(rtype, ctx.tx_id, (), ctx.commit_version)
+                if self.replicas[ls].submit_record(rec) is None:
+                    ctx._undelivered[ls] = rec
 
     def ensure_tx_id_above(self, floor: int) -> None:
         """Restart recovery: a recovered (still-undecided) XA branch keeps
@@ -333,13 +350,16 @@ class TransService:
         elif rtype is RecordType.PREPARE and ctx.state is TxState.PREPARING:
             ctx._prepared.add(ls_id)
             if ctx._prepared >= set(ctx.mutations.keys()):
-                ctx.commit_version = self.gts.next_ts()
-                ctx.state = TxState.COMMITTING
-                for ls in ctx.mutations:
-                    rec = TxRecord(RecordType.COMMIT, ctx.tx_id, (),
-                                   ctx.commit_version)
-                    if self.replicas[ls].submit_record(rec) is None:
-                        ctx._undelivered[ls] = rec
+                # version fetch + COMMIT fan-out atomically vs other
+                # committers (watermark invariant, GtsService.submit_lock)
+                with self.gts.submit_lock:
+                    ctx.commit_version = self.gts.next_ts()
+                    ctx.state = TxState.COMMITTING
+                    for ls in ctx.mutations:
+                        rec = TxRecord(RecordType.COMMIT, ctx.tx_id, (),
+                                       ctx.commit_version)
+                        if self.replicas[ls].submit_record(rec) is None:
+                            ctx._undelivered[ls] = rec
         elif rtype is RecordType.COMMIT and ctx.state is TxState.COMMITTING:
             ctx._committed_ls.add(ls_id)
             if ctx._committed_ls >= set(ctx.mutations.keys()):

@@ -1,0 +1,482 @@
+"""Elastic multi-node serving: bounded-staleness follower reads,
+zero-cold-start rolling restarts, and chaos-gated leader rebalancing.
+
+The contracts under test:
+
+  * a bounded-staleness read serves from a follower replica at a
+    GTS-checked snapshot that is provably complete on that replica and
+    within the session's ob_max_read_stale_us — NEVER newer than its
+    snapshot, never staler than the bound (it rejects to the leader
+    path instead, counted in sysstat);
+  * `strong` on any session routes to the leader and returns rows
+    bit-identical to the follower path at the same quiesced state;
+  * NotMaster carries the LS it was raised for, and the retry layer
+    invalidates exactly that location entry (regression: a forced
+    election must not dump the whole cache);
+  * rootserver leader rebalancing evacuates dead leaders and spreads
+    them under QoS pressure, as background dags;
+  * a rolling node restart drains the async front end (in-flight
+    finishes, queued statements shed with a retryable 1053), loses only
+    memory state, and warm-boots compiled plans from the artifact store
+    so its first statement performs zero JIT compiles.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from oceanbase_tpu.ha.detect import KA_BASE
+from oceanbase_tpu.rootserver.service import plan_leader_moves
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.sentinel import evaluate_window
+from oceanbase_tpu.server.workload import build_snapshot
+
+
+def _mk_db(**kw):
+    db = Database(n_nodes=3, n_ls=2, **kw)
+    s = db.session()
+    s.sql("create table ekv (id bigint primary key, v bigint not null)")
+    s.sql("insert into ekv values " + ", ".join(
+        f"({i}, {i * 7 % 100})" for i in range(1, 65)))
+    db.cluster.settle(1.0)  # followers apply the seed before tests read
+    return db, s
+
+
+def _bounded(db, max_stale_us: int = 5_000_000):
+    s = db.session()
+    s.sql("set ob_read_consistency = 'bounded_staleness'")
+    s.sql(f"set ob_max_read_stale_us = {max_stale_us}")
+    return s
+
+
+def _leader_rows_at(db, name: str, snap: int) -> list[tuple]:
+    """Ground truth: the leader's MVCC state AS OF `snap`, via the
+    flashback materializer (no follower machinery involved)."""
+    t = db.snapshot_table(name, snap)
+    ids, vs = t.data["id"], t.data["v"]
+    return sorted((int(ids[i]), int(vs[i])) for i in range(len(ids)))
+
+
+# ------------------------------------------------------------ follower reads
+
+
+def test_bounded_staleness_serves_from_follower_bit_identical():
+    db, s = _mk_db()
+    try:
+        b = _bounded(db)
+        rows = b.sql("select id, v from ekv order by id").rows()
+        assert b.last_follower_read is not None
+        snap, stale = b.last_follower_read
+        assert 0 <= stale <= 5_000_000
+        # bit-identical to the leader's state at the same snapshot
+        assert rows == _leader_rows_at(db, "ekv", snap)
+        # identical to a strong read on the quiesced cluster
+        assert rows == s.sql("select id, v from ekv order by id").rows()
+        snap_ss = db.metrics.counters_snapshot()
+        assert snap_ss.get("follower read hits", 0) > 0
+    finally:
+        db.close()
+
+
+def test_strong_on_follower_routes_to_leader():
+    db, s = _mk_db()
+    try:
+        st = db.session()
+        st.sql("set ob_read_consistency = 'strong'")
+        hits0 = db.metrics.counters_snapshot().get("follower read hits", 0)
+        rows = st.sql("select id, v from ekv order by id").rows()
+        # strong never touches the follower path: no hit counted, no
+        # follower snapshot recorded, rows identical to the leader's
+        assert st.last_follower_read is None
+        assert db.metrics.counters_snapshot().get(
+            "follower read hits", 0) == hits0
+        assert rows == s.sql("select id, v from ekv order by id").rows()
+    finally:
+        db.close()
+
+
+def test_weak_read_serves_with_zero_bound():
+    db, _s = _mk_db()
+    try:
+        w = db.session()
+        w.sql("set ob_read_consistency = 'weak'")
+        w.sql("set ob_max_read_stale_us = 0")
+        rows = w.sql("select count(*) as n from ekv").rows()
+        # weak never rejects on staleness; it still records its snapshot
+        assert rows == [(64,)]
+        assert w.last_follower_read is not None
+    finally:
+        db.close()
+
+
+def test_staleness_bound_rejects_lagging_replica_to_leader():
+    """Deterministic replication lag: partition follower A's palf
+    endpoints (its keepalive stays up, so it is still 'reachable'), take
+    follower B out of the vote by killing only its keepalive, commit on
+    the leader+B majority. The only choosable follower is now the
+    laggard — the read must REJECT to the leader (counted, with the
+    replica-snapshot-wait event), never serve beyond the bound."""
+    db, s = _mk_db()
+    try:
+        ls_id = next(ls for ls, _t in db.tables["ekv"].all_partitions())
+        c = db.cluster
+        leader = c.leader_node(ls_id)
+        foll_a, foll_b = [n for n in range(3) if n != leader]
+
+        # B leaves the keepalive vote -> unreachable, not choosable
+        c.bus.kill(KA_BASE + foll_b)
+        c.settle(3.0)  # past dead_after so the majority votes it dead
+        assert foll_b in c.unreachable_nodes()
+
+        # A's replication lags: palf partitioned, keepalive untouched
+        a_ids = {g[foll_a].palf.node_id for g in c.ls_groups.values()}
+        rest = {g[n].palf.node_id for g in c.ls_groups.values()
+                for n in (leader, foll_b)}
+        c.bus.partition(a_ids, rest)
+        s.sql("update ekv set v = v + 1 where id <= 8")  # leader+B commit
+        c.settle(1.0)  # lag grows in virtual time
+
+        b = _bounded(db, max_stale_us=100_000)
+        rej0 = db.metrics.counters_snapshot().get(
+            "follower read staleness rejects", 0)
+        rows = b.sql("select id, v from ekv order by id").rows()
+        # served correctly — by the LEADER path, after a counted reject
+        assert b.last_follower_read is None
+        assert rows == s.sql("select id, v from ekv order by id").rows()
+        snap_ss = db.metrics.counters_snapshot()
+        assert snap_ss.get("follower read staleness rejects", 0) > rej0
+        ev = db.metrics.wait_event("replica snapshot wait")
+        assert ev is not None and ev.count > 0
+
+        # heal: the follower path resumes within the bound
+        c.bus.heal()
+        c.bus.revive(KA_BASE + foll_b)
+        c.settle(3.0)
+        rows2 = b.sql("select id, v from ekv order by id").rows()
+        assert b.last_follower_read is not None
+        assert rows2 == rows
+    finally:
+        db.close()
+
+
+def test_bounded_staleness_property_under_fault_schedule():
+    """Property run: writes interleaved with partitions and a leader
+    kill; EVERY follower-served read must be within its bound and
+    bit-identical to the leader AS OF the identical snapshot (checked
+    after the faults heal — MVCC versions survive)."""
+    db, s = _mk_db()
+    try:
+        ls_id = next(ls for ls, _t in db.tables["ekv"].all_partitions())
+        c = db.cluster
+        b = _bounded(db)
+        served: list[tuple[int, list]] = []
+        nid = 1000
+        for step in range(24):
+            if step == 6:
+                node = (c.leader_node(ls_id) + 1) % 3
+                mine = {g[node].palf.node_id for g in c.ls_groups.values()}
+                rest = {g[n].palf.node_id for g in c.ls_groups.values()
+                        for n in range(3) if n != node}
+                c.bus.partition(mine, rest)
+            elif step == 12:
+                c.bus.heal()
+                c.settle(1.0)
+            elif step == 14:
+                victim = c.leader_node(ls_id)
+                c.kill_node(victim, settle=0.5)
+            elif step == 20:
+                c.revive_node(victim, settle=1.0)
+            nid += 1
+            s.sql(f"insert into ekv values ({nid}, {step})")
+            rows = b.sql("select id, v from ekv order by id").rows()
+            fr = b.last_follower_read
+            if fr is not None:
+                snap, stale = fr
+                assert stale <= 5_000_000, (step, stale)
+                served.append((snap, rows))
+        c.bus.heal()
+        c.settle(2.0)
+        assert served, "no read ever served from a follower"
+        for snap, rows in served:
+            assert rows == _leader_rows_at(db, "ekv", snap), snap
+    finally:
+        db.close()
+
+
+# ----------------------------------------------------- location invalidation
+
+
+def test_notmaster_targeted_invalidation_after_forced_election():
+    """A write tx homes on the CACHED leader and drags LS leadership
+    there; when that cached node is dead the drag raises NotMaster
+    naming the LS, and the retry layer must invalidate exactly that
+    location entry — the other LS's cached leader survives."""
+    db, s = _mk_db()
+    try:
+        kv_ls = next(ls for ls, _t in db.tables["ekv"].all_partitions())
+        other_ls = next(ls for ls in db.cluster.ls_groups if ls != kv_ls)
+        # populate both location entries; the tx home is kv_ls's leader
+        home = db.location.leader(kv_ls)
+        db.location.leader(other_ls)
+        assert other_ls in db.location._cache
+        # forced election: the cached home dies, survivors elect
+        db.cluster.kill_node(home, settle=3.0)
+        inv0 = db.metrics.counters_snapshot().get(
+            "location targeted invalidations", 0)
+        s.sql("update ekv set v = 0 where id = 1")  # NotMaster -> retry
+        assert db.cluster.leader_node(kv_ls) != home
+        assert s.sql(
+            "select v from ekv where id = 1").rows() == [(0,)]
+        snap_ss = db.metrics.counters_snapshot()
+        assert snap_ss.get("location targeted invalidations", 0) > inv0
+        # regression: the OTHER ls's cached leader survived the refresh
+        # (a full clear() would have dumped it)
+        assert other_ls in db.location._cache
+        db.cluster.revive_node(home, settle=1.0)
+    finally:
+        db.close()
+
+
+# ------------------------------------------------------------ ls replica VT
+
+
+def test_ls_replica_vt_and_unreachable_sentinel_rule():
+    db, s = _mk_db()
+    try:
+        rows = s.sql(
+            "select ls_id, svr_node, role, unreachable from "
+            "__all_virtual_ls_replica order by ls_id, svr_node").rows()
+        assert len(rows) == 2 * 3  # 2 LS x 3 replicas
+        assert all(r[3] == 0 for r in rows)
+        assert sum(1 for r in rows if r[2] == "LEADER") == 2
+
+        snap0 = build_snapshot(db, 1, 0.0)
+        victim = db.cluster.leader_node(next(iter(db.cluster.ls_groups)))
+        db.cluster.kill_node(victim, settle=3.0)
+        snap1 = build_snapshot(db, 2, 1.0)
+        alerts = [a for a in evaluate_window(snap0, snap1)
+                  if a["rule"] == "replica_unreachable"]
+        assert len(alerts) == 1
+        assert alerts[0]["evidence"]["node"] == victim
+        # edge-triggered: a node that STAYS down does not re-fire
+        snap2 = build_snapshot(db, 3, 2.0)
+        again = [a for a in evaluate_window(snap1, snap2)
+                 if a["rule"] == "replica_unreachable"]
+        assert not again
+        # and the VT now shows the dark replicas
+        rows = s.sql(
+            "select svr_node, unreachable from __all_virtual_ls_replica "
+            f"where svr_node = {victim}").rows()
+        assert rows and all(r[1] == 1 for r in rows)
+    finally:
+        db.close()
+
+
+# -------------------------------------------------------- leader rebalancing
+
+
+def test_plan_leader_moves_decisions():
+    reps = {1: [0, 1, 2], 2: [0, 1, 2]}
+    # evacuation: dead leader moves to the least-loaded alive holder
+    assert plan_leader_moves({1: 0, 2: 1}, reps, {1, 2}) == [(1, 0, 2)]
+    # spread only under pressure, and only when imbalance >= 2
+    assert plan_leader_moves({1: 0, 2: 0}, reps, {0, 1, 2}) == []
+    moves = plan_leader_moves({1: 0, 2: 0}, reps, {0, 1, 2}, spread=True)
+    assert len(moves) == 1 and moves[0][1] == 0
+    assert plan_leader_moves({1: 0, 2: 1}, reps, {0, 1, 2},
+                             spread=True) == []
+    # no alive replica holder: the move is dropped, not invented
+    assert plan_leader_moves({1: 0}, {1: [0]}, {1, 2}) == []
+
+
+def test_rebalance_driver_moves_leader_under_pressure():
+    db, s = _mk_db()
+    try:
+        for ls in db.cluster.ls_groups:
+            db.cluster.transfer_leader(ls, 0)
+        # healthy + unpressured: the maintenance tick plans nothing
+        assert db.maybe_rebalance_leaders(force=True) == []
+        db._qos_pressure = lambda: True
+        moves = db.maybe_rebalance_leaders(force=True)
+        assert len(moves) == 1 and moves[0][1] == 0
+        db.dag_scheduler.run_until_idle()
+        lm = db.rootservice.leader_map()
+        assert sorted(lm.values()) in ([0, 1], [0, 2]), lm
+        assert db.metrics.counters_snapshot().get("leader moved", 0) == 1
+        # serving still correct after the move
+        assert s.sql("select count(*) as n from ekv").rows() == [(64,)]
+    finally:
+        db.close()
+
+
+def test_rebalance_interval_throttle_and_config_gate():
+    db, _s = _mk_db()
+    try:
+        db._qos_pressure = lambda: True
+        for ls in db.cluster.ls_groups:
+            db.cluster.transfer_leader(ls, 0)
+        db.config.set("enable_leader_rebalance", False)
+        assert db.maybe_rebalance_leaders(force=True) == []
+        db.config.set("enable_leader_rebalance", True)
+        assert db.maybe_rebalance_leaders(force=True) != []
+        # within min_interval the unforced driver is a no-op
+        assert db.maybe_rebalance_leaders() == []
+    finally:
+        db.close()
+
+
+# --------------------------------------------------- drain + warm restarts
+
+
+def _handshake(port: int):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+
+    def read_pkt():
+        buf = b""
+        while len(buf) < 4:
+            buf += sock.recv(4 - len(buf))
+        n = int.from_bytes(buf[:3], "little")
+        out = b""
+        while len(out) < n:
+            out += sock.recv(n - len(out))
+        return out
+
+    read_pkt()
+    caps = 0x0200 | 0x8000
+    login = struct.pack("<IIB23x", caps, 1 << 24, 33) + b"root\x00" + b"\x00"
+    sock.sendall(len(login).to_bytes(3, "little") + b"\x01" + login)
+    assert read_pkt()[0] == 0x00
+    return sock, read_pkt
+
+
+def _query(sock, read_pkt, q: str):
+    """None on success, (errno, msg) on ERR."""
+    p = b"\x03" + q.encode()
+    sock.sendall(len(p).to_bytes(3, "little") + b"\x00" + p)
+    first, eofs = True, 0
+    while True:
+        pkt = read_pkt()
+        if first:
+            if pkt[0] == 0xFF:
+                return (int.from_bytes(pkt[1:3], "little"),
+                        pkt[9:].decode(errors="replace"))
+            if pkt[0] == 0x00:
+                return None
+            first = False
+        elif pkt[0] == 0xFE and len(pkt) < 9:
+            eofs += 1
+            if eofs == 2:
+                return None
+
+
+def test_async_front_drain_sheds_and_resume_serves():
+    from oceanbase_tpu.server.async_front import AsyncMySqlFrontend
+
+    db, _s = _mk_db()
+    fe = AsyncMySqlFrontend(db).start()
+    try:
+        sock, rp = _handshake(fe.port)
+        assert _query(sock, rp, "select count(*) as n from ekv") is None
+        info = fe.drain(timeout=5)
+        assert info["inflight"] == 0
+        # queued statements shed with the retryable shutdown error
+        err = _query(sock, rp, "select count(*) as n from ekv")
+        assert err is not None and err[0] == 1053
+        assert fe.shed >= 1
+        # listener is closed: a new connection is refused while drained
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", fe.port), timeout=0.5)
+        fe.resume()
+        assert _query(sock, rp, "select count(*) as n from ekv") is None
+        sock2, rp2 = _handshake(fe.port)  # accepting again
+        assert _query(sock2, rp2, "select 1 as x") is None
+        sock.close()
+        sock2.close()
+    finally:
+        fe.stop()
+        db.close()
+
+
+def test_simulate_node_restart_warm_boots_from_artifacts(tmp_path):
+    db, s = _mk_db(data_dir=str(tmp_path / "node"), fsync=False)
+    try:
+        s.sql("alter system set ob_plan_artifact_mode = 'rw'")
+        hot = ("select v % 7 as g, count(*) as c, sum(v + id) as s "
+               "from ekv group by g order by s desc, g")
+        rows0 = s.sql(hot).rows()
+        rows0 = s.sql(hot).rows()
+        ex = db.engine.executor
+        warm0 = db.metrics.counters_snapshot().get(
+            "plan artifact warm load", 0)
+        db.simulate_node_restart(1)
+        c0 = ex.compiles + ex.batched_compiles
+        rows1 = s.sql(hot).rows()
+        # first statement after the restart: warm artifact hit,
+        # zero cold JIT compiles, bit-identical rows
+        assert (ex.compiles + ex.batched_compiles) - c0 == 0
+        assert rows1 == rows0
+        assert db.metrics.counters_snapshot().get(
+            "plan artifact warm load", 0) > warm0
+    finally:
+        db.close()
+
+
+def test_rolling_restart_serves_through_with_retries():
+    """All 3 nodes restart in sequence while a client keeps writing and
+    reading through share/retry.py — zero failed statements."""
+    db, s = _mk_db()
+    try:
+        stop = threading.Event()
+        errs: list = []
+        done = [0]
+
+        def client():
+            cs = _bounded(db)
+            nid = 5000
+            while not stop.is_set():
+                nid += 1
+                try:
+                    cs.sql(f"insert into ekv values ({nid}, 1)")
+                    cs.sql("select count(*) as n from ekv")
+                    done[0] += 2
+                except Exception as e:  # noqa: BLE001 — any failure fails
+                    errs.append(repr(e))
+                time.sleep(0.01)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        for node in range(3):
+            db.simulate_node_restart(node, settle=1.0)
+        time.sleep(0.2)
+        stop.set()
+        t.join(timeout=60)
+        assert not errs, errs[:3]
+        assert done[0] > 0
+    finally:
+        db.close()
+
+
+# ------------------------------------------------------------- observability
+
+
+def test_follower_counters_surface_in_sysstat_and_system_event():
+    db, _s = _mk_db()
+    try:
+        b = _bounded(db)
+        b.sql("select count(*) as n from ekv")
+        names = {r[0] for r in _s_rows(b, "__all_virtual_sysstat")}
+        assert "follower read hits" in names
+        # the wait-event and reject counters appear once exercised (the
+        # lag test covers that); the VT surface itself must exist
+        evs = b.sql("select event from __all_virtual_system_event").rows()
+        assert isinstance(evs, list)
+    finally:
+        db.close()
+
+
+def _s_rows(sess, vt: str):
+    return sess.sql(f"select name, value from {vt}").rows()

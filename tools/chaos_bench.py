@@ -455,6 +455,440 @@ def failover_warmboot_leg(verbose: bool = False) -> dict:
     return out
 
 
+# ------------------------------------------------------------- elastic leg
+
+#: staleness bound the elastic flood sessions request (5 virtual seconds)
+ELASTIC_MAX_STALE_US = 5_000_000
+
+# flood statements: single-table on purpose — a flood read never needs a
+# log stream the fault schedule just beheaded, so follower reads keep
+# serving straight through the election. `{a}` is the AS OF SNAPSHOT
+# splice point for the bit-identity replay against the leader.
+ELASTIC_HOT = [
+    "select v % 7 as g, count(*) as c, sum(v) as s from elastic_kv{a} "
+    "group by g order by s desc, g",
+    "select count(*) as n, sum(v) as s, min(id) as lo, max(id) as hi "
+    "from elastic_kv{a}",
+    "select id, v from elastic_kv{a} where id > 40 and id <= 90 order by id",
+    "select (v + id) % 5 as b, count(*) as c from elastic_kv{a} "
+    "group by b order by b",
+]
+
+# rolling-restart control statement: a join + group-by heavy enough that
+# re-deriving it (trace + XLA compile) is unmissable — the restarted
+# node's first statement must hit a warm artifact instead
+ELASTIC_CONTROL = (
+    "select k.v % 7 as g, count(*) as c, sum(k.v + d.w) as s "
+    "from elastic_kv k join elastic_dim d on k.v = d.k "
+    "where k.id > 3 group by g order by s desc")
+
+
+def _pctl(lat: list, q: float) -> float:
+    if not lat:
+        return 0.0
+    xs = sorted(lat)
+    return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+
+def _elastic_flood(db, n_clients: int, stmts_each: int, seed: int,
+                   kill_after: int | None = None) -> dict:
+    """Closed-loop bounded-staleness reader flood (flash crowd). When
+    kill_after is set, the main thread kills the elastic_kv leader node
+    once that many statements completed — mid-flood, by construction —
+    and revives it after the flood drains."""
+    import threading
+    import time
+
+    CLASSIFIED = classified_errors()
+    lock = threading.Lock()
+    lats: list[float] = []
+    classified: list[tuple] = []
+    raws: list[tuple] = []
+    violations = [0]
+    samples: list[tuple] = []
+    done = [0]
+    kill_gate = threading.Event()
+
+    hits0 = db.metrics.counters_snapshot().get("follower read hits", 0)
+
+    def client(idx: int) -> None:
+        s = db.session()
+        s.sql("set ob_read_consistency = 'bounded_staleness'")
+        s.sql(f"set ob_max_read_stale_us = {ELASTIC_MAX_STALE_US}")
+        rng = random.Random(seed * 7919 + idx)
+        mine: list[float] = []
+        for i in range(stmts_each):
+            qi = rng.randrange(len(ELASTIC_HOT))
+            q = ELASTIC_HOT[qi].format(a="")
+            t0 = time.perf_counter()
+            try:
+                rs = s.sql(q)
+                mine.append(time.perf_counter() - t0)
+                fr = s.last_follower_read
+                if fr is not None:
+                    snap, stale = fr
+                    if stale > ELASTIC_MAX_STALE_US:
+                        with lock:
+                            violations[0] += 1
+                    if (i + idx) % 8 == 0:
+                        with lock:
+                            samples.append((qi, snap, rs.rows()))
+            except CLASSIFIED as e:
+                with lock:
+                    classified.append((idx, i, type(e).__name__))
+            except Exception as e:  # noqa: BLE001 — raw leak, recorded
+                with lock:
+                    raws.append((idx, i, repr(e)))
+            with lock:
+                done[0] += 1
+                if kill_after is not None and done[0] >= kill_after:
+                    kill_gate.set()
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+
+    victim = None
+    if kill_after is not None:
+        kill_gate.wait(timeout=120)
+        kv_ls = next(ls for ls, _tab in
+                     db.tables["elastic_kv"].all_partitions())
+        victim = db.cluster.leader_node(kv_ls)
+        db.cluster.kill_node(victim, settle=0.5)
+    for t in threads:
+        t.join(timeout=300)
+    if victim is not None:
+        db.cluster.revive_node(victim, settle=1.0)
+
+    hits1 = db.metrics.counters_snapshot().get("follower read hits", 0)
+    return {
+        "statements": n_clients * stmts_each,
+        "p50_ms": round(_pctl(lats, 0.50) * 1e3, 3),
+        "p99_ms": round(_pctl(lats, 0.99) * 1e3, 3),
+        "follower_hits": int(hits1 - hits0),
+        "staleness_violations": violations[0],
+        "classified": len(classified),
+        "raw_failures": raws,
+        "victim": victim,
+        "_samples": samples,
+    }
+
+
+def _elastic_identity(db, samples: list, seed: int,
+                      max_checks: int = 12) -> dict:
+    """Replay a seeded subset of follower reads on the LEADER at the
+    identical snapshot (AS OF SNAPSHOT splice) — rows must bit-match."""
+    rng = random.Random(seed ^ 0xE1A5)
+    picks = samples if len(samples) <= max_checks else \
+        rng.sample(samples, max_checks)
+    s = db.session()  # strong consistency: the leader path
+    mismatches = []
+    for qi, snap, rows in picks:
+        q = ELASTIC_HOT[qi].format(a=f" as of snapshot {snap}")
+        want = s.sql(q).rows()
+        if want != rows:
+            mismatches.append({"query": qi, "snapshot": snap,
+                               "follower": rows[:4], "leader": want[:4]})
+    return {"checked": len(picks), "mismatches": mismatches}
+
+
+class _WireClient:
+    """Minimal blocking MySQL client for the rolling-restart phase: a
+    shed statement (1053) or a refused/refused-mid-drain connection is
+    retried transparently — the peer-redrive a production router does —
+    so the statement stream sees zero failures or it is a bench fail."""
+
+    def __init__(self, port: int, setup: list):
+        import socket
+
+        self.port = port
+        self.setup = setup
+        self.sock: "socket.socket | None" = None
+        self.retries = 0
+        self.reconnects = 0
+
+    def _connect(self) -> None:
+        import socket
+        import struct
+
+        sock = socket.create_connection(("127.0.0.1", self.port),
+                                        timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = sock
+        self._read_pkt()  # greeting
+        caps = 0x0200 | 0x8000  # PROTOCOL_41 | SECURE_CONNECTION
+        login = (struct.pack("<IIB23x", caps, 1 << 24, 33)
+                 + b"root\x00" + b"\x00")
+        sock.sendall(len(login).to_bytes(3, "little") + b"\x01" + login)
+        if self._read_pkt()[0] != 0x00:
+            raise ConnectionError("login refused")
+        for q in self.setup:
+            err = self._query_once(q)
+            if err is not None:
+                raise ConnectionError(f"setup failed: {err}")
+
+    def _read_n(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            if not c:
+                raise ConnectionError("peer closed")
+            buf += c
+        return buf
+
+    def _read_pkt(self) -> bytes:
+        head = self._read_n(4)
+        return self._read_n(int.from_bytes(head[:3], "little"))
+
+    def _query_once(self, q: str):
+        """None on success, (errno, msg) on an ERR packet."""
+        p = b"\x03" + q.encode()
+        self.sock.sendall(len(p).to_bytes(3, "little") + b"\x00" + p)
+        first, eofs = True, 0
+        while True:
+            pkt = self._read_pkt()
+            if first:
+                if pkt[0] == 0xFF:
+                    return (int.from_bytes(pkt[1:3], "little"),
+                            pkt[9:].decode(errors="replace"))
+                if pkt[0] == 0x00:
+                    return None
+                first = False
+            elif pkt[0] == 0xFE and len(pkt) < 9:
+                eofs += 1
+                if eofs == 2:
+                    return None
+
+    def query(self, q: str, stop) -> "tuple | None":
+        """Redrive shed statements and reconnect through drain windows;
+        returns the first NON-retryable error, None on success."""
+        import time
+
+        while True:
+            if self.sock is None:
+                try:
+                    self._connect()
+                    self.reconnects += 1
+                except OSError:
+                    if stop.is_set():
+                        return None
+                    time.sleep(0.05)
+                    continue
+            try:
+                err = self._query_once(q)
+            except OSError:
+                self.sock = None  # dropped mid-statement: reconnect
+                if stop.is_set():
+                    return None
+                continue
+            if err is None:
+                return None
+            if err[0] == 1053:  # shed by a draining node: redrive
+                self.retries += 1
+                if stop.is_set():
+                    return None
+                time.sleep(0.05)
+                continue
+            return err
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def _elastic_roll(db, fe, seed: int, verbose: bool) -> dict:
+    """Full rolling restart of all 3 nodes under a live wire workload:
+    node 0 (the listener host) drains first, every node loses its memory
+    plan tiers and warm-boots from the artifact store, and the client
+    statement stream must complete with ZERO failures."""
+    import threading
+    import time
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    failures: list[tuple] = []
+    stmts = [0]
+
+    def wire_worker(idx: int) -> None:
+        c = _WireClient(fe.port, [
+            "set ob_read_consistency = 'bounded_staleness'",
+            f"set ob_max_read_stale_us = {ELASTIC_MAX_STALE_US}",
+        ])
+        rng = random.Random(seed * 104729 + idx)
+        while not stop.is_set():
+            q = ELASTIC_HOT[rng.randrange(len(ELASTIC_HOT))].format(a="")
+            err = c.query(q, stop)
+            with lock:
+                stmts[0] += 1
+                if err is not None:
+                    failures.append((idx, q, err))
+        wire_stats[idx] = (c.retries, c.reconnects)
+        c.close()
+
+    n_wire = 4
+    wire_stats: dict[int, tuple] = {}
+    threads = [threading.Thread(target=wire_worker, args=(i,), daemon=True)
+               for i in range(n_wire)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # clients flowing before the roll starts
+
+    control = db.session()
+    ex = db.engine.executor
+    per_node = []
+    for node in range(db.cluster.n_nodes):
+        snap0 = db.metrics.counters_snapshot()
+        shed0 = fe.shed
+        if node == 0:
+            # the listener host restarts: drain (finish in-flight, shed
+            # queued to the retrying clients), restart, reopen the port
+            drained = fe.drain(timeout=30)
+            db.simulate_node_restart(node, settle=1.0)
+            fe.resume()
+        else:
+            drained = None
+            db.simulate_node_restart(node, settle=1.0)
+        c0 = ex.compiles + ex.batched_compiles
+        control.sql(ELASTIC_CONTROL)
+        first_compiles = (ex.compiles + ex.batched_compiles) - c0
+        snap1 = db.metrics.counters_snapshot()
+        rec = {
+            "node": node,
+            "drained": drained,
+            "shed": fe.shed - shed0,
+            "warm_loads": int(snap1.get("plan artifact warm load", 0)
+                              - snap0.get("plan artifact warm load", 0)),
+            "first_stmt_compiles": int(first_compiles),
+        }
+        per_node.append(rec)
+        if verbose:
+            print(f"  restart node {node}: {rec}")
+    time.sleep(0.5)  # post-roll serving proof before stopping clients
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    return {
+        "client_statements": stmts[0],
+        "client_failures": failures,
+        "client_retries": sum(r for r, _ in wire_stats.values()),
+        "client_reconnects": sum(r for _, r in wire_stats.values()),
+        "per_node": per_node,
+    }
+
+
+def elastic_leg(seed: int = 11, clients: int = 8, stmts_each: int = 40,
+                verbose: bool = False) -> dict:
+    """The --elastic gate: flash crowd -> leader kill mid-flood ->
+    bit-identity replay -> full rolling restart. Returns the JSON-ready
+    report with an "ok" verdict and per-check detail."""
+    import shutil
+    import tempfile
+    import time
+
+    from oceanbase_tpu.server import Database
+    from oceanbase_tpu.server.async_front import AsyncMySqlFrontend
+
+    d = tempfile.mkdtemp(prefix="chaos_elastic_")
+    fe = None
+    db = None
+    t_start = time.perf_counter()
+    try:
+        db = Database(n_nodes=3, n_ls=2, data_dir=d, fsync=False)
+        s = db.session()
+        s.sql("alter system set ob_plan_artifact_mode = 'rw'")
+        s.sql("create table elastic_kv "
+              "(id bigint primary key, v bigint not null)")
+        s.sql("create table elastic_dim "
+              "(k bigint primary key, w bigint not null)")
+        s.sql("insert into elastic_kv values " + ", ".join(
+            f"({i}, {i * 37 % 1000})" for i in range(1, 257)))
+        s.sql("insert into elastic_dim values " + ", ".join(
+            f"({i}, {i * 3})" for i in range(1000)))
+        for q in ELASTIC_HOT:
+            s.sql(q.format(a=""))
+        s.sql(ELASTIC_CONTROL)
+
+        # background writer: keeps GTS and the kv apply watermark moving
+        # so bounded-staleness reads stay provably fresh through faults
+        import threading
+
+        wstop = threading.Event()
+        wstats = {"ok": 0, "classified": 0}
+
+        def writer() -> None:
+            ws = db.session()
+            wrng = random.Random(seed ^ 0xA11CE)
+            nid = 100000
+            CLASSIFIED = classified_errors()
+            while not wstop.is_set():
+                nid += 1
+                try:
+                    ws.sql(f"insert into elastic_kv values "
+                           f"({nid}, {wrng.randrange(1000)})")
+                    wstats["ok"] += 1
+                except CLASSIFIED:
+                    wstats["classified"] += 1
+                time.sleep(0.01)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+
+        pre = _elastic_flood(db, clients, stmts_each, seed)
+        kill = _elastic_flood(db, clients, int(stmts_each * 1.5), seed + 1,
+                              kill_after=clients * stmts_each // 3)
+        wstop.set()
+        wt.join(timeout=30)
+
+        identity = _elastic_identity(
+            db, pre.pop("_samples") + kill.pop("_samples"), seed)
+
+        fe = AsyncMySqlFrontend(db).start()
+        rolling = _elastic_roll(db, fe, seed, verbose)
+
+        checks = {
+            "follower_reads_served": kill["follower_hits"] > 0,
+            "zero_staleness_violations":
+                pre["staleness_violations"] == 0
+                and kill["staleness_violations"] == 0,
+            "bit_identical_to_leader":
+                identity["checked"] > 0 and not identity["mismatches"],
+            "no_raw_failures":
+                not pre["raw_failures"] and not kill["raw_failures"],
+            "kill_p99_bounded":
+                kill["p99_ms"] <= 3.0 * max(pre["p99_ms"], 1.0),
+            "rolling_zero_failed_statements":
+                not rolling["client_failures"]
+                and rolling["client_statements"] > 0,
+            "rolling_warm_restarts": all(
+                r["first_stmt_compiles"] == 0 and r["warm_loads"] > 0
+                for r in rolling["per_node"]),
+        }
+        return {
+            "bench": "chaos_elastic",
+            "seed": seed,
+            "ok": all(checks.values()),
+            "checks": checks,
+            "pre_kill": pre,
+            "kill": kill,
+            "identity": identity,
+            "rolling": rolling,
+            "writer": dict(wstats),
+            "total_s": round(time.perf_counter() - t_start, 1),
+        }
+    finally:
+        if fe is not None:
+            fe.stop()
+        if db is not None:
+            db.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -466,8 +900,42 @@ def main() -> int:
     ap.add_argument("--failover-warmboot", action="store_true",
                     help="A/B leg: restart time-to-first-warm-hit with the "
                          "plan artifact store on (rw) vs off")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic serving gate: flash crowd + leader kill "
+                         "mid-flood + bit-identity replay + full rolling "
+                         "restart under live wire clients")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
+    if args.elastic:
+        import json
+
+        rep = elastic_leg(seed=args.seed if args.seed != 7 else 11,
+                          verbose=args.verbose)
+        tools = os.path.dirname(os.path.abspath(__file__))
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        from bench_meta import collect as bench_meta
+
+        rep["meta"] = bench_meta(None)
+        line = json.dumps(rep)
+        print(line, flush=True)
+        bench_out = os.environ.get("BENCH_OUT")
+        if bench_out:
+            with open(bench_out, "a") as f:
+                f.write(line + "\n")
+        if not rep["ok"]:
+            for name, ok in rep["checks"].items():
+                if not ok:
+                    print(f"ELASTIC FAIL: {name}", file=sys.stderr)
+            return 1
+        k = rep["kill"]
+        print(f"elastic OK: {k['follower_hits']} follower reads through "
+              f"the kill (p99 {rep['pre_kill']['p99_ms']}ms -> "
+              f"{k['p99_ms']}ms), {rep['identity']['checked']} "
+              "bit-identity replays, rolling restart served "
+              f"{rep['rolling']['client_statements']} statements with "
+              f"{len(rep['rolling']['client_failures'])} failures")
+        return 0
     if args.failover_warmboot:
         leg = failover_warmboot_leg(verbose=args.verbose)
         on, off = leg["rw"], leg["off"]

@@ -5,7 +5,8 @@
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
-#                      [--advisor] [--warmboot] [extra pytest args...]
+#                      [--advisor] [--warmboot] [--elastic]
+#                      [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -50,6 +51,16 @@
 # faster than the cold leg; the JSON summary (with provenance) lands in
 # $BENCH_OUT when set.
 #
+# --elastic additionally runs the elastic-serving gate
+# (tools/chaos_bench.py --elastic): a bounded-staleness flash crowd with
+# a leader kill mid-flood (follower reads must keep serving with zero
+# staleness violations, bit-identical to leader reads at the same
+# snapshot, aggregate p99 <= 3x pre-kill), then a full rolling restart
+# of all 3 nodes under live wire clients — zero failed statements, each
+# restarted node's first statement a warm plan-artifact hit with 0 cold
+# JIT compiles; the JSON artifact (with bench_meta provenance) lands in
+# $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -68,6 +79,7 @@ awr=0
 health=0
 advisor=0
 warmboot=0
+elastic=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -77,6 +89,7 @@ while true; do
         --health) health=1; shift ;;
         --advisor) advisor=1; shift ;;
         --warmboot) warmboot=1; shift ;;
+        --elastic) elastic=1; shift ;;
         *) break ;;
     esac
 done
@@ -137,6 +150,11 @@ fi
 
 if [ "$warmboot" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/warmboot_smoke.py
+    rc=$?
+fi
+
+if [ "$elastic" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_bench.py --elastic
     rc=$?
 fi
 exit $rc
