@@ -394,6 +394,14 @@ class Executor:
         # through compile(), which is what the warm-boot smoke pins:
         # compiles + batched_compiles stays 0 across a warm replay
         self.compiles = 0
+        # hook: engine/memory_governor.MemoryGovernor — when wired, its
+        # (OOM-shrunk) effective budget clamps the static device budget
+        # so prepare() routes oversized inputs through the chunked path
+        # instead of attempting an unguarded whole-table upload
+        self.governor = None
+        # set on degraded host-fallback executors: disables the
+        # EN_DEVICE_OOM injection point (host execution cannot device-OOM)
+        self.host_fallback = False
 
     # ---- input preparation -------------------------------------------
     def _collect_scans(self, plan: LogicalOp) -> list[Scan]:
@@ -3058,17 +3066,28 @@ class Executor:
                 plan_input_bytes,
             )
 
-            if plan_input_bytes(self, plan) > self.device_budget:
+            # the memory governor's effective budget (shrunk after any
+            # observed OOM) clamps the static streaming threshold, so an
+            # oversized scan is routed through the chunked path up front
+            # instead of gambling on a whole-table upload
+            budget = self.device_budget
+            gov = self.governor
+            if gov is not None:
+                budget = min(budget, gov.upload_budget())
+            if plan_input_bytes(self, plan) > budget:
                 try:
                     stream, split, kind = _find_stream_split(
-                        self, plan, self.device_budget)
+                        self, plan, budget)
                     cp = ChunkedPreparedPlan(
                         self, plan, stream, split, kind, self.chunk_rows
                     )
                     cp.access_profile = access
                     return cp
                 except NotStreamable:
-                    pass  # whole-table upload; may exhaust device memory
+                    # whole-table upload: governor-accounted at admission;
+                    # a residual device OOM is absorbed by the retry
+                    # ladder (evict -> chunk -> host), never a crash
+                    pass
         params = self.seed_params(plan)
         jitted, input_spec, overflow_nodes = self.compile(plan, params)
         prepared = PreparedPlan(

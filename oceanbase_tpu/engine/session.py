@@ -517,8 +517,15 @@ class Session:
         only in-statement sync is the overflow-counter + row-count fetch.
         Column data stays device-resident behind the DeviceResult cursor
         until the caller touches it."""
+        from ..share.errsim import errsim_point
         from ..sql.json_host import apply_host_json
 
+        if not getattr(ex, "host_fallback", False):
+            # device OOM injection point (EN_DEVICE_OOM): covers the fast
+            # path, the full path and chunked dispatch alike. A host-
+            # fallback executor never device-OOMs, which is what lets the
+            # degradation ladder's final rung terminate.
+            errsim_point("EN_DEVICE_OOM")
         jn = getattr(entry, "json_specs", ())
         prepared = entry.prepared
         retries0 = getattr(prepared, "retries", 0)

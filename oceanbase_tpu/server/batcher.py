@@ -300,6 +300,11 @@ class StatementBatcher:
         # ob_batch_queue_depth); Database re-seeds these on hot reload
         self.follower_timeout_s = 10.0
         self.queue_depth = 32
+        # hook: engine/memory_governor.MemoryGovernor — while the device
+        # ledger is under pressure, wide batches (one dispatch holding
+        # many lanes' working sets at once) are exactly the wrong shape;
+        # execute() clamps the cohort width until pressure clears
+        self.governor = None
 
     # ------------------------------------------------------------ public
     def execute(self, hit, max_size: int, wait_us: int):
@@ -316,6 +321,14 @@ class StatementBatcher:
         gate = self.gate
         entry = hit.entry
         prepared = entry.prepared
+        gov = self.governor
+        if gov is not None and max_size > 2 and gov.under_pressure():
+            # device memory pressure: narrow the cohort so one batched
+            # dispatch can't concentrate the working sets the governor
+            # is busy queueing individual statements over
+            max_size = 2
+            if m is not None and m.enabled:
+                m.add("stmt batch memory clamp")
         if not self.enabled or max_size <= 1:
             return self._solo_token()
         if not getattr(prepared, "batchable", False):

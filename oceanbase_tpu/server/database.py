@@ -226,7 +226,15 @@ class TenantUnit:
 
     max_workers: int | None = None  # concurrent statements
     queue_timeout_s: float = 5.0  # wait for a worker slot
-    memory_limit: int | None = None  # bytes of resident catalog snapshots
+    #: unified tenant memory quota, charged by TWO consumers sharing one
+    #: accounting surface: (1) resident catalog snapshot bytes, enforced
+    #: by Database._enforce_memory (evicts the tenant's OWN coldest
+    #: tables, never a neighbour's); (2) live device-memory reservations,
+    #: charged by the memory governor at statement admission
+    #: (engine/memory_governor.py) — a tenant at its limit QUEUES on the
+    #: "device memory reservation" wait event rather than evicting
+    #: another tenant's residency. None = unbounded (sys tenant default).
+    memory_limit: int | None = None
     px_target: int | None = None  # cluster-parallelism quota
     # continuous-batching admission share: the dispatch gate's weighted
     # round-robin picks this tenant's queued cohorts `weight` times per
@@ -713,6 +721,35 @@ class Database:
         self.config.on_change(
             "ob_tenant_admission_slots",
             lambda _n, _o, v: setattr(gate, "slots", v))
+        # device-memory governor: ONE per-device HBM ledger shared by
+        # every tenant on the cluster (like the dispatch gate) — per-
+        # tenant shares seeded from TenantUnit.memory_limit, statement
+        # admission reserves its estimated peak working set before any
+        # upload. Knobs: ob_device_memory_limit (0 = auto/synthetic),
+        # ob_governor_queue_timeout, ob_governor_max_queue,
+        # ob_governor_cold_reserve
+        from ..engine.memory_governor import (MemoryGovernor,
+                                              detect_device_budget)
+
+        gov = getattr(self.cluster, "_memory_governor", None)
+        if gov is None:
+            limit = int(self.config["ob_device_memory_limit"])
+            gov = MemoryGovernor(
+                limit if limit > 0 else detect_device_budget(),
+                max_queue=self.config["ob_governor_max_queue"])
+            self.cluster._memory_governor = gov
+        self.governor = gov
+        gov.register_tenant(self.tenant_name, self.unit.memory_limit,
+                            self._resident_bytes)
+        self.engine.executor.governor = gov
+        self.batcher.governor = gov
+        self.config.on_change(
+            "ob_device_memory_limit",
+            lambda _n, _o, v: gov.set_budget(
+                int(v) if int(v) > 0 else detect_device_budget()))
+        self.config.on_change(
+            "ob_governor_max_queue",
+            lambda _n, _o, v: setattr(gov, "max_queue", int(v)))
         # one shared virtual-clock closure: sql() builds a statement
         # Deadline from it on every call — no per-statement lambda
         self._bus_clock = lambda: self.cluster.bus.now
@@ -2078,6 +2115,26 @@ class Database:
                 f"({self._resident_bytes()} > {limit} bytes)"
             )
 
+    def _evict_cold_residency(self) -> None:
+        """Degradation ladder rung 1 (after a device OOM): free the
+        coldest device-resident state without touching durable data —
+        cached device batches of low-priority tables (advisor residency
+        priorities order the walk, like _enforce_memory) and half the
+        decoded block cache. Everything re-materializes on next use."""
+        ex = self.engine.executor
+        order = sorted(
+            {k[0] for k in ex._batch_cache} | {k[0] for k in ex._assembled},
+            key=lambda n: self.residency_priority.get(n, 0.0),
+        )
+        for name in order:
+            ex.invalidate_table(name)
+        bc = self.block_cache
+        if bc.bytes_used > 0:
+            cap = bc.capacity_bytes
+            bc.set_capacity(bc.bytes_used // 2)
+            bc.capacity_bytes = cap  # one-shot trim, budget unchanged
+        self.metrics.add("residency evictions: device oom")
+
     _UID_MISS = object()
 
     def _block_priority(self, key) -> float:
@@ -2208,6 +2265,14 @@ class DbSession:
         self._fast_reg = None
         # lazily-created statement-summary accumulator (workload.py)
         self._ws_acc = None
+        # device-OOM degradation ladder state (reset per statement in
+        # _sql_inner): None | "chunk" | "host", plus the fired rungs
+        self._degrade_mode = None
+        self._ladder = []
+        # text -> digest memo for the governor's admission estimate (a
+        # serving session repeats few texts; re-tokenizing each repeat
+        # just to look up its measured peak would tax the fast path)
+        self._digest_memo: dict[str, str] = {}
         # session variables (SET <name> = <value>): full-link trace
         # collection flag, PX degree-of-parallelism routing, and the
         # statement/transaction deadlines in MICROSECONDS of virtual time
@@ -2343,6 +2408,10 @@ class DbSession:
         # ONE metrics.bulk() below
         self._stmt_adds = []
         self._fast_reg = None
+        # degradation-ladder state (device OOM): None -> "chunk" -> "host";
+        # _ladder records the rungs fired, in order, for tests/diagnosis
+        self._degrade_mode = None
+        self._ladder = []
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
@@ -2478,8 +2547,15 @@ class DbSession:
         db = self.db
         schema_v = db.schema_service.version
         ctrl = None
+        reserve_bytes = self._reserve_estimate(text)
         while True:
+            res = None
             try:
+                if reserve_bytes > 0:
+                    # admission-time device-memory reservation, held for
+                    # the whole attempt (re-taken per attempt so post-OOM
+                    # attempts charge the SHRUNK pool)
+                    res = self._reserve_device_memory(reserve_bytes)
                 return self._dispatch(text)
             except Exception as e:
                 if ctrl is None:
@@ -2509,6 +2585,31 @@ class DbSession:
                 m = db.metrics
                 m.add("statement retries")
                 m.add(f"statement retries: {policy.reason}")
+                if policy.reason == "device oom":
+                    # the three-rung degradation ladder: rung N is chosen
+                    # by how many device OOMs THIS statement has already
+                    # absorbed. Each rung strictly weakens the memory
+                    # demand, so the sequence terminates: host execution
+                    # (rung 3) cannot device-OOM at all.
+                    rung = ctrl._per_policy.get("device oom", 0)
+                    m.add("device OOM retries")
+                    if rung <= 1:
+                        # rung 1: evict cold residency + shrink the
+                        # reservation pool, retry the same plan
+                        db._evict_cold_residency()
+                        db.governor.note_oom()
+                        self._ladder.append("evict")
+                    elif rung == 2:
+                        # rung 2: re-plan through the chunked executor,
+                        # chunk size derived from the remaining budget
+                        self._degrade_mode = "chunk"
+                        m.add("stmt degraded chunked")
+                        self._ladder.append("chunked")
+                    else:
+                        # rung 3: host fallback, bit-identical
+                        self._degrade_mode = "host"
+                        m.add("stmt degraded host")
+                        self._ladder.append("host")
                 if policy.flush_plan_cache:
                     db.plan_cache.flush()
                 if policy.refresh_location:
@@ -2527,6 +2628,11 @@ class DbSession:
                         db.cluster.settle(wait)
                 if d is not None and d.expired:
                     raise ctrl.timeout_error(e) from e
+            finally:
+                # the ledger must balance: release THIS attempt's grant on
+                # every exit — success, retry, or surfaced error
+                if res is not None:
+                    res.release()
 
     def _maybe_flight_record(self, text, sp, elapsed_s, rs, err,
                              prof) -> None:
@@ -2770,6 +2876,11 @@ class DbSession:
         Returns None to fall through to the full parse path."""
         db = self.db
         if self._tx is not None or self._vars.get("ob_px_dop", 0) > 0:
+            return None
+        if self._degrade_mode is not None:
+            # a device OOM put this statement on the degradation ladder:
+            # the cached fast plan is exactly what just OOMed — force the
+            # full parse path so _select can re-plan chunked/host
             return None
         if self._vars.get("ob_read_consistency", 0) != 0:
             # the fast tier replays against the shared committed catalog
@@ -3755,6 +3866,49 @@ class DbSession:
         db.metrics.add("follower read hits")
         return rs
 
+    def _select_degraded(self, ast: A.Select, norm_key: str) -> ResultSet:
+        """Device-OOM ladder rungs 2/3: re-drive the statement on a
+        private degraded executor. "chunk" re-plans through the chunked
+        path with a chunk size derived from the budget the governor has
+        left; "host" compiles a fresh plan pinned to the host device
+        (which cannot device-OOM). Both bypass the plan cache — the
+        cached executable is exactly what just OOMed — and both return
+        bit-identical rows to the undegraded plan."""
+        import contextlib
+
+        from ..engine.executor import Executor
+        from ..engine.memory_governor import derive_chunk_rows
+
+        db = self.db
+        base = db.engine.executor
+        if self._degrade_mode == "chunk":
+            remaining = max(db.governor.remaining(), 1)
+            ex = Executor(
+                db.catalog, unique_keys=base.unique_keys, stats=base.stats,
+                device_budget=remaining,
+                chunk_rows=derive_chunk_rows(remaining, base.chunk_rows),
+            )
+            ctx = contextlib.nullcontext()
+        else:  # host fallback
+            ex = Executor(db.catalog, unique_keys=base.unique_keys,
+                          stats=base.stats)
+            ex.chunking_enabled = False
+            ex.host_fallback = True
+            try:
+                import jax
+
+                ctx = jax.default_device(jax.devices("cpu")[0])
+            except Exception:  # no CPU device handle: backend IS the host
+                ctx = contextlib.nullcontext()
+        ex.timeline = base.timeline
+        in_tx = self._tx is not None and self._tx.ctx is not None
+        views = self._tx.views if in_tx else None
+        with ctx, db.catalog.tx_scope(views):
+            rs = db.engine.run_ast(ast, norm_key, use_cache=False,
+                                   executor=ex)
+        self._stmt_cache_hit = False
+        return rs
+
     def _select(self, ast: A.Select, norm_key: str, fast_reg=None
                 ) -> ResultSet:
         fb = _flashback_refs(ast)
@@ -3764,6 +3918,11 @@ class DbSession:
         names = self.db.expand_views(set(raw_names))
         any_vt = self.db.refresh_virtual(names)
         self.last_follower_read = None
+        if self._degrade_mode is not None and not any_vt:
+            # device-OOM ladder rungs 2/3: re-plan on a private degraded
+            # executor (chunked or host), bypassing PX and index routing
+            self.db.refresh_catalog(names, tx=self._tx)
+            return self._select_degraded(ast, norm_key)
         if (self._vars.get("ob_read_consistency", 0) != 0
                 and self._tx is None and not any_vt
                 and self._vars.get("ob_px_dop", 0) == 0
@@ -3871,6 +4030,52 @@ class DbSession:
                 self.db.metrics.add("statement timeouts")
                 raise d._error() from e
             raise _R.PxAdmissionTimeout(str(e)) from e
+
+    def _reserve_estimate(self, text: str) -> int:
+        """Peak-device-bytes estimate for the admission reservation:
+        the workload repository's measured per-digest peak when this
+        statement has run before, else a conservative cold default for
+        reads (ob_governor_cold_reserve). Non-reads reserve nothing —
+        DML device work rides the read paths it triggers."""
+        db = self.db
+        low = text.lstrip().lower()
+        if not low.startswith(("select", "with", "(")):
+            return 0
+        digest = self._digest_memo.get(text)
+        if digest is None:
+            if len(self._digest_memo) >= 256:
+                self._digest_memo.clear()
+            digest = self._digest_memo[text] = P.digest_text(text)
+        measured = db.stmt_summary.peak_estimate(digest)
+        if measured > 0:
+            return measured
+        return int(db.config["ob_governor_cold_reserve"])
+
+    def _reserve_device_memory(self, nbytes: int):
+        """Deadline-bounded device-memory admission (mirrors _px_admit):
+        wait on the governor's ledger no longer than the statement
+        deadline allows. A reservation timeout is retryable (peers
+        release as they finish) unless the deadline was the tighter
+        bound, which surfaces as the statement's timeout."""
+        db = self.db
+        gov = db.governor
+        wait_s = float(db.config["ob_governor_queue_timeout"])
+        d = _R.current_deadline()
+        bounded = d is not None and d.tighter_than(wait_s)
+        if bounded:
+            wait_s = max(d.remaining(), 0.0)
+        with db.metrics.waiting("device memory reservation"):
+            res = gov.reserve(db.tenant_name, nbytes, timeout_s=wait_s)
+        if res is None:
+            db.metrics.add("device memory rejects")
+            if bounded:
+                db.metrics.add("statement timeouts")
+                raise d._error()
+            raise _R.DeviceMemoryTimeout(
+                f"device memory reservation of {nbytes} bytes timed out "
+                f"after {wait_s:.3f}s (reserved {gov.reserved} of "
+                f"{gov.effective_budget()} bytes)")
+        return res
 
     # --------------------------------------------------------------- tx
     def _dml(self, body) -> ResultSet:

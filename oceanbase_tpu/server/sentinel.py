@@ -21,7 +21,12 @@ sentinel test replays a recorded pair and asserts the exact alert set):
   tenant_starvation — one tenant's admission wait diverging from its
       peers' (or repeated worker-queue rejections) in the QoS ledger;
   fastpath_collapse — warm fast-path hit rate falling off a healthy
-      baseline.
+      baseline;
+  replica_unreachable — a node's replicas went dark inside the window
+      (edge-triggered on the keepalive transition);
+  device_memory_pressure — sustained governor reservation-wait p99 plus
+      degraded executions (OOM retries / chunked / host fallbacks) in
+      the window, edge-triggered like replica_unreachable.
 
 Evaluating the same window twice never duplicates an alert: the dedup
 key is (rule, subject key, window-ending snap_id).
@@ -67,6 +72,11 @@ class SentinelConfig:
     fastpath_floor: float = 0.5  # window hit rate at/below = collapse
     fastpath_baseline: float = 0.8  # only off a healthy baseline
     fastpath_min_stmts: int = 20
+    # device_memory_pressure: reservation-wait p99 above the floor AND
+    # degraded executions (OOM retries / chunked / host fallbacks) in
+    # the window
+    govr_wait_p99_s: float = 0.05
+    govr_min_degraded: int = 1
 
 
 @dataclass
@@ -341,6 +351,53 @@ def _rule_replica_unreachable(first, last, cfg, out) -> None:
         })
 
 
+def _rule_device_memory_pressure(first, last, cfg, out) -> None:
+    """Device memory stayed scarce across the window: the governor's
+    reservation-wait p99 is over the floor at the window end AND
+    statements actually degraded (OOM retries, chunked re-plans, host
+    fallbacks or reservation rejects) inside it. Edge-triggered like
+    replica_unreachable: a window that STARTS pressured doesn't re-fire
+    — pressure must clear before the next alert."""
+    g1 = last.get("governor") or {}
+    if not g1:
+        return
+    degraded = int(
+        _sys_delta(first, last, "device OOM retries")
+        + _sys_delta(first, last, "stmt degraded chunked")
+        + _sys_delta(first, last, "stmt degraded host")
+        + _sys_delta(first, last, "device memory rejects"))
+
+    def pressured(snap) -> bool:
+        g = snap.get("governor") or {}
+        return float(g.get("wait_p99_s", 0.0)) >= cfg.govr_wait_p99_s
+
+    if not pressured(last) or degraded < cfg.govr_min_degraded:
+        return
+    if pressured(first):
+        return  # was already pressured at the window start
+    host = int(_sys_delta(first, last, "stmt degraded host"))
+    out.append({
+        "rule": "device_memory_pressure",
+        "severity": "critical" if host else "warn",
+        "key": "",
+        "summary": (f"device memory pressure: reservation-wait p99 "
+                    f"{g1.get('wait_p99_s', 0.0) * 1e3:.1f}ms, {degraded} "
+                    f"degraded executions in window"),
+        "evidence": {
+            "wait_p99_s": g1.get("wait_p99_s", 0.0),
+            "degraded": degraded,
+            "oom_retries": int(_sys_delta(first, last,
+                                          "device OOM retries")),
+            "chunked": int(_sys_delta(first, last, "stmt degraded chunked")),
+            "host": host,
+            "rejects": int(_sys_delta(first, last, "device memory rejects")),
+            "reserved": g1.get("reserved", 0),
+            "effective_budget": g1.get("effective_budget", 0),
+            "shrink": g1.get("shrink", 1.0),
+        },
+    })
+
+
 _RULES = (
     _rule_digest_regression,
     _rule_error_retry,
@@ -349,6 +406,7 @@ _RULES = (
     _rule_tenant_starvation,
     _rule_fastpath_collapse,
     _rule_replica_unreachable,
+    _rule_device_memory_pressure,
 )
 
 

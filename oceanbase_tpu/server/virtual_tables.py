@@ -668,6 +668,36 @@ def _plan_artifact(db) -> Table:
     ])
 
 
+def _memory_governor(db) -> Table:
+    """Device-memory governor ledger (engine/memory_governor.py): the
+    budget and its OOM-shrunk effective value, live/peak reserved bytes,
+    grant/reject/oom counters, reservation-wait p99, and one
+    `reserved:<tenant>` / `limit:<tenant>` row pair per registered
+    tenant share."""
+    gov = getattr(db, "governor", None)
+    st = gov.stats() if gov is not None else {}
+    rows: list[tuple[str, int]] = [
+        ("budget", int(st.get("budget", 0))),
+        ("effective_budget", int(st.get("effective_budget", 0))),
+        ("reserved", int(st.get("reserved", 0))),
+        ("peak_reserved", int(st.get("peak_reserved", 0))),
+        ("waiters", int(st.get("waiters", 0))),
+        ("grants", int(st.get("grants", 0))),
+        ("rejects", int(st.get("rejects", 0))),
+        ("oom_notes", int(st.get("oom_notes", 0))),
+        ("shrink_pct", int(round(st.get("shrink", 1.0) * 100))),
+        ("wait_p99_us", int(st.get("wait_p99_s", 0.0) * 1e6)),
+    ]
+    for name, t in sorted(st.get("tenants", {}).items()):
+        rows.append((f"reserved:{name}", int(t["reserved"])))
+        rows.append((f"limit:{name}",
+                     int(t["limit"]) if t["limit"] is not None else -1))
+    return _t("__all_virtual_memory_governor", [
+        ("metric", DataType.varchar(), [m for m, _ in rows]),
+        ("value", DataType.int64(), [v for _, v in rows]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -714,4 +744,5 @@ PROVIDERS = {
     "__all_virtual_alert_history": _alert_history,
     "__all_virtual_layout_advisor": _layout_advisor,
     "__all_virtual_plan_artifact": _plan_artifact,
+    "__all_virtual_memory_governor": _memory_governor,
 }

@@ -456,6 +456,19 @@ class StatementSummaryRegistry:
                                 key=lambda s: s.seq)[:over]:
                     del self._map[s.digest]
 
+    def peak_estimate(self, digest: str) -> int:
+        """Measured peak device working set of a digest (bytes), 0 when
+        the digest is cold. The memory governor sizes admission-time
+        reservations from this — the feedback loop that turns measured
+        QueryProfile peaks into next-execution estimates. Reads the
+        merged map only (no accumulator flush): this sits on the
+        admission path of every read, and an estimate that lags one
+        drain window is still conservative enough — cold digests fall
+        back to ob_governor_cold_reserve anyway."""
+        with self._lock:
+            s = self._map.get(digest)
+            return int(s.max_peak_bytes) if s is not None else 0
+
     def snapshot(self) -> list[dict]:
         self.flush_all()
         with self._lock:
@@ -769,6 +782,10 @@ def build_snapshot(db, snap_id: int, ts: float) -> dict:
         # replica serving health (keepalive reachability + watermark lag):
         # the replica_unreachable sentinel rule's input
         "ls_replica": ls_replica_health(db),
+        # device-memory governor ledger (reservation pressure + shrink
+        # state): the device_memory_pressure sentinel rule's input
+        "governor": (db.governor.stats()
+                     if getattr(db, "governor", None) is not None else {}),
     }
 
 

@@ -243,6 +243,21 @@ def default_params() -> list[Param]:
         Param("leader_rebalance_min_interval", "time", 5.0,
               "floor between rootserver rebalance passes (hysteresis "
               "against leader ping-pong)"),
+        # device memory governor
+        Param("ob_device_memory_limit", "capacity", 0,
+              "device HBM budget the memory governor reserves against; "
+              "0 = auto (a fraction of detected HBM, or a synthetic "
+              "budget on CPU backends)", scope="cluster", min=0),
+        Param("ob_governor_queue_timeout", "time", 5.0,
+              "max wait on the 'device memory reservation' event before "
+              "a statement is rejected (deadline-bounded)", min=0.0),
+        Param("ob_governor_max_queue", "int", 64,
+              "queue-depth backpressure: reservation requests beyond "
+              "this many waiters are rejected immediately",
+              scope="cluster", min=1, max=1 << 16),
+        Param("ob_governor_cold_reserve", "capacity", 16 << 20,
+              "conservative peak-working-set reservation for digests "
+              "the workload repository has not measured yet", min=0),
         # storage
         Param("block_cache_size", "capacity", 256 << 20,
               "budget for decoded micro-block column cache"),

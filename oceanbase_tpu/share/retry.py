@@ -63,6 +63,19 @@ class CommitUnknown(Exception):
     re-driven. Non-retryable, surfaced as a timeout class."""
 
 
+class DeviceOOM(Exception):
+    """Device memory exhausted mid-statement (XlaRuntimeError:
+    RESOURCE_EXHAUSTED, or its errsim twin EN_DEVICE_OOM on CPU chaos
+    runs). Retryable through the degradation ladder: evict + shrink,
+    re-plan chunked, finally execute on host — never surfaced raw."""
+
+
+class DeviceMemoryTimeout(Exception):
+    """Device-memory reservation wait exceeded its bound (the governor
+    queue stayed full). Retryable: reservations free up as peers
+    finish, exactly like PX admission quota."""
+
+
 # ---------------------------------------------------------------- policies
 
 #: policy kinds (mirrors ObQueryRetryCtrl's retry_type)
@@ -128,6 +141,26 @@ WRITE_CONFLICT = RetryPolicy(
     base_wait=0.02, max_wait=0.5,
 )
 
+#: Device OOM: exactly three attempts — one per rung of the degradation
+#: ladder (evict + shrink pool, re-plan chunked, host fallback). The
+#: host rung cannot OOM, so a fourth attempt would mean a logic bug.
+DEVICE_OOM = RetryPolicy(
+    kind=CAPPED, reason="device oom",
+    base_wait=0.02, max_wait=0.5, max_retries=3,
+)
+
+DEVICE_MEMORY = RetryPolicy(
+    kind=CAPPED, reason="device memory reservation timeout",
+    base_wait=0.05, max_wait=1.0, max_retries=4,
+)
+
+
+def _is_xla_oom(err: BaseException) -> bool:
+    """Recognize a real XLA RESOURCE_EXHAUSTED without importing jax
+    (share/ must stay importable on bare interpreters)."""
+    return ("XlaRuntimeError" in type(err).__name__
+            and "RESOURCE_EXHAUSTED" in str(err))
+
 
 def classify(err: BaseException) -> RetryPolicy:
     """Map an engine failure onto its retry policy.
@@ -145,6 +178,10 @@ def classify(err: BaseException) -> RetryPolicy:
         return PX_ADMISSION
     if isinstance(err, SchemaVersionMismatch):
         return SCHEMA_EAGAIN
+    if isinstance(err, DeviceOOM) or _is_xla_oom(err):
+        return DEVICE_OOM
+    if isinstance(err, DeviceMemoryTimeout):
+        return DEVICE_MEMORY
     if isinstance(err, InjectedError):
         return INJECTED_TRANSIENT
     try:
@@ -317,10 +354,12 @@ class RetryController:
 __all__ = [
     "StatementTimeout", "QueryTimeout", "TrxTimeout", "StaleLocation",
     "PxAdmissionTimeout", "SchemaVersionMismatch", "CommitUnknown",
+    "DeviceOOM", "DeviceMemoryTimeout",
     "RetryPolicy", "classify", "Deadline", "RetryController",
     "current_deadline", "set_current_deadline", "deadline_scope",
     "checkpoint_deadline",
     "NONE", "IMMEDIATE", "BACKOFF", "CAPPED",
     "NOT_RETRYABLE", "LOCATION_REFRESH", "STALE_LOCATION",
     "INJECTED_TRANSIENT", "PX_ADMISSION", "SCHEMA_EAGAIN", "WRITE_CONFLICT",
+    "DEVICE_OOM", "DEVICE_MEMORY",
 ]

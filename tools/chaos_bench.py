@@ -48,6 +48,7 @@ def classified_errors() -> tuple:
         R.CommitUnknown,
         R.StaleLocation,
         R.PxAdmissionTimeout,
+        R.DeviceMemoryTimeout,
         QueryInterrupted,
     )
 
@@ -889,6 +890,158 @@ def elastic_leg(seed: int = 11, clients: int = 8, stmts_each: int = 40,
         shutil.rmtree(d, ignore_errors=True)
 
 
+#: read mix for the --oom gate: deterministic ORDER BY everywhere so the
+#: constrained run is bit-comparable to the unconstrained baseline
+OOM_QUERIES = (
+    "select v, count(*) as c from oom_fact group by v order by v",
+    "select id, v from oom_fact where v < 40 order by id limit 64",
+    "select min(id) as a, max(id) as b, sum(v) as s from oom_fact",
+    "select f.v, sum(d.w) as sw from oom_fact f, oom_dim d "
+    "where f.v = d.k group by f.v order by f.v limit 32",
+    "select v, avg(id) as a from oom_fact group by v "
+    "order by a desc limit 16",
+    "select count(*) as n from oom_fact where id % 7 = 3",
+)
+
+
+def oom_leg(seed: int = 13, clients: int = 6, stmts_each: int = 30,
+            oom_prob: float = 0.35, verbose: bool = False) -> dict:
+    """The --oom gate: a read workload whose working set is ~3x a
+    synthetic device budget, with probabilistic EN_DEVICE_OOM arms.
+    Every statement must finish (queueing, degrading or retrying — never
+    crashing, never surfacing a raw DeviceOOM), results must be
+    bit-identical to the unconstrained baseline, every degradation must
+    be visible in sysstat + __all_virtual_memory_governor, and the
+    governor ledger must balance to zero at exit."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from oceanbase_tpu.server import Database
+
+    d = tempfile.mkdtemp(prefix="chaos_oom_")
+    db = None
+    t_start = time.perf_counter()
+    try:
+        db = Database(n_nodes=3, n_ls=2, data_dir=d, fsync=False)
+        s = db.session()
+        s.sql("create table oom_fact "
+              "(id bigint primary key, v bigint not null)")
+        s.sql("create table oom_dim "
+              "(k bigint primary key, w bigint not null)")
+        rng = random.Random(seed)
+        for lo in range(0, 20000, 1000):
+            s.sql("insert into oom_fact values " + ", ".join(
+                f"({i}, {i * 37 % 100})" for i in range(lo, lo + 1000)))
+        s.sql("insert into oom_dim values " + ", ".join(
+            f"({i}, {i * 3})" for i in range(100)))
+
+        # unconstrained baseline: one canonical result per query text
+        def rows_of(rs):
+            return tuple(zip(*[tuple(rs.columns[n]) for n in rs.names])) \
+                if rs.names else ()
+
+        baseline = {q: rows_of(s.sql(q)) for q in OOM_QUERIES}
+
+        # synthetic budget: one-third of the resident working set, so
+        # cold reservations (clamped to the whole effective budget)
+        # genuinely queue and the ladder has something to degrade under
+        ws = db._resident_bytes()
+        budget = max(ws // 3, 1 << 16)
+        s.sql(f"alter system set ob_device_memory_limit = {budget}")
+        assert db.governor.budget == budget
+        # under a budget this tight every statement reserves the whole
+        # pool (measured peaks exceed it), so the queue is effectively
+        # serial: the wait bound must cover the drain of the whole
+        # backlog — "queues, never loses" is exactly the gate's promise
+        s.sql("alter system set ob_governor_queue_timeout = 60")
+
+        ERRSIM.reseed(seed)
+        ERRSIM.arm("EN_DEVICE_OOM", error=R.DeviceOOM("EN_DEVICE_OOM"),
+                   prob=oom_prob, count=-1)
+
+        CLASSIFIED = classified_errors()
+        stats_lock = threading.Lock()
+        stats = {"ok": 0, "classified": [], "raw": [], "mismatch": 0}
+
+        def client(cid: int) -> None:
+            cs = db.session()
+            crng = random.Random(seed ^ (cid * 0x9E37))
+            for _ in range(stmts_each):
+                q = OOM_QUERIES[crng.randrange(len(OOM_QUERIES))]
+                try:
+                    got = rows_of(cs.sql(q))
+                    with stats_lock:
+                        stats["ok"] += 1
+                        if got != baseline[q]:
+                            stats["mismatch"] += 1
+                except CLASSIFIED as e:
+                    with stats_lock:
+                        stats["classified"].append(
+                            f"{type(e).__name__}: {e}")
+                except Exception as e:  # noqa: BLE001 - the gate's point
+                    with stats_lock:
+                        stats["raw"].append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        ERRSIM.clear("EN_DEVICE_OOM")
+
+        total = clients * stmts_each
+        cs0 = db.metrics.counters_snapshot()
+        gov = db.governor.stats()
+        # the VT surface the README points operators at must itself work
+        vt = s.sql("select metric, value from __all_virtual_memory_governor")
+        vt_rows = dict(zip(vt.columns["metric"], vt.columns["value"]))
+        balanced = db.governor.ledger_balanced()
+        assert balanced, f"governor ledger leaked: {gov}"
+        checks = {
+            "completed_all": stats["ok"] == total,
+            "no_raw_failures": not stats["raw"],
+            "no_classified_failures": not stats["classified"],
+            "bit_identical": stats["mismatch"] == 0,
+            "degradations_visible": (
+                cs0.get("device OOM retries", 0) > 0
+                and cs0.get("stmt degraded chunked", 0) > 0
+                and cs0.get("stmt degraded host", 0) > 0),
+            "governor_vt_readable": int(vt_rows.get("grants", 0)) > 0,
+            "ledger_balanced_at_exit": balanced,
+        }
+        rep = {
+            "bench": "chaos_oom",
+            "seed": seed,
+            "ok": all(checks.values()),
+            "checks": checks,
+            "statements": total,
+            "completed": stats["ok"],
+            "classified_failures": stats["classified"][:8],
+            "raw_failures": stats["raw"][:8],
+            "working_set_bytes": ws,
+            "budget_bytes": budget,
+            "device_oom_retries": cs0.get("device OOM retries", 0),
+            "stmt_degraded_chunked": cs0.get("stmt degraded chunked", 0),
+            "stmt_degraded_host": cs0.get("stmt degraded host", 0),
+            "device_memory_rejects": cs0.get("device memory rejects", 0),
+            "reservation_wait_p99_s": gov.get("wait_p99_s", 0.0),
+            "governor": gov,
+            "total_s": round(time.perf_counter() - t_start, 1),
+        }
+        if verbose:
+            print(_json.dumps(rep, indent=2))
+        return rep
+    finally:
+        ERRSIM.clear("EN_DEVICE_OOM")
+        if db is not None:
+            db.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -904,8 +1057,44 @@ def main() -> int:
                     help="elastic serving gate: flash crowd + leader kill "
                          "mid-flood + bit-identity replay + full rolling "
                          "restart under live wire clients")
+    ap.add_argument("--oom", action="store_true",
+                    help="device-memory governor gate: read workload at "
+                         "~3x a synthetic device budget with EN_DEVICE_OOM "
+                         "arms — 100%% completion, bit-identical results, "
+                         "visible degradations, zero leaked reservations")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
+    if args.oom:
+        import json
+
+        rep = oom_leg(seed=args.seed if args.seed != 7 else 13,
+                      verbose=args.verbose)
+        tools = os.path.dirname(os.path.abspath(__file__))
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        from bench_meta import collect as bench_meta
+
+        rep["meta"] = bench_meta(None)
+        line = json.dumps(rep)
+        print(line, flush=True)
+        bench_out = os.environ.get("BENCH_OUT")
+        if bench_out:
+            with open(bench_out, "a") as f:
+                f.write(line + "\n")
+        if not rep["ok"]:
+            for name, ok in rep["checks"].items():
+                if not ok:
+                    print(f"OOM FAIL: {name}", file=sys.stderr)
+            return 1
+        print(f"oom OK: {rep['completed']}/{rep['statements']} statements "
+              f"under a {rep['budget_bytes']}-byte budget "
+              f"({rep['working_set_bytes']} working set): "
+              f"{rep['device_oom_retries']} OOM retries, "
+              f"{rep['stmt_degraded_chunked']} chunked, "
+              f"{rep['stmt_degraded_host']} host fallbacks, "
+              f"reservation-wait p99 "
+              f"{rep['reservation_wait_p99_s'] * 1e3:.1f}ms")
+        return 0
     if args.elastic:
         import json
 

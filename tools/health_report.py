@@ -19,6 +19,7 @@ jobs that expect a healthy window.
 
     python tools/health_report.py dump.json
     python tools/health_report.py dump.json --rule tenant_starvation
+    python tools/health_report.py dump.json --rule device_memory_pressure
 """
 
 from __future__ import annotations

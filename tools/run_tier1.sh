@@ -5,7 +5,7 @@
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
-#                      [--advisor] [--warmboot] [--elastic]
+#                      [--advisor] [--warmboot] [--elastic] [--oom]
 #                      [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
@@ -61,6 +61,16 @@
 # JIT compiles; the JSON artifact (with bench_meta provenance) lands in
 # $BENCH_OUT when set.
 #
+# --oom additionally runs the device-memory governor gate
+# (tools/chaos_bench.py --oom): a concurrent read workload whose working
+# set is ~3x a synthetic device budget, with probabilistic EN_DEVICE_OOM
+# arms — every statement must complete (0 crashes, 0 lost queries) with
+# results bit-identical to the unconstrained baseline, every degradation
+# visible in sysstat ("device OOM retries", "stmt degraded chunked",
+# "stmt degraded host") and __all_virtual_memory_governor, and the
+# governor ledger balanced to zero at exit; the JSON artifact (with
+# bench_meta provenance) lands in $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -80,6 +90,7 @@ health=0
 advisor=0
 warmboot=0
 elastic=0
+oom=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -90,6 +101,7 @@ while true; do
         --advisor) advisor=1; shift ;;
         --warmboot) warmboot=1; shift ;;
         --elastic) elastic=1; shift ;;
+        --oom) oom=1; shift ;;
         *) break ;;
     esac
 done
@@ -155,6 +167,11 @@ fi
 
 if [ "$elastic" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_bench.py --elastic
+    rc=$?
+fi
+
+if [ "$oom" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_bench.py --oom
     rc=$?
 fi
 exit $rc
