@@ -3074,6 +3074,11 @@ class Executor:
             gov = self.governor
             if gov is not None:
                 budget = min(budget, gov.upload_budget())
+            # mesh executors shard every upload over N devices, so the
+            # per-device budget admits N x the single-chip working set
+            # before degrading to chunk streaming (PxExecutor sets
+            # budget_scale = mesh size; single-chip has no attribute)
+            budget *= max(1, int(getattr(self, "budget_scale", 1)))
             if plan_input_bytes(self, plan) > budget:
                 try:
                     stream, split, kind = _find_stream_split(
@@ -3251,6 +3256,12 @@ class PreparedPlan:
         )
         self._batched.clear()
         self._traceable = True
+        # mesh executors rebuild their exchange recorder per compile; the
+        # cached plan must follow the fresh one or its mesh plan (worker
+        # spans, collective counters) would freeze at the old capacities
+        sync = getattr(self.executor, "sync_prepared", None)
+        if sync is not None:
+            sync(self)
         if self.artifact_ref is not None:
             # the executable just changed capacity under a persisted
             # artifact: re-export at the new capacity, or the overflow

@@ -123,6 +123,12 @@ class MemoryGovernor:
         self.reserved = 0
         self.peak_reserved = 0
         self._tenants: dict[str, _Tenant] = {}
+        # sharded-residency reporters (mesh executors): each returns the
+        # PER-DEVICE bytes its partitioned tables pin — total/n_shards,
+        # because row sharding leaves each device one slice of every
+        # resident table. The budget is per-device HBM, so this is the
+        # unit that competes with reservations for the same pool.
+        self._sharded_fns: list[Callable[[], int]] = []
         self._waiters = 0
         self._cond = threading.Condition()
         # monotonic counters (mirrored into sysstat by callers)
@@ -158,9 +164,27 @@ class MemoryGovernor:
                 if resident_fn is not None:
                     t.resident_fn = resident_fn
 
+    def register_sharded_residency(self, fn: Callable[[], int]) -> None:
+        """Register a mesh executor's partitioned-residency reporter
+        (ShardedResidency.per_device_bytes). Idempotent per callable."""
+        with self._cond:
+            if fn not in self._sharded_fns:
+                self._sharded_fns.append(fn)
+
     # ----------------------------------------------------------- budget
     def effective_budget(self) -> int:
         return max(1, int(self.budget * self._shrink))
+
+    def sharded_resident_bytes(self) -> int:
+        """Per-device bytes pinned by partitioned (mesh-sharded) tables
+        across all registered mesh executors."""
+        total = 0
+        for fn in list(self._sharded_fns):
+            try:
+                total += int(fn())
+            except Exception:
+                pass
+        return total
 
     def upload_budget(self) -> int:
         """What a single statement may plan to hold on device: the
@@ -169,7 +193,8 @@ class MemoryGovernor:
 
     def remaining(self) -> int:
         with self._cond:
-            return max(0, self.effective_budget() - self.reserved)
+            return max(0, self.effective_budget() - self.reserved
+                       - self.sharded_resident_bytes())
 
     def note_oom(self) -> None:
         """A device OOM proved the estimates optimistic: shrink the
@@ -230,7 +255,17 @@ class MemoryGovernor:
                     # a share-capped tenant's lone statement is likewise
                     # clamped so it can always eventually be admitted
                     want = min(want, max(1, t.limit))
-                fits = (self.reserved + want <= self.effective_budget()
+                # sharded residency shrinks the pool new reservations
+                # compete for — but never below `want`: a lone statement
+                # must stay admissible even when partitioned tables pin
+                # most of the device (they are evictable, exactly like
+                # the per-tenant lone-statement rule), else admission
+                # deadlocks with no one left to trigger eviction.
+                pool = self.effective_budget()
+                sharded = self.sharded_resident_bytes()
+                if sharded:
+                    pool = max(pool - sharded, want)
+                fits = (self.reserved + want <= pool
                         and self._tenant_fits(t, want))
                 if fits:
                     break
@@ -305,6 +340,7 @@ class MemoryGovernor:
                 "grants": self.grants,
                 "rejects": self.rejects,
                 "oom_notes": self.oom_notes,
+                "sharded_resident": self.sharded_resident_bytes(),
                 "shrink": round(self._shrink, 4),
                 "wait_p99_s": self.wait_p99_s() if self._wait_ring else 0.0,
                 "tenants": {

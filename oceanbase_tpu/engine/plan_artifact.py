@@ -103,6 +103,14 @@ class ArtifactMeta:
     fast: dict | None = None  # FastEntry kwargs (text-tier re-install)
     text_key: str | None = None
     px_nsh: int = 0
+    # SPMD programs: the mesh geometry the shardings were lowered against
+    # (mesh_signature), the compiled exchange layout (worker spans come
+    # back warm), and the MeshPlan (collective counters come back warm).
+    # A hydrating executor whose live mesh signature differs is REJECTED
+    # — an AOT program must never run with another mesh's shardings.
+    mesh_sig: tuple = ()
+    px_exchanges: list | None = None
+    mesh_plan: object = None
 
 
 class _WarmExecutable:
@@ -444,6 +452,12 @@ class PlanArtifactStore:
                 output_names=tuple(output_names), dtypes=list(dtypes),
                 fast=fast, text_key=text_key,
                 px_nsh=int(getattr(prepared, "px_nsh", 0)),
+                # save runs after the first successful execution, so the
+                # lazily-traced exchange layout is populated by now
+                mesh_sig=tuple(getattr(prepared, "mesh_sig", ()) or ()),
+                px_exchanges=list(
+                    getattr(prepared, "px_exchanges", None) or []),
+                mesh_plan=getattr(prepared, "mesh_plan", None),
             )
             meta_blob = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
@@ -655,6 +669,16 @@ class PlanArtifactStore:
             st["misses"] += 1
             self._note("plan artifact version mismatch")
             return None
+        if meta.px_nsh:
+            # SPMD program: its shardings were lowered against one mesh
+            # geometry. A different live mesh must key-mismatch cleanly
+            # (counted; caller recompiles) — never run wrong shardings.
+            saved_sig = tuple(getattr(meta, "mesh_sig", ()) or ())
+            live_sig = tuple(getattr(executor, "mesh_sig", ()) or ())
+            if saved_sig and saved_sig != live_sig:
+                st["misses"] += 1
+                self._note("plan artifact mesh mismatch")
+                return None
         if key_extra_fn is not None:
             try:
                 extra = key_extra_fn(meta.tables)
@@ -698,7 +722,15 @@ class PlanArtifactStore:
         prepared._art_proto = meta.out_proto
         if meta.px_nsh:
             prepared.px_nsh = meta.px_nsh
-            prepared.px_exchanges = []
+            # the exchange layout and mesh plan were captured at save
+            # time (post-trace): warm boots get their worker spans and
+            # collective counters without ever re-tracing
+            prepared.px_exchanges = list(
+                getattr(meta, "px_exchanges", None) or [])
+            prepared.mesh_sig = tuple(getattr(meta, "mesh_sig", ()) or ())
+            mp = getattr(meta, "mesh_plan", None)
+            if mp is not None:
+                prepared.mesh_plan = mp
         dt = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.wait("plan artifact load", dt)

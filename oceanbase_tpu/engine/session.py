@@ -345,7 +345,11 @@ class Session:
             nsh = getattr(executor, "nsh", 0)
             if not nsh:
                 return None  # unknown override: don't risk a collision
-            tag = ("#px", int(nsh))
+            # full mesh signature, not just the device count: an SPMD
+            # program's shardings are lowered against axis sizes + names,
+            # and 8x1 vs 4x2 (or renamed axes) must never share artifacts
+            sig = getattr(executor, "mesh_sig", ()) or ()
+            tag = ("#px", int(nsh), *sig)
         return (norm_key, pz.sig, pz.baked, fp, extra, tag)
 
     def _emit_px_spans(self, prepared, start: float, end: float) -> None:
@@ -674,6 +678,12 @@ class Session:
                 prepared._access_memo = memo
             if memo[1]:
                 acc.fold_resolved(memo[1])
+        # mesh-SPMD collective accounting: the MeshPlan rides the prepared
+        # plan (filled at first-dispatch trace, restored warm from the
+        # artifact store), so cached and warm-booted plans fold identically
+        mesh_plan = getattr(prepared, "mesh_plan", None)
+        if mesh_plan is not None and not mesh_plan.total_ops:
+            mesh_plan = None
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1
@@ -684,6 +694,10 @@ class Session:
                 mon.total_transfer_bytes += profile.transfer_bytes
                 mon.last_device_bytes = profile.device_bytes
                 mon.peak_bytes = max(mon.peak_bytes, profile.peak_bytes)
+            if mesh_plan is not None:
+                mon.px_collective_ops += mesh_plan.total_ops
+                mon.px_collective_bytes += mesh_plan.total_bytes
+                mon.px_exchanges = mesh_plan.describe()
         m = self.metrics
         if m is not None and m.enabled:
             m.observe("sql plan", plan_s)
@@ -694,6 +708,10 @@ class Session:
             retries = getattr(prepared, "retries", 0) - retries0
             if retries > 0:
                 m.add("overflow recompiles", retries)
+            if mesh_plan is not None:
+                for coll, cnt in mesh_plan.ops_by_collective().items():
+                    m.add(f"px collective {coll}", cnt)
+                m.add("px collective bytes", mesh_plan.total_bytes)
         tl = self.timeline
         if tl is not None and tl.enabled:
             # serving timeline: this dispatch's device-busy seconds plus
@@ -701,4 +719,7 @@ class Session:
             # this path — their ONE shared dispatch is fed by the batcher
             tl.record_exec(dispatch_s, 0.0 if was_hit else compile_s,
                            d2h_bytes)
+            if mesh_plan is not None:
+                tl.record_collective(
+                    mesh_plan.total_ops, mesh_plan.total_bytes)
         return rs

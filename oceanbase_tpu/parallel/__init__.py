@@ -1,6 +1,7 @@
 from .mesh import (
     SHARD_AXIS,
     make_mesh,
+    mesh_signature,
     replicated,
     row_sharding,
     shard_map_compat,
@@ -12,11 +13,20 @@ from .exchange import (
     dest_round_robin,
     merge_partials,
     repartition,
+    ring_broadcast_rows,
+)
+from .spmd import (
+    MeshExchange,
+    MeshPlan,
+    ShardedResidency,
+    SpmdLowering,
+    shard_put,
 )
 
 __all__ = [
     "SHARD_AXIS",
     "make_mesh",
+    "mesh_signature",
     "replicated",
     "row_sharding",
     "shard_map_compat",
@@ -26,4 +36,10 @@ __all__ = [
     "dest_round_robin",
     "merge_partials",
     "repartition",
+    "ring_broadcast_rows",
+    "MeshExchange",
+    "MeshPlan",
+    "ShardedResidency",
+    "SpmdLowering",
+    "shard_put",
 ]

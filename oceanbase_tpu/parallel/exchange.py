@@ -124,6 +124,45 @@ def broadcast_rows(
     return out, new_mask
 
 
+def ring_broadcast_rows(
+    cols: dict[str, jnp.ndarray],
+    mask: jnp.ndarray,
+    n_shards: int,
+    axis_name: str = SHARD_AXIS,
+):
+    """BROADCAST distribution on a ring schedule: n_shards-1 ppermute
+    steps, each shard forwarding the block it just received to its
+    neighbor.
+
+    Bit-identical output layout to broadcast_rows (all_gather
+    tiled=True): shard i's rows land at offset i*n on every shard. The
+    point of the variant: all_gather's bisection schedule peaks at
+    log2(n) concurrent link pairs, while the ring moves one block per
+    ICI hop per step — on torus topologies with a congested axis the
+    ring keeps per-link pressure flat (the classic bandwidth-optimal
+    ring collective). Selected via PxExecutor(broadcast_impl="ring");
+    the lowering records "ppermute" as the collective so the plan
+    monitor distinguishes the schedules."""
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    me = lax.axis_index(axis_name)
+
+    def gather_one(x):
+        n = x.shape[0]
+        out = jnp.zeros((n_shards * n,) + x.shape[1:], x.dtype)
+        out = lax.dynamic_update_slice_in_dim(out, x, me * n, axis=0)
+        blk = x
+        for s in range(1, n_shards):
+            blk = lax.ppermute(blk, axis_name, perm)
+            # after s forwards, the block in hand originated at shard
+            # (me - s) mod n_shards; place it at that shard's offset
+            out = lax.dynamic_update_slice_in_dim(
+                out, blk, ((me - s) % n_shards) * n, axis=0
+            )
+        return out
+
+    return {name: gather_one(c) for name, c in cols.items()}, gather_one(mask)
+
+
 def merge_partials(partials, axis_name: str = SHARD_AXIS):
     """Merge per-shard partial aggregates (datahub rollup analog)."""
     return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), partials)

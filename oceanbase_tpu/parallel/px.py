@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.column import ColumnBatch
 from ..core.dtypes import Schema
@@ -72,8 +72,14 @@ from ..sql.logical import (
     TopN,
     Window,
 )
-from .exchange import broadcast_rows, dest_by_hash, repartition
-from .mesh import SHARD_AXIS, shard_map_compat
+from .exchange import (
+    broadcast_rows,
+    dest_by_hash,
+    repartition,
+    ring_broadcast_rows,
+)
+from .mesh import SHARD_AXIS, mesh_signature, shard_map_compat
+from .spmd import ShardedResidency, SpmdLowering, shard_put
 
 SHARDED = "sharded"
 REPLICATED = "replicated"
@@ -162,7 +168,7 @@ class PxExecutor(Executor):
         # per-shard granularity: the chunk capacity must shard evenly
         unit = 1024 * self.nsh
         rows = -(-chunk_rows // unit) * unit
-        return _PxChunkSourceExecutor(
+        src = _PxChunkSourceExecutor(
             self.catalog, stream_table, rows, mesh=self.mesh,
             unique_keys=self.unique_keys, stats=self.stats,
             default_rows_estimate=self.default_rows_estimate,
@@ -170,8 +176,17 @@ class PxExecutor(Executor):
             join_bloom=self.join_bloom,
             bloom_max_bits=self.bloom_max_bits,
             hybrid_hash=self.hybrid_hash,
+            broadcast_impl=self.broadcast_impl,
+            tracer=self.tracer, metrics=self.metrics,
             access=self.access,
         )
+        # the streamed path re-crosses the host every chunk: it must share
+        # the observability channels so those hops are COUNTED, and the
+        # residency ledger so resident side tables charge the governor once
+        src.timeline = self.timeline
+        src.governor = self.governor
+        src.residency = self.residency
+        return src
 
     def _affine_build_info(self, op):
         # inside shard_map every batch is a per-shard SLICE (and hash
@@ -184,7 +199,8 @@ class PxExecutor(Executor):
                  broadcast_threshold: int = 1 << 16,
                  join_bloom: bool = True,
                  bloom_max_bits: int = 1 << 20,
-                 hybrid_hash: "bool | str" = "auto", stats=None,
+                 hybrid_hash: "bool | str" = "auto",
+                 broadcast_impl: str = "all_gather", stats=None,
                  device_budget=None, chunk_rows=None,
                  tracer=None, metrics=None, access=None):
         if stats is None:
@@ -199,6 +215,27 @@ class PxExecutor(Executor):
                          chunk_rows=chunk_rows)
         self.mesh = mesh
         self.nsh = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        self.mesh_sig = mesh_signature(mesh)
+        # BROADCAST lowering schedule: "all_gather" (bisection, default) or
+        # "ring" (ppermute pipeline — flat per-link pressure on congested
+        # torus axes). Bit-identical outputs; the MeshPlan records which
+        # collective actually compiled.
+        if broadcast_impl not in ("all_gather", "ring"):
+            raise ValueError(f"unknown broadcast_impl {broadcast_impl!r}")
+        self.broadcast_impl = broadcast_impl
+        # partitioned residency: row sharding leaves each device holding
+        # total/nsh bytes of every resident table — the ledger the memory
+        # governor charges per device (register_sharded_residency)
+        self.residency = ShardedResidency(self.nsh)
+        # a plan's input bytes spread over nsh devices, so the per-device
+        # budget admits nsh x the single-chip working set before the
+        # prepare path degrades to chunk streaming (engine.Executor.prepare
+        # multiplies its budget by this)
+        self.budget_scale = self.nsh
+        # per-compile mesh-plan recorder; bound (and reset) at trace entry
+        # of the compiled program — jit traces lazily, so the MeshPlan
+        # attached at prepare() time fills in during the first dispatch
+        self._lowering: SpmdLowering | None = None
         self.broadcast_threshold = broadcast_threshold
         self.join_bloom = join_bloom
         self.bloom_max_bits = bloom_max_bits
@@ -224,19 +261,39 @@ class PxExecutor(Executor):
         # execute() turns these into per-DFO worker spans
         self._exch_log: list[tuple[str, int, int]] = []
 
-    def _note_exchange(self, kind: str, ncols: int, cap: int) -> None:
+    def _note_exchange(self, kind: str, ncols: int, cap: int,
+                       collective: str | None = None) -> None:
         """Host-side DTL accounting, called at TRACE time (once per
         compile): per-lane capacity x lane count x 8-byte columns is the
         shuffle volume the program moves each dispatch."""
-        self._exch_log.append((kind, ncols, cap))
+        # broadcast all_gathers cap rows per shard; repartition is an
+        # all_to_all over nsh^2 (src,dst) lanes of cap rows each
+        lanes = self.nsh if kind == "broadcast" else self.nsh * self.nsh
+        low = self._lowering
+        if low is not None:
+            # note() appends the legacy triple too — and _exch_log IS
+            # lowering.legacy_log once the traced body bound it
+            low.note(kind, ncols, cap, lanes, collective=collective)
+        else:
+            self._exch_log.append((kind, ncols, cap))
         m = self.metrics
         if m is not None:
-            # broadcast all_gathers cap rows per shard; repartition is an
-            # all_to_all over nsh^2 (src,dst) lanes of cap rows each
-            lanes = self.nsh if kind == "broadcast" else self.nsh * self.nsh
             m.add("px exchanges compiled")
             m.add("px exchange rows capacity", cap * lanes)
             m.add("px exchange bytes capacity", ncols * cap * lanes * 8)
+
+    def _note_merge(self, kind: str, ncols: int, cap: int,
+                    elem_bytes: int = 8) -> None:
+        """Record a reduction collective (psum/pmin/pmax families) in the
+        mesh plan. These move O(groups) or O(bitset) data — tiny next to
+        the row exchanges — so they stay out of the legacy exchange log
+        (whose consumers size worker spans and peak-exchange bytes), but
+        the mesh plan must show them: they ARE collectives the hot loop
+        dispatches, and the zero-host-hop invariant counts them."""
+        low = self._lowering
+        if low is not None:
+            low.note(kind, ncols, cap, self.nsh, collective="psum",
+                     elem_bytes=elem_bytes, legacy=False)
 
     def execute(self, plan, max_retries: int = 3):
         """Coordinator-side execution wrapper: when a tracer is wired, the
@@ -262,8 +319,12 @@ class PxExecutor(Executor):
             exec_s = _time.perf_counter() - t0
             if tr is not None:
                 # per-DFO worker spans (one per exchange boundary the
-                # compile emitted), inside the coordinator span
-                for i, (kind, ncols, cap) in enumerate(self._exch_log):
+                # compile emitted), inside the coordinator span. Read from
+                # the prepared plan, not self._exch_log: the layout rides
+                # the plan (filled at first-dispatch trace), so CACHED
+                # plans — which never retrace — still get their spans.
+                exch = getattr(prepared, "px_exchanges", self._exch_log)
+                for i, (kind, ncols, cap) in enumerate(exch):
                     with tr.span("px_worker", dfo=i, exchange=kind,
                                  lane_cap=cap, cols=ncols):
                         pass
@@ -274,18 +335,50 @@ class PxExecutor(Executor):
                 m.observe("px compile", compile_s)
                 m.observe("px execute", exec_s)
                 m.wait("px dispatch", exec_s)
+            mp = getattr(prepared, "mesh_plan", None)
+            if mp is not None and mp.total_ops:
+                if m is not None:
+                    for coll, cnt in mp.ops_by_collective().items():
+                        m.add(f"px collective {coll}", cnt)
+                    m.add("px collective bytes", mp.total_bytes)
+                tl = self.timeline
+                if tl is not None:
+                    tl.record_collective(mp.total_ops, mp.total_bytes)
         return out
 
     def prepare(self, plan):
-        """Compile + attach the exchange layout to the prepared plan, so a
+        """Compile + attach the mesh plan to the prepared plan, so a
         session executing a CACHED PX plan can still emit per-DFO worker
-        spans (the exchange list is a compile-time artifact; re-deriving
-        it per execution would mean re-tracing)."""
-        self._exch_log = []
+        spans and per-collective counters (the exchange layout is a
+        compile-time artifact; re-deriving it per execution would mean
+        re-tracing).
+
+        The attachment is BY REFERENCE, not a snapshot: jax.jit traces at
+        first dispatch, so the emission-site notes land in the
+        SpmdLowering compile() created only when the program first runs.
+        The prepared plan and the traced closure share the same MeshPlan
+        object; it fills in during dispatch and every later consumer
+        (session folds, artifact save) reads the populated layout."""
+        self._lowering = None
         prepared = super().prepare(plan)
-        prepared.px_exchanges = list(self._exch_log)
-        prepared.px_nsh = self.nsh
+        self.sync_prepared(prepared)
         return prepared
+
+    def sync_prepared(self, prepared) -> None:
+        """(Re)attach the current compile's mesh plan to a prepared plan —
+        called from prepare() and again by PreparedPlan.recompile(), whose
+        overflow-retry recompiles build a fresh SpmdLowering that the
+        cached plan must follow."""
+        low = self._lowering
+        if low is None:
+            # chunk-streamed plans compile inside the chunk-source
+            # executor; the outer plan keeps an empty mesh plan (its
+            # per-chunk programs are accounted by the source executor)
+            low = SpmdLowering(self.mesh_sig, self.nsh)
+        prepared.mesh_plan = low.plan
+        prepared.px_exchanges = low.legacy_log
+        prepared.px_nsh = self.nsh
+        prepared.mesh_sig = self.mesh_sig
 
     # ------------------------------------------------------------ inputs
     def table_batch(self, name: str, cols: tuple[str, ...]):
@@ -294,14 +387,20 @@ class PxExecutor(Executor):
         is_private = getattr(self.catalog, "is_private", None)
         if is_private is not None and is_private(name):
             # tx-private view: shard + upload fresh, NEVER through the
-            # shared cache (same isolation contract as the base executor)
-            return self._shard_upload(name, cols)
+            # shared cache (same isolation contract as the base executor).
+            # No residency charge: the view dies with the statement.
+            return self._shard_upload(name, cols, resident=False)
         key = (name, cols)
         if key not in self._batch_cache:
             self._batch_cache[key] = self._shard_upload(name, cols)
         return self._batch_cache[key]
 
-    def _shard_upload(self, name: str, cols: tuple[str, ...]):
+    def invalidate_table(self, name: str) -> None:
+        super().invalidate_table(name)
+        self.residency.discharge(name)
+
+    def _shard_upload(self, name: str, cols: tuple[str, ...],
+                      resident: bool = True):
         from ..core.column import make_batch
 
         t = self.catalog[name]
@@ -317,17 +416,18 @@ class PxExecutor(Executor):
             capacity=cap,
             valid={c: v for c, v in t.valid.items() if c in cols},
         )
-        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
-        raw = {
-            "cols": {n: jax.device_put(a, shard) for n, a in b.cols.items()},
-            "valid": {n: jax.device_put(a, shard) for n, a in b.valid.items()},
-            "sel": jax.device_put(b.sel, shard),
-        }
-        self.h2d_bytes += sum(
-            int(a.nbytes)
-            for d in (raw["cols"], raw["valid"])
-            for a in d.values()
-        ) + int(raw["sel"].nbytes)
+        raw, nbytes = shard_put(self.mesh, b)
+        self.h2d_bytes += nbytes
+        if resident:
+            # partitioned residency: each device of the mesh now holds
+            # nbytes/nsh of this table; the governor charges per device
+            self.residency.charge(name, nbytes)
+        tl = self.timeline
+        if tl is not None:
+            tl.record_transfer(nbytes)
+        m = self.metrics
+        if m is not None:
+            m.add("px sharded upload bytes", nbytes)
         return raw
 
     # ------------------------------------------------------- capacities
@@ -403,12 +503,18 @@ class PxExecutor(Executor):
 
     # -------------------------------------------------------- exchanges
     def _gather_batch(self, b: ColumnBatch) -> ColumnBatch:
-        """GATHER/BROADCAST: replicate all rows on every shard."""
+        """GATHER/BROADCAST: replicate all rows on every shard, via
+        all_gather (bisection) or the ppermute ring per broadcast_impl."""
+        ring = self.broadcast_impl == "ring"
         self._note_exchange("broadcast", len(b.cols) + len(b.valid),
-                            int(b.sel.shape[0]))
+                            int(b.sel.shape[0]),
+                            collective="ppermute" if ring else "all_gather")
         payload = {f"c:{n}": a for n, a in b.cols.items()}
         payload.update({f"v:{n}": a for n, a in b.valid.items()})
-        out, mask = broadcast_rows(payload, b.sel)
+        if ring:
+            out, mask = ring_broadcast_rows(payload, b.sel, self.nsh)
+        else:
+            out, mask = broadcast_rows(payload, b.sel)
         return ColumnBatch(
             cols={n: out[f"c:{n}"] for n in b.cols},
             valid={n: out[f"v:{n}"] for n in b.valid},
@@ -463,6 +569,8 @@ class PxExecutor(Executor):
         every shard; popular probe rows stay local, popular build rows
         all_gather, normal rows of both sides all_to_all by key hash."""
         hb = 4096
+        # two psum'd histograms (probe + build) pick the hot buckets
+        self._note_merge("skew_histogram", 2, hb)
         pk = [evaluate(e, probe)[0] for e in probe_keys]
         ph = (hash32_combine(pk) % jnp.uint32(hb)).astype(jnp.int32)
         bk = [evaluate(e, build)[0] for e in build_keys]
@@ -502,6 +610,7 @@ class PxExecutor(Executor):
         """Join-filter pushdown: OR-reduce a build-side key bitset across
         shards, drop probe rows that cannot match BEFORE the exchange."""
         m = min(self.bloom_max_bits, next_pow2(max(int(4 * est_build), 1024)))
+        self._note_merge("bloom", 1, m, elem_bytes=4)
         bk = [evaluate(e, build)[0] for e in build_keys]
         h = (hash32_combine(bk) % jnp.uint32(m)).astype(jnp.int32)
         bits = jnp.zeros(m, dtype=jnp.int32).at[
@@ -715,6 +824,7 @@ class PxExecutor(Executor):
 
         key_expr, desc0 = op.keys[0]
         kv = evaluate(key_expr, child)[0]
+        self._note_merge("range_sample", 1, 4096)
         bounds = sample_range_bounds(kv, child.sel, self.nsh)
         dest = dest_by_range(kv.astype(jnp.int64), bounds)
         if desc0:
@@ -947,6 +1057,11 @@ class PxExecutor(Executor):
             out, ovf = super()._emit_aggregate(
                 op, nid, inputs, _override(emit, op.child, (child, covf)),
                 params)
+            # datahub-rollup merge: one reduction over the partial-agg
+            # columns + sel/valid masks (O(groups) data, not O(rows))
+            self._note_merge(
+                "merge", len(out.cols) + len(out.valid) + 1,
+                int(out.sel.shape[0]))
             merged = dict(out.cols)
             for name, fn, _arg, _d in op.aggs:
                 col = out.cols[name]
@@ -1023,10 +1138,22 @@ class PxExecutor(Executor):
         from ..engine.executor import _collect_qparam_spec, _unpack_qparams
 
         qparam_spec = _collect_qparam_spec(plan)
+        # the mesh-plan recorder for THIS compile. jit traces lazily, so
+        # run_local binds it (and resets it — a retrace replays every
+        # note) at trace entry; prepare() attaches the same object to the
+        # prepared plan so the layout is visible once the program has run
+        lowering = SpmdLowering(self.mesh_sig, self.nsh)
+        self._lowering = lowering
 
         def run_local(raw_inputs, qparams):
             from ..expr import compile as expr_compile
 
+            # trace-entry binding: emission-site notes (and the legacy
+            # exchange log execute() reads) land in this compile's
+            # recorder regardless of which plan this executor traced last
+            self._lowering = lowering
+            self._exch_log = lowering.legacy_log
+            lowering.reset()
             # packed-vector ABI parity with the single-chip PreparedPlan
             # (a packed array here would otherwise hit bool(tracer))
             qparams = _unpack_qparams(qparams, qparam_spec)
@@ -1108,12 +1235,18 @@ class _PxChunkSourceExecutor(ChunkWindowMixin, PxExecutor):
         if name != self.stream_table or self._chunk is None:
             return super().table_batch(name, cols)
         b = self._chunk_slice_batch(name, cols)
-        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
-        return {
-            "cols": {n: jax.device_put(a, shard) for n, a in b.cols.items()},
-            "valid": {n: jax.device_put(a, shard) for n, a in b.valid.items()},
-            "sel": jax.device_put(b.sel, shard),
-        }
+        # THE host-mediated DTL hop: each chunk of the streamed table
+        # crosses host->device per dispatch. Counted so the mesh smoke can
+        # assert the resident SPMD hot loop performs ZERO of these —
+        # collectives move all steady-state data.
+        m = self.metrics
+        if m is not None:
+            m.add("px dtl host hops")
+        low = self._lowering
+        if low is not None:
+            low.note_host_hop()
+        raw, _nbytes = shard_put(self.mesh, b)
+        return raw
 
 
 def _override(emit, node, result):

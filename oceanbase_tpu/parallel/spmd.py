@@ -1,0 +1,237 @@
+"""Mesh-SPMD execution subsystem: the explicit plan-level representation
+of distributed execution over a device mesh.
+
+Reference surface: the PX plan tree — ObPxTransmit/ObPxReceive pairs
+mark DFO boundaries, each annotated with a distribution method
+(ob_sql_define.h ObPQDistributeMethod) and wired through DTL channels at
+runtime. The TPU rebuild compiles every exchange INTO one shard_map
+program (parallel/px.py), so the channel graph disappears from runtime —
+but the *representation* must not: operators, observability and the
+artifact store all need a first-class answer to "what collectives does
+this plan dispatch, over which mesh, moving how many bytes".
+
+This module is that answer:
+
+  * ``mesh_signature``  — the restart-stable identity of a mesh (axis
+    shape + axis names). Joins the plan-artifact key so an SPMD program
+    exported on one mesh shape can never hydrate onto another.
+  * ``MeshExchange`` / ``MeshPlan`` — the mesh-aware physical-plan
+    layer: one record per exchange boundary the lowering emitted, each
+    naming its PX kind (broadcast / repartition / merge / ...) and the
+    XLA collective it lowered to (all_gather / all_to_all / psum /
+    ppermute), with static lane capacities -> per-dispatch byte volume.
+  * ``SpmdLowering`` — the per-compile recorder px.py's emission sites
+    write through at trace time. jax.jit traces lazily, so the recorder
+    object rides the compiled program's closure and the SAME MeshPlan
+    instance attached to the PreparedPlan fills in on first dispatch
+    (and resets cleanly if jit ever retraces).
+  * ``ShardedResidency`` — the partitioned residency ledger for the
+    executor's upload path: a table uploaded as sharded device arrays
+    holds bytes/n_shards per device, which is what the memory governor
+    must charge (engine/memory_governor.register_sharded_residency).
+  * ``shard_put`` — partition a host-built ColumnBatch across the mesh
+    as row-sharded device arrays (the granule map made physical).
+
+Single-chip is the degenerate 1-device mesh: every structure here is
+exercised on CPU under ``--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import SHARD_AXIS, mesh_signature
+
+#: PX exchange kind -> the XLA collective it lowers to by default.
+#: "broadcast" may lower to "ppermute" instead when the executor's
+#: broadcast_impl knob selects the ring schedule (exchange.py
+#: ring_broadcast_rows) — the lowering records the ACTUAL collective.
+KIND_COLLECTIVE = {
+    "broadcast": "all_gather",
+    "repartition": "all_to_all",
+    "merge": "psum",
+    "bloom": "psum",
+    "skew_histogram": "psum",
+    "range_sample": "psum",
+}
+
+
+@dataclass(frozen=True)
+class MeshExchange:
+    """One exchange boundary of a compiled SPMD program (the
+    ObPxTransmit/Receive pair analog), fully static: capacities and
+    column counts are Python ints at trace time."""
+
+    kind: str  # PX distribution kind (broadcast/repartition/merge/...)
+    collective: str  # XLA collective it lowered to
+    ncols: int  # payload columns (cols + validity lanes)
+    lane_cap: int  # rows per lane
+    lanes: int  # lane count across the mesh
+    nbytes: int  # per-dispatch byte capacity the collective moves
+
+    def describe(self) -> str:
+        return (f"{self.kind}->{self.collective}"
+                f"[{self.ncols}x{self.lane_cap}x{self.lanes}]")
+
+
+@dataclass
+class MeshPlan:
+    """Mesh-aware physical plan summary: which collectives one jitted
+    SPMD program dispatches, over which mesh. Attached to the
+    PreparedPlan (and pickled into the plan artifact) so cached and
+    warm-booted plans keep their exchange layout."""
+
+    mesh_sig: tuple  # ((shape...), (axis names...))
+    n_shards: int
+    exchanges: list = field(default_factory=list)
+    # host-mediated data hops the compiled HOT LOOP performs per
+    # dispatch. Zero for resident SPMD plans — the acceptance invariant
+    # tools/mesh_smoke.py pins; chunk-streamed plans count one per
+    # chunk upload (the data genuinely crosses the host each dispatch).
+    host_hops: int = 0
+
+    @property
+    def total_ops(self) -> int:
+        return len(self.exchanges)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(e.nbytes for e in self.exchanges)
+
+    def ops_by_collective(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.exchanges:
+            out[e.collective] = out.get(e.collective, 0) + 1
+        return out
+
+    def bytes_by_collective(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.exchanges:
+            out[e.collective] = out.get(e.collective, 0) + e.nbytes
+        return out
+
+    def describe(self) -> str:
+        """Compact per-collective summary for the plan monitor row."""
+        return ",".join(
+            f"{c}:{n}" for c, n in sorted(self.ops_by_collective().items())
+        )
+
+
+class SpmdLowering:
+    """Per-compile exchange recorder.
+
+    px.py creates one per compile() and binds it at the top of the
+    traced program body; every emission-site note lands here. Because
+    jit traces lazily, the MeshPlan it owns is attached to the
+    PreparedPlan BEFORE the first dispatch and fills in during it —
+    reset() at trace entry keeps a retrace from double-counting.
+    """
+
+    def __init__(self, mesh_sig: tuple, n_shards: int):
+        self.plan = MeshPlan(mesh_sig=mesh_sig, n_shards=n_shards)
+        # legacy (kind, ncols, cap) triples: the worker-span and
+        # peak-bytes consumers predate MeshExchange and read this shape
+        self.legacy_log: list[tuple[str, int, int]] = []
+
+    def reset(self) -> None:
+        """Called at trace entry: a jit retrace replays every emission
+        note, so the recorder must start from zero each trace."""
+        self.plan.exchanges.clear()
+        self.plan.host_hops = 0
+        del self.legacy_log[:]
+
+    def note(self, kind: str, ncols: int, cap: int, lanes: int,
+             collective: str | None = None, elem_bytes: int = 8,
+             legacy: bool = True) -> None:
+        if collective is None:
+            collective = KIND_COLLECTIVE.get(kind, kind)
+        self.plan.exchanges.append(MeshExchange(
+            kind=kind, collective=collective, ncols=ncols, lane_cap=cap,
+            lanes=lanes, nbytes=ncols * cap * lanes * elem_bytes,
+        ))
+        # reductions (legacy=False) stay out of the (kind, ncols, cap)
+        # triple log: its consumers size row-exchange worker spans and
+        # peak shuffle bytes, where a psum of group partials is noise
+        if legacy:
+            self.legacy_log.append((kind, ncols, cap))
+
+    def note_host_hop(self) -> None:
+        self.plan.host_hops += 1
+
+
+class ShardedResidency:
+    """Partitioned residency ledger: which base tables are resident as
+    sharded device arrays, and how many bytes each device actually
+    holds (total/n_shards — row sharding splits every column evenly).
+
+    The memory governor charges ``per_device_bytes()`` against its
+    per-device HBM budget (register_sharded_residency); virtual tables
+    and the mesh smoke read ``tables()``. Thread-safe: uploads happen
+    under serving concurrency."""
+
+    def __init__(self, n_shards: int):
+        self.n_shards = max(1, int(n_shards))
+        self._tables: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def charge(self, table: str, nbytes: int) -> None:
+        with self._lock:
+            self._tables[table] = self._tables.get(table, 0) + int(nbytes)
+
+    def discharge(self, table: str) -> None:
+        with self._lock:
+            self._tables.pop(table, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tables.clear()
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(self._tables.values())
+
+    def per_device_bytes(self) -> int:
+        """What ONE device of the mesh holds — the governor's unit of
+        account (its budget is per-device HBM)."""
+        return self.total_bytes() // self.n_shards
+
+    def tables(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._tables)
+
+
+def shard_put(mesh, batch):
+    """Partition a host-built ColumnBatch across the mesh as row-sharded
+    device arrays (jax.device_put with a NamedSharding over the shard
+    axis — the granule map made physical). Returns (raw, nbytes): the
+    raw {"cols", "valid", "sel"} dict the SPMD program takes as one
+    input leaf group, and the TOTAL bytes placed (bytes/n_shards of it
+    lands per device)."""
+    shard = NamedSharding(mesh, P(SHARD_AXIS))
+    raw = {
+        "cols": {n: jax.device_put(a, shard) for n, a in batch.cols.items()},
+        "valid": {n: jax.device_put(a, shard)
+                  for n, a in batch.valid.items()},
+        "sel": jax.device_put(batch.sel, shard),
+    }
+    nbytes = sum(
+        int(a.nbytes)
+        for d in (raw["cols"], raw["valid"])
+        for a in d.values()
+    ) + int(raw["sel"].nbytes)
+    return raw, nbytes
+
+
+__all__ = [
+    "KIND_COLLECTIVE",
+    "MeshExchange",
+    "MeshPlan",
+    "ShardedResidency",
+    "SpmdLowering",
+    "mesh_signature",
+    "shard_put",
+]

@@ -1157,7 +1157,7 @@ class Database:
             from ..parallel.mesh import make_mesh
             from ..parallel.px import PxExecutor
 
-            self._px_executor_obj = PxExecutor(
+            px = PxExecutor(
                 self.catalog,
                 make_mesh(),
                 unique_keys=self._unique_keys,
@@ -1166,6 +1166,17 @@ class Database:
                 metrics=self.metrics,
                 access=self.access,
             )
+            # serving-plane wiring: sharded uploads land in the transfer
+            # timeline, the partitioned residency charges the memory
+            # governor bytes/n_shards per device, and PX prepare()
+            # consults the governed upload budget like single-chip
+            px.timeline = self.timeline
+            gov = getattr(self, "governor", None)
+            if gov is not None:
+                px.governor = gov
+                gov.register_sharded_residency(
+                    px.residency.per_device_bytes)
+            self._px_executor_obj = px
         return self._px_executor_obj
 
     def _px_admission(self):

@@ -294,6 +294,11 @@ class PlanMonitorEntry:
     total_transfer_bytes: int = 0
     last_device_bytes: int = 0
     peak_bytes: int = 0
+    # mesh-SPMD plans: cumulative XLA collectives dispatched / their byte
+    # capacity, plus a compact per-collective layout ("all_to_all:2,psum:1")
+    px_collective_ops: int = 0
+    px_collective_bytes: int = 0
+    px_exchanges: str = ""
 
     @property
     def avg_exec_s(self) -> float:

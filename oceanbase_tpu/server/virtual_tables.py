@@ -118,6 +118,14 @@ def _plan_monitor(db) -> Table:
         ("last_device_bytes", DataType.int64(),
          [e.last_device_bytes for e in es]),
         ("peak_bytes", DataType.int64(), [e.peak_bytes for e in es]),
+        # mesh-SPMD plans: how many XLA collectives each execution
+        # dispatches, their byte capacity, and the exchange layout
+        # ("all_to_all:2,psum:1"); zeros/empty for single-chip plans
+        ("px_collective_ops", DataType.int64(),
+         [e.px_collective_ops for e in es]),
+        ("px_collective_bytes", DataType.int64(),
+         [e.px_collective_bytes for e in es]),
+        ("px_exchanges", DataType.varchar(), [e.px_exchanges for e in es]),
     ])
 
 
@@ -554,6 +562,12 @@ def _server_timeline(db) -> Table:
          [b["transfer_events"] for b in bs]),
         ("transfer_bytes", DataType.int64(),
          [b["transfer_bytes"] for b in bs]),
+        # cross-chip interconnect pressure (mesh-SPMD dispatches): XLA
+        # collectives run in the slice + their static byte capacity
+        ("collective_ops", DataType.int64(),
+         [b["collective_ops"] for b in bs]),
+        ("collective_bytes", DataType.int64(),
+         [b["collective_bytes"] for b in bs]),
         ("max_in_flight", DataType.int64(),
          [b["max_in_flight"] for b in bs]),
         ("admitted", DataType.int64(), [b["admitted"] for b in bs]),

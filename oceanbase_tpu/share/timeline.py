@@ -84,7 +84,8 @@ class _Bucket:
     __slots__ = (
         "period", "stmts", "errors", "host_busy_s", "device_busy_s",
         "dispatches", "batch_dispatches", "batch_lanes", "compile_events",
-        "compile_s", "transfer_events", "transfer_bytes", "max_in_flight",
+        "compile_s", "transfer_events", "transfer_bytes",
+        "collective_ops", "collective_bytes", "max_in_flight",
         "admitted", "rejected", "admission_wait_s", "sched_queue_max",
         "gate_admissions", "gate_wait_s", "occ_hist",
         "depth_hist", "wait_hist", "tenants",
@@ -110,6 +111,8 @@ class _Bucket:
         self.compile_s = 0.0
         self.transfer_events = 0
         self.transfer_bytes = 0
+        self.collective_ops = 0
+        self.collective_bytes = 0
         self.max_in_flight = 0
         self.admitted = 0
         self.rejected = 0
@@ -296,6 +299,18 @@ class ServingTimeline:
         b.transfer_events += 1
         b.transfer_bytes += nbytes
 
+    def record_collective(self, ops: int, nbytes: int) -> None:
+        """One SPMD dispatch's exchange traffic (mesh PX): how many XLA
+        collectives the program ran and their static byte capacity —
+        cross-chip interconnect pressure, the third interference axis
+        next to compiles and host transfers."""
+        if not self.enabled or not ops:
+            return
+        b = self._bucket(self._clock())
+        self.records += 1
+        b.collective_ops += ops
+        b.collective_bytes += nbytes
+
     # ---------------------------------------------------------- readout
     def snapshot(self) -> list[dict]:
         """Live buckets as dicts, oldest first. The current (partial)
@@ -328,6 +343,8 @@ class ServingTimeline:
                     "compile_s": b.compile_s,
                     "transfer_events": b.transfer_events,
                     "transfer_bytes": b.transfer_bytes,
+                    "collective_ops": b.collective_ops,
+                    "collective_bytes": b.collective_bytes,
                     "max_in_flight": b.max_in_flight,
                     "admitted": b.admitted,
                     "rejected": b.rejected,

@@ -139,8 +139,12 @@ def test_px_chunked_streams_over_mesh(tables, qid):
     sql = QUERIES[qid]
     whole = Executor(tables, unique_keys=UNIQUE_KEYS)
     _, want = _rows(whole, tables, sql)
+    # device_budget is PER DEVICE: the mesh shards every upload over its
+    # 8 devices, so the streaming threshold scales by the mesh size —
+    # hand the PX executor 1/8 of the single-chip budget to stream the
+    # same working set
     px = PxExecutor(tables, make_mesh(8), unique_keys=UNIQUE_KEYS,
-                    device_budget=BUDGET, chunk_rows=CHUNK)
+                    device_budget=BUDGET // 8, chunk_rows=CHUNK)
     prepared, got = _rows(px, tables, sql)
     assert isinstance(prepared, ChunkedPreparedPlan), f"Q{qid} did not chunk"
     from oceanbase_tpu.parallel.px import _PxChunkSourceExecutor

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Mesh-SPMD smoke: the --mesh leg of tools/run_tier1.sh.
+
+Runs TPC-H Q1/Q6/Q3 through the PX executor on an 8-virtual-device CPU
+mesh and asserts the three properties the mesh subsystem promises:
+
+  1. bit-identity — the 8-device mesh, the degenerate 1-device mesh and
+     the single-chip executor return EXACTLY the same rows;
+  2. collectives on-device — the warm steady-state loop increments the
+     per-collective counters ("px collective all_gather" / psum /
+     all_to_all / ppermute), i.e. exchanges really lower to XLA
+     collectives inside the jitted program;
+  3. zero host hops — "px dtl host hops" stays flat across the warm
+     loop: no exchange falls back to a host-mediated DTL transfer while
+     tables are device-resident.
+
+Emits one JSON summary line (stdout, appended to $BENCH_OUT when set)
+with bench_meta provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BENCH_OUT = os.environ.get("BENCH_OUT")
+
+QIDS = (1, 6, 3)
+WARM_ITERS = 3
+
+
+def fail(msg: str) -> int:
+    print(f"MESH-SMOKE FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    from oceanbase_tpu.core.column import batch_rows_normalized
+    from oceanbase_tpu.engine.executor import Executor
+    from oceanbase_tpu.models.tpch import datagen
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+    from oceanbase_tpu.parallel.mesh import make_mesh
+    from oceanbase_tpu.parallel.px import PxExecutor
+    from oceanbase_tpu.share.metrics import MetricsRegistry
+    from oceanbase_tpu.sql.parser import parse
+    from oceanbase_tpu.sql.planner import Planner
+
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        return fail(f"need 8 virtual devices, backend exposes {len(devices)}")
+
+    tables = datagen.generate(sf=0.005)
+    planner = Planner(tables)
+    metrics = MetricsRegistry()
+    single = Executor(tables, unique_keys=UNIQUE_KEYS)
+    px8 = PxExecutor(tables, make_mesh(8, devices=devices[:8]),
+                     unique_keys=UNIQUE_KEYS, metrics=metrics)
+    px1 = PxExecutor(tables, make_mesh(1, devices=devices[:1]),
+                     unique_keys=UNIQUE_KEYS)
+
+    plans = {q: planner.plan(parse(QUERIES[q])) for q in QIDS}
+
+    # ---- bit-identity: single chip vs 1-device mesh vs 8-device mesh ----
+    for q, planned in plans.items():
+        want = batch_rows_normalized(
+            single.execute(planned.plan), planned.output_names)
+        got1 = batch_rows_normalized(
+            px1.execute(planned.plan), planned.output_names)
+        got8 = batch_rows_normalized(
+            px8.execute(planned.plan), planned.output_names)
+        if got8 != want:
+            return fail(f"Q{q}: 8-device mesh rows differ from single chip")
+        if got1 != want:
+            return fail(f"Q{q}: 1-device mesh rows differ from single chip")
+        if not want:
+            return fail(f"Q{q} returned no rows")
+
+    # ---- steady state: collectives tick, host hops do not ---------------
+    before = metrics.counters_snapshot()
+    for _ in range(WARM_ITERS):
+        for planned in plans.values():
+            px8.execute(planned.plan)
+    after = metrics.counters_snapshot()
+
+    def delta(name: str) -> float:
+        return after.get(name, 0) - before.get(name, 0)
+
+    collectives = {
+        k.split()[-1]: delta(k)
+        for k in after
+        if k.startswith("px collective ") and k != "px collective bytes"
+        and delta(k) > 0
+    }
+    coll_ops = sum(collectives.values())
+    coll_bytes = delta("px collective bytes")
+    host_hops = delta("px dtl host hops")
+
+    if coll_ops <= 0:
+        return fail("warm loop folded no collective ops — exchanges are "
+                    "not lowering to XLA collectives")
+    if "psum" not in collectives:
+        return fail(f"no psum merge in warm loop (saw {collectives})")
+    if "all_to_all" not in collectives and "all_gather" not in collectives:
+        return fail(f"no join exchange collective in warm loop "
+                    f"(saw {collectives})")
+    if host_hops != 0:
+        return fail(f"{host_hops:.0f} host-mediated DTL hops in the warm "
+                    "loop — steady state must keep exchanges on-device")
+
+    tools = os.path.dirname(os.path.abspath(__file__))
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    from bench_meta import collect as bench_meta
+
+    summary = {
+        "bench": "mesh_smoke",
+        "devices": 8,
+        "queries": [f"q{q}" for q in QIDS],
+        "warm_iters": WARM_ITERS,
+        "collective_ops": int(coll_ops),
+        "collective_bytes": int(coll_bytes),
+        "collectives": {k: int(v) for k, v in sorted(collectives.items())},
+        "host_hops": int(host_hops),
+        "meta": bench_meta(None),
+    }
+    line = json.dumps(summary)
+    print(line, flush=True)
+    if _BENCH_OUT:
+        with open(_BENCH_OUT, "a") as f:
+            f.write(line + "\n")
+    print(f"mesh smoke OK: {int(coll_ops)} collective ops "
+          f"({summary['collectives']}), 0 host hops, rows bit-identical "
+          "across single chip / 1-device mesh / 8-device mesh")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,7 @@
 # bounds the whole run with a timeout so a hung test can't wedge CI.
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
-#                      [--advisor] [--warmboot] [--elastic] [--oom]
+#                      [--advisor] [--warmboot] [--elastic] [--oom] [--mesh]
 #                      [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
@@ -71,6 +71,15 @@
 # governor ledger balanced to zero at exit; the JSON artifact (with
 # bench_meta provenance) lands in $BENCH_OUT when set.
 #
+# --mesh additionally runs the mesh-SPMD smoke (tools/mesh_smoke.py):
+# TPC-H Q1/Q6/Q3 on an 8-virtual-device CPU mesh must return rows
+# bit-identical to the single-chip executor and a degenerate 1-device
+# mesh, the warm steady-state loop must fold per-collective counters
+# ("px collective all_gather"/psum/all_to_all) > 0, and "px dtl host
+# hops" must stay at 0 — exchanges run as XLA collectives inside ONE
+# jitted SPMD program, never through a host-mediated DTL transfer; the
+# JSON summary (with provenance) lands in $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -91,6 +100,7 @@ advisor=0
 warmboot=0
 elastic=0
 oom=0
+mesh=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -102,6 +112,7 @@ while true; do
         --warmboot) warmboot=1; shift ;;
         --elastic) elastic=1; shift ;;
         --oom) oom=1; shift ;;
+        --mesh) mesh=1; shift ;;
         *) break ;;
     esac
 done
@@ -172,6 +183,11 @@ fi
 
 if [ "$oom" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_bench.py --oom
+    rc=$?
+fi
+
+if [ "$mesh" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/mesh_smoke.py
     rc=$?
 fi
 exit $rc
