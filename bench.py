@@ -30,8 +30,10 @@ Every line honors the one-line summary contract:
 Env knobs: BENCH_SF (default 10), BENCH_REPS (default 5), BENCH_BUDGET_S
 (default 420; enforced INSIDE rep loops — a long step stops repping near
 the budget instead of running into the driver's hard kill), BENCH_STREAM_SF
-(default 30; 0 disables the streamed section), OB_TPU_DEVICE_BUDGET for the
-non-streamed device budget. Exit code is always 0 with a parseable final
+(default 30; 0 disables the streamed section), BENCH_STREAM=1 to add the
+pipeline A/B legs (prefetch on/off x compressed/raw wire on the same warm
+streamed plans, emitting stream_prefetch_speedup), OB_TPU_DEVICE_BUDGET for
+the non-streamed device budget. Exit code is always 0 with a parseable final
 summary line, even on a crash.
 """
 
@@ -915,11 +917,13 @@ def main():
             sess_s = Session(tables_s, unique_keys=UNIQUE_KEYS)
             seed_stats(sess_s, tables_s, stream_sf)
             # force real streaming: lineitem may NOT ride up whole
-            sess_s.executor.device_budget = 2 << 30
+            stream_budget = int(
+                os.environ.get("BENCH_STREAM_BUDGET", str(2 << 30)))
+            sess_s.executor.device_budget = stream_budget
             detail["stream_sf"] = stream_sf
             detail["stream_rows"] = int(n_s)
             detail["stream_tables_source"] = src_s
-            detail["stream_device_budget"] = 2 << 30
+            detail["stream_device_budget"] = stream_budget
             detail["streamed"] = True
             for qname in ("q6", "q1"):
                 if elapsed() > budget - 45:
@@ -945,6 +949,61 @@ def main():
                 detail[f"stream_{qname}_vs_e2e"] = round(cpu_s / warm_s, 3)
                 detail[f"stream_{qname}_rows_per_s"] = round(n_s / warm_s, 1)
                 detail[f"stream_{qname}_correct"] = bool(ok)
+                summary(tpu_t, cpu_t)
+
+            # ---- BENCH_STREAM=1: pipeline A/B legs over the SAME warm
+            # plans — prefetch on/off x compressed/raw wire. The knobs
+            # are read per-run from the executor, so toggling them
+            # between runs isolates the pipeline effect (same chunk
+            # grid, same compiled program). ---------------------------
+            if os.environ.get("BENCH_STREAM") == "1":
+                def _stream_snap():
+                    tots = [0.0] * 7
+                    for e_ in sess_s.plan_cache._entries.values():
+                        ss = getattr(
+                            getattr(e_, "prepared", None),
+                            "stream_stats", None)
+                        if ss is not None:
+                            for i, v in enumerate(ss.snapshot()):
+                                tots[i] += v
+                    return tots
+
+                ex_s = sess_s.executor
+                knobs0 = (ex_s.stream_prefetch_depth, ex_s.stream_compress)
+                ab = {}
+                for leg, depth, comp in (
+                    ("prefetch_compressed", knobs0[0] or 2, True),
+                    ("noprefetch_compressed", 0, True),
+                    ("prefetch_raw", knobs0[0] or 2, False),
+                ):
+                    if elapsed() > budget - 30:
+                        detail[f"stream_ab_{leg}_skipped"] = "budget"
+                        continue
+                    ex_s.stream_prefetch_depth = depth
+                    ex_s.stream_compress = comp
+                    s0 = _stream_snap()
+                    t1 = time.perf_counter()
+                    for qname in ("q6", "q1"):
+                        sess_s.sql(QUERIES[QID[qname]])
+                    leg_s = time.perf_counter() - t1
+                    d = [b - a for a, b in zip(s0, _stream_snap())]
+                    ab[leg] = leg_s
+                    detail[f"stream_ab_{leg}_s"] = round(leg_s, 3)
+                    detail[f"stream_ab_{leg}_overlap_pct"] = round(
+                        100.0 * d[5] / d[3] if d[3] else 0.0, 1)
+                    detail[f"stream_ab_{leg}_wire_ratio"] = round(
+                        d[1] / d[2] if d[2] else 1.0, 3)
+                ex_s.stream_prefetch_depth, ex_s.stream_compress = knobs0
+                if "prefetch_compressed" in ab and \
+                        "noprefetch_compressed" in ab:
+                    emit({
+                        "metric": "stream_prefetch_speedup",
+                        "value": round(
+                            ab["noprefetch_compressed"]
+                            / ab["prefetch_compressed"], 3),
+                        "unit": "x",
+                        "detail": {k: round(v, 3) for k, v in ab.items()},
+                    })
                 summary(tpu_t, cpu_t)
         except Exception as e:  # pragma: no cover
             detail["stream_error"] = f"{type(e).__name__}: {e}"

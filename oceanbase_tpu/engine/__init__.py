@@ -1,4 +1,25 @@
 from .executor import Executor, PhysicalParams
+from .pipeline import (
+    ChunkPrefetcher,
+    ChunkStager,
+    GraceHashPreparedPlan,
+    NotPartitionable,
+    StreamStats,
+    run_stream,
+    try_grace_hash,
+)
 from .session import ResultSet, Session
 
-__all__ = ["Executor", "PhysicalParams", "ResultSet", "Session"]
+__all__ = [
+    "ChunkPrefetcher",
+    "ChunkStager",
+    "Executor",
+    "GraceHashPreparedPlan",
+    "NotPartitionable",
+    "PhysicalParams",
+    "ResultSet",
+    "Session",
+    "StreamStats",
+    "run_stream",
+    "try_grace_hash",
+]

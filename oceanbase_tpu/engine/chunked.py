@@ -53,6 +53,7 @@ from ..sql.logical import (
     output_schema,
 )
 from .executor import Executor, _children
+from .pipeline import StreamStats, assemble_partials_table, run_stream
 
 import jax
 import jax.numpy as jnp
@@ -337,10 +338,31 @@ class ChunkWindowMixin:
     `table_batch` (the device placement differs: plain arrays vs sharded
     device_put)."""
 
+    #: single-chip chunk sources accept prefetch-staged compressed chunks
+    #: (engine/pipeline.py); the PX source keeps the legacy host-slice
+    #: path (its uploads must shard over the mesh, not ride device_put)
+    supports_staged = False
+
     def set_chunk(self, start: int, end: int):
         self._chunk = (start, end)
+        item = getattr(self, "_staged_item", None)
+        if item is not None and item.win != (start, end):
+            self._staged_item = None
         # drop only the streamed table's cached device batch
         self.invalidate_table(self.stream_table)
+
+    def set_stager(self, stager) -> None:
+        """Attach/detach the wire-encoding stager for the streaming run
+        (pipeline.run_stream brackets the chunk loop with this)."""
+        self._stager = stager
+        self._staged_item = None
+
+    def set_chunk_staged(self, start: int, end: int, item) -> None:
+        """Position the window on a chunk whose wire-encoded arrays are
+        already on device (prefetched): the next table read decodes the
+        staged tree instead of re-slicing host arrays."""
+        self._staged_item = item
+        self.set_chunk(start, end)
 
     def _chunk_slice_batch(self, name, cols):
         """Host ColumnBatch of the current chunk window, padded to the
@@ -360,6 +382,13 @@ class ChunkWindowMixin:
         from ..core.column import ColumnBatch, narrow_tier
 
         s, e = self._chunk
+        item = getattr(self, "_staged_item", None)
+        stager = getattr(self, "_stager", None)
+        if item is not None and stager is not None \
+                and item.win == (s, e):
+            # decode-on-device path: the wire-encoded chunk is already on
+            # device (prefetched); ONE jitted kernel expands it
+            return stager.decode_batch(item, cols)
         t = self.catalog[name]
         sub_schema = Schema(
             tuple(f for f in t.schema.fields if f.name in cols)
@@ -444,6 +473,7 @@ class ChunkWindowMixin:
 class _ChunkSourceExecutor(ChunkWindowMixin, Executor):
     """Executor whose streamed table reads one fixed-capacity chunk."""
 
+    supports_staged = True
     chunking_enabled = False
     # chunk windows break the whole-table storage-order premise of the
     # clustered-FK segment aggregation (fk_ranges index full-table rows)
@@ -485,6 +515,7 @@ class ChunkedPreparedPlan:
         self.kind = kind
         self.chunk_rows = chunk_rows
         self.retries = 0
+        self.stream_stats = StreamStats()
 
         if kind == "scan":
             # chunk program = the scan narrowed to the raw columns the
@@ -539,6 +570,23 @@ class ChunkedPreparedPlan:
         return self.run(qparams=qparams)
 
     def run(self, max_retries: int = 3, qparams: tuple = ()):
+        if getattr(self.chunk_exec, "supports_staged", False):
+            # streaming pipeline (engine/pipeline.py): prefetch-staged
+            # wire-encoded chunks, decode-on-device, overlap metering
+            cols, valids, dicts = run_stream(
+                self, qparams=qparams, max_retries=max_retries)
+        else:
+            cols, valids, dicts = self._run_legacy(max_retries, qparams)
+        partials, self._partial_cap = assemble_partials_table(
+            self.partial_schema, cols, valids, dicts, self._partial_cap)
+        self._overlay_extra["$partials"] = partials
+        self.merge_exec.invalidate_table("$partials")
+        if self._merge_prepared is None or self._merge_cap != self._partial_cap:
+            self._merge_prepared = self.merge_exec.prepare(self.above_plan)
+            self._merge_cap = self._partial_cap
+        return self._merge_prepared.run(max_retries, qparams=qparams)
+
+    def _run_legacy(self, max_retries: int = 3, qparams: tuple = ()):
         import os
         from collections import deque
 
@@ -649,38 +697,4 @@ class ChunkedPreparedPlan:
                     valids[f.name].append(np.ones(int(sel.sum()), np.bool_))
             dicts.update(out.dicts)
 
-        data = {k: np.concatenate(v) for k, v in cols.items()}
-        vdata = {k: np.concatenate(v) for k, v in valids.items()}
-        n_part = len(next(iter(data.values()))) if data else 0
-        while self._partial_cap < n_part:
-            self._partial_cap *= 2
-        pad = self._partial_cap - n_part
-        if pad:
-            data = {
-                k: np.concatenate([v, np.zeros(pad, dtype=v.dtype)])
-                for k, v in data.items()
-            }
-            vdata = {
-                k: np.concatenate([v, np.zeros(pad, dtype=np.bool_)])
-                for k, v in vdata.items()
-            }
-        data["$live"] = np.concatenate(
-            [np.ones(n_part, np.int8), np.zeros(pad, np.int8)]
-        )
-        # partial sum columns may be NULL (empty chunk): mark nullable
-        part_fields = [
-            Field(f.name, f.dtype.with_nullable(f.dtype.nullable or f.name in vdata))
-            for f in self.partial_schema.fields
-        ]
-        part_fields.append(Field("$live", DataType.int8()))
-        partials = Table(
-            "$partials", Schema(tuple(part_fields)), data,
-            {k: d for k, d in dicts.items() if k in data},
-            valid=vdata,
-        )
-        self._overlay_extra["$partials"] = partials
-        self.merge_exec.invalidate_table("$partials")
-        if self._merge_prepared is None or self._merge_cap != self._partial_cap:
-            self._merge_prepared = self.merge_exec.prepare(self.above_plan)
-            self._merge_cap = self._partial_cap
-        return self._merge_prepared.run(max_retries, qparams=qparams)
+        return cols, valids, dicts

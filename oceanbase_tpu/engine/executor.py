@@ -402,6 +402,17 @@ class Executor:
         # set on degraded host-fallback executors: disables the
         # EN_DEVICE_OOM injection point (host execution cannot device-OOM)
         self.host_fallback = False
+        # streaming pipeline knobs (engine/pipeline.py): prefetch depth 0
+        # disables the prefetch thread (strictly alternating wire/compute
+        # — the bench A/B baseline); stream_compress off ships raw
+        # frame-of-reference chunks instead of the advisor encodings
+        import os as _os
+
+        self.stream_prefetch_depth = max(0, int(_os.environ.get(
+            "OB_STREAM_PREFETCH",
+            _os.environ.get("OB_STREAM_PIPELINE", "2"))))
+        self.stream_compress = _os.environ.get(
+            "OB_STREAM_COMPRESS", "1") not in ("0", "false", "off")
 
     # ---- input preparation -------------------------------------------
     def _collect_scans(self, plan: LogicalOp) -> list[Scan]:
@@ -3047,6 +3058,24 @@ class Executor:
             unique_keys=self.unique_keys, stats=self.stats,
         )
 
+    def _clamped_chunk_rows(self, plan, stream, budget: int) -> int:
+        """Chunk rows sized from the DECODED on-device width of the
+        streamed columns: the pipeline holds up to depth+1 decoded chunks
+        in flight, so each must fit its slice of the budget. The staged
+        (compressed) host bytes are charged separately through the
+        governor's staged ledger and do not enter this sizing — sizing
+        from wire bytes would let a high-ratio RLE column overcommit HBM
+        by its encoding ratio."""
+        from .memory_governor import derive_chunk_rows
+        from .pipeline import decoded_row_bytes
+
+        needed = self._needed_columns(plan).get(stream.alias) or set()
+        row_b = decoded_row_bytes(
+            self.catalog, stream.table, sorted(needed))
+        slots = max(1, int(getattr(self, "stream_prefetch_depth", 2))) + 1
+        return derive_chunk_rows(
+            max(1, budget // slots), self.chunk_rows, row_bytes=row_b)
+
     def prepare(self, plan: LogicalOp):
         """Compile once; the returned PreparedPlan caches the XLA executable
         (the expensive artifact — this is what the plan cache stores).
@@ -3083,12 +3112,28 @@ class Executor:
                 try:
                     stream, split, kind = _find_stream_split(
                         self, plan, budget)
+                    chunk_rows = self._clamped_chunk_rows(
+                        plan, stream, budget)
                     cp = ChunkedPreparedPlan(
-                        self, plan, stream, split, kind, self.chunk_rows
+                        self, plan, stream, split, kind, chunk_rows
                     )
                     cp.access_profile = access
                     return cp
                 except NotStreamable:
+                    # grace-hash partitioned spill: when even the BUILD
+                    # side exceeds the budget, partition both sides to
+                    # host segments and stream partition pairs through
+                    # one static program (engine/pipeline.py). Mesh
+                    # executors shard instead (budget_scale > 1).
+                    if int(getattr(self, "budget_scale", 1)) == 1:
+                        from .pipeline import NotPartitionable, try_grace_hash
+
+                        try:
+                            gp = try_grace_hash(self, plan, budget)
+                            gp.access_profile = access
+                            return gp
+                        except NotPartitionable:
+                            pass
                     # whole-table upload: governor-accounted at admission;
                     # a residual device OOM is absorbed by the retry
                     # ladder (evict -> chunk -> host), never a crash

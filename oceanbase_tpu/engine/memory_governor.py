@@ -73,11 +73,19 @@ def detect_device_budget() -> int:
     return int(os.environ.get("OB_TPU_SYNTHETIC_HBM", SYNTHETIC_CPU_BUDGET))
 
 
-def derive_chunk_rows(budget_bytes: int, default_rows: int) -> int:
-    """Chunk size for the degraded re-plan (ladder rung 2): fit the
-    remaining byte budget assuming wide rows, clamped so a tiny budget
-    still makes forward progress and a huge one keeps the default."""
-    rows = int(max(budget_bytes, 1) // _EST_ROW_BYTES)
+def derive_chunk_rows(budget_bytes: int, default_rows: int,
+                      row_bytes: int = _EST_ROW_BYTES) -> int:
+    """Chunk size for a byte budget, clamped so a tiny budget still makes
+    forward progress and a huge one keeps the default.
+
+    `row_bytes` must be the DECODED on-device row width of the streamed
+    columns (engine/pipeline.decoded_row_bytes), not the wire width: the
+    governor charges staged (compressed) host-pinned bytes separately
+    through the staged ledger, so sizing chunks from compressed bytes
+    would let a high-ratio RLE column overcommit HBM by its encoding
+    ratio. Callers without column knowledge keep the conservative
+    wide-row default."""
+    rows = int(max(budget_bytes, 1) // max(int(row_bytes), 1))
     return max(4096, min(default_rows, rows))
 
 
@@ -99,6 +107,32 @@ class Reservation:
             self._gov._release(self.tenant, self.nbytes)
 
     def __enter__(self) -> "Reservation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class StagedLease:
+    """One staged (host-pinned, wire-encoded) chunk's slice of the staged
+    ledger — the streaming prefetcher holds one per in-flight chunk.
+    Idempotent release; usable as a context manager so a cancelled
+    prefetch cannot leak staged bytes."""
+
+    __slots__ = ("_gov", "tenant", "nbytes", "_live")
+
+    def __init__(self, gov: "MemoryGovernor", tenant: str, nbytes: int):
+        self._gov = gov
+        self.tenant = tenant
+        self.nbytes = nbytes
+        self._live = True
+
+    def release(self) -> None:
+        if self._live:
+            self._live = False
+            self._gov._release_staged(self.nbytes)
+
+    def __enter__(self) -> "StagedLease":
         return self
 
     def __exit__(self, *exc) -> None:
@@ -131,6 +165,14 @@ class MemoryGovernor:
         self._sharded_fns: list[Callable[[], int]] = []
         self._waiters = 0
         self._cond = threading.Condition()
+        # staged ledger: host-pinned wire-encoded chunk buffers held by
+        # the streaming prefetcher (engine/pipeline.py). Tracked apart
+        # from device reservations — staged bytes live in HOST memory
+        # awaiting H2D, so they must not eat the HBM pool — but they
+        # participate in ledger_balanced(): a statement error/timeout
+        # with a prefetch in flight must still drain to zero.
+        self.staged = 0
+        self.peak_staged = 0
         # monotonic counters (mirrored into sysstat by callers)
         self.grants = 0
         self.rejects = 0
@@ -300,6 +342,22 @@ class MemoryGovernor:
                 t.reserved = max(0, t.reserved - nbytes)
             self._cond.notify_all()
 
+    def stage(self, tenant: str, nbytes: int) -> StagedLease:
+        """Charge `nbytes` of host-pinned staged (wire-encoded) chunk
+        buffers to the staged ledger. Never blocks: the prefetch queue
+        depth is the backpressure (at most `depth` staged chunks exist),
+        so this is accounting + leak detection, not admission."""
+        nbytes = int(max(0, nbytes))
+        with self._cond:
+            self.staged += nbytes
+            self.peak_staged = max(self.peak_staged, self.staged)
+        return StagedLease(self, tenant, nbytes)
+
+    def _release_staged(self, nbytes: int) -> None:
+        with self._cond:
+            self.staged = max(0, self.staged - nbytes)
+            self._cond.notify_all()
+
     def _note_wait(self, s: float) -> None:
         # caller holds _cond
         self._wait_ring.append(s)
@@ -327,6 +385,7 @@ class MemoryGovernor:
     def ledger_balanced(self) -> bool:
         with self._cond:
             return (self.reserved == 0
+                    and self.staged == 0
                     and all(t.reserved == 0 for t in self._tenants.values()))
 
     def stats(self) -> dict:
@@ -336,6 +395,8 @@ class MemoryGovernor:
                 "effective_budget": self.effective_budget(),
                 "reserved": self.reserved,
                 "peak_reserved": self.peak_reserved,
+                "staged": self.staged,
+                "peak_staged": self.peak_staged,
                 "waiters": self._waiters,
                 "grants": self.grants,
                 "rejects": self.rejects,
@@ -351,6 +412,6 @@ class MemoryGovernor:
 
 
 __all__ = [
-    "MemoryGovernor", "Reservation", "detect_device_budget",
+    "MemoryGovernor", "Reservation", "StagedLease", "detect_device_budget",
     "derive_chunk_rows", "SYNTHETIC_CPU_BUDGET",
 ]

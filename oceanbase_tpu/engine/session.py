@@ -533,6 +533,10 @@ class Session:
         jn = getattr(entry, "json_specs", ())
         prepared = entry.prepared
         retries0 = getattr(prepared, "retries", 0)
+        # streaming pipeline counters are cumulative on the prepared plan
+        # (plan-cache shared): fold per-run deltas, like overflow retries
+        sstats = getattr(prepared, "stream_stats", None)
+        stream0 = sstats.snapshot() if sstats is not None else None
         t0 = time.perf_counter()
         if hasattr(prepared, "run_host"):
             # packed parameter upload: ONE host->device transfer for the
@@ -684,6 +688,12 @@ class Session:
         mesh_plan = getattr(prepared, "mesh_plan", None)
         if mesh_plan is not None and not mesh_plan.total_ops:
             mesh_plan = None
+        stream_d = None
+        if sstats is not None:
+            s1 = sstats.snapshot()
+            d = tuple(b - a for a, b in zip(stream0, s1))
+            if d[0] or d[6]:  # chunks streamed or partitions spilled
+                stream_d = d
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1
@@ -698,6 +708,12 @@ class Session:
                 mon.px_collective_ops += mesh_plan.total_ops
                 mon.px_collective_bytes += mesh_plan.total_bytes
                 mon.px_exchanges = mesh_plan.describe()
+            if stream_d is not None:
+                mon.stream_chunks += stream_d[0]
+                mon.spill_partitions += stream_d[6]
+                h2d_d, overlap_d = stream_d[3], stream_d[5]
+                mon.h2d_overlap_pct = (
+                    100.0 * overlap_d / h2d_d if h2d_d else 0.0)
         m = self.metrics
         if m is not None and m.enabled:
             m.observe("sql plan", plan_s)
@@ -712,6 +728,11 @@ class Session:
                 for coll, cnt in mesh_plan.ops_by_collective().items():
                     m.add(f"px collective {coll}", cnt)
                 m.add("px collective bytes", mesh_plan.total_bytes)
+            if stream_d is not None:
+                m.add("stream chunks", stream_d[0])
+                m.add("stream h2d overlap", int(stream_d[5] * 1e6))
+                if stream_d[6]:
+                    m.add("stream spill partitions", stream_d[6])
         tl = self.timeline
         if tl is not None and tl.enabled:
             # serving timeline: this dispatch's device-busy seconds plus
@@ -722,4 +743,7 @@ class Session:
             if mesh_plan is not None:
                 tl.record_collective(
                     mesh_plan.total_ops, mesh_plan.total_bytes)
+            if stream_d is not None:
+                tl.record_stream(stream_d[0], stream_d[3], stream_d[4],
+                                 stream_d[5], stream_d[6])
         return rs

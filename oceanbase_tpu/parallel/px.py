@@ -1223,6 +1223,10 @@ class _PxChunkSourceExecutor(ChunkWindowMixin, PxExecutor):
     chunk executor; the slice/estimate logic lives in ChunkWindowMixin)."""
 
     chunking_enabled = False
+    # legacy host-slice chunk loop: PX uploads must shard over the mesh
+    # (jax.device_put of a staged pytree would land whole on one device),
+    # so the streaming prefetch/decode pipeline stays single-chip
+    supports_staged = False
 
     def __init__(self, catalog, stream_table: str, chunk_rows: int,
                  mesh=None, **kw):

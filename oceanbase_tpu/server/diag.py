@@ -299,6 +299,12 @@ class PlanMonitorEntry:
     px_collective_ops: int = 0
     px_collective_bytes: int = 0
     px_exchanges: str = ""
+    # streaming pipeline (engine/pipeline.py): chunks streamed through
+    # this plan, last run's H2D/compute overlap fraction, and grace-hash
+    # partitions spilled to host segments
+    stream_chunks: int = 0
+    h2d_overlap_pct: float = 0.0
+    spill_partitions: int = 0
 
     @property
     def avg_exec_s(self) -> float:

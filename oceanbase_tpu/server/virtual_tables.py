@@ -126,6 +126,14 @@ def _plan_monitor(db) -> Table:
         ("px_collective_bytes", DataType.int64(),
          [e.px_collective_bytes for e in es]),
         ("px_exchanges", DataType.varchar(), [e.px_exchanges for e in es]),
+        # streaming pipeline (engine/pipeline.py): chunks streamed through
+        # the plan, last run's H2D/compute overlap percentage, grace-hash
+        # partitions spilled; zeros for resident plans
+        ("stream_chunks", DataType.int64(), [e.stream_chunks for e in es]),
+        ("h2d_overlap_pct", DataType.float64(),
+         [round(e.h2d_overlap_pct, 3) for e in es]),
+        ("spill_partitions", DataType.int64(),
+         [e.spill_partitions for e in es]),
     ])
 
 
@@ -568,6 +576,20 @@ def _server_timeline(db) -> Table:
          [b["collective_ops"] for b in bs]),
         ("collective_bytes", DataType.int64(),
          [b["collective_bytes"] for b in bs]),
+        # streaming pipeline pressure per slice: chunks streamed,
+        # wire-busy vs compute-busy seconds and their overlap fraction
+        # (is the H2D tunnel or the device the out-of-core ceiling?),
+        # grace-hash partitions spilled
+        ("stream_chunks", DataType.int64(),
+         [b["stream_chunks"] for b in bs]),
+        ("stream_h2d_us", DataType.int64(),
+         [int(b["stream_h2d_s"] * 1e6) for b in bs]),
+        ("stream_compute_us", DataType.int64(),
+         [int(b["stream_compute_s"] * 1e6) for b in bs]),
+        ("h2d_overlap_pct", DataType.float64(),
+         [round(100.0 * b["h2d_overlap_frac"], 3) for b in bs]),
+        ("stream_spill_parts", DataType.int64(),
+         [b["stream_spill_parts"] for b in bs]),
         ("max_in_flight", DataType.int64(),
          [b["max_in_flight"] for b in bs]),
         ("admitted", DataType.int64(), [b["admitted"] for b in bs]),
@@ -695,6 +717,11 @@ def _memory_governor(db) -> Table:
         ("effective_budget", int(st.get("effective_budget", 0))),
         ("reserved", int(st.get("reserved", 0))),
         ("peak_reserved", int(st.get("peak_reserved", 0))),
+        # staged ledger: host-pinned wire-encoded chunk buffers held by
+        # the streaming prefetcher (zero between statements — a leak
+        # here means a cancelled prefetch did not drain)
+        ("staged", int(st.get("staged", 0))),
+        ("peak_staged", int(st.get("peak_staged", 0))),
         ("waiters", int(st.get("waiters", 0))),
         ("grants", int(st.get("grants", 0))),
         ("rejects", int(st.get("rejects", 0))),

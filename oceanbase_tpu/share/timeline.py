@@ -85,7 +85,9 @@ class _Bucket:
         "period", "stmts", "errors", "host_busy_s", "device_busy_s",
         "dispatches", "batch_dispatches", "batch_lanes", "compile_events",
         "compile_s", "transfer_events", "transfer_bytes",
-        "collective_ops", "collective_bytes", "max_in_flight",
+        "collective_ops", "collective_bytes",
+        "stream_chunks", "stream_h2d_s", "stream_compute_s",
+        "stream_overlap_s", "stream_spill_parts", "max_in_flight",
         "admitted", "rejected", "admission_wait_s", "sched_queue_max",
         "gate_admissions", "gate_wait_s", "occ_hist",
         "depth_hist", "wait_hist", "tenants",
@@ -113,6 +115,11 @@ class _Bucket:
         self.transfer_bytes = 0
         self.collective_ops = 0
         self.collective_bytes = 0
+        self.stream_chunks = 0
+        self.stream_h2d_s = 0.0
+        self.stream_compute_s = 0.0
+        self.stream_overlap_s = 0.0
+        self.stream_spill_parts = 0
         self.max_in_flight = 0
         self.admitted = 0
         self.rejected = 0
@@ -311,6 +318,23 @@ class ServingTimeline:
         b.collective_ops += ops
         b.collective_bytes += nbytes
 
+    def record_stream(self, chunks: int, h2d_s: float, compute_s: float,
+                      overlap_s: float, spill_parts: int = 0) -> None:
+        """One streaming execution's pipeline activity (engine
+        Session._execute_entry, from the prepared plan's StreamStats
+        delta): wire-busy vs compute-busy seconds and their interval-
+        union overlap — the fourth interference axis, answering whether
+        the H2D tunnel or the device is the out-of-core ceiling."""
+        if not self.enabled or not chunks:
+            return
+        b = self._bucket(self._clock())
+        self.records += 1
+        b.stream_chunks += chunks
+        b.stream_h2d_s += h2d_s
+        b.stream_compute_s += compute_s
+        b.stream_overlap_s += overlap_s
+        b.stream_spill_parts += spill_parts
+
     # ---------------------------------------------------------- readout
     def snapshot(self) -> list[dict]:
         """Live buckets as dicts, oldest first. The current (partial)
@@ -345,6 +369,14 @@ class ServingTimeline:
                     "transfer_bytes": b.transfer_bytes,
                     "collective_ops": b.collective_ops,
                     "collective_bytes": b.collective_bytes,
+                    "stream_chunks": b.stream_chunks,
+                    "stream_h2d_s": b.stream_h2d_s,
+                    "stream_compute_s": b.stream_compute_s,
+                    "stream_overlap_s": b.stream_overlap_s,
+                    "stream_spill_parts": b.stream_spill_parts,
+                    "h2d_overlap_frac": (
+                        b.stream_overlap_s / b.stream_h2d_s
+                        if b.stream_h2d_s else 0.0),
                     "max_in_flight": b.max_in_flight,
                     "admitted": b.admitted,
                     "rejected": b.rejected,

@@ -6,7 +6,9 @@ per-tenant accounting.
 
 The rebuild spills numpy column chunks to .npz segments under a spill
 directory, tracks bytes, and cleans up deterministically. The device-side
-consumers live in ops/spill.py.
+consumers live in ops/spill.py, and the streaming pipeline's grace-hash
+partitioned join/group-by (engine/pipeline.py) spills its key-disjoint
+partition segments through the same manager.
 """
 
 from __future__ import annotations

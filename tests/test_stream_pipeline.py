@@ -1,0 +1,374 @@
+"""Streaming pipeline engine (engine/pipeline.py): decode-on-device
+bit-identity across wire encodings, prefetch/governor ledger hygiene on
+error paths, grace-hash partitioned join/group-by, and the stream
+observability surfacing (plan monitor / sysstat / timeline)."""
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.core.column import batch_rows_normalized
+from oceanbase_tpu.core.dtypes import DataType, Field, Schema
+from oceanbase_tpu.core.table import Table
+from oceanbase_tpu.engine.chunked import ChunkedPreparedPlan
+from oceanbase_tpu.engine.executor import Executor
+from oceanbase_tpu.engine.memory_governor import (
+    MemoryGovernor,
+    derive_chunk_rows,
+)
+from oceanbase_tpu.engine.pipeline import (
+    _W_FOR,
+    _W_RLE,
+    ChunkPrefetcher,
+    ChunkStager,
+    GraceHashPreparedPlan,
+    NotPartitionable,
+    OverlapMeter,
+    StagedChunk,
+    decoded_row_bytes,
+    try_grace_hash,
+)
+from oceanbase_tpu.models.tpch import datagen
+from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+from oceanbase_tpu.sql.parser import parse
+from oceanbase_tpu.sql.planner import Planner
+
+# lineitem at sf=0.01 (~60k rows) exceeds this; every other table fits
+BUDGET = 1 << 20
+CHUNK = 1 << 14
+# small enough that BOTH join sides (lineitem AND orders) exceed it
+GRACE_BUDGET = 48 << 10
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return datagen.generate(sf=0.01)
+
+
+def _rows(executor, tables, sql):
+    pq = Planner(tables).plan(parse(sql))
+    prepared = executor.prepare(pq.plan)
+    out = prepared.run()
+    return prepared, batch_rows_normalized(out, pq.output_names)
+
+
+def _stream_exec(tables, *, depth=2, compress=True, budget=BUDGET,
+                 governor=None):
+    ex = Executor(tables, unique_keys=UNIQUE_KEYS, device_budget=budget,
+                  chunk_rows=CHUNK)
+    ex.stream_prefetch_depth = depth
+    ex.stream_compress = compress
+    ex.governor = governor
+    return ex
+
+
+# ---------------------------------------------------------------------------
+# decode-on-device bit-identity
+
+
+@pytest.mark.parametrize("qid", [6, 1, 3])
+def test_streamed_bit_identity(tables, qid):
+    """Compressed prefetch streaming must match the resident executor
+    bit-for-bit, including the padded last chunk."""
+    sql = QUERIES[qid]
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, sql)
+    gov = MemoryGovernor(budget=BUDGET)
+    ex = _stream_exec(tables, governor=gov)
+    prepared, got = _rows(ex, tables, sql)
+    assert isinstance(prepared, ChunkedPreparedPlan), f"Q{qid} did not chunk"
+    # the fixture SF must exercise last-chunk padding
+    assert tables["lineitem"].nrows % prepared.chunk_rows != 0
+    assert got == want, f"Q{qid} streamed mismatch"
+    ss = prepared.stream_stats
+    assert ss.chunks >= 3
+    assert 0 < ss.staged_bytes <= ss.decoded_bytes
+    assert gov.ledger_balanced()
+    assert gov.peak_staged > 0
+
+
+@pytest.mark.parametrize("depth,compress", [(0, True), (2, False), (0, False)])
+def test_streamed_ab_legs_identical(tables, depth, compress):
+    """The bench A/B levers (prefetch off, raw wire) change nothing but
+    timing: every leg returns identical rows."""
+    sql = QUERIES[1]
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, sql)
+    prepared, got = _rows(
+        _stream_exec(tables, depth=depth, compress=compress), tables, sql)
+    assert isinstance(prepared, ChunkedPreparedPlan)
+    assert got == want
+    if depth == 0:
+        # no prefetch thread -> wire and compute strictly alternate
+        assert prepared.stream_stats.overlap_s == 0.0
+
+
+def test_wire_encodings_decode_bit_identical():
+    """FOR / RLE / dict-coded / nullable / raw-float columns all survive
+    the stage -> device_put -> jitted-decode round trip exactly, on full
+    and on padded (last) chunks."""
+    import jax
+
+    n, cap = 5000, 2048
+    rng = np.random.default_rng(7)
+    far = rng.integers(0, 200, n) + 7_000_000_000  # FOR: huge base
+    runs = np.repeat(np.arange(n // 100, dtype=np.int64), 100)  # RLE
+    labels = [("AIR", "RAIL", "SHIP")[i % 3] for i in range(n)]  # dict
+    flt = rng.standard_normal(n)  # raw (float never narrows)
+    nullable = rng.integers(0, 50, n)
+    schema = Schema((
+        Field("far", DataType.int64()),
+        Field("runs", DataType.int64()),
+        Field("mode", DataType.varchar()),
+        Field("flt", DataType.float64()),
+        Field("nn", DataType.int64().with_nullable(True)),
+    ))
+    t = Table.from_pydict("wt", schema, {
+        "far": far, "runs": runs, "mode": labels, "flt": flt,
+        "nn": nullable,
+    })
+    t.valid["nn"] = rng.random(n) < 0.8
+    cols = tuple(f.name for f in schema.fields)
+    stager = ChunkStager(t, cols, cap, compress=True)
+
+    kinds = {k: stager._freeze(k, t.data[k], t.schema[k].storage_np)[0]
+             for k in ("far", "runs")}
+    assert kinds["far"] == _W_FOR
+    assert kinds["runs"] == _W_RLE
+
+    for s in range(0, n, cap):  # the final window is partial -> padded
+        e = min(s + cap, n)
+        staged, bases, meta, wire, dec = stager.stage(s, e)
+        assert wire < dec  # compression actually shrinks the wire bytes
+        item = StagedChunk((s, e), jax.device_put(staged), bases, meta,
+                           e - s, wire, dec, None)
+        b = stager.decode_batch(item)
+        sel = np.asarray(b.sel)
+        assert int(sel.sum()) == e - s
+        for c in cols:
+            got = np.asarray(b.cols[c])[: e - s]
+            np.testing.assert_array_equal(got, t.data[c][s:e], err_msg=c)
+        np.testing.assert_array_equal(
+            np.asarray(b.valid["nn"])[: e - s], t.valid["nn"][s:e])
+        assert b.dicts["mode"] is t.dicts["mode"]
+        # a narrowed request filters the decoded batch, same values
+        nb = stager.decode_batch(item, ("runs", "nn"))
+        assert set(nb.cols) == {"runs", "nn"}
+        np.testing.assert_array_equal(
+            np.asarray(nb.cols["runs"])[: e - s], t.data["runs"][s:e])
+
+
+def test_frame_violating_chunk_degrades_to_raw():
+    """A chunk outside the frozen FOR frame (data changed under a cached
+    plan) ships raw for that chunk — one wide transfer, still exact."""
+    import jax
+
+    n, cap = 1000, 512
+    base = np.arange(n, dtype=np.int64) + 100
+    schema = Schema((Field("k", DataType.int64()),))
+    t = Table.from_pydict("ft", schema, {"k": base})
+    stager = ChunkStager(t, ("k",), cap, compress=True)
+    stager.stage(0, cap)  # freeze the frame from the original data
+    t.data["k"] = base - 5000  # now every value is below the frozen min
+    staged, bases, meta, wire, dec = stager.stage(0, cap)
+    item = StagedChunk((0, cap), jax.device_put(staged), bases, meta,
+                       cap, wire, dec, None)
+    got = np.asarray(stager.decode_batch(item).cols["k"])[:cap]
+    np.testing.assert_array_equal(got, t.data["k"][:cap])
+
+
+# ---------------------------------------------------------------------------
+# governor ledger hygiene on error/cancel paths
+
+
+def test_prefetch_cancel_releases_staged_ledger(tables):
+    """close() mid-stream (statement error / timeout) must drain every
+    in-flight staged lease — the governor ledger balances."""
+    gov = MemoryGovernor(budget=BUDGET)
+    t = tables["lineitem"]
+    stager = ChunkStager(t, ("l_quantity", "l_discount"), CHUNK)
+    windows = [(s, min(s + CHUNK, t.nrows))
+               for s in range(0, t.nrows, CHUNK)]
+    pf = ChunkPrefetcher(stager, windows, depth=2, meter=OverlapMeter(),
+                         governor=gov, tenant="sys")
+    item = pf.get()  # consume ONE chunk, leave the rest in flight
+    assert item is not None
+    assert gov.staged >= item.wire_bytes
+    pf.close()  # cancelled mid-stream: undelivered leases drain here
+    item.release()  # the consumer releases what it took
+    assert gov.ledger_balanced(), gov.stats()
+    assert gov.peak_staged > 0
+
+
+def test_statement_error_mid_stream_balances_ledger(tables):
+    """A chunk program failing mid-stream propagates the error AND
+    releases every staged lease (delivered, pending and in-flight)."""
+    gov = MemoryGovernor(budget=BUDGET)
+    ex = _stream_exec(tables, governor=gov)
+    pq = Planner(tables).plan(parse(QUERIES[6]))
+    cp = ex.prepare(pq.plan)
+    assert isinstance(cp, ChunkedPreparedPlan)
+    calls = {"n": 0}
+    real = cp.chunk_prepared.jitted
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("injected mid-stream failure")
+        return real(*a, **kw)
+
+    cp.chunk_prepared.jitted = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        cp.run()
+    assert gov.ledger_balanced(), gov.stats()
+    # the executor recovers once the fault clears
+    cp.chunk_prepared.jitted = real
+    out = cp.run()
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, QUERIES[6])
+    assert batch_rows_normalized(out, pq.output_names) == want
+    assert gov.ledger_balanced()
+
+
+def test_derive_chunk_rows_uses_decoded_width(tables):
+    # narrower decoded rows -> more rows per chunk for the same budget
+    assert derive_chunk_rows(1 << 20, 1 << 20, row_bytes=16) \
+        == 4 * derive_chunk_rows(1 << 20, 1 << 20, row_bytes=64)
+    # legacy 2-arg call (degraded re-plan ladder) keeps its behavior
+    assert derive_chunk_rows(1 << 20, 1 << 14) == 1 << 13
+    # floor: a tiny budget still makes forward progress
+    assert derive_chunk_rows(1, 1 << 14, row_bytes=128) == 4096
+    t = tables["lineitem"]
+    w = decoded_row_bytes(tables, "lineitem", ("l_quantity", "l_discount"))
+    assert w == sum(t.schema[c].storage_np.itemsize
+                    for c in ("l_quantity", "l_discount"))
+
+
+# ---------------------------------------------------------------------------
+# grace-hash partitioned spill
+
+
+GRACE_JOIN_SQL = """
+    select o.o_orderpriority, sum(l.l_quantity) as qty, count(*) as cnt
+    from lineitem l, orders o
+    where l.l_orderkey = o.o_orderkey and l.l_quantity < 30
+    group by o.o_orderpriority
+    order by o.o_orderpriority
+"""
+
+GRACE_GROUPBY_SQL = """
+    select l_orderkey, sum(l_quantity) as q,
+           count(distinct l_linenumber) as dl
+    from lineitem group by l_orderkey order by l_orderkey limit 7
+"""
+
+
+def test_grace_hash_join_bit_identity(tables):
+    """Build side ALSO exceeds the budget: prepare() promotes the plan
+    to grace-hash partitioned execution, results stay bit-identical."""
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, GRACE_JOIN_SQL)
+    gov = MemoryGovernor(budget=GRACE_BUDGET)
+    ex = _stream_exec(tables, budget=GRACE_BUDGET, governor=gov)
+    prepared, got = _rows(ex, tables, GRACE_JOIN_SQL)
+    assert isinstance(prepared, GraceHashPreparedPlan), type(prepared)
+    assert prepared.mode == "join"
+    assert prepared.n_parts >= 2
+    assert got == want
+    assert prepared.stream_stats.spill_partitions >= prepared.n_parts
+    assert gov.ledger_balanced()
+
+
+def test_grace_hash_groupby_bit_identity(tables):
+    """Keyed aggregate over one oversized scan partitions on a group
+    key: groups are partition-disjoint, so even count(distinct) merges
+    exactly by concatenation."""
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, GRACE_GROUPBY_SQL)
+    ex = _stream_exec(tables, budget=GRACE_BUDGET)
+    pq = Planner(tables).plan(parse(GRACE_GROUPBY_SQL))
+    gp = try_grace_hash(ex, pq.plan, GRACE_BUDGET)
+    assert gp.mode == "groupby"
+    out = gp.run()
+    assert batch_rows_normalized(out, pq.output_names) == want
+
+
+def test_grace_hash_rejects_unpartitionable(tables):
+    # no equi-join, no keyed aggregate -> nothing to partition on
+    pq = Planner(tables).plan(parse(
+        "select sum(l_quantity) as q from lineitem"))
+    with pytest.raises(NotPartitionable):
+        try_grace_hash(
+            _stream_exec(tables, budget=GRACE_BUDGET), pq.plan,
+            GRACE_BUDGET)
+
+
+def test_grace_hash_repeated_runs(tables):
+    """The partitioned program and the merge executable are reused
+    across runs (plan-cache discipline): second run, same answer."""
+    whole = Executor(tables, unique_keys=UNIQUE_KEYS)
+    _, want = _rows(whole, tables, GRACE_JOIN_SQL)
+    ex = _stream_exec(tables, budget=GRACE_BUDGET)
+    pq = Planner(tables).plan(parse(GRACE_JOIN_SQL))
+    gp = ex.prepare(pq.plan)
+    assert isinstance(gp, GraceHashPreparedPlan)
+    for _ in range(2):
+        got = batch_rows_normalized(gp.run(), pq.output_names)
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# observability surfacing
+
+
+def test_stream_counters_surface(tables):
+    """Session fold: plan monitor columns, sysstat counters and the
+    timeline's h2d/compute overlap all move when a statement streams."""
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.server.diag import PlanMonitor
+    from oceanbase_tpu.share.metrics import MetricsRegistry
+    from oceanbase_tpu.share.timeline import ServingTimeline
+
+    m = MetricsRegistry()
+    pm = PlanMonitor()
+    sess = Session(tables, unique_keys=UNIQUE_KEYS, metrics=m,
+                   plan_monitor=pm)
+    sess.timeline = ServingTimeline(bucket_s=60.0)
+    sess.executor.device_budget = BUDGET
+    sess.executor.chunk_rows = CHUNK
+    rs = sess.sql(QUERIES[6])
+    assert rs.nrows == 1
+    assert m.counter("stream chunks") >= 3
+    assert m.counter("stream h2d overlap") >= 0
+    es = [e for e in pm.entries() if e.stream_chunks > 0]
+    assert es and es[-1].h2d_overlap_pct >= 0.0
+    buckets = [b for b in sess.timeline.snapshot() if b["stream_chunks"]]
+    assert buckets
+    b = buckets[-1]
+    assert b["stream_h2d_s"] > 0.0
+    assert b["stream_compute_s"] > 0.0
+    assert 0.0 <= b["h2d_overlap_frac"] <= 1.0
+
+
+def test_stream_virtual_table_columns():
+    """The widened virtual tables answer through SQL (zeros for resident
+    plans; the governor VT carries the staged ledger rows)."""
+    from oceanbase_tpu.server import Database
+
+    d = Database(n_nodes=1, n_ls=1)
+    s = d.session()
+    s.sql("create table sp_t (k bigint primary key, v bigint not null)")
+    s.sql("insert into sp_t values (1, 10), (2, 20)")
+    s.sql("select sum(v) as sv from sp_t")
+    rs = s.sql(
+        "select stream_chunks, h2d_overlap_pct, spill_partitions "
+        "from __all_virtual_sql_plan_monitor")
+    assert rs.nrows >= 1
+    rs = s.sql(
+        "select stream_chunks, stream_h2d_us, h2d_overlap_pct, "
+        "stream_spill_parts from __all_virtual_server_timeline")
+    assert rs.nrows >= 1
+    rs = s.sql(
+        "select metric, value from __all_virtual_memory_governor "
+        "where metric in ('staged', 'peak_staged')")
+    assert rs.nrows == 2
+    assert all(r[1] == 0 for r in rs.rows())  # balanced between stmts

@@ -6,7 +6,7 @@
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
 #                      [--advisor] [--warmboot] [--elastic] [--oom] [--mesh]
-#                      [extra pytest args...]
+#                      [--stream] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -80,6 +80,16 @@
 # jitted SPMD program, never through a host-mediated DTL transfer; the
 # JSON summary (with provenance) lands in $BENCH_OUT when set.
 #
+# --stream additionally runs the streaming-pipeline smoke
+# (tools/stream_smoke.py): TPC-H Q1/Q6 under a 256KB synthetic governor
+# budget at scale factors quadrupling twice — streamed rows must be
+# bit-identical to the unconstrained resident executor at every SF, the
+# prefetch thread must actually overlap H2D with compute (timeline
+# h2d_overlap_frac > 0), warm e2e must grow strictly sublinearly in the
+# 4x data steps, and the governor's reservation AND staged ledgers must
+# balance to zero at exit; the JSON summary (with bench_meta provenance)
+# lands in $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -101,6 +111,7 @@ warmboot=0
 elastic=0
 oom=0
 mesh=0
+stream=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -113,6 +124,7 @@ while true; do
         --elastic) elastic=1; shift ;;
         --oom) oom=1; shift ;;
         --mesh) mesh=1; shift ;;
+        --stream) stream=1; shift ;;
         *) break ;;
     esac
 done
@@ -188,6 +200,11 @@ fi
 
 if [ "$mesh" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/mesh_smoke.py
+    rc=$?
+fi
+
+if [ "$stream" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/stream_smoke.py
     rc=$?
 fi
 exit $rc

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Streaming-pipeline smoke: the --stream leg of tools/run_tier1.sh.
+
+Runs TPC-H Q1/Q6 through the streaming pipeline (engine/pipeline.py)
+under a synthetic governor budget at scale factors quadrupling from a
+base, and asserts the four properties the subsystem promises:
+
+  1. bit-identity — every streamed result matches the unconstrained
+     resident executor at every SF;
+  2. overlap — the prefetch thread actually overlaps H2D staging with
+     device compute: the timeline's per-bucket overlap and the plan's
+     h2d_overlap_pct are > 0 in the warm loop;
+  3. sublinear degradation — warm end-to-end seconds grow by strictly
+     less than the 4x data growth at every quadrupling step (fixed
+     per-chunk overhead amortizes, transfers hide behind compute);
+  4. ledger hygiene — the governor's reservation AND staged ledgers
+     balance to zero at exit (no leaked prefetch lease anywhere).
+
+Emits one JSON summary line (stdout, appended to $BENCH_OUT when set)
+with bench_meta provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BENCH_OUT = os.environ.get("BENCH_OUT")
+
+QIDS = (1, 6)
+# quadrupling sweep; the synthetic budget forces streaming at every SF
+SFS = (float(os.environ.get("STREAM_SMOKE_SF0", "0.005")),)
+SFS = (SFS[0], SFS[0] * 4, SFS[0] * 16)
+BUDGET = 256 << 10
+CHUNK = 1 << 13
+WARM_ITERS = 3
+
+
+def fail(msg: str) -> int:
+    print(f"STREAM-SMOKE FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.engine.chunked import ChunkedPreparedPlan
+    from oceanbase_tpu.engine.memory_governor import MemoryGovernor
+    from oceanbase_tpu.models.tpch import datagen
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+    from oceanbase_tpu.share.timeline import ServingTimeline
+
+    legs = []
+    for sf in SFS:
+        tables = datagen.generate(sf=sf)
+        resident = Session(tables, unique_keys=UNIQUE_KEYS)
+        gov = MemoryGovernor(budget=BUDGET)
+        sess = Session(tables, unique_keys=UNIQUE_KEYS)
+        sess.timeline = ServingTimeline(bucket_s=3600.0)
+        sess.executor.device_budget = BUDGET
+        sess.executor.chunk_rows = CHUNK
+        sess.executor.governor = gov
+
+        for q in QIDS:
+            want = [tuple(r) for r in resident.sql(QUERIES[q]).rows()]
+            got = [tuple(r) for r in sess.sql(QUERIES[q]).rows()]
+            if got != want:
+                return fail(f"sf={sf} Q{q}: streamed rows differ from "
+                            "resident execution")
+
+        # warm loop: plan-cache hits, pure streaming steady state
+        t0 = time.perf_counter()
+        for _ in range(WARM_ITERS):
+            for q in QIDS:
+                sess.sql(QUERIES[q])
+        warm_s = (time.perf_counter() - t0) / WARM_ITERS
+
+        # the warm loop must actually stream (budget forces chunking)
+        streamed = [
+            e.prepared for e in sess.plan_cache._entries.values()
+            if isinstance(getattr(e, "prepared", None), ChunkedPreparedPlan)
+        ] if hasattr(sess.plan_cache, "_entries") else []
+        chunks = overlap_pct = 0
+        sstats = [
+            cp.stream_stats for cp in streamed
+            if getattr(cp, "stream_stats", None) is not None
+        ]
+        if sstats:
+            chunks = sum(s.chunks for s in sstats)
+            h2d = sum(s.h2d_s for s in sstats)
+            ovl = sum(s.overlap_s for s in sstats)
+            overlap_pct = 100.0 * ovl / h2d if h2d else 0.0
+        buckets = [b for b in sess.timeline.snapshot()
+                   if b["stream_chunks"] > 0]
+        if not buckets:
+            return fail(f"sf={sf}: no streaming activity reached the "
+                        "serving timeline")
+        tl_overlap = max(b["h2d_overlap_frac"] for b in buckets)
+        if chunks <= 0:
+            return fail(f"sf={sf}: the warm loop streamed no chunks "
+                        "(budget did not force the pipeline)")
+        if tl_overlap <= 0.0 and overlap_pct <= 0.0:
+            return fail(f"sf={sf}: h2d/compute overlap is zero — the "
+                        "prefetch pipeline is not overlapping transfers")
+        if not gov.ledger_balanced():
+            return fail(f"sf={sf}: governor ledger unbalanced at exit: "
+                        f"{gov.stats()}")
+        legs.append({
+            "sf": sf,
+            "lineitem_rows": tables["lineitem"].nrows,
+            "warm_e2e_s": round(warm_s, 4),
+            "stream_chunks": int(chunks),
+            "h2d_overlap_pct": round(overlap_pct, 2),
+            "timeline_overlap_frac": round(tl_overlap, 4),
+            "peak_staged_bytes": int(gov.peak_staged),
+        })
+        print(f"sf={sf}: warm e2e {warm_s*1e3:.1f}ms, "
+              f"{chunks} chunks, overlap {overlap_pct:.1f}%", flush=True)
+
+    # ---- sublinear degradation across each 4x step ----------------------
+    ratios = []
+    for a, b in zip(legs, legs[1:]):
+        r = b["warm_e2e_s"] / max(a["warm_e2e_s"], 1e-9)
+        ratios.append(round(r, 3))
+        if r >= 4.0:
+            return fail(
+                f"e2e degraded {r:.2f}x over a 4x SF step "
+                f"(sf {a['sf']} -> {b['sf']}): streaming must amortize")
+
+    tools = os.path.dirname(os.path.abspath(__file__))
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    from bench_meta import collect as bench_meta
+
+    summary = {
+        "bench": "stream_smoke",
+        "queries": [f"q{q}" for q in QIDS],
+        "budget_bytes": BUDGET,
+        "chunk_rows": CHUNK,
+        "warm_iters": WARM_ITERS,
+        "legs": legs,
+        "e2e_ratios_per_4x": ratios,
+        "meta": bench_meta(None),
+    }
+    line = json.dumps(summary)
+    print(line, flush=True)
+    if _BENCH_OUT:
+        with open(_BENCH_OUT, "a") as f:
+            f.write(line + "\n")
+    print(f"stream smoke OK: overlap > 0 at every SF, e2e ratios {ratios} "
+          "per 4x data step (sublinear), ledgers balanced, rows "
+          "bit-identical to resident execution")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
