@@ -1028,8 +1028,9 @@ class GraceHashPreparedPlan:
         cols: dict[str, list] = {
             f.name: [] for f in self.partial_schema.fields}
         valids: dict[str, list] = {}
-        with TmpFileManager(tenant=getattr(self.executor, "tenant", "sys")) \
-                as tmp:
+        with TmpFileManager(
+                tenant=getattr(self.executor, "tenant", "sys"),
+                metrics=getattr(self.executor, "metrics", None)) as tmp:
             # phase 1: co-partition every grace input by its key column;
             # the fixed per-input capacity (max partition, pow2) is what
             # lets ONE compiled program serve all partitions

@@ -210,10 +210,20 @@ def load_flat(blob, in_avals, proto, example_leaves=None):
 
 
 def _atomic_write(path: str, data: bytes) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    # artifacts are recomputable (worst case: one honest compile), so no
+    # fsync — but they still ride the integrity envelope: a corrupt
+    # artifact must be DETECTED and quarantined, never half-unpickled
+    from ..storage.integrity import ARTIFACT, write_atomic
+
+    write_atomic(path, data, fsync=False, path_class=ARTIFACT)
+
+
+def _read_verified(path: str) -> bytes:
+    """Verified read for every artifact file; raises FileNotFoundError
+    (missing) or CorruptBlock (damaged) — never returns bad bytes."""
+    from ..storage.integrity import ARTIFACT, read_verified
+
+    return read_verified(path, path_class=ARTIFACT)
 
 
 class PlanArtifactStore:
@@ -321,11 +331,21 @@ class PlanArtifactStore:
         return os.path.join(self.root, "index.json")
 
     def _load_index(self) -> None:
+        from ..storage.integrity import CorruptBlock, quarantine_file
+
         try:
-            with open(self._index_path(), "rb") as f:
-                idx = json.load(f)
+            idx = json.loads(_read_verified(self._index_path()))
             if isinstance(idx, dict) and "entries" in idx:
                 self._index = idx
+        except FileNotFoundError:
+            pass  # fresh store
+        except CorruptBlock as e:
+            # a corrupt index is quarantined and the store starts empty:
+            # orphaned artifact files are unreachable (never hydrated)
+            # and get re-exported/overwritten on the next compile
+            quarantine_file(self._index_path(), e.reason)
+            self._note("plan artifact quarantined")
+            self._note("checksum failures")
         except (OSError, ValueError):
             pass
 
@@ -338,6 +358,22 @@ class PlanArtifactStore:
                 json.dumps(self._index, sort_keys=True).encode())
         except OSError:
             pass
+
+    def quarantine(self, aid: str, path: str, reason: str) -> None:
+        """First load error on a corrupt artifact file: move it into
+        quarantine/ (kept for forensics, never re-read), drop the whole
+        entry from the index so later boots don't retry it, and count."""
+        from ..storage.integrity import quarantine_file
+
+        quarantine_file(path, reason)
+        with self._lock:
+            if aid in self._index["entries"]:
+                if self.writable:
+                    self._drop_files(aid)
+                self._index["entries"].pop(aid, None)
+                self._save_index()
+        self._note("plan artifact quarantined")
+        self._note("checksum failures")
 
     def key_id(self, art_key: tuple) -> str:
         return hashlib.md5(repr(art_key).encode()).hexdigest()
@@ -547,8 +583,7 @@ class PlanArtifactStore:
                 return
             meta_p, _ = self._paths(aid)
             try:
-                with open(meta_p, "rb") as f:
-                    meta = pickle.load(f)
+                meta = pickle.loads(_read_verified(meta_p))
             except Exception:
                 self._drop_files(aid)
                 self._index["entries"].pop(aid, None)
@@ -602,8 +637,23 @@ class PlanArtifactStore:
         path = self._bucket_path(aid, bucket)
         t0 = time.perf_counter()
         try:
-            with open(path, "rb") as f:
-                blob = f.read()
+            from ..storage.integrity import CorruptBlock
+
+            try:
+                blob = _read_verified(path)
+            except CorruptBlock as e:
+                # quarantine just the bucket file; the base program and
+                # the index entry stay (the caller recompiles the bucket)
+                from ..storage.integrity import quarantine_file
+                quarantine_file(path, e.reason)
+                with self._lock:
+                    ent = self._index["entries"].get(aid)
+                    if ent is not None and bucket in ent.get("buckets", ()):
+                        ent["buckets"].remove(bucket)
+                        self._save_index()
+                self._note("plan artifact quarantined")
+                self._note("checksum failures")
+                raise
             inputs = prepared._inputs()
             qb = np.zeros((bucket, len(spec)), np.int64)
             leaves = jax.tree_util.tree_leaves((inputs, qb))
@@ -626,15 +676,21 @@ class PlanArtifactStore:
 
     # ----------------------------------------------------------- hydrate
     def read_meta(self, aid: str):
-        """Pickled ArtifactMeta for one entry, or None (counted as a load
-        error when the file exists but will not unpickle)."""
+        """Pickled ArtifactMeta for one entry, or None (a corrupt file is
+        quarantined on first load error; an unpicklable-but-valid-crc
+        payload is counted as a load error)."""
+        from ..storage.integrity import CorruptBlock
+
         if not self.readable:
             return None
         meta_p, _ = self._paths(aid)
         try:
-            with open(meta_p, "rb") as f:
-                return pickle.load(f)
+            return pickle.loads(_read_verified(meta_p))
         except FileNotFoundError:
+            return None
+        except CorruptBlock as e:
+            self.quarantine(aid, meta_p, e.reason)
+            self._note("plan artifact load error")
             return None
         except Exception:
             self._note("plan artifact load error")
@@ -689,8 +745,13 @@ class PlanArtifactStore:
                 self._note("plan artifact key mismatch")
                 return None
         try:
-            with open(blob_p, "rb") as f:
-                blob = f.read()
+            from ..storage.integrity import CorruptBlock
+
+            try:
+                blob = _read_verified(blob_p)
+            except CorruptBlock as e:
+                self.quarantine(aid, blob_p, e.reason)
+                raise
             from .executor import PreparedPlan
 
             prepared = PreparedPlan(

@@ -279,6 +279,10 @@ class Database:
         self._xa_prepared: dict[str, tuple] = {}
         self.unit = unit or TenantUnit()
         self._shared_cluster = cluster is not None
+        # integrity counters accrued BEFORE the metrics registry exists
+        # (meta/checkpoint verification runs first thing in boot); folded
+        # into sysstat once the registry is built below
+        self._boot_integrity: dict[str, float] = {}
         self._unique_keys: dict[str, tuple[str, ...]] = {}
         # tablet_id -> TableInfo, rebuilt lazily after DDL (apply-path hot)
         self._ti_by_tablet: dict[int, TableInfo] | None = None
@@ -440,6 +444,10 @@ class Database:
         from ..share.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+        # fold integrity counters accrued during boot-time verification
+        # (before this registry existed) into sysstat
+        for _n, _v in self._boot_integrity.items():
+            self.metrics.add(_n, _v)
         self.plan_cache.metrics = self.metrics
         if getattr(self.cluster.bus, "metrics", None) is None:
             # shared-cluster mode: the first tenant (sys) owns the bus
@@ -624,6 +632,31 @@ class Database:
             tablets_fn=self._all_tablets,
             snapshot_fn=lambda: self.cluster.gts.current(),
         )
+        # background storage scrubber (storage/scrub.py): queued as a
+        # BACKGROUND dag from run_maintenance every ob_scrub_interval
+        from ..storage.scrub import StorageScrubber
+
+        self.scrubber = StorageScrubber(self)
+        # ALTER SYSTEM SET ob_errsim_disk_* arms the shared disk-fault
+        # injector live (chaos harness entry point; 0 disarms)
+        from ..share.errsim import ERRSIM as _ERRSIM
+
+        _disk_arms = {
+            "ob_errsim_disk_bitflip": "EN_DISK_BITFLIP",
+            "ob_errsim_disk_torn_write": "EN_DISK_TORN_WRITE",
+            "ob_errsim_disk_truncate": "EN_DISK_TRUNCATE",
+            "ob_errsim_disk_io_error": "EN_IO_ERROR",
+        }
+
+        def _arm_disk(name, _old, v):
+            point = _disk_arms[name]
+            if float(v) > 0.0:
+                _ERRSIM.arm(point, prob=float(v), count=-1)
+            else:
+                _ERRSIM.clear(point)
+
+        for _k in _disk_arms:
+            self.config.on_change(_k, _arm_disk)
 
         from ..tx.tablelock import LockManager
 
@@ -690,6 +723,9 @@ class Database:
         # feeds (admission, completion) go through db.timeline directly
         self.engine.timeline = self.timeline
         self.engine.executor.timeline = self.timeline
+        # spill-segment corruption counting (storage/tmp_file.py) reaches
+        # sysstat through the executor the grace-hash pipeline holds
+        self.engine.executor.metrics = self.metrics
         # cross-session continuous-batching scheduler: concurrent
         # fast-path hits fold into batched device dispatches behind ONE
         # cluster-shared DispatchGate (like cluster._timeline) — the
@@ -832,6 +868,7 @@ class Database:
         post-commit hook); live servers call maintenance.start()."""
         out = self.maintenance.tick()
         self.maybe_rebalance_leaders()
+        self.scrubber.maybe_queue()
         self.dag_scheduler.run_until_idle()
         return out
 
@@ -930,14 +967,35 @@ class Database:
         return os.path.join(self.data_dir, f"n{node}", f"ls_{ls_id}", "ckpt.pkl")
 
     def _load_node_meta(self) -> dict | None:
+        """Read the newest verifiable node-meta snapshot. Missing means a
+        fresh boot (None); a corrupt latest copy is counted, quarantined,
+        and boot falls back to the retained .prev (schema changes since
+        that snapshot replay from the log). All copies corrupt raises —
+        booting with guessed schema would be silent data loss."""
         import os
         import pickle
 
+        from ..storage.integrity import (META, CorruptBlock, CounterSink,
+                                         quarantine_file, read_verified)
+
+        sink = CounterSink(self._boot_integrity)
         path = self._meta_path()
-        if not os.path.exists(path):
-            return None
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        last_err: CorruptBlock | None = None
+        for p in (path, path + ".prev"):
+            if not os.path.exists(p):
+                continue
+            try:
+                return pickle.loads(read_verified(p, path_class=META))
+            except CorruptBlock as e:
+                last_err = e
+            except Exception as e:  # unpicklable despite a valid crc
+                last_err = CorruptBlock(p, f"{type(e).__name__}: {e}")
+            sink.add("node meta corruption")
+            sink.add("checksum failures")
+            quarantine_file(p, last_err.reason)
+        if last_err is not None:
+            raise last_err
+        return None
 
     def _save_node_meta(self) -> None:
         """Persist schema + TableInfo state (the slog meta-redo analog,
@@ -986,12 +1044,23 @@ class Database:
                 for x, e in self._xa_registry.items()
             },
         }
-        from ..share.fsutil import atomic_write
+        import os
 
-        atomic_write(
-            self._meta_path(),
+        from ..storage.integrity import META, write_atomic
+
+        path = self._meta_path()
+        if os.path.exists(path):
+            # keep the previous snapshot: a damaged latest copy still has
+            # a fallback (same rotation as LS checkpoints)
+            try:
+                os.replace(path, path + ".prev")
+            except OSError:
+                pass
+        write_atomic(
+            path,
             pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL),
             fsync=self._fsync,
+            path_class=META,
         )
 
     def _restore_from_disk(self, meta: dict) -> None:
@@ -1000,10 +1069,21 @@ class Database:
         the last checkpoint. Replay of entries (applied_lsn, commit] then
         happens through the normal apply path once leaders elect."""
         from ..storage.ckpt import read_ls_checkpoint, restore_ls_replica
+        from ..storage.integrity import CorruptBlock, CounterSink
 
+        sink = CounterSink(self._boot_integrity)
         for ls_id, group in self.cluster.ls_groups.items():
             for node, rep in group.items():
-                st = read_ls_checkpoint(self._ckpt_path(node, ls_id))
+                try:
+                    st = read_ls_checkpoint(
+                        self._ckpt_path(node, ls_id), metrics=sink)
+                except CorruptBlock:
+                    # EVERY retained copy failed verification (each one
+                    # counted + quarantined by the reader). Recovery is
+                    # full log replay — only safe while nothing below the
+                    # checkpoint was recycled, checked just like the
+                    # missing-checkpoint case below.
+                    st = None
                 if st is not None:
                     restore_ls_replica(rep, st)
                     # GTS must clear every restored commit version even if

@@ -26,7 +26,11 @@ sentinel test replays a recorded pair and asserts the exact alert set):
       (edge-triggered on the keepalive transition);
   device_memory_pressure — sustained governor reservation-wait p99 plus
       degraded executions (OOM retries / chunked / host fallbacks) in
-      the window, edge-triggered like replica_unreachable.
+      the window, edge-triggered like replica_unreachable;
+  storage_corruption — checksum failures detected inside the window
+      (scrubber or read path); critical when corruption is sitting
+      UNREPAIRED at the window end, warn when every detection was
+      repaired (quarantine + rewrite/rebuild/recompute).
 
 Evaluating the same window twice never duplicates an alert: the dedup
 key is (rule, subject key, window-ending snap_id).
@@ -77,6 +81,8 @@ class SentinelConfig:
     # the window
     govr_wait_p99_s: float = 0.05
     govr_min_degraded: int = 1
+    # storage_corruption: checksum failures in window to fire at all
+    corruption_min_failures: int = 1
 
 
 @dataclass
@@ -398,6 +404,47 @@ def _rule_device_memory_pressure(first, last, cfg, out) -> None:
     })
 
 
+def _rule_storage_corruption(first, last, cfg, out) -> None:
+    """Checksum failures surfaced inside the window — from any verified
+    read path or a scrub pass. Severity is the repair state at the
+    window end: corruption that is sitting UNREPAIRED (a backup with no
+    source to regenerate from, a replica that could not rebuild) is
+    critical; fully-repaired detections (quarantine + rewrite/rebuild/
+    recompute) warn. Edge-triggered by construction: the rule fires on
+    the failure-count DELTA, so a window with no new detections is
+    silent no matter how much history sysstat carries."""
+    fails = int(_sys_delta(first, last, "checksum failures"))
+    if fails < cfg.corruption_min_failures:
+        return
+    i0 = first.get("integrity") or {}
+    i1 = last.get("integrity") or {}
+    unrepaired = max(0, int(i1.get("unrepaired", 0))
+                     - int(i0.get("unrepaired", 0)))
+    quarantined = int(_sys_delta(first, last, "quarantined files"))
+    repairs = int(_sys_delta(first, last, "replica repairs"))
+    by_class = i1.get("by_class") or {}
+    bad_classes = sorted(
+        c for c, v in by_class.items() if v.get("failures", 0) > 0)
+    out.append({
+        "rule": "storage_corruption",
+        "severity": "critical" if unrepaired else "warn",
+        "key": "",
+        "summary": (f"{fails} checksum failures in window "
+                    f"({quarantined} quarantined, {repairs} replica "
+                    f"repairs); "
+                    + (f"{unrepaired} UNREPAIRED" if unrepaired
+                       else "all repaired")),
+        "evidence": {
+            "window_failures": fails,
+            "window_quarantined": quarantined,
+            "window_replica_repairs": repairs,
+            "unrepaired": unrepaired,
+            "classes": bad_classes,
+            "scrub_passes": int(i1.get("passes", 0)),
+        },
+    })
+
+
 _RULES = (
     _rule_digest_regression,
     _rule_error_retry,
@@ -407,6 +454,7 @@ _RULES = (
     _rule_fastpath_collapse,
     _rule_replica_unreachable,
     _rule_device_memory_pressure,
+    _rule_storage_corruption,
 )
 
 

@@ -739,6 +739,35 @@ def _memory_governor(db) -> Table:
     ])
 
 
+def _storage_integrity(db) -> Table:
+    """Storage-scrub ledger (storage/scrub.py): one row per artifact
+    class with cumulative scrubbed/failure/quarantine/repair counts,
+    plus one `quarantine:<class>` row per quarantined file (its new
+    path and the verification failure that sent it there)."""
+    scr = getattr(db, "scrubber", None)
+    st = scr.stats() if scr is not None else {}
+    rows: list[tuple[str, int, int, int, int, int, str]] = []
+    for cls, v in sorted((st.get("by_class") or {}).items()):
+        rows.append((
+            cls, int(v.get("scrubbed", 0)), int(v.get("failures", 0)),
+            int(v.get("quarantined", 0)), int(v.get("repaired", 0)),
+            int(v.get("unrepaired", 0)),
+            f"passes={int(st.get('passes', 0))}",
+        ))
+    for cls, qpath, reason in st.get("quarantined", ()):
+        rows.append((f"quarantine:{cls}", 0, 0, 1, 0, 0,
+                     f"{qpath}: {reason}"[:160]))
+    return _t("__all_virtual_storage_integrity", [
+        ("path_class", DataType.varchar(), [r[0] for r in rows]),
+        ("scrubbed", DataType.int64(), [r[1] for r in rows]),
+        ("failures", DataType.int64(), [r[2] for r in rows]),
+        ("quarantined", DataType.int64(), [r[3] for r in rows]),
+        ("repaired", DataType.int64(), [r[4] for r in rows]),
+        ("unrepaired", DataType.int64(), [r[5] for r in rows]),
+        ("detail", DataType.varchar(), [r[6] for r in rows]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -786,4 +815,5 @@ PROVIDERS = {
     "__all_virtual_layout_advisor": _layout_advisor,
     "__all_virtual_plan_artifact": _plan_artifact,
     "__all_virtual_memory_governor": _memory_governor,
+    "__all_virtual_storage_integrity": _storage_integrity,
 }

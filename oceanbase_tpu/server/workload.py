@@ -786,6 +786,10 @@ def build_snapshot(db, snap_id: int, ts: float) -> dict:
         # state): the device_memory_pressure sentinel rule's input
         "governor": (db.governor.stats()
                      if getattr(db, "governor", None) is not None else {}),
+        # storage-scrub state (storage/scrub.py): pass/quarantine/repair
+        # tallies — the storage_corruption sentinel rule's input
+        "integrity": (db.scrubber.stats()
+                      if getattr(db, "scrubber", None) is not None else {}),
     }
 
 

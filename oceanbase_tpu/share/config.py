@@ -266,6 +266,27 @@ def default_params() -> list[Param]:
               choices=("raw", "for", "rle", "auto")),
         Param("micro_block_rows", "int", 16384,
               "rows per micro block at dump time", min=256, max=1 << 20),
+        # storage integrity (storage/integrity.py + storage/scrub.py)
+        Param("ob_scrub_interval", "time", 0.0,
+              "floor between background storage-scrub passes verifying "
+              "every durable artifact's checksum envelope; 0 disables "
+              "the scrubber", min=0.0),
+        Param("ob_errsim_disk_bitflip", "double", 0.0,
+              "disk-fault injection: probability a durable write/read "
+              "flips one payload byte (EN_DISK_BITFLIP arm)",
+              min=0.0, max=1.0),
+        Param("ob_errsim_disk_torn_write", "double", 0.0,
+              "disk-fault injection: probability a durable write "
+              "persists only a prefix (EN_DISK_TORN_WRITE arm)",
+              min=0.0, max=1.0),
+        Param("ob_errsim_disk_truncate", "double", 0.0,
+              "disk-fault injection: probability a durable file loses "
+              "its tail before a read (EN_DISK_TRUNCATE arm)",
+              min=0.0, max=1.0),
+        Param("ob_errsim_disk_io_error", "double", 0.0,
+              "disk-fault injection: probability a durable read/write "
+              "raises an I/O error (EN_IO_ERROR arm)",
+              min=0.0, max=1.0),
         # security
         Param("secure_file_priv", "str", "",
               "directory non-root external-table locations must resolve "

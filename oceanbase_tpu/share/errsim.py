@@ -32,6 +32,9 @@ class _Arm:
     prob: float
     remaining: int  # -1 = unlimited
     fired: int = 0
+    # scope the arm to one path class (ckpt/meta/artifact/spill/backup/
+    # sstable — see storage/integrity.py); None fires for every class
+    path_class: object = None
 
 
 #: default seed for probabilistic arms; reseed() replays a chaos schedule
@@ -53,12 +56,15 @@ class ErrsimRegistry:
             self._rng = random.Random(seed)
 
     def arm(self, name: str, error: Exception | None = None,
-            prob: float = 1.0, count: int = -1) -> None:
+            prob: float = 1.0, count: int = -1,
+            path_class: object = None) -> None:
         """Arm a tracepoint: `error` raises at the point (default
         InjectedError(name)); fires `count` times (-1 = until cleared)
-        with probability `prob`."""
+        with probability `prob`. `path_class` (str or tuple of str)
+        restricts a disk-fault arm to matching should_fire() callers."""
         with self._lock:
-            self._arms[name] = _Arm(error, prob, count)
+            self._arms[name] = _Arm(error, prob, count,
+                                    path_class=path_class)
 
     def clear(self, name: str | None = None) -> None:
         with self._lock:
@@ -85,6 +91,26 @@ class ErrsimRegistry:
             a.fired += 1
             err = a.error
         raise err if err is not None else InjectedError(name)
+
+    def should_fire(self, name: str, path_class: str | None = None) -> bool:
+        """Non-raising fire decision for data-corrupting arms (the disk
+        fault layer in storage/integrity.py asks, then corrupts the bytes
+        itself instead of raising). Honors prob/count exactly like check()
+        and additionally filters on the arm's path-class scope."""
+        with self._lock:
+            a = self._arms.get(name)
+            if a is None or a.remaining == 0:
+                return False
+            if a.path_class is not None:
+                classes = (a.path_class if isinstance(a.path_class, (tuple, list, set, frozenset)) else (a.path_class,))
+                if path_class not in classes:
+                    return False
+            if a.prob < 1.0 and self._rng.random() >= a.prob:
+                return False
+            if a.remaining > 0:
+                a.remaining -= 1
+            a.fired += 1
+            return True
 
 
 class DebugSyncRegistry:

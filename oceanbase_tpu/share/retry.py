@@ -154,6 +154,16 @@ DEVICE_MEMORY = RetryPolicy(
     base_wait=0.05, max_wait=1.0, max_retries=4,
 )
 
+#: Corrupt transient storage mid-statement (a spill segment failed its
+#: checksum): the bad file is already deleted by the reader, so a
+#: re-drive recomputes it from the base tables. Bounded — corruption
+#: of DURABLE state (checkpoint, sstable) surfaces through recovery or
+#: the scrubber instead, never a statement retry loop.
+STORAGE_CORRUPT = RetryPolicy(
+    kind=CAPPED, reason="storage corruption recompute",
+    base_wait=0.0, max_wait=0.1, max_retries=3,
+)
+
 
 def _is_xla_oom(err: BaseException) -> bool:
     """Recognize a real XLA RESOURCE_EXHAUSTED without importing jax
@@ -184,6 +194,13 @@ def classify(err: BaseException) -> RetryPolicy:
         return DEVICE_MEMORY
     if isinstance(err, InjectedError):
         return INJECTED_TRANSIENT
+    try:
+        from oceanbase_tpu.storage.integrity import CorruptBlock
+    except Exception:  # pragma: no cover - storage layer absent
+        pass
+    else:
+        if isinstance(err, CorruptBlock):
+            return STORAGE_CORRUPT
     try:
         from oceanbase_tpu.tx.txn import NotMaster, WriteConflict
     except Exception:  # pragma: no cover - tx layer absent in unit slices
@@ -361,5 +378,5 @@ __all__ = [
     "NONE", "IMMEDIATE", "BACKOFF", "CAPPED",
     "NOT_RETRYABLE", "LOCATION_REFRESH", "STALE_LOCATION",
     "INJECTED_TRANSIENT", "PX_ADMISSION", "SCHEMA_EAGAIN", "WRITE_CONFLICT",
-    "DEVICE_OOM", "DEVICE_MEMORY",
+    "DEVICE_OOM", "DEVICE_MEMORY", "STORAGE_CORRUPT",
 ]

@@ -29,7 +29,8 @@ from ..core.dtypes import Field, Schema
 from ..log.archive import ArchiveReader
 from ..log.cdc import CdcClient, merge_streams
 from ..sql.logical import _parse_type
-from .sstable import OP_DELETE, OP_PUT, SSTable, write_sstable
+from .sstable import (OP_DELETE, OP_PUT, SSTable, load_sstable,
+                      save_sstable, write_sstable)
 
 
 def backup_database(db, root: str) -> int:
@@ -53,8 +54,7 @@ def backup_database(db, root: str) -> int:
         from ..share.io_manager import GLOBAL_IO
 
         GLOBAL_IO.account("backup", len(blob))
-        with open(os.path.join(root, f"{name}.sst"), "wb") as f:
-            f.write(blob)
+        save_sstable(os.path.join(root, f"{name}.sst"), blob, fsync=False)
         meta["tables"].append({
             "name": name,
             "tablet_id": ti.tablet_id,  # archived redo references this id
@@ -66,10 +66,10 @@ def backup_database(db, root: str) -> int:
             "dicts": {c: d.values() for c, d in ti.dicts.items()},
             "rows": int(n),
         })
-    tmp = os.path.join(root, "meta.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, os.path.join(root, "meta.json"))
+    from .integrity import BACKUP, write_atomic
+
+    write_atomic(os.path.join(root, "meta.json"),
+                 json.dumps(meta).encode(), fsync=False, path_class=BACKUP)
     return scn
 
 
@@ -94,9 +94,10 @@ def restore_database(root: str, n_nodes: int = 3, n_ls: int = 2,
     Returns the restored Database. New writes get timestamps beyond the
     restored history (GTS fast-forward)."""
     from ..server.database import Database
+    from .integrity import BACKUP, read_verified
 
-    with open(os.path.join(root, "meta.json")) as f:
-        meta = json.load(f)
+    meta = json.loads(read_verified(
+        os.path.join(root, "meta.json"), path_class=BACKUP))
     backup_scn = meta["backup_scn"]
     db = Database(n_nodes=n_nodes, n_ls=n_ls)
 
@@ -123,8 +124,9 @@ def restore_database(root: str, n_nodes: int = 3, n_ls: int = 2,
             # codes inside the backup snapshot are already durable: the
             # first post-restore commit must not re-log the whole dict
             ti.logged_dict_len[c] = len(values)
-        with open(os.path.join(root, f"{tmeta['name']}.sst"), "rb") as f:
-            blob = f.read()
+        ss = load_sstable(os.path.join(root, f"{tmeta['name']}.sst"),
+                          schema, ti.key_cols, cache=db.block_cache)
+        blob = bytes(ss.buf)
         for rep in db.cluster.ls_groups[ti.ls_id].values():
             t = rep.tablets[ti.tablet_id]
             t.base = SSTable(blob, schema, ti.key_cols, cache=db.block_cache)

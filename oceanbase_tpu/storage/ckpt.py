@@ -63,21 +63,40 @@ def write_ls_checkpoint(path: str, rep, fsync: bool = True) -> int | None:
             os.replace(path, path + ".prev")
         except OSError:
             pass
-    from ..share.fsutil import atomic_write
+    from .integrity import CKPT, write_atomic
 
-    atomic_write(path, blob, fsync=fsync)
+    write_atomic(path, blob, fsync=fsync, path_class=CKPT)
     return covered
 
 
-def read_ls_checkpoint(path: str) -> dict | None:
+def read_ls_checkpoint(path: str, metrics=None) -> dict | None:
+    """Read the newest verifiable checkpoint.
+
+    Missing and corrupt are DIFFERENT outcomes: None means no checkpoint
+    was ever written (fresh boot, full log replay); a damaged latest file
+    is counted ("checkpoint corruption"), quarantined, and recovery falls
+    back to the retained previous snapshot — replay then covers the gap
+    from that older applied_lsn. Only when every existing copy fails
+    verification does this raise CorruptBlock, so the caller can decide
+    whether log replay from zero (or a replica rebuild) is still safe."""
+    from .integrity import CKPT, CorruptBlock, quarantine_file, read_verified
+
+    last_err: CorruptBlock | None = None
     for p in (path, path + ".prev"):
         if not os.path.exists(p):
             continue
         try:
-            with open(p, "rb") as f:
-                return pickle.load(f)
-        except (EOFError, pickle.UnpicklingError):
-            continue  # torn/corrupt: try the retained previous snapshot
+            return pickle.loads(read_verified(p, path_class=CKPT))
+        except CorruptBlock as e:
+            last_err = e
+        except Exception as e:  # unpicklable payload despite a valid crc
+            last_err = CorruptBlock(p, f"{type(e).__name__}: {e}")
+        if metrics is not None:
+            metrics.add("checkpoint corruption")
+            metrics.add("checksum failures")
+        quarantine_file(p, last_err.reason)
+    if last_err is not None:
+        raise last_err
     return None
 
 

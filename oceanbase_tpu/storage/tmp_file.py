@@ -9,10 +9,17 @@ directory, tracks bytes, and cleans up deterministically. The device-side
 consumers live in ops/spill.py, and the streaming pipeline's grace-hash
 partitioned join/group-by (engine/pipeline.py) spills its key-disjoint
 partition segments through the same manager.
+
+Segments ride the shared integrity envelope (storage/integrity.py): a
+corrupt segment raises a typed CorruptBlock on read — counted, and the
+bad file deleted so it is never re-read — and the statement retry
+taxonomy (share/retry.py) classifies it as recomputable: the grace-hash
+run re-partitions from the base tables on the retry.
 """
 
 from __future__ import annotations
 
+import io
 import os
 import shutil
 import tempfile
@@ -20,10 +27,12 @@ import threading
 
 import numpy as np
 
+from .integrity import SPILL, CorruptBlock, apply_write_faults, read_verified, wrap
+
 
 class TmpFileManager:
     def __init__(self, root: str | None = None, limit_bytes: int = 8 << 30,
-                 tenant: object = "sys", io_mgr=None):
+                 tenant: object = "sys", io_mgr=None, metrics=None):
         self._own_root = root is None
         self.root = root or tempfile.mkdtemp(prefix="ob_tpu_spill_")
         os.makedirs(self.root, exist_ok=True)
@@ -38,6 +47,7 @@ class TmpFileManager:
 
             io_mgr = GLOBAL_IO
         self.io_mgr = io_mgr
+        self.metrics = metrics
 
     def write_segment(self, cols: dict[str, np.ndarray]) -> str:
         """Spill one segment (a dict of equal-length column arrays)."""
@@ -47,7 +57,13 @@ class TmpFileManager:
         self.io_mgr.account(
             self.tenant, sum(a.nbytes for a in cols.values())
         )
-        np.savez(path, **cols)
+        buf = io.BytesIO()
+        np.savez(buf, **cols)
+        # spill is transient (a crash loses the statement anyway): no
+        # fsync/rename, but the envelope + write-fault arms still apply
+        data = apply_write_faults(wrap(buf.getvalue()), SPILL)
+        with open(path, "wb") as f:
+            f.write(data)
         sz = os.path.getsize(path)
         with self._lock:
             self._bytes += sz
@@ -61,7 +77,17 @@ class TmpFileManager:
 
     def read_segment(self, path: str) -> dict[str, np.ndarray]:
         self.io_mgr.account(self.tenant, os.path.getsize(path))
-        with np.load(path) as z:
+        try:
+            payload = read_verified(path, path_class=SPILL)
+        except CorruptBlock:
+            # count, then delete: the segment must never be re-read (the
+            # retrying statement re-partitions and re-spills fresh ones)
+            if self.metrics is not None:
+                self.metrics.add("spill segment corruption")
+                self.metrics.add("checksum failures")
+            self.free_segment(path)
+            raise
+        with np.load(io.BytesIO(payload)) as z:
             return {k: z[k] for k in z.files}
 
     def free_segment(self, path: str) -> None:

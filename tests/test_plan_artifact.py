@@ -10,6 +10,20 @@ artifact reexport"). A stale executable must never serve rows.
 import pickle
 
 from oceanbase_tpu.server import Database
+from oceanbase_tpu.storage.integrity import unwrap, wrap
+
+
+def _read_env(path) -> bytes:
+    """Strip the integrity envelope the store writes around every file."""
+    with open(path, "rb") as f:
+        return unwrap(f.read(), str(path))
+
+
+def _write_env(path, payload: bytes) -> None:
+    """Re-wrap a doctored payload so the store's verified reads accept it
+    (the doctoring simulates stale-but-intact files, not corruption)."""
+    with open(path, "wb") as f:
+        f.write(wrap(payload))
 
 Q = ("select g, count(*) as c, sum(v) as s from art_t "
      "group by g order by g")
@@ -52,11 +66,10 @@ def _doctor_metas(tmp_path, fn):
     root = tmp_path / "node" / "plan_artifacts"
     n = 0
     for meta_p in root.glob("*.meta"):
-        with open(meta_p, "rb") as f:
-            meta = pickle.load(f)
+        meta = pickle.loads(_read_env(meta_p))
         fn(meta)
-        with open(meta_p, "wb") as f:
-            pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _write_env(meta_p,
+                   pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL))
         n += 1
     assert n, "no artifacts on disk to doctor"
 
@@ -83,24 +96,23 @@ def test_schema_bump_rejects_artifact_and_recompiles(tmp_path):
     import json
 
     root = tmp_path / "node" / "plan_artifacts"
-    idx = json.loads((root / "index.json").read_text())
+    idx = json.loads(_read_env(root / "index.json"))
     ents = {}
     for old_aid, ent in idx["entries"].items():
-        with open(root / f"{old_aid}.meta", "rb") as f:
-            meta = pickle.load(f)
+        meta = pickle.loads(_read_env(root / f"{old_aid}.meta"))
         meta.art_key = (*meta.art_key[:4],
                         (("art_t", 999_999, "stale-dict"),),
                         meta.art_key[5])
         new_aid = hashlib.md5(repr(meta.art_key).encode()).hexdigest()
         meta.aid = new_aid
-        with open(root / f"{new_aid}.meta", "wb") as f:
-            pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _write_env(root / f"{new_aid}.meta",
+                   pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL))
         (root / f"{old_aid}.x").rename(root / f"{new_aid}.x")
         (root / f"{old_aid}.meta").unlink()
         ents[new_aid] = ent
     assert ents
     idx["entries"] = ents
-    (root / "index.json").write_text(json.dumps(idx))
+    _write_env(root / "index.json", json.dumps(idx).encode())
 
     db = _boot(tmp_path)
     snap = db.metrics.counters_snapshot()

@@ -1,0 +1,518 @@
+"""Durable-storage integrity: envelope, fault injection, scrub, repair.
+
+Every durable artifact (checkpoints, node meta, plan artifacts, spill
+segments, backups) rides the shared integrity envelope
+(storage/integrity.py): a 20-byte magic/version/length/crc32 header in
+front of the payload, written tmp -> fsync -> rename. These tests prove
+the READERS actually check it — every damage mode surfaces as a typed
+CorruptBlock, never a half-parsed pickle — and that recovery is typed:
+checkpoint -> .prev fallback / rewrite from the live replica, artifact
+-> quarantine + recompute, spill -> delete + statement retry. The
+crash-consistency tests kill the writer at every write/fsync/rename
+boundary and assert a restart is bit-identical to a never-crashed
+control. The --scrub gate (tools/chaos_bench.py --disk) drives the same
+machinery under a live workload with probabilistic arms.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from oceanbase_tpu.server import Database
+from oceanbase_tpu.server.sentinel import evaluate_window
+from oceanbase_tpu.share.errsim import ERRSIM, InjectedError
+from oceanbase_tpu.storage.ckpt import read_ls_checkpoint
+from oceanbase_tpu.storage.integrity import (
+    ARTIFACT, CKPT, HEADER_SIZE, META, QUARANTINE_DIR, CorruptBlock,
+    CounterSink, read_verified, unwrap, verify_file, wrap, write_atomic)
+from oceanbase_tpu.storage.tmp_file import TmpFileManager
+
+CRASH_POINTS = ("EN_CRASH_TMP_PARTIAL", "EN_CRASH_BEFORE_RENAME",
+                "EN_CRASH_AFTER_RENAME")
+DISK_ARMS = ("EN_DISK_BITFLIP", "EN_DISK_TORN_WRITE", "EN_DISK_TRUNCATE")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """No test leaves a live arm behind for its neighbors."""
+    yield
+    ERRSIM.clear()
+
+
+def _mkdb(tmp_path, name="node", **kw):
+    return Database(n_nodes=3, n_ls=2, data_dir=str(tmp_path / name),
+                    fsync=False, **kw)
+
+
+def _flip_payload_byte(path, off=5):
+    """Damage one payload byte in place — silent bit rot."""
+    with open(path, "r+b") as f:
+        raw = bytearray(f.read())
+        raw[HEADER_SIZE + off] ^= 0xFF
+        f.seek(0)
+        f.write(raw)
+
+
+def _truncate_tail(path, n=16):
+    with open(path, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(path) - n))
+
+
+# ------------------------------------------------------------- envelope
+
+
+def test_wrap_unwrap_roundtrip():
+    for payload in (b"", b"x", b"hello" * 1000, bytes(range(256))):
+        assert unwrap(wrap(payload)) == payload
+
+
+def test_unwrap_rejects_every_damage_mode():
+    data = wrap(b"payload bytes" * 32)
+
+    def reason_of(buf):
+        with pytest.raises(CorruptBlock) as ei:
+            unwrap(buf, "/d/f")
+        assert ei.value.path == "/d/f"
+        return ei.value.reason
+
+    assert "short header" in reason_of(data[:HEADER_SIZE - 1])
+    assert "bad magic" in reason_of(b"\x00" + data[1:])
+    # version field is bytes [4:6] of the header
+    assert "version" in reason_of(data[:4] + b"\xff\xff" + data[6:])
+    assert "length mismatch" in reason_of(data[:-3])
+    flipped = bytearray(data)
+    flipped[HEADER_SIZE + 4] ^= 0x01
+    assert "crc mismatch" in reason_of(bytes(flipped))
+
+
+def test_missing_file_is_not_corruption(tmp_path):
+    """FileNotFoundError (legitimately absent) and CorruptBlock (present
+    but bad) are distinct, never conflated."""
+    with pytest.raises(FileNotFoundError):
+        read_verified(str(tmp_path / "absent.bin"), META)
+    p = tmp_path / "bad.bin"
+    write_atomic(str(p), b"abc" * 50, fsync=False, path_class=META)
+    _flip_payload_byte(p)
+    with pytest.raises(CorruptBlock):
+        read_verified(str(p), META)
+
+
+# ------------------------------------------------------ fault injection
+
+
+@pytest.mark.parametrize("arm", DISK_ARMS)
+def test_write_fault_arms_damage_the_landed_bytes(tmp_path, arm):
+    """An armed disk fault corrupts the bytes ON DISK, so the verified
+    reader (not the injector) is what detects it."""
+    p = str(tmp_path / "f.bin")
+    ERRSIM.arm(arm, count=1, path_class=META)
+    write_atomic(p, b"payload" * 64, fsync=False, path_class=META)
+    with pytest.raises(CorruptBlock):
+        read_verified(p, META)
+
+
+def test_io_error_arm_raises_oserror(tmp_path):
+    p = str(tmp_path / "f.bin")
+    ERRSIM.arm("EN_IO_ERROR", count=1, path_class=META)
+    with pytest.raises(OSError):
+        write_atomic(p, b"x" * 64, fsync=False, path_class=META)
+    assert not os.path.exists(p)  # nothing half-landed
+
+
+def test_read_decay_persistently_damages_the_file(tmp_path):
+    """EN_DISK_BITFLIP on the read path models bit rot: the file on disk
+    stays damaged after the arm is cleared."""
+    p = str(tmp_path / "f.bin")
+    write_atomic(p, b"y" * 256, fsync=False, path_class=CKPT)
+    ERRSIM.arm("EN_DISK_BITFLIP", count=1, path_class=CKPT)
+    with pytest.raises(CorruptBlock):
+        read_verified(p, CKPT)
+    ERRSIM.clear()
+    with pytest.raises(CorruptBlock):  # rot persisted, not transient
+        read_verified(p, CKPT)
+
+
+def test_arm_path_class_scoping(tmp_path):
+    """An arm scoped to one path class never fires for another — a chaos
+    run can corrupt ONLY checkpoints while artifacts stay clean."""
+    ERRSIM.arm("EN_DISK_BITFLIP", count=-1, path_class=CKPT)
+    assert not ERRSIM.should_fire("EN_DISK_BITFLIP", META)
+    assert not ERRSIM.should_fire("EN_DISK_BITFLIP", ARTIFACT)
+    assert ERRSIM.should_fire("EN_DISK_BITFLIP", CKPT)
+    ERRSIM.clear()
+    # tuple scope: any member class fires, others never
+    ERRSIM.arm("EN_DISK_TRUNCATE", count=-1, path_class=(CKPT, META))
+    assert ERRSIM.should_fire("EN_DISK_TRUNCATE", META)
+    assert not ERRSIM.should_fire("EN_DISK_TRUNCATE", ARTIFACT)
+    # unscoped writes are untouched end to end
+    p = str(tmp_path / "a.bin")
+    write_atomic(p, b"clean" * 10, fsync=False, path_class=ARTIFACT)
+    assert read_verified(p, ARTIFACT) == b"clean" * 10
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_write_atomic_crash_atomicity(tmp_path, point):
+    """Kill the writer at each boundary: the file afterwards is either
+    the complete old generation or the complete new one — never a tear.
+    Only a crash AFTER the rename commits the new bytes."""
+    p = str(tmp_path / "f.bin")
+    old, new = b"OLD" * 100, b"NEW" * 100
+    write_atomic(p, old, fsync=False, path_class=META)
+    ERRSIM.arm(point, count=1, path_class=META)
+    with pytest.raises(InjectedError):
+        write_atomic(p, new, fsync=False, path_class=META)
+    ERRSIM.clear()
+    got = read_verified(p, META)
+    if point == "EN_CRASH_AFTER_RENAME":
+        assert got == new
+    else:
+        assert got == old  # tmp never renamed: the tear is invisible
+
+
+# ------------------------------------------- checkpoint corrupt vs prev
+
+
+def _ckpt_files(tmp_path, name="node"):
+    root = tmp_path / name
+    files = sorted(root.rglob("ckpt.pkl"))
+    assert files, "no checkpoints on disk"
+    return files
+
+
+@pytest.mark.parametrize("damage", [_flip_payload_byte, _truncate_tail])
+def test_corrupt_latest_checkpoint_falls_back_to_prev(tmp_path, damage):
+    """A bit-flipped or truncated latest checkpoint must NOT half-parse:
+    boot detects it (typed + counted), quarantines it, and restores from
+    the .prev generation + full log replay — every committed row back."""
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table t (k bigint primary key, v bigint not null)")
+    s.sql("insert into t values " + ", ".join(
+        f"({i}, {i * 7})" for i in range(40)))
+    assert db.checkpoint(recycle=False)
+    s.sql("insert into t values " + ", ".join(
+        f"({i}, {i * 7})" for i in range(40, 60)))
+    assert db.checkpoint(recycle=False)  # rotates gen 1 -> .prev
+    expect = s.sql("select k, v from t order by k").rows()
+    db.close()
+
+    for p in _ckpt_files(tmp_path):
+        damage(p)
+
+    db2 = _mkdb(tmp_path)
+    assert db2.session().sql("select k, v from t order by k").rows() \
+        == expect
+    snap = db2.metrics.counters_snapshot()
+    assert snap.get("checkpoint corruption", 0) >= 1
+    assert snap.get("checksum failures", 0) >= 1
+    # the bad generations were quarantined, never to be re-read
+    qdirs = list((tmp_path / "node").rglob(QUARANTINE_DIR))
+    assert any(any(d.iterdir()) for d in qdirs)
+    db2.close()
+
+
+def test_missing_checkpoint_is_none_not_error(tmp_path):
+    sink = CounterSink()
+    assert read_ls_checkpoint(str(tmp_path / "no" / "ckpt.pkl"),
+                              metrics=sink) is None
+    assert sink.counts == {}  # absence is not corruption
+
+
+def test_both_generations_corrupt_raises_typed(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table t (k bigint primary key)")
+    s.sql("insert into t values (1)")
+    assert db.checkpoint(recycle=False)
+    s.sql("insert into t values (2)")
+    assert db.checkpoint(recycle=False)
+    db.close()
+    p = _ckpt_files(tmp_path)[0]
+    _flip_payload_byte(p)
+    _flip_payload_byte(str(p) + ".prev")
+    sink = CounterSink()
+    with pytest.raises(CorruptBlock):
+        read_ls_checkpoint(str(p), metrics=sink)
+    assert sink.counts.get("checkpoint corruption", 0) == 2
+
+
+# --------------------------------------------- node meta corrupt / prev
+
+
+def test_corrupt_node_meta_falls_back_to_prev(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table nm (k bigint primary key, s varchar(8) not null)")
+    s.sql("insert into nm values (1, 'a')")
+    db._save_node_meta()
+    s.sql("insert into nm values (2, 'b')")
+    db._save_node_meta()  # rotates the first meta to .prev
+    db.close()
+    _flip_payload_byte(db._meta_path())
+
+    db2 = _mkdb(tmp_path)
+    assert db2.session().sql("select k, s from nm order by k").rows() \
+        == [(1, "a"), (2, "b")]
+    assert db2.metrics.counters_snapshot().get("node meta corruption", 0) \
+        >= 1
+    db2.close()
+
+
+# ---------------------------------------------- crash consistency (e2e)
+
+
+@pytest.mark.parametrize("path_class", [CKPT, META])
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crash_during_checkpoint_restart_bit_identical(
+        tmp_path, point, path_class):
+    """Property: killing the checkpoint writer at ANY write/fsync/rename
+    boundary (per-ls checkpoint or node meta) leaves a tree whose
+    restart serves rows bit-identical to a never-crashed control."""
+    def ops(db):
+        s = db.session()
+        s.sql("create table cc (k bigint primary key, v bigint not null)")
+        s.sql("insert into cc values " + ", ".join(
+            f"({i}, {i * 3})" for i in range(30)))
+        assert db.checkpoint(recycle=False)
+        s.sql("insert into cc values " + ", ".join(
+            f"({i}, {i * 3})" for i in range(30, 45)))
+
+    control = _mkdb(tmp_path, "control")
+    ops(control)
+    assert control.checkpoint(recycle=False)
+    control.close()
+    c2 = _mkdb(tmp_path, "control")
+    expect = c2.session().sql("select k, v from cc order by k").rows()
+    c2.close()
+
+    crashed = _mkdb(tmp_path, "crashed")
+    ops(crashed)
+    ERRSIM.arm(point, count=1, path_class=path_class)
+    with pytest.raises(InjectedError):
+        crashed.checkpoint(recycle=False)
+    ERRSIM.clear()
+    crashed.close()  # log stores flushed; the torn ckpt stays torn
+
+    db2 = _mkdb(tmp_path, "crashed")
+    assert db2.session().sql("select k, v from cc order by k").rows() \
+        == expect
+    # the recovered writer keeps working: a fresh checkpoint + restart
+    assert db2.checkpoint(recycle=False)
+    db2.close()
+    db3 = _mkdb(tmp_path, "crashed")
+    assert db3.session().sql("select k, v from cc order by k").rows() \
+        == expect
+    db3.close()
+
+
+def test_crash_during_artifact_index_write_keeps_store_loadable(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("alter system set ob_plan_artifact_mode = 'rw'")
+    s.sql("create table at (k bigint primary key, v bigint not null)")
+    s.sql("insert into at values (1, 10), (2, 20)")
+    q = "select v, count(*) as c from at group by v order by v"
+    expect = s.sql(q).rows()
+    assert db.plan_artifact._index["entries"]
+    ERRSIM.arm("EN_CRASH_BEFORE_RENAME", count=1, path_class=ARTIFACT)
+    with pytest.raises(InjectedError):
+        db.plan_artifact._save_index()
+    ERRSIM.clear()
+    db._save_node_meta()
+    db.close()
+
+    db2 = _mkdb(tmp_path)
+    assert db2.session().sql(q).rows() == expect
+    snap = db2.metrics.counters_snapshot()
+    assert snap.get("checksum failures", 0) == 0  # tear was invisible
+    db2.close()
+
+
+# ------------------------------------------ artifact quarantine-on-load
+
+
+def test_corrupt_artifact_blob_quarantined_on_load(tmp_path):
+    """A corrupt plan-artifact blob is moved to quarantine/ (kept for
+    forensics, NEVER re-read), its index entry dropped, the event
+    counted — and the statement recompiles cleanly to correct rows."""
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("alter system set ob_plan_artifact_mode = 'rw'")
+    s.sql("create table qa (k bigint primary key, v bigint not null)")
+    s.sql("insert into qa values " + ", ".join(
+        f"({i}, {i % 4})" for i in range(32)))
+    q = "select v, count(*) as c from qa group by v order by v"
+    expect = s.sql(q).rows()
+    aids = list(db.plan_artifact._index["entries"])
+    assert aids
+    root = db.plan_artifact.root
+    db._save_node_meta()
+    db.close()
+
+    blobs = [p for p in os.listdir(root) if p.endswith(".x")]
+    assert blobs
+    for b in blobs:
+        _flip_payload_byte(os.path.join(root, b))
+
+    db2 = _mkdb(tmp_path)
+    assert db2.session().sql(q).rows() == expect
+    snap = db2.metrics.counters_snapshot()
+    assert snap.get("plan artifact quarantined", 0) >= 1
+    assert snap.get("checksum failures", 0) >= 1
+    qdir = os.path.join(root, QUARANTINE_DIR)
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+    # the corrupt blob never serves again: anything now under the aid is
+    # the freshly recomputed re-export, and it verifies cleanly
+    for a in set(aids) & set(db2.plan_artifact._index["entries"]):
+        p = os.path.join(root, f"{a}.x")
+        if os.path.exists(p):
+            assert verify_file(p, ARTIFACT) > 0
+    db2.close()
+
+
+# --------------------------------------------------------- spill + retry
+
+
+def test_spill_segment_corruption_typed_counted_and_deleted(tmp_path):
+    sink = CounterSink()
+    tmp = TmpFileManager(root=str(tmp_path / "spill"), metrics=sink)
+    seg = tmp.write_segment({"a": np.arange(64), "b": np.ones(64)})
+    _flip_payload_byte(seg, off=32)
+    with pytest.raises(CorruptBlock):
+        tmp.read_segment(seg)
+    assert sink.counts.get("spill segment corruption", 0) == 1
+    assert sink.counts.get("checksum failures", 0) == 1
+    assert not os.path.exists(seg)  # deleted: never re-read
+    tmp.close()
+
+
+def test_retry_taxonomy_classifies_corruption_as_recomputable():
+    from oceanbase_tpu.share.retry import STORAGE_CORRUPT, classify
+
+    pol = classify(CorruptBlock("/d/seg_1.npz", "crc mismatch"))
+    assert pol is STORAGE_CORRUPT
+    assert pol.max_retries >= 1
+
+
+# ------------------------------------------------------------- scrubber
+
+
+def test_scrubber_detects_quarantines_and_repairs(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table sc (k bigint primary key, v bigint not null)")
+    s.sql("insert into sc values " + ", ".join(
+        f"({i}, {i})" for i in range(25)))
+    assert db.checkpoint(recycle=False)
+    expect = s.sql("select k, v from sc order by k").rows()
+
+    clean = db.scrubber.run_pass()
+    assert sum(v["failures"] for v in clean["delta"].values()) == 0
+    assert sum(v["scrubbed"] for v in clean["delta"].values()) > 0
+
+    bad = _ckpt_files(tmp_path)[0]
+    _flip_payload_byte(bad)
+    rep = db.scrubber.run_pass()
+    d = rep["delta"]["ckpt"]
+    assert d["failures"] >= 1 and d["quarantined"] >= 1
+    assert d["repaired"] >= 1 and d["unrepaired"] == 0
+    # the repair is a REWRITE from the live replica: file verifies again
+    assert verify_file(str(bad), CKPT) > 0
+
+    snap = db.metrics.counters_snapshot()
+    assert snap.get("blocks scrubbed", 0) > 0
+    assert snap.get("checksum failures", 0) >= 1
+    assert snap.get("quarantined files", 0) >= 1
+    assert snap.get("checkpoint rewrites", 0) >= 1
+
+    # third pass over the repaired tree: nothing new
+    again = db.scrubber.run_pass()
+    assert sum(v["failures"] for v in again["delta"].values()) == 0
+
+    # the VT operators read: per-class ledger + one row per quarantine
+    vt = s.sql("select path_class, failures, quarantined, repaired, "
+               "unrepaired from __all_virtual_storage_integrity")
+    by = {c: (int(f), int(q), int(r), int(u)) for c, f, q, r, u in zip(
+        vt.columns["path_class"], vt.columns["failures"],
+        vt.columns["quarantined"], vt.columns["repaired"],
+        vt.columns["unrepaired"])}
+    assert by["ckpt"][0] >= 1 and by["ckpt"][2] >= 1 and by["ckpt"][3] == 0
+    assert any(c.startswith("quarantine:ckpt") for c in by)
+
+    # the repaired tree restarts to identical rows
+    db.close()
+    db2 = _mkdb(tmp_path)
+    assert db2.session().sql("select k, v from sc order by k").rows() \
+        == expect
+    db2.close()
+
+
+def test_scrub_interval_queues_background_dag(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("create table bg (k bigint primary key)")
+    s.sql("insert into bg values (1)")
+    assert db.checkpoint(recycle=False)
+    assert db.scrubber.stats()["passes"] == 0
+    s.sql("alter system set ob_scrub_interval = 0.000001")
+    import time as _t
+    _t.sleep(0.01)
+    db.run_maintenance()
+    assert db.scrubber.stats()["passes"] >= 1
+    assert db.metrics.counters_snapshot().get("blocks scrubbed", 0) > 0
+    db.close()
+
+
+def test_errsim_disk_config_arms_and_disarms(tmp_path):
+    db = _mkdb(tmp_path)
+    s = db.session()
+    s.sql("alter system set ob_errsim_disk_bitflip = 1.0")
+    assert ERRSIM.should_fire("EN_DISK_BITFLIP", CKPT)
+    s.sql("alter system set ob_errsim_disk_bitflip = 0.0")
+    assert not ERRSIM.should_fire("EN_DISK_BITFLIP", CKPT)
+    db.close()
+
+
+# ------------------------------------------------------------- sentinel
+
+
+def _snap(snap_id, ts, sysstat, integrity):
+    return {"snap_id": snap_id, "ts": ts, "summary": [], "access": [],
+            "census": [], "sysstat": sysstat, "timeline": [],
+            "timeline_meta": {}, "qos": {}, "integrity": integrity}
+
+
+def test_sentinel_storage_corruption_warn_when_repaired():
+    first = _snap(1, 100.0, {"checksum failures": 0}, {"unrepaired": 0})
+    last = _snap(2, 160.0,
+                 {"checksum failures": 3, "quarantined files": 3,
+                  "replica repairs": 1},
+                 {"unrepaired": 0, "passes": 4,
+                  "by_class": {"ckpt": {"failures": 3}}})
+    alerts = [a for a in evaluate_window(first, last)
+              if a["rule"] == "storage_corruption"]
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["severity"] == "warn"
+    assert a["evidence"]["window_failures"] == 3
+    assert a["evidence"]["classes"] == ["ckpt"]
+
+
+def test_sentinel_storage_corruption_critical_when_unrepaired():
+    first = _snap(1, 100.0, {"checksum failures": 2}, {"unrepaired": 0})
+    last = _snap(2, 160.0, {"checksum failures": 4},
+                 {"unrepaired": 1, "passes": 2,
+                  "by_class": {"backup": {"failures": 2}}})
+    alerts = [a for a in evaluate_window(first, last)
+              if a["rule"] == "storage_corruption"]
+    assert alerts and alerts[0]["severity"] == "critical"
+    assert alerts[0]["evidence"]["unrepaired"] == 1
+
+
+def test_sentinel_silent_without_new_failures():
+    first = _snap(1, 100.0, {"checksum failures": 9}, {"unrepaired": 0})
+    last = _snap(2, 160.0, {"checksum failures": 9}, {"unrepaired": 0})
+    assert not [a for a in evaluate_window(first, last)
+                if a["rule"] == "storage_corruption"]

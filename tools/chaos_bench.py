@@ -1042,6 +1042,203 @@ def oom_leg(seed: int = 13, clients: int = 6, stmts_each: int = 30,
         shutil.rmtree(d, ignore_errors=True)
 
 
+#: read mix for the --disk gate: deterministic ORDER BY everywhere so
+#: every client result is bit-comparable to the main-thread baseline
+DISK_QUERIES = (
+    "select k, v from disk_kv order by k",
+    "select count(*) as n, sum(v) as sv from disk_kv",
+    "select v, count(*) as c from disk_kv group by v order by v limit 32",
+    "select k, v from disk_kv where v % 5 = 2 order by k limit 64",
+    "select min(k) as a, max(k) as b from disk_kv",
+)
+
+#: the three data-corrupting disk arms the --disk gate drives; EN_IO_ERROR
+#: raises instead of corrupting, so it rides the retry taxonomy tests
+DISK_ARMS = ("EN_DISK_BITFLIP", "EN_DISK_TORN_WRITE", "EN_DISK_TRUNCATE")
+
+
+def disk_leg(seed: int = 17, clients: int = 4, stmts_each: int = 25,
+             corrupt_prob: float = 0.2, cycles: int = 2,
+             verbose: bool = False) -> dict:
+    """The --disk gate: a live read workload while every durable
+    checkpoint/meta write is corrupted with probability `corrupt_prob`
+    per arm (bit flips, torn writes, truncation), across `cycles`
+    crash-restart cycles. Every corruption must be detected by the
+    envelope (never served), the scrubber must quarantine + repair from
+    live replicas (a follow-up scrub of the repaired tree reports zero
+    new failures), the repairs must be visible in sysstat +
+    __all_virtual_storage_integrity, and every restart must come back
+    with rows bit-identical to the in-memory model."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from oceanbase_tpu.server import Database
+    from oceanbase_tpu.storage.integrity import CKPT, META
+
+    d = tempfile.mkdtemp(prefix="chaos_disk_")
+    db = None
+    t_start = time.perf_counter()
+    totals = {"failures": 0, "quarantined": 0, "repaired": 0,
+              "unrepaired": 0, "rewrites": 0, "replica_repairs": 0,
+              "clean_failures": 0, "injected": 0}
+    stats = {"ok": 0, "raw": [], "mismatch": 0}
+    stats_lock = threading.Lock()
+    vt_rows: list[tuple] = []
+    restarts_identical = []
+    try:
+        db = Database(n_nodes=3, n_ls=2, data_dir=d, fsync=False)
+        s = db.session()
+        s.sql("create table disk_kv "
+              "(k bigint primary key, v bigint not null)")
+        s.sql("insert into disk_kv values " + ", ".join(
+            f"({i}, {i * 31 % 97})" for i in range(2000)))
+
+        def rows_of(rs):
+            return tuple(zip(*[tuple(rs.columns[n]) for n in rs.names])) \
+                if rs.names else ()
+
+        ERRSIM.reseed(seed)
+        model = {k: k * 31 % 97 for k in range(2000)}
+        next_k = 2000
+
+        for cycle in range(cycles):
+            # grow the model so each cycle's checkpoints carry new state
+            batch = [(next_k + i, (next_k + i) * 13 % 89)
+                     for i in range(200)]
+            s.sql("insert into disk_kv values " + ", ".join(
+                f"({k}, {v})" for k, v in batch))
+            model.update(dict(batch))
+            next_k += 200
+            s.sql("update disk_kv set v = v + 1 where k = 0")
+            model[0] += 1
+            baseline = {q: rows_of(s.sql(q)) for q in DISK_QUERIES}
+
+            # live readers while durable writes are being corrupted
+            def client(cid: int) -> None:
+                cs = db.session()
+                crng = random.Random(seed ^ (cycle * 0xB5) ^ (cid * 0x9E37))
+                for _ in range(stmts_each):
+                    q = DISK_QUERIES[crng.randrange(len(DISK_QUERIES))]
+                    try:
+                        got = rows_of(cs.sql(q))
+                        with stats_lock:
+                            stats["ok"] += 1
+                            if got != baseline[q]:
+                                stats["mismatch"] += 1
+                    except Exception as e:  # noqa: BLE001 - gate's point
+                        with stats_lock:
+                            stats["raw"].append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+
+            # corrupt the durable write path while the readers run: two
+            # checkpoints under the arms so rotation puts corrupt bytes
+            # in both the live files and the .prev generation
+            for arm in DISK_ARMS:
+                ERRSIM.arm(arm, prob=corrupt_prob, count=-1,
+                           path_class=(CKPT, META))
+            try:
+                db.checkpoint(recycle=False)
+                db.checkpoint(recycle=False)
+            finally:
+                for arm in DISK_ARMS:
+                    totals["injected"] += ERRSIM.fired(arm)
+                    ERRSIM.clear(arm)
+
+            for t in threads:
+                t.join(timeout=300)
+
+            # scrub the corrupted tree: detect, quarantine, repair from
+            # the live replicas — then prove a second pass runs clean
+            def pass_sum(pass_rep: dict, key: str) -> int:
+                return sum(v.get(key, 0)
+                           for v in pass_rep["delta"].values())
+
+            delta = db.scrubber.run_pass()
+            totals["failures"] += pass_sum(delta, "failures")
+            totals["quarantined"] += pass_sum(delta, "quarantined")
+            totals["repaired"] += pass_sum(delta, "repaired")
+            totals["unrepaired"] += pass_sum(delta, "unrepaired")
+            clean = db.scrubber.run_pass()
+            totals["clean_failures"] += pass_sum(clean, "failures")
+
+            cs0 = db.metrics.counters_snapshot()
+            totals["rewrites"] += (cs0.get("checkpoint rewrites", 0)
+                                   + cs0.get("node meta rewrites", 0))
+            totals["replica_repairs"] += cs0.get("replica repairs", 0)
+            vt = s.sql("select path_class, quarantined, repaired from "
+                       "__all_virtual_storage_integrity")
+            vt_rows = list(zip(vt.columns["path_class"],
+                               vt.columns["quarantined"],
+                               vt.columns["repaired"]))
+
+            # crash-restart: the scrubbed tree must boot and replay to a
+            # state bit-identical to the in-memory model
+            db.close()
+            db = Database(n_nodes=3, n_ls=2, data_dir=d, fsync=False)
+            s = db.session()
+            got = rows_of(s.sql("select k, v from disk_kv order by k"))
+            restarts_identical.append(
+                got == tuple(sorted(model.items())))
+
+        total = cycles * clients * stmts_each
+        checks = {
+            "completed_all": stats["ok"] == total,
+            "no_raw_failures": not stats["raw"],
+            "zero_wrong_results": stats["mismatch"] == 0,
+            "corruption_injected": totals["injected"] > 0,
+            "corruption_detected": totals["failures"] > 0,
+            "all_corruptions_quarantined": (
+                totals["quarantined"] >= totals["failures"] > 0),
+            "all_repaired": totals["unrepaired"] == 0,
+            "repairs_visible_in_sysstat": (
+                totals["rewrites"] + totals["replica_repairs"] > 0),
+            "clean_scrub_zero_failures": totals["clean_failures"] == 0,
+            "integrity_vt_readable": any(
+                int(q) > 0 or int(r) > 0 for _, q, r in vt_rows),
+            "restarts_bit_identical": (
+                len(restarts_identical) == cycles
+                and all(restarts_identical)),
+        }
+        rep = {
+            "bench": "chaos_disk",
+            "seed": seed,
+            "ok": all(checks.values()),
+            "checks": checks,
+            "cycles": cycles,
+            "corrupt_prob": corrupt_prob,
+            "statements": total,
+            "completed": stats["ok"],
+            "raw_failures": stats["raw"][:8],
+            "faults_injected": totals["injected"],
+            "checksum_failures": totals["failures"],
+            "quarantined_files": totals["quarantined"],
+            "repaired": totals["repaired"],
+            "unrepaired": totals["unrepaired"],
+            "rewrites": totals["rewrites"],
+            "replica_repairs": totals["replica_repairs"],
+            "integrity_vt": [[str(c), int(q), int(r)]
+                             for c, q, r in vt_rows[:12]],
+            "total_s": round(time.perf_counter() - t_start, 1),
+        }
+        if verbose:
+            print(_json.dumps(rep, indent=2))
+        return rep
+    finally:
+        for arm in DISK_ARMS:
+            ERRSIM.clear(arm)
+        if db is not None:
+            db.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -1062,8 +1259,45 @@ def main() -> int:
                          "~3x a synthetic device budget with EN_DEVICE_OOM "
                          "arms — 100%% completion, bit-identical results, "
                          "visible degradations, zero leaked reservations")
+    ap.add_argument("--disk", action="store_true",
+                    help="durable-storage integrity gate: live workload "
+                         "while checkpoint/meta writes are corrupted at "
+                         "p=0.2 (bit flips, torn writes, truncation) "
+                         "across two crash-restarts — every corruption "
+                         "detected + quarantined + repaired, zero wrong "
+                         "results, restarts bit-identical")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
+    if args.disk:
+        import json
+
+        rep = disk_leg(seed=args.seed if args.seed != 7 else 17,
+                       verbose=args.verbose)
+        tools = os.path.dirname(os.path.abspath(__file__))
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        from bench_meta import collect as bench_meta
+
+        rep["meta"] = bench_meta(None)
+        line = json.dumps(rep)
+        print(line, flush=True)
+        bench_out = os.environ.get("BENCH_OUT")
+        if bench_out:
+            with open(bench_out, "a") as f:
+                f.write(line + "\n")
+        if not rep["ok"]:
+            for name, ok in rep["checks"].items():
+                if not ok:
+                    print(f"DISK FAIL: {name}", file=sys.stderr)
+            return 1
+        print(f"disk OK: {rep['completed']}/{rep['statements']} statements "
+              f"with {rep['faults_injected']} disk faults injected over "
+              f"{rep['cycles']} crash-restarts: "
+              f"{rep['checksum_failures']} corruptions detected, "
+              f"{rep['quarantined_files']} quarantined, "
+              f"{rep['rewrites']} rewrites + "
+              f"{rep['replica_repairs']} replica repairs, 0 unrepaired")
+        return 0
     if args.oom:
         import json
 

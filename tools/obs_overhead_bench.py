@@ -19,10 +19,14 @@ warm point read with (1) the statement summary OFF then ON
 ON (`serve_timeline.timeline_overhead_pct`, with the ring's
 self-metered bucket/byte evidence) — the cost of each recorder under
 the serving workload its 2%% budget is written against (`--sessions
-32`). The gated overhead is the median paired delta in process CPU
-per statement (see _serve_ab for why, paired throughput reported as
-context); --strict-pct P exits 1 if either overhead exceeds P or the
-timeline ring outgrew its capacity.
+32`) — plus (3) the background storage scrubber OFF then ON against a
+data-dir-backed, checkpointed db, with a helper thread driving
+back-to-back scrub passes through the whole ON leg
+(`serve_scrub.scrub_overhead_pct`). The gated overhead is the median
+paired delta in process CPU per statement (see _serve_ab for why,
+paired throughput reported as context); --strict-pct P exits 1 if any
+overhead exceeds P, the timeline ring outgrew its capacity, or the
+scrub A/B ran zero passes.
 
 Prints a small JSON report. The warmup pass compiles every plan first,
 so all timed passes measure pure host dispatch + cached execution —
@@ -159,6 +163,83 @@ def serve_summary_ab(sessions: int, seconds: float, reps: int) -> dict:
     }
 
 
+def serve_scrub_ab(sessions: int, seconds: float, reps: int) -> dict:
+    """Background storage scrubber OFF vs ON under the same closed-loop
+    serving load — the measurement the scrubber's 2%% budget is written
+    against. The db is data-dir-backed and checkpointed first so every
+    ON-leg pass verifies real durable files (node meta, per-replica
+    checkpoints, in-memory sstable checksums), and a helper thread
+    drives back-to-back scrub passes (20ms apart — far hotter than any
+    production ob_scrub_interval) for the whole ON leg."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import latency_bench as LB
+    from oceanbase_tpu.server.database import Database
+
+    d = tempfile.mkdtemp(prefix="scrub_ab_")
+    db = Database(n_nodes=1, n_ls=1, data_dir=d, fsync=False)
+    try:
+        s = db.session()
+        s.sql("create table kv (id int primary key, k int, v int, grp int)")
+        rng = np.random.default_rng(7)
+        rows = 2000
+        vals = rng.integers(0, 1000, size=rows)
+        for lo in range(0, rows, 500):
+            hi = min(lo + 500, rows)
+            s.sql("insert into kv values " + ", ".join(
+                f"({i + 1}, {i}, {int(vals[i])}, {i % 16})"
+                for i in range(lo, hi)))
+        db.checkpoint()  # durable tree: the scrubber needs real work
+
+        on = threading.Event()
+        stop = threading.Event()
+
+        def _scrub_loop() -> None:
+            while not stop.is_set():
+                if on.is_set():
+                    db.scrubber.run_pass()
+                stop.wait(0.02)
+
+        driver = threading.Thread(target=_scrub_loop, daemon=True)
+        driver.start()
+
+        def toggle(_db, enabled: bool) -> None:
+            if enabled:
+                on.set()
+            else:
+                on.clear()
+
+        try:
+            best = _serve_ab(db, toggle, sessions, seconds, reps)
+        finally:
+            stop.set()
+            driver.join(timeout=10)
+        st = db.scrubber.stats()
+        return {
+            "sessions": sessions,
+            "leg_seconds": seconds,
+            "reps": reps,
+            "off_stmts_per_sec": best["off"],
+            "on_stmts_per_sec": best["on"],
+            "scrub_overhead_pct": best["overhead_pct"],
+            "rep_cpu_overheads_pct": best["rep_cpu_overheads_pct"],
+            "tput_overhead_pct": best["tput_overhead_pct"],
+            # evidence the ON legs actually scrubbed a real tree
+            "scrub_passes": st["passes"],
+            "blocks_scrubbed": db.metrics.counter("blocks scrubbed"),
+            "checksum_failures": db.metrics.counter("checksum failures"),
+        }
+    finally:
+        try:
+            db.close()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def serve_timeline_ab(sessions: int, seconds: float, reps: int) -> dict:
     """Serving timeline OFF vs ON under the same closed-loop serving
     load — the measurement the 2%% timeline budget is written against —
@@ -261,6 +342,9 @@ def main() -> int:
         tl = serve_timeline_ab(args.sessions, args.serve_seconds,
                                args.serve_reps)
         report["serve_timeline"] = tl
+        sc = serve_scrub_ab(args.sessions, args.serve_seconds,
+                            args.serve_reps)
+        report["serve_scrub"] = sc
         if args.strict_pct is not None:
             fails = []
             if serve["summary_overhead_pct"] > args.strict_pct:
@@ -271,6 +355,12 @@ def main() -> int:
                 fails.append(
                     f"serve timeline overhead "
                     f"{tl['timeline_overhead_pct']}%")
+            if sc["scrub_overhead_pct"] > args.strict_pct:
+                fails.append(
+                    f"serve scrub overhead "
+                    f"{sc['scrub_overhead_pct']}%")
+            if sc["scrub_passes"] == 0:
+                fails.append("scrub A/B ran zero passes")
             if tl["timeline_buckets"] > tl["timeline_capacity"]:
                 fails.append(
                     f"timeline ring overflow {tl['timeline_buckets']}"

@@ -6,7 +6,7 @@
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
 #                      [--advisor] [--warmboot] [--elastic] [--oom] [--mesh]
-#                      [--stream] [extra pytest args...]
+#                      [--stream] [--scrub] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -90,6 +90,17 @@
 # balance to zero at exit; the JSON summary (with bench_meta provenance)
 # lands in $BENCH_OUT when set.
 #
+# --scrub additionally runs the durable-storage integrity gate
+# (tools/chaos_bench.py --disk): a live read workload while every
+# checkpoint/meta write is corrupted at p=0.2 per arm (EN_DISK_BITFLIP,
+# EN_DISK_TORN_WRITE, EN_DISK_TRUNCATE) across two crash-restart cycles
+# — zero wrong results ever served, every corruption detected by the
+# block envelope and quarantined, the scrubber repairs everything from
+# live replicas (a follow-up scrub reports zero new failures), repairs
+# are visible in sysstat + __all_virtual_storage_integrity, and each
+# restart returns rows bit-identical to the in-memory model; the JSON
+# artifact (with bench_meta provenance) lands in $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -112,6 +123,7 @@ elastic=0
 oom=0
 mesh=0
 stream=0
+scrub=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -125,6 +137,7 @@ while true; do
         --oom) oom=1; shift ;;
         --mesh) mesh=1; shift ;;
         --stream) stream=1; shift ;;
+        --scrub) scrub=1; shift ;;
         *) break ;;
     esac
 done
@@ -205,6 +218,11 @@ fi
 
 if [ "$stream" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/stream_smoke.py
+    rc=$?
+fi
+
+if [ "$scrub" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_bench.py --disk
     rc=$?
 fi
 exit $rc
