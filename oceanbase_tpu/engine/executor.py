@@ -3567,12 +3567,18 @@ class DeviceResult:
         self._hvalid: dict = {}
         self._hsel = None
 
-    def _observe(self, seconds: float, nbytes: int) -> None:
+    def _observe(self, seconds: float, nbytes: int,
+                 kind: str = "sync") -> None:
         if self.profile is not None:
             self.profile.fetch_s += seconds
             self.profile.d2h_bytes += nbytes
         if self.phases is not None:
             self.phases["fetch_s"] = self.phases.get("fetch_s", 0.0) + seconds
+            if kind == "d2h":
+                # column-data transfers, split out of the dispatch sync so
+                # the host-tax ledger can carve "d2h" from "device wait"
+                self.phases["d2h_s"] = (
+                    self.phases.get("d2h_s", 0.0) + seconds)
 
     def _sync(self) -> None:
         """Overflow check + row count: the deferred tail of the dispatch.
@@ -3678,7 +3684,8 @@ class DeviceResult:
                          for d in (harrs, hvals) for a in d.values())
             if sel_fetched:
                 nbytes += int(self._hsel.nbytes)
-            self._observe(_time.perf_counter() - t0, nbytes)
+            self._observe(_time.perf_counter() - t0, nbytes,
+                          kind="d2h")
             self._hcols.update(harrs)
             self._hvalid.update(hvals)
         sub = Schema(tuple(fields))
@@ -3712,7 +3719,7 @@ class DeviceResult:
         harrs, hvals = jax.device_get((arrs, vals))
         nbytes = sum(int(getattr(a, "nbytes", 0))
                      for d in (harrs, hvals) for a in d.values())
-        self._observe(_time.perf_counter() - t0, nbytes)
+        self._observe(_time.perf_counter() - t0, nbytes, kind="d2h")
         host = host_rows(self._out.schema, self._out.dicts, harrs, hvals,
                          np.ones(kb, dtype=np.bool_))
         return {n: v[:k] for n, v in host.items()}

@@ -37,6 +37,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..share import gap_ledger as _gap
+
 #: synthetic budget used when no accelerator reports its HBM size (CPU
 #: tier-1 backend); big enough that tests opt *in* to pressure by
 #: configuring a small explicit limit.
@@ -363,6 +365,11 @@ class MemoryGovernor:
         self._wait_ring.append(s)
         if len(self._wait_ring) > self._wait_cap:
             del self._wait_ring[: len(self._wait_ring) - self._wait_cap]
+        # host-tax: admission waits park the statement's own thread here,
+        # so the hint lands on its ledger without any plumbing
+        led = _gap.current()
+        if led is not None and s > 0.0:
+            led.add("governor reserve", s)
 
     # ------------------------------------------------------- observation
     def wait_p99_s(self) -> float:

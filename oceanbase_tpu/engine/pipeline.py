@@ -74,6 +74,7 @@ from ..sql.logical import (
     Scan,
     output_schema,
 )
+from ..share import gap_ledger as _gap
 from ..storage.encoding import ENC_FOR, ENC_RLE, analyze_ints, choose_encoding
 
 # ---------------------------------------------------------------------------
@@ -754,6 +755,15 @@ def run_stream(cp, qparams: tuple = (), max_retries: int = 3):
         stats.h2d_s += meter.h2d_s
         stats.compute_s += meter.compute_s
         stats.overlap_s += meter.overlap_s
+        # host-tax ledger: a streamed plan's per-chunk walls would
+        # otherwise vanish inside the statement's dispatch span — hint
+        # the non-overlapped H2D as wall and the chunk compute as device
+        # busy onto the current statement's ledger (the window clamp in
+        # the serving layer keeps these inside the dispatch wall)
+        led = _gap.current()
+        if led is not None:
+            led.add("h2d", max(0.0, meter.h2d_s - meter.overlap_s))
+            led.device(meter.compute_s)
 
     return cols, valids, dicts
 

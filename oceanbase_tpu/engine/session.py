@@ -661,10 +661,18 @@ class Session:
         if lazy:
             # wire the in-place observability sinks, THEN force the sync
             # point: the overflow check + row count (two scalars). All the
-            # host work above overlapped device compute.
+            # host work above overlapped device compute. The sync wall IS
+            # the statement's device wait — time it (host-tax ledger's
+            # "device wait" phase reads fetch_s; leaving it 0.0 hid the
+            # chip time inside exec_s).
             cursor.profile = profile
             cursor.phases = phases
+            tf = time.perf_counter()
             nrows = rs.nrows
+            fetch_s = time.perf_counter() - tf
+            phases["fetch_s"] = fetch_s
+            if profile is not None:
+                profile.fetch_s = fetch_s
         else:
             nrows = rs.nrows
         exec_s = time.perf_counter() - exec_t0
@@ -694,6 +702,12 @@ class Session:
             d = tuple(b - a for a, b in zip(stream0, s1))
             if d[0] or d[6]:  # chunks streamed or partitions spilled
                 stream_d = d
+                # streamed plans execute inside dispatch_s; expose the
+                # per-chunk H2D/compute/overlap split so the host-tax
+                # ledger can carve the dispatch wall into real phases
+                phases["stream_h2d_s"] = d[3]
+                phases["stream_compute_s"] = d[4]
+                phases["stream_overlap_s"] = d[5]
         mon = getattr(entry, "monitor", None)
         if mon is not None:
             mon.runs += 1

@@ -203,8 +203,27 @@ class AsyncMySqlFrontend:
                 1053, "server shutting down: retry on a peer")]
         with self._flight_lock:
             self._inflight += 1
+        import time as _t
+
+        t0 = _t.perf_counter()
+
+        def timed():
+            # worker-pool handoff wait: wall between the event loop
+            # posting the statement and a pool thread picking it up —
+            # host tax the statement ledger (which opens inside fn)
+            # cannot see. Folded post-hoc against the statement's digest
+            # as frontend ingress ("wire read").
+            queued_s = _t.perf_counter() - t0
+            out = fn(*args)
+            sess_obj = args[0] if args else None
+            ht = getattr(self.db, "host_tax", None)
+            dg = getattr(sess_obj, "_last_digest", "")
+            if ht is not None and ht.enabled and dg and queued_s > 0.0:
+                ht.fold_extra(dg, "wire read", queued_s)
+            return out
+
         try:
-            return await self._loop.run_in_executor(self._pool, fn, *args)
+            return await self._loop.run_in_executor(self._pool, timed)
         finally:
             with self._flight_lock:
                 self._inflight -= 1

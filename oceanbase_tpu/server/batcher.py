@@ -68,6 +68,7 @@ from collections import deque
 import numpy as np
 
 from ..ops.hashing import next_pow2
+from ..share import gap_ledger as _gl
 
 
 class BatcherShutdown(RuntimeError):
@@ -414,6 +415,10 @@ class StatementBatcher:
             if m is not None and m.enabled:
                 m.add("stmt admission throttled")
                 m.wait("tenant admission", waited)
+            led = _gl.current()
+            if led is not None:
+                # host-tax: the statement's thread parked here
+                led.add("tenant permit", waited)
 
     def admit_done(self) -> None:
         self.gate.release_slot(self.tenant)
@@ -461,6 +466,11 @@ class StatementBatcher:
         waited = time.perf_counter() - t0
         if m is not None and m.enabled:
             m.wait("stmt batch window", waited)
+        led = _gl.current()
+        if led is not None:
+            # host-tax hint on the LEADER's ledger: its group-commit
+            # window wait (the dispatch is added separately, once)
+            led.add("batch window", waited)
         with self._lock:
             b.closed = True
             if self._forming.get(b.key) is b:
@@ -502,6 +512,19 @@ class StatementBatcher:
         batch under the lock — it is neither device-executed nor
         counted — and re-execute solo on a fresh token."""
         bound = wait_us / 1e6 + self.follower_timeout_s
+        tw = time.perf_counter()
+        try:
+            return self._follow_inner(b, lane, bound, m)
+        finally:
+            led = _gl.current()
+            if led is not None:
+                # host-tax hint: a FOLLOWER attributes its whole wait
+                # (window + the leader's dispatch it rode out) as batch
+                # window — the cohort's device busy is the leader's to
+                # count, exactly once
+                led.add("batch window", time.perf_counter() - tw)
+
+    def _follow_inner(self, b: _Batch, lane: int, bound: float, m) -> bool:
         ok = b.done.wait(bound)
         if not ok:
             with self._lock:
@@ -543,6 +566,14 @@ class StatementBatcher:
             hcols, hvalid, hsel, schema, dicts = (
                 prepared.run_batched_host(qblock))
             b.dispatch_s = time.perf_counter() - t0
+            led = _gl.current()
+            if led is not None:
+                # _dispatch runs on the leader's thread: the cohort's ONE
+                # batched device execution lands on the LEADER's ledger
+                # (followers hint only their window wait) — the double-
+                # count regression test anchors here
+                led.add("device dispatch", b.dispatch_s)
+                led.device(b.dispatch_s)
             b.d2h_bytes = sum(
                 int(getattr(a, "nbytes", 0))
                 for d in (hcols, hvalid) for a in d.values()

@@ -38,6 +38,7 @@ from ..log.palf import leader_of as _leader_of
 from ..engine.session import ResultSet, Session
 from ..rootserver import RootService
 from ..share import Config, LocationService
+from ..share import gap_ledger as _GL
 from ..share import interrupt as _I
 from ..share import retry as _R
 from ..share.schema_service import SchemaError
@@ -492,6 +493,34 @@ class Database:
         self.config.on_change(
             "trace_log_slow_query_watermark",
             lambda _n, _o, v: setattr(self.flight, "watermark_s", v))
+        # host-tax gap ledger (share/gap_ledger.py): conservation-account
+        # every statement's e2e wall into named phases + an explicit
+        # unattributed residual, aggregated per digest behind
+        # __all_virtual_host_tax; the stack sampler rides the slow-query
+        # watermark so a recurring slow statement gets caught with
+        # collapsed stacks in its flight-recorder bundle
+        self.host_tax = _GL.HostTaxRegistry(
+            max_digests=self.config["host_tax_max_digests"],
+            window_s=self.config["host_tax_window"])
+        self.host_tax.enabled = self.config["enable_host_tax"]
+        self.stack_sampler = _GL.StackSampler(
+            interval_s=self.config["stack_sampler_interval"])
+        if self.config["enable_stack_sampler"]:
+            self.stack_sampler.set_continuous(True)
+        self.config.on_change(
+            "enable_host_tax",
+            lambda _n, _o, v: setattr(self.host_tax, "enabled", v))
+        self.config.on_change(
+            "host_tax_max_digests",
+            lambda _n, _o, v: setattr(self.host_tax, "max_digests",
+                                      max(8, v)))
+        self.config.on_change(
+            "enable_stack_sampler",
+            lambda _n, _o, v: self.stack_sampler.set_continuous(v))
+        self.config.on_change(
+            "stack_sampler_interval",
+            lambda _n, _o, v: setattr(self.stack_sampler, "interval_s",
+                                      max(1e-4, v)))
         # workload repository (server/workload.py): digest-keyed statement
         # summaries + table/column access heat folded at statement
         # completion, bounded AWR-style snapshots on demand or periodic
@@ -2356,6 +2385,10 @@ class DbSession:
         self._fast_reg = None
         # lazily-created statement-summary accumulator (workload.py)
         self._ws_acc = None
+        # per-statement host-tax gap ledger (share/gap_ledger.py); also
+        # published thread-locally so batcher/governor waits self-report
+        self._gap = None
+        self._last_digest = ""
         # device-OOM degradation ladder state (reset per statement in
         # _sql_inner): None | "chunk" | "host", plus the fired rungs
         self._degrade_mode = None
@@ -2424,6 +2457,20 @@ class DbSession:
         err, rs = "", None
         self._last_stmt_type = ""  # "": did not parse
         self._stmt_cache_hit = False  # set by any inner _select
+        # host-tax gap ledger: one per statement, spanning the SAME t0 as
+        # the audit elapsed_s. Published thread-locally so the batcher and
+        # governor (which run their waits on this thread) self-report
+        # hints without any API plumbing.
+        led = None
+        if db.host_tax.enabled:
+            # one ledger object per session, re-armed per statement
+            # (begin() fully resets) — no per-statement allocation
+            led = self._gap
+            if led is None:
+                led = _GL.GapLedger()
+            led.begin(t0)
+            _GL.set_current(led)
+        self._gap = led
         # statement deadline: min(ob_query_timeout from now, the open tx's
         # ob_trx_timeout deadline) on the bus virtual clock — one Deadline
         # object bounds the worker wait, PX admission, DAS routing retries,
@@ -2445,9 +2492,13 @@ class DbSession:
             bounded = deadline is not None and deadline.tighter_than(wait_s)
             if bounded:
                 wait_s = max(deadline.remaining(), 0.0)
+            if led is not None:
+                led.cut("setup")  # deadline/quota bookkeeping since t0
             tq = _time.perf_counter()
             ok = sem.acquire(timeout=wait_s)
             waited = _time.perf_counter() - tq
+            if led is not None:
+                led.cut("admission queue")
             db.metrics.wait("tenant worker queue", waited)
             tl = db.timeline
             if tl.enabled:
@@ -2472,9 +2523,16 @@ class DbSession:
         # and the generator contextmanager is measurable per-statement
         prev_dl = _R.current_deadline()
         _R.set_current_deadline(deadline)
+        if led is not None:
+            # interrupt + deadline registration (and admission metrics/
+            # timeline above): small but real, and the residual gate is
+            # strict — name it instead of leaking it
+            led.cut("setup")
         try:
             return self._sql_inner(text, t0)
         finally:
+            if led is not None:
+                _GL.set_current(None)
             _R.set_current_deadline(prev_dl)
             _I.set_current(prev)
             db._active_stmts.pop(self.session_id, None)
@@ -2486,8 +2544,11 @@ class DbSession:
         db = self.db
         err, rs = "", None
         # last_profile is per-run_ast; statements that never reach run_ast
-        # (pure DDL, SHOW) must not inherit the previous statement's
+        # (pure DDL, SHOW) must not inherit the previous statement's.
+        # last_phases likewise: the host-tax carve reads it after the
+        # engine window and must never see a previous statement's walls
         db.engine.last_profile = None
+        db.engine.last_phases = {}
         # retry bookkeeping spans attempts but the statement keeps ONE
         # span tree, ASH activity and audit record — retries are an
         # internal redrive, not new statements. The controller is built
@@ -2506,6 +2567,9 @@ class DbSession:
         with db.tracer.span("sql", session=self.session_id) as sp:
             with db.ash.activity(self.session_id, "EXECUTING", text,
                                  sp.trace_id):
+                if self._gap is not None:
+                    # tracer span + ASH activity registration glue
+                    self._gap.cut("setup")
                 try:
                     rs = self._run_with_retries(text)
                 except Exception as e:
@@ -2526,7 +2590,16 @@ class DbSession:
                         prof = rs.profile
                     bi = (getattr(rs, "batch_info", None)
                           if rs is not None else None)
+                    led = self._gap
+                    fr = self._fast_reg
+                    digest = ""
                     ws = db.stmt_summary
+                    if ws.enabled or led is not None:
+                        digest = (fr[0] if fr is not None
+                                  else P.digest_text(text))
+                        # fronts annotate post-close wall (wire write)
+                        # against this digest via host_tax.fold_extra
+                        self._last_digest = digest
                     if ws.enabled:
                         # exactly-once digest fold per statement — here in
                         # the completion finally, never in the except arm
@@ -2540,14 +2613,21 @@ class DbSession:
                         acc = self._ws_acc
                         if acc is None:
                             acc = self._ws_acc = ws.session_acc()
-                        fr = self._fast_reg
                         acc.fold(
-                            fr[0] if fr is not None else P.digest_text(text),
+                            digest,
                             stype, elapsed_s, err,
                             self._retry_ctrl.retry_cnt
                             if self._retry_ctrl else 0,
                             rs, bi is not None, prof,
                         )
+                    if led is not None:
+                        # the return path + digest + summary fold are host
+                        # wall too: cut everything since the engine window
+                        # closed, then freeze e2e/residual/chip-idle and
+                        # fold the ledger under the statement's digest
+                        led.cut("completion fold")
+                        led.close()
+                        db.host_tax.fold(digest, led)
                     # hot-path diet: when metrics/audit are disabled, skip
                     # even the counter lookups and kwargs construction —
                     # the serving path pays zero for observability it
@@ -2561,8 +2641,21 @@ class DbSession:
                             adds.append(("sql dml count", 1))
                         if err:
                             adds.append(("sql fail count", 1))
-                        m.bulk(adds=adds,
-                               observes=(("sql response time", elapsed_s),))
+                        observes = [("sql response time", elapsed_s)]
+                        waits = ()
+                        if led is not None:
+                            # per-phase wait events: sysstat/system_event
+                            # rows AND prometheus summaries for free
+                            adds.append(("host tax statements", 1))
+                            observes.append(
+                                ("host chip idle pct", led.chip_idle_pct))
+                            waits = [("host tax: " + k, v)
+                                     for k, v in led.phases.items()]
+                            if led.unattributed_s > 0.0:
+                                waits.append(("host tax: unattributed",
+                                              led.unattributed_s))
+                        m.bulk(adds=adds, observes=tuple(observes),
+                               waits=tuple(waits))
                     tl = db.timeline
                     if tl.enabled:
                         # timeline completion feed (exactly once per
@@ -2600,6 +2693,11 @@ class DbSession:
                             is_batched=bi is not None,
                             batch_id=bi[0] if bi is not None else 0,
                             batch_wait_us=bi[2] if bi is not None else 0,
+                            chip_idle_us=int(
+                                max(0.0, led.e2e_s - led.device_s) * 1e6)
+                            if led is not None else 0,
+                            unattributed_us=int(led.unattributed_s * 1e6)
+                            if led is not None else 0,
                         )
                     if stype not in ("Show", "SetVar", ""):
                         if self._vars.get("ob_enable_show_trace"):
@@ -2715,8 +2813,13 @@ class DbSession:
                     else:
                         db.location.clear()
                 if wait > 0:
+                    tb = _time.perf_counter()
                     with m.waiting("statement retry backoff"):
                         db.cluster.settle(wait)
+                    led = _GL.current()
+                    if led is not None:
+                        led.add("retry backoff",
+                                _time.perf_counter() - tb)
                 if d is not None and d.expired:
                     raise ctrl.timeout_error(e) from e
             finally:
@@ -2734,6 +2837,13 @@ class DbSession:
         db = self.db
         if not db.flight.should_record(elapsed_s):
             return
+        # arm the stack sampler: THIS statement is already over, but slow
+        # statements recur — the next occurrence gets sampled stacks into
+        # its bundle. Config-armed mode (enable_stack_sampler) keeps it
+        # running regardless.
+        auto = db.config["stack_sampler_auto_arm"]
+        if auto > 0:
+            db.stack_sampler.arm(auto)
         spans = [
             {
                 "depth": depth,
@@ -2762,6 +2872,13 @@ class DbSession:
             "config": {
                 n: v for n, v, _p in db.config.snapshot()
             },
+            # host-tax ledger: where THIS statement's wall went, phase by
+            # phase, residual named — plus whatever collapsed stacks the
+            # sampler holds (armed by a previous slow statement or config)
+            "host_tax": (self._gap.to_dict()
+                         if self._gap is not None and self._gap.closed
+                         else {}),
+            "stacks": db.stack_sampler.snapshot(),
         }
         db.flight.record(bundle, counters=db.metrics.counters_snapshot())
         db.metrics.add("flight recorder bundles")
@@ -2943,6 +3060,11 @@ class DbSession:
         tp = _time.perf_counter()
         stmt = P.parse_statement(text)
         self.db.metrics.observe("sql parse", _time.perf_counter() - tp)
+        led = self._gap
+        if led is not None:
+            # cut, not a tp-anchored add: covers the fast-tier fallthrough
+            # glue since the miss cut (or dispatch entry) too
+            led.cut("parse bind")
         self._last_stmt_type = type(stmt).__name__
         # privileges first: a DENIED statement must not burn sequence
         # values or write node meta
@@ -2954,7 +3076,18 @@ class DbSession:
             norm_key = self._fast_reg[0].replace("?n", "?").replace("?s", "?")
         else:
             norm_key = P.normalize_for_cache(text)[0]
-        return self._dispatch_stmt(stmt, norm_key, fast_reg=self._fast_reg)
+        if led is None:
+            return self._dispatch_stmt(stmt, norm_key,
+                                       fast_reg=self._fast_reg)
+        # full-path engine window: whatever the engine measured
+        # (plan/compile/bind/dispatch/fetch) carves the window wall; the
+        # rest is the named measured remainder "engine host"
+        led.window_start()
+        try:
+            return self._dispatch_stmt(stmt, norm_key,
+                                       fast_reg=self._fast_reg)
+        finally:
+            led.window_end_carved(self.db.engine.last_phases, "engine host")
 
     def _fast_select(self, text: str) -> "ResultSet | None":
         """Server half of the statement fast path. Eligibility mirrors the
@@ -2979,20 +3112,29 @@ class DbSession:
             # follower view path in _select instead
             return None
         t0 = _time.perf_counter()
+        led = self._gap
+
+        def miss():
+            # the fast tier's wall is host tax even when it MISSES — the
+            # tokenize/peek attempt preceded the full parse path
+            if led is not None:
+                led.cut("fast lookup")
+            return None
+
         try:
             fkey, params, kinds = P.fast_normalize(text)
         except Exception:
-            return None  # tokenizer rejects: the full parser owns the error
+            return miss()  # tokenizer rejects: the full parser owns the error
         if "nextval" in fkey or "currval" in fkey:
             # sequence draws are side-effecting: _bind_sequences rewrites
             # them into fresh literals pre-resolution, which a text-keyed
             # replay would freeze. Never serve OR register these.
-            return None
+            return miss()
         self._fast_reg = (fkey, params, kinds)
         fe = db.plan_cache.fast_peek(fkey)
         if fe is None:
             db.plan_cache.note_fast_miss()
-            return None
+            return miss()
         if self.user != "root":
             from ..share.privilege import AccessDenied
 
@@ -3004,11 +3146,16 @@ class DbSession:
         hit = db.engine.fast_lookup(fkey, params, fe=fe,
                                     defer_adds=self._stmt_adds)
         if hit is None:
-            return None
+            return miss()
         # set BEFORE execute: the audit record and the retry controller's
         # retryability decision both read it if dispatch raises
         self._last_stmt_type = fe.stmt_type
         fastparse_s = _time.perf_counter() - t0
+        if led is not None:
+            # tokenize + peek + priv + catalog refresh + lookup: the fast
+            # tier's whole host cost, as one contiguous cut from the
+            # dispatch-entry cursor
+            led.cut("fast lookup")
         # cross-session micro-batching: concurrent hits on the SAME entry
         # fold into one batched device dispatch. Admission honors the
         # tenant unit — a batch wider than max_workers could never form
@@ -3024,9 +3171,19 @@ class DbSession:
             # CPU time across session threads
             db.batcher.admit()
             try:
+                # host-tax window over the gated execution: the batcher
+                # self-reports hints (window wait; dispatch on the leader
+                # only — the cohort's device busy is counted ONCE) from
+                # this thread via gap_ledger.current()
+                if led is not None:
+                    led.window_start()
                 rs = db.batcher.execute(
                     hit, bmax, self._vars.get("ob_batch_max_wait_us", 0))
                 if rs is not None:
+                    if led is not None:
+                        # batched lane: hints only; batcher glue stays in
+                        # the unattributed residual (no engine ran here)
+                        led.window_end()
                     if db.config["enable_query_profile"]:
                         rs.profile = QueryProfile(
                             compile_hit=True,
@@ -3048,11 +3205,20 @@ class DbSession:
                         hit, fastparse_s=fastparse_s)
                 finally:
                     db.batcher.solo_done()
+                    if led is not None:
+                        led.window_end_carved(
+                            db.engine.last_phases, "engine host")
                 self._stmt_cache_hit = True
                 return rs
             finally:
                 db.batcher.admit_done()
-        rs = db.engine.fast_execute(hit, fastparse_s=fastparse_s)
+        if led is not None:
+            led.window_start()
+        try:
+            rs = db.engine.fast_execute(hit, fastparse_s=fastparse_s)
+        finally:
+            if led is not None:
+                led.window_end_carved(db.engine.last_phases, "engine host")
         self._stmt_cache_hit = True
         return rs
 

@@ -240,6 +240,11 @@ class AuditRecord:
     is_batched: bool = False
     batch_id: int = 0
     batch_wait_us: int = 0
+    # host-tax gap ledger (share/gap_ledger.py): time the chip sat idle
+    # during this statement's wall, and the wall the ledger could not
+    # attribute to any named phase (the conservation residual)
+    chip_idle_us: int = 0
+    unattributed_us: int = 0
 
 
 class SqlAudit:

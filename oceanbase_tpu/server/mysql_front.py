@@ -29,6 +29,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time as _time
 
 import numpy as np
 
@@ -308,6 +309,18 @@ def _literal(v) -> str:
     return f"'{s}'"
 
 
+def _fold_wire(sess, phase: str, seconds: float) -> None:
+    """Host-tax attribution for wall spent OUTSIDE the statement ledger
+    (the ledger closed when sess.sql returned): result encode / packet
+    work rides the statement's digest aggregate via fold_extra, which
+    adds to both the phase and the digest e2e so digest-level
+    conservation still holds."""
+    ht = getattr(sess.db, "host_tax", None)
+    dg = getattr(sess, "_last_digest", "")
+    if ht is not None and ht.enabled and dg and seconds > 0.0:
+        ht.fold_extra(dg, phase, seconds)
+
+
 def query_payloads(sess, sql: str) -> list[bytes]:
     """COM_QUERY: text resultset (typed column defs, EOF, rows, EOF),
     or OK (DML/DDL with affected-rows), or ERR."""
@@ -318,6 +331,7 @@ def query_payloads(sess, sql: str) -> list[bytes]:
             getattr(e, "code", 1064), f"{type(e).__name__}: {e}")]
     if not rs.names:
         return [_ok_packet(affected=rs.affected)]
+    tw = _time.perf_counter()
     cols = [rs.columns[n] for n in rs.names]
     out = [_lenenc_int(len(rs.names))]
     for n, c in zip(rs.names, cols):
@@ -326,6 +340,7 @@ def query_payloads(sess, sql: str) -> list[bytes]:
     for i in range(rs.nrows):
         out.append(b"".join(_cell(c[i]) for c in cols))
     out.append(_eof_packet())
+    _fold_wire(sess, "wire write", _time.perf_counter() - tw)
     return out
 
 
@@ -356,6 +371,7 @@ def stmt_execute_payloads(sess, pkt: bytes, stmts: dict) -> list[bytes]:
     """COM_STMT_EXECUTE: binary resultset (typed rows, NULL bitmap).
     Bound parameters substitute as literals and ride the plan cache's
     parameterization, so re-executions reuse the compiled artifact."""
+    tr = _time.perf_counter()
     sid = int.from_bytes(pkt[1:5], "little")
     entry = stmts.get(sid)
     if entry is None:
@@ -370,13 +386,18 @@ def stmt_execute_payloads(sess, pkt: bytes, stmts: dict) -> list[bytes]:
         p + (_literal(params[i]) if i < nparams else "")
         for i, p in enumerate(pieces)
     )
+    wire_read_s = _time.perf_counter() - tr
     try:
         rs = sess.sql(sql)
     except Exception as e:
         return [_err_packet(
             getattr(e, "code", 1064), f"{type(e).__name__}: {e}")]
+    # packet decode + literal substitution happened before the ledger
+    # opened; attribute it now that the digest is known
+    _fold_wire(sess, "wire read", wire_read_s)
     if not rs.names:
         return [_ok_packet(affected=rs.affected)]
+    tw = _time.perf_counter()
     cols = [rs.columns[n] for n in rs.names]
     types = [_col_mysql_type(c) for c in cols]
     out = [_lenenc_int(len(rs.names))]
@@ -406,6 +427,7 @@ def stmt_execute_payloads(sess, pkt: bytes, stmts: dict) -> list[bytes]:
                 body += _lenenc_str(str(v).encode())
         out.append(b"\x00" + bytes(bitmap) + bytes(body))
     out.append(_eof_packet())
+    _fold_wire(sess, "wire write", _time.perf_counter() - tw)
     return out
 
 

@@ -98,6 +98,50 @@ def _sql_audit(db) -> Table:
         ("batch_id", DataType.int64(), [r.batch_id for r in recs]),
         ("batch_wait_us", DataType.int64(),
          [r.batch_wait_us for r in recs]),
+        # host-tax gap ledger: chip-idle wall + the conservation residual
+        # (e2e minus every attributed phase) — see __all_virtual_host_tax
+        # for the per-digest phase breakdown
+        ("chip_idle_us", DataType.int64(),
+         [r.chip_idle_us for r in recs]),
+        ("unattributed_us", DataType.int64(),
+         [r.unattributed_us for r in recs]),
+    ])
+
+
+def _host_tax(db) -> Table:
+    """Per-digest host-tax breakdown (share/gap_ledger.py): where every
+    second of e2e wall went, phase by phase, with the residual named
+    instead of silently absorbed — the standing surface for ROADMAP
+    item 2 ("crush the host tax")."""
+    import json
+
+    rows = db.host_tax.rows()
+
+    def top(ph: dict):
+        if not ph:
+            return "", 0
+        k, v = max(ph.items(), key=lambda kv: kv[1])
+        return k, int(v * 1e6)
+
+    tops = [top(r["phases"]) for r in rows]
+    return _t("__all_virtual_host_tax", [
+        ("digest", DataType.varchar(), [str(r["digest"]) for r in rows]),
+        ("executions", DataType.int64(), [r["count"] for r in rows]),
+        ("e2e_us", DataType.int64(),
+         [int(r["e2e_s"] * 1e6) for r in rows]),
+        ("device_us", DataType.int64(),
+         [int(r["device_s"] * 1e6) for r in rows]),
+        ("chip_idle_pct", DataType.float64(),
+         [r["chip_idle_pct"] for r in rows]),
+        ("unattributed_us", DataType.int64(),
+         [int(r["unattributed_s"] * 1e6) for r in rows]),
+        ("unattributed_pct", DataType.float64(),
+         [r["unattributed_pct"] for r in rows]),
+        ("top_phase", DataType.varchar(), [t[0] for t in tops]),
+        ("top_phase_us", DataType.int64(), [t[1] for t in tops]),
+        ("phases_json", DataType.varchar(),
+         [json.dumps({k: round(v, 9) for k, v in sorted(
+             r["phases"].items())}) for r in rows]),
     ])
 
 
@@ -782,6 +826,7 @@ PROVIDERS = {
     "__all_virtual_table": _tables,
     "__all_virtual_plan_cache_stat": _plan_cache_stat,
     "__all_virtual_sql_audit": _sql_audit,
+    "__all_virtual_host_tax": _host_tax,
     "__all_virtual_sql_plan_monitor": _plan_monitor,
     "__all_virtual_ash": _ash,
     "__all_virtual_trace_span": _trace,

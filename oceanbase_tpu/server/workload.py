@@ -790,6 +790,11 @@ def build_snapshot(db, snap_id: int, ts: float) -> dict:
         # tallies — the storage_corruption sentinel rule's input
         "integrity": (db.scrubber.stats()
                       if getattr(db, "scrubber", None) is not None else {}),
+        # host-tax ledger (share/gap_ledger.py): cumulative per-digest
+        # phase walls + recent chip-idle windows — awr_report's "Host tax
+        # (window)" section diffs two of these
+        "host_tax": (db.host_tax.snapshot()
+                     if getattr(db, "host_tax", None) is not None else {}),
     }
 
 

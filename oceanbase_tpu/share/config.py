@@ -196,6 +196,26 @@ def default_params() -> list[Param]:
         Param("serving_timeline_capacity", "int", 120,
               "bounded count of timeline buckets held in the ring",
               min=8, max=1 << 16),
+        # host-tax gap ledger + stack sampler (share/gap_ledger.py)
+        Param("enable_host_tax", "bool", True,
+              "conservation-account every statement's e2e wall into "
+              "named host phases + an explicit unattributed residual "
+              "(share/gap_ledger.py, __all_virtual_host_tax)"),
+        Param("host_tax_max_digests", "int", 256,
+              "bounded count of per-digest host-tax aggregates",
+              min=8, max=1 << 16),
+        Param("host_tax_window", "time", 1.0,
+              "width of one host-tax chip-idle window bucket", min=0.05),
+        Param("enable_stack_sampler", "bool", False,
+              "keep the in-process wall-clock stack sampler armed "
+              "continuously (otherwise it only auto-arms after a "
+              "statement crosses the slow-query watermark)"),
+        Param("stack_sampler_interval", "time", 0.005,
+              "stack sampler period", min=0.0001),
+        Param("stack_sampler_auto_arm", "time", 2.0,
+              "how long the sampler stays armed after a statement "
+              "crosses trace_log_slow_query_watermark; 0 disables "
+              "auto-arming", min=0.0),
         Param("enable_health_sentinel", "bool", True,
               "evaluate health rules (latency regressions, starvation, "
               "compile storms...) on every workload snapshot"),

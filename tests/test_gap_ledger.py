@@ -1,0 +1,476 @@
+"""Host-tax gap ledger: conservation-complete e2e wall attribution.
+
+Unit layer: GapLedger on a fake clock — the conservation invariant
+(sum(phases) + unattributed == e2e, exactly) across the serial cut()
+timeline, measured windows with clamped hints, the engine-phase carve,
+and batched leader/follower attribution (cohort device busy counted
+ONCE).  Integration layer: the same invariant read off live statement
+ledgers through the real serving stack — solo fast path, batched
+cohorts under an 8-thread hammer, the errsim retry/degradation ladder,
+follower reads, streamed out-of-core plans — plus liveness of the
+__all_virtual_host_tax / sysstat / workload-snapshot surfaces.
+
+Reference: share/gap_ledger.py (PR-16), server/database.py wiring.
+"""
+
+import json
+import threading
+
+import pytest
+
+from oceanbase_tpu.share import gap_ledger as GL
+from oceanbase_tpu.share.gap_ledger import (GapLedger, HostTaxRegistry,
+                                            carve_engine_phases)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, s: float) -> None:
+        self.t += s
+
+
+def conserved(led: GapLedger) -> None:
+    """The module's central claim, asserted exactly (fake clock: no
+    float noise beyond one sum)."""
+    attributed = sum(led.phases.values())
+    assert led.closed
+    assert attributed <= led.e2e_s + 1e-12
+    assert abs(attributed + led.unattributed_s - led.e2e_s) < 1e-12
+
+
+# ---- serial timeline: cut() / add() -----------------------------------------
+
+
+def test_cut_timeline_is_gapless():
+    """Contiguous cuts cover every nanosecond from begin to close: the
+    inter-span glue lands in the adjacent named phase, so a fully-cut
+    statement has ZERO unattributed residual."""
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    c.tick(0.010)
+    led.cut("setup")
+    c.tick(0.002)
+    led.cut("fast lookup")
+    c.tick(0.050)
+    led.cut("device dispatch")
+    c.tick(0.005)
+    led.cut("completion fold")
+    led.close()
+    assert led.e2e_s == pytest.approx(0.067)
+    assert led.phases == pytest.approx({
+        "setup": 0.010, "fast lookup": 0.002,
+        "device dispatch": 0.050, "completion fold": 0.005})
+    assert led.unattributed_s == 0.0
+    conserved(led)
+
+
+def test_uncut_wall_stays_unattributed():
+    """The residual is the whole point: wall nobody claimed is surfaced
+    as `unattributed`, never folded into a neighbouring phase."""
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    c.tick(0.004)
+    led.cut("setup")
+    c.tick(0.006)  # nobody cuts this
+    led.close()
+    assert led.unattributed_s == pytest.approx(0.006)
+    conserved(led)
+
+
+def test_add_advances_cursor_so_cut_does_not_recover_it():
+    """add() outside a window is a caller-measured span that just
+    ended; the following cut() must not attribute that wall again."""
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    c.tick(0.020)
+    led.add("retry backoff", 0.020)  # caller timed the sleep itself
+    c.tick(0.003)
+    led.cut("setup")  # only the 3ms since the add
+    led.close()
+    assert led.phases["retry backoff"] == pytest.approx(0.020)
+    assert led.phases["setup"] == pytest.approx(0.003)
+    assert led.unattributed_s == 0.0
+    conserved(led)
+
+
+def test_begin_fully_resets_for_session_reuse():
+    """Sessions reuse ONE ledger object; begin() must erase every trace
+    of the previous statement."""
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    c.tick(0.01)
+    led.cut("setup")
+    led.device(0.5)
+    led.close()
+    c.tick(1.0)
+    led.begin()
+    c.tick(0.002)
+    led.close()
+    assert led.phases == {}
+    assert led.device_s == 0.0
+    assert led.e2e_s == pytest.approx(0.002)
+    assert led.unattributed_s == pytest.approx(0.002)
+    conserved(led)
+
+
+# ---- measured windows: hint clamp -------------------------------------------
+
+
+def test_window_hints_clamped_to_wall():
+    """Overlapping inner spans can hint MORE than the window's measured
+    wall; the proportional clamp keeps sum(phases) <= e2e no matter
+    what inner layers report."""
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    led.window_start()
+    c.tick(0.010)  # window wall: 10ms
+    led.add("batch window", 0.008)
+    led.add("governor reserve", 0.008)  # hints total 16ms > 10ms wall
+    led.window_end()
+    led.close()
+    assert sum(led.phases.values()) == pytest.approx(0.010)
+    # clamp is proportional: both hints scaled by 10/16
+    assert led.phases["batch window"] == pytest.approx(0.005)
+    assert led.phases["governor reserve"] == pytest.approx(0.005)
+    conserved(led)
+
+
+def test_window_leftover_goes_to_default_phase():
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    led.window_start()
+    c.tick(0.010)
+    led.add("device dispatch", 0.004)
+    led.window_end("engine host")
+    led.close()
+    assert led.phases["device dispatch"] == pytest.approx(0.004)
+    assert led.phases["engine host"] == pytest.approx(0.006)
+    assert led.unattributed_s == 0.0
+    conserved(led)
+
+
+def test_cut_is_noop_inside_window_and_resumes_after():
+    """Hints inside a window are clamped spans, not a serial timeline:
+    cut() must not fire there.  window_end resumes the cursor, so the
+    next cut covers only post-window wall."""
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    c.tick(0.002)
+    led.cut("setup")
+    led.window_start()
+    c.tick(0.010)
+    led.cut("setup")  # ignored: window open
+    led.window_end("engine host")
+    c.tick(0.003)
+    led.cut("completion fold")
+    led.close()
+    assert led.phases["setup"] == pytest.approx(0.002)
+    assert led.phases["engine host"] == pytest.approx(0.010)
+    assert led.phases["completion fold"] == pytest.approx(0.003)
+    conserved(led)
+
+
+def test_unbalanced_window_flushed_on_close():
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    led.window_start()
+    c.tick(0.004)
+    led.add("batch window", 0.004)
+    led.close()  # caller died before window_end: close() flushes it
+    assert led.phases["batch window"] == pytest.approx(0.004)
+    conserved(led)
+
+
+# ---- engine-phase carve -----------------------------------------------------
+
+
+def test_carve_d2h_never_overlaps_device_wait():
+    hints, dev = carve_engine_phases({
+        "dispatch_s": 0.010, "fetch_s": 0.006, "d2h_s": 0.002,
+        "bind_s": 0.001})
+    assert hints["device dispatch"] == pytest.approx(0.010)
+    assert hints["d2h"] == pytest.approx(0.002)
+    assert hints["device wait"] == pytest.approx(0.004)  # fetch - d2h
+    assert hints["param pack"] == pytest.approx(0.001)
+    assert dev == pytest.approx(0.014)  # dispatch + (fetch - d2h)
+
+
+def test_carve_streamed_h2d_carved_out_of_dispatch():
+    """A streamed plan's per-chunk H2D wall sits INSIDE dispatch_s; the
+    carve subtracts its non-overlapped part so it is never counted
+    twice.  On the serving path the pipeline already hinted it live
+    (served_stream_hints=True): the carve must then NOT emit its own
+    h2d, only shrink dispatch."""
+    phases = {"dispatch_s": 0.020, "fetch_s": 0.001,
+              "stream_h2d_s": 0.008, "stream_overlap_s": 0.002,
+              "stream_compute_s": 0.010}
+    served, dev_served = carve_engine_phases(
+        phases, served_stream_hints=True)
+    assert "h2d" not in served
+    assert served["device dispatch"] == pytest.approx(0.014)  # 20-(8-2)
+    solo, dev_solo = carve_engine_phases(
+        phases, served_stream_hints=False)
+    assert solo["h2d"] == pytest.approx(0.006)
+    assert solo["device dispatch"] == pytest.approx(0.014)
+    # solo carve owns the chunk compute as device busy; served path got
+    # it hinted live by the pipeline instead
+    assert dev_solo - dev_served == pytest.approx(0.010)
+
+
+def test_window_end_carved_fuses_and_conserves():
+    c = FakeClock()
+    led = GapLedger(clock=c).begin()
+    led.window_start()
+    c.tick(0.020)
+    led.window_end_carved(
+        {"dispatch_s": 0.010, "fetch_s": 0.004, "d2h_s": 0.001},
+        "engine host")
+    led.close()
+    assert led.phases["device dispatch"] == pytest.approx(0.010)
+    assert led.phases["d2h"] == pytest.approx(0.001)
+    assert led.phases["device wait"] == pytest.approx(0.003)
+    assert led.phases["engine host"] == pytest.approx(0.006)
+    assert led.device_s == pytest.approx(0.013)
+    assert led.unattributed_s == 0.0
+    conserved(led)
+
+
+def test_from_phases_builds_conservation_complete_ledger():
+    led = GapLedger.from_phases(
+        0.010, {"dispatch_s": 0.004, "fetch_s": 0.002, "bind_s": 0.001},
+        device_s=0.005)
+    conserved(led)
+    assert led.e2e_s == pytest.approx(0.010)
+    assert led.device_s == pytest.approx(0.005)
+    d = led.to_dict()
+    assert abs(sum(d["phases"].values())
+               + d["unattributed_s"] - d["e2e_s"]) < 1e-6
+
+
+# ---- batched cohorts: busy counted once -------------------------------------
+
+
+def test_batched_cohort_device_busy_counted_once():
+    """Double-count regression: in a cohort of 4, the leader attributes
+    the shared dispatch (and its device busy) exactly once; followers
+    hint only their window wait.  Registry device_s must equal the
+    leader's dispatch, not 4x it."""
+    c = FakeClock()
+    reg = HostTaxRegistry(clock=c)
+    leds = [GapLedger(clock=c) for _ in range(4)]
+    for led in leds:
+        led.begin()
+        led.window_start()
+    c.tick(0.002)  # window fill
+    # leader (index 0) dispatches for everyone: 3ms busy, once
+    c.tick(0.003)
+    leds[0].add("device dispatch", 0.003)
+    leds[0].device(0.003)
+    for led in leds[1:]:
+        led.add("batch window", 0.005)  # followers waited the window
+    for led in leds:
+        led.window_end()
+        led.close()
+        conserved(led)
+        reg.fold(7, led)
+    snap = reg.snapshot()["digests"][7]
+    assert snap["count"] == 4
+    assert snap["device_s"] == pytest.approx(0.003)  # once, not 4x
+    assert snap["phases"]["device dispatch"] == pytest.approx(0.003)
+    assert snap["phases"]["batch window"] == pytest.approx(0.015)
+    assert snap["e2e_s"] == pytest.approx(0.020)
+
+
+def test_registry_windows_and_fold_extra():
+    c = FakeClock()
+    reg = HostTaxRegistry(clock=c, window_s=1.0)
+    led = GapLedger(clock=c).begin()
+    c.tick(0.4)
+    led.cut("device dispatch")
+    led.device(0.3)
+    led.close()
+    reg.fold(1, led)
+    c.tick(1.0)  # next window bucket
+    led2 = GapLedger(clock=c).begin()
+    c.tick(0.2)
+    led2.cut("setup")
+    led2.close()
+    reg.fold(1, led2)
+    # post-close wall (wire write) lands on phase AND e2e: digest-level
+    # conservation survives the annotation
+    reg.fold_extra(1, "wire write", 0.1)
+    a = reg.snapshot()["digests"][1]
+    assert a["e2e_s"] == pytest.approx(0.7)
+    assert sum(a["phases"].values()) + a["unattributed_s"] == (
+        pytest.approx(a["e2e_s"]))
+    wins = reg.snapshot()["windows"]
+    assert len(wins) == 2 and wins[0]["stmts"] == 1
+    # chip idle over the most recent window: no device time folded there
+    assert reg.window_chip_idle_pct() == pytest.approx(100.0)
+
+
+# ---- integration: live serving stack ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def db():
+    from oceanbase_tpu.server import Database
+
+    d = Database(n_nodes=3, n_ls=2)
+    s = d.session()
+    s.sql("create table gt (k bigint primary key, v bigint not null)")
+    s.sql("insert into gt values " + ", ".join(
+        f"({i}, {i * 3})" for i in range(64)))
+    return d
+
+
+def _assert_live_conserved(led):
+    assert led is not None and led.closed
+    attributed = sum(led.phases.values())
+    assert attributed <= led.e2e_s + 1e-9
+    assert abs(attributed + led.unattributed_s - led.e2e_s) < 1e-9
+
+
+def test_solo_statement_conserves(db):
+    s = db.session()
+    for i in range(6):  # varying literals: registers + warms the fast tier
+        s.sql(f"select v from gt where k = {i}").rows()
+    _assert_live_conserved(s._gap)
+    assert s._gap.phases  # named phases, not one unattributed blob
+
+
+def test_hammer_8_threads_batched_conserves(db):
+    """8 closed-loop threads through the micro-batcher: every final
+    ledger conserves, and nothing attributed exceeds its own e2e (the
+    window clamp holds under cohort overlap)."""
+    sessions = [db.session() for _ in range(8)]
+    for s in sessions:
+        s.sql("set ob_batch_max_wait_us = 300")
+    errs: list = []
+
+    def worker(s, i):
+        try:
+            for j in range(30):
+                s.sql(f"select v from gt where k = {(i * 7 + j) % 64}"
+                      ).rows()
+                _assert_live_conserved(s._gap)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s, i))
+               for i, s in enumerate(sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    for s in sessions:
+        _assert_live_conserved(s._gap)
+    # registry-level sanity after the hammer: the window ring never
+    # reports more device busy than wall
+    for w in db.host_tax.snapshot()["windows"]:
+        assert w["device_s"] <= w["e2e_s"] + 1e-9
+
+
+def test_retry_degradation_conserves_and_names_backoff():
+    """The errsim OOM ladder (evict -> chunked -> host) retries inside
+    one statement: its ledger must still conserve and must name the
+    retry backoff instead of leaking it into the residual."""
+    from oceanbase_tpu.server import Database
+    from oceanbase_tpu.share import retry as R
+    from oceanbase_tpu.share.errsim import ERRSIM
+
+    d = Database(n_nodes=1, n_ls=1)
+    try:
+        s = d.session()
+        s.sql("create table rt (id bigint primary key, v bigint)")
+        for i in range(0, 2000, 500):
+            s.sql("insert into rt values " + ", ".join(
+                f"({j}, {j * 37 % 100})" for j in range(i, i + 500)))
+        q = "select v, count(*) as n from rt group by v order by v"
+        baseline = s.sql(q).rows()
+        ERRSIM.arm("EN_DEVICE_OOM", error=R.DeviceOOM("EN_DEVICE_OOM"),
+                   prob=1.0, count=3)
+        assert s.sql(q).rows() == baseline
+        led = s._gap
+        _assert_live_conserved(led)
+        assert led.phases.get("retry backoff", 0.0) > 0.0
+    finally:
+        ERRSIM.clear("EN_DEVICE_OOM")
+        d.close()
+
+
+def test_follower_read_conserves(db):
+    db.cluster.settle(1.0)  # followers apply the seed before weak reads
+    s = db.session()
+    s.sql("set ob_read_consistency = 'weak'")
+    try:
+        rows = s.sql("select count(*) as n from gt").rows()
+        assert rows == [(64,)]
+        assert s.last_follower_read is not None
+        _assert_live_conserved(s._gap)
+    finally:
+        s.sql("set ob_read_consistency = 'strong'")
+
+
+def test_streamed_plan_conserves_with_pipeline_hints():
+    """A tiny device budget forces the out-of-core streaming pipeline;
+    its live H2D/compute hints must land on the statement ledger
+    without double-counting against the engine carve."""
+    from oceanbase_tpu.server import Database
+
+    d = Database(n_nodes=1, n_ls=1)
+    try:
+        d.config.set("ob_device_memory_limit", "65536")
+        s = d.session()
+        s.sql("create table st (id bigint primary key, v bigint not null)")
+        for i in range(0, 30000, 1000):
+            s.sql("insert into st values " + ", ".join(
+                f"({j}, {j % 97})" for j in range(i, i + 1000)))
+        q = "select sum(v) as s, count(*) as n from st where v < 50"
+        s.sql(q).rows()
+        chunks0 = d.metrics.counter("stream chunks")
+        s.sql(q).rows()
+        assert d.metrics.counter("stream chunks") > chunks0
+        led = s._gap
+        _assert_live_conserved(led)
+        assert led.phases.get("h2d", 0.0) > 0.0  # pipeline hinted live
+        assert led.device_s > 0.0
+    finally:
+        d.close()
+
+
+def test_vt_sysstat_and_snapshot_surfaces_live(db):
+    s = db.session()
+    for i in range(4):
+        s.sql(f"select v from gt where k = {i}").rows()
+    rs = s.sql(
+        "select digest, executions, unattributed_pct, phases_json "
+        "from __all_virtual_host_tax")
+    rows = rs.rows()
+    assert rows
+    dig, execs, unattr_pct, pj = max(rows, key=lambda r: r[1])
+    assert execs >= 4 and 0.0 <= unattr_pct <= 100.0
+    phases = json.loads(pj)
+    assert phases and all(v >= 0.0 for v in phases.values())
+    assert db.metrics.counter("host tax statements") >= execs
+    # audit ring carries the per-statement columns
+    rec = db.audit.records()[-1]
+    assert rec.chip_idle_us >= 0 and rec.unattributed_us >= 0
+    # workload snapshots embed the registry for awr_report's window diff
+    snap = db.workload.take(db)
+    assert snap["host_tax"]["digests"]
+    assert "window_s" in snap["host_tax"]
+
+
+def test_phase_order_covers_wired_phases():
+    """Every phase name the serving stack emits renders in canonical
+    order — a new phase added to the wiring must join PHASE_ORDER."""
+    for name in ("setup", "fast lookup", "batch window", "retry backoff",
+                 "governor reserve", "h2d", "completion fold"):
+        assert name in GL.PHASE_ORDER

@@ -223,6 +223,15 @@ def test_metrics_text_prometheus_exposition(db):
     assert "# TYPE ob_plan_cache_entries gauge" in text
     assert "ob_wait_tx_commit_log_sync_seconds_count" in text
     assert 'le="+Inf"' in text
+    # host-tax families (gap ledger): the statements counter, the
+    # per-phase wait summaries, and the chip-idle histogram must all be
+    # declared with HELP/TYPE like every other family
+    assert "# TYPE ob_host_tax_statements_total counter" in text
+    assert ("# TYPE ob_wait_host_tax__completion_fold_seconds summary"
+            in text)
+    assert "ob_wait_host_tax__completion_fold_seconds_sum" in text
+    assert "# TYPE ob_host_chip_idle_pct_seconds histogram" in text
+    assert "ob_host_chip_idle_pct_seconds_count" in text
 
 
 # ---- tracer fixes (spans on live clock, error tagging) ----------------------

@@ -9,7 +9,9 @@ report runs anywhere the JSON can be copied to.
 
 Output: a human-readable report on stdout — top-K digests by window
 total/p99 time, hottest tables/columns, compile-cache churn, residency
-changes — followed by ONE machine-readable JSON line (the last stdout
+changes, and the window host-tax view (per-digest phase breakdown from
+the conservation ledger + chip-idle over the interval) — followed by
+ONE machine-readable JSON line (the last stdout
 line) whose `advisor` block is the data contract the layout advisor
 (ROADMAP item 3) consumes: recommended sorted projections, residency
 priorities, batching candidates.
@@ -308,6 +310,62 @@ def saturation(first: dict, last: dict, restarted: bool) -> dict:
     }
 
 
+def diff_host_tax(first: dict, last: dict, restarted: bool) -> dict:
+    """Window view of the host-tax conservation ledger.  Each snapshot
+    embeds the registry's cumulative per-digest totals, so the window
+    figure is last - first per digest; chip idle comes from the ring of
+    per-second windows overlapping the report interval (same floored-
+    start convention as the serving timeline)."""
+    h1 = last.get("host_tax") or {}
+    h0 = {} if restarted else (first.get("host_tax") or {})
+    d0 = h0.get("digests", {})
+    rows = []
+    for dig, a in h1.get("digests", {}).items():
+        z = d0.get(dig, {})
+        n = a.get("count", 0) - z.get("count", 0)
+        if n <= 0:
+            continue
+        e2e = max(0.0, a.get("e2e_s", 0.0) - z.get("e2e_s", 0.0))
+        dev = max(0.0, a.get("device_s", 0.0) - z.get("device_s", 0.0))
+        una = max(0.0, a.get("unattributed_s", 0.0)
+                  - z.get("unattributed_s", 0.0))
+        zp = z.get("phases", {})
+        phases = {}
+        for k, v in a.get("phases", {}).items():
+            pv = v - zp.get(k, 0.0)
+            if pv > 1e-12:
+                phases[k] = pv
+        rows.append({
+            "digest": dig,
+            "count": n,
+            "e2e_s": e2e,
+            "device_s": dev,
+            "chip_idle_pct": (max(0.0, min(1.0, 1.0 - dev / e2e)) * 100.0
+                              if e2e > 0 else 0.0),
+            "unattributed_s": una,
+            "unattributed_pct": 100.0 * una / e2e if e2e > 0 else 0.0,
+            "phases": phases,
+        })
+    rows.sort(key=lambda r: -r["e2e_s"])
+    t0, t1 = first.get("ts", 0.0), last.get("ts", 0.0)
+    win_s = h1.get("window_s", 1.0)
+    wins = [w for w in h1.get("windows", ())
+            if t0 - win_s < w.get("ts", -1.0 - win_s) <= t1]
+    we2e = sum(w.get("e2e_s", 0.0) for w in wins)
+    wdev = sum(w.get("device_s", 0.0) for w in wins)
+    return {
+        "digests": rows,
+        "window_stmts": sum(w.get("stmts", 0) for w in wins),
+        "window_e2e_s": we2e,
+        "window_device_s": wdev,
+        "window_chip_idle_pct": (
+            max(0.0, min(1.0, 1.0 - wdev / we2e)) * 100.0
+            if we2e > 0 else 0.0),
+        "window_unattributed_s": sum(w.get("unattributed_s", 0.0)
+                                     for w in wins),
+    }
+
+
 def render(first: dict, last: dict, top: int) -> dict:
     restarted = detect_restart(first, last)
     base = first
@@ -325,6 +383,7 @@ def render(first: dict, last: dict, top: int) -> dict:
     sysd = {k: sys1[k] - sys0.get(k, 0) for k in sys1
             if sys1[k] != sys0.get(k, 0)}
     sat = saturation(first, last, restarted)
+    htax = diff_host_tax(first, last, restarted)
 
     interval = last["ts"] - first["ts"]
     w = print
@@ -395,6 +454,26 @@ def render(first: dict, last: dict, top: int) -> dict:
         w("  (no timeline buckets in window — serving timeline disabled "
           "or dump predates it)")
     w("")
+    w("Host tax (window):")
+    if htax["digests"]:
+        w(f"  chip idle {htax['window_chip_idle_pct']:.1f}% over "
+          f"{htax['window_stmts']} stmts "
+          f"({htax['window_e2e_s'] * 1e3:.1f}ms e2e, "
+          f"{htax['window_device_s'] * 1e3:.1f}ms on device, "
+          f"{htax['window_unattributed_s'] * 1e3:.1f}ms unattributed)")
+        for r in htax["digests"][:top]:
+            w(f"  x{r['count']:<6} e2e={_us(r['e2e_s'])}us "
+              f"idle={r['chip_idle_pct']:.0f}% "
+              f"unattr={r['unattributed_pct']:.1f}%  "
+              f"{str(r['digest'])[:70]}")
+            worst = sorted(r["phases"].items(), key=lambda kv: -kv[1])
+            for name, sec in worst[:4]:
+                w(f"      {name:<18} {_us(sec):>8}us "
+                  f"({100.0 * sec / r['e2e_s'] if r['e2e_s'] else 0:.0f}%)")
+    else:
+        w("  (no host-tax ledgers folded in window — enable_host_tax "
+          "off or dump predates it)")
+    w("")
     folds = sysd.get("stmt summary folds", 0)
     if folds:
         w(f"Repository overhead: {sysd.get('stmt summary fold ns', 0) / folds:.0f}"
@@ -407,6 +486,7 @@ def render(first: dict, last: dict, top: int) -> dict:
         "interval_s": interval,
         "restarted": restarted,
         "saturation": sat,
+        "host_tax": htax,
         "top_digests": by_total,
         "top_p99_digests": by_p99,
         "hot_tables": tables,

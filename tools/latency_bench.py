@@ -10,6 +10,9 @@ parameterized statements through a real DbSession and reports:
   - the serving-phase breakdown (fastparse / bind / dispatch / fetch) from
     the sql_audit ring, i.e. exactly what `select ... from
     __all_virtual_sql_audit` shows a DBA;
+  - the full-statement host-tax waterfall (per-phase mean us, chip-idle %,
+    unattributed residual) from the conservation ledger behind
+    __all_virtual_host_tax, per workload and per serve leg;
   - the fast-path hit rate over the timed (warm) window;
   - an A/B against the same statements with the text tier disabled
     (plan_cache.fast_enabled = False): the full tokenize/parse/plan path
@@ -153,6 +156,46 @@ def phase_breakdown(db, n: int) -> dict:
     }
 
 
+def ledger_waterfall(db, before: dict) -> dict:
+    """Mean per-statement host-tax waterfall since `before` (a
+    db.host_tax.snapshot()): every e2e nanosecond in a named phase or
+    the explicit unattributed residual — the full-statement complement
+    to the audit-ring engine spans, straight from the conservation
+    ledger behind __all_virtual_host_tax."""
+    reg = getattr(db, "host_tax", None)
+    if reg is None or not reg.enabled:
+        return {}
+    b = before.get("digests", {})
+    n = 0
+    e2e = dev = una = 0.0
+    phases: dict = {}
+    for dig, a in reg.snapshot()["digests"].items():
+        z = b.get(dig, {})
+        dn = a["count"] - z.get("count", 0)
+        if dn <= 0:
+            continue
+        n += dn
+        e2e += a["e2e_s"] - z.get("e2e_s", 0.0)
+        dev += a["device_s"] - z.get("device_s", 0.0)
+        una += a["unattributed_s"] - z.get("unattributed_s", 0.0)
+        zp = z.get("phases", {})
+        for k, v in a["phases"].items():
+            d = v - zp.get(k, 0.0)
+            if d > 0.0:
+                phases[k] = phases.get(k, 0.0) + d
+    if not n or e2e <= 0.0:
+        return {}
+    return {
+        "stmts": n,
+        "e2e_us": round(e2e / n * 1e6, 1),
+        "chip_idle_pct": round(
+            max(0.0, min(1.0, 1.0 - dev / e2e)) * 100.0, 2),
+        "unattributed_pct": round(100.0 * una / e2e, 3),
+        "phases_us": {k: round(v / n * 1e6, 1) for k, v in
+                      sorted(phases.items(), key=lambda kv: -kv[1])},
+    }
+
+
 def pretrace_buckets(db, max_size: int) -> None:
     """Pre-trace every pow2 bucket executable a leg can touch: a
     straggler lane forms a partial batch whose bucket would otherwise
@@ -260,6 +303,7 @@ def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
     # every worker is idle between the barriers: snapshot cleanly
     c0 = db.metrics.counters_snapshot()
     compiles0 = db.engine.executor.batched_compiles
+    ht0 = db.host_tax.snapshot() if getattr(db, "host_tax", None) else {}
     b_measure.wait()
     t_start = time.perf_counter()
     cpu_start = time.process_time()
@@ -302,6 +346,10 @@ def run_serve_leg(db, nsessions: int, seconds: float, wait_us: int,
         else 0.0,
         "batched_compiles": (db.engine.executor.batched_compiles
                              - compiles0),
+        # where the leg's milliseconds went, from the conservation
+        # ledger: mean per-statement phase waterfall + chip idle over
+        # the measured window (includes window-wait for batch followers)
+        "host_tax": ledger_waterfall(db, ht0),
     }
     return out
 
@@ -884,6 +932,8 @@ def main() -> int:
         run_stmts(sess, stmts[:args.warmup])
         st = db.plan_cache.stats
         h0, m0 = st.fast_hits, st.fast_misses
+        ht0 = (db.host_tax.snapshot()
+               if getattr(db, "host_tax", None) else {})
         lat = run_stmts(sess, stmts)
         hits, misses = st.fast_hits - h0, st.fast_misses - m0
         rate = hits / max(hits + misses, 1)
@@ -893,6 +943,7 @@ def main() -> int:
             **percentiles(lat),
             "warm_fast_hit_rate": round(rate, 4),
             "phases": phase_breakdown(db, len(stmts)),
+            "host_tax": ledger_waterfall(db, ht0),
         }
         if rate < 1.0:
             strict_ok = False

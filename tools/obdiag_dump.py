@@ -13,6 +13,9 @@ given a Database it collects
   - the system_event wait classes,
   - the trace-span ring,
   - the active config snapshot,
+  - the host-tax registry (per-digest phase breakdown + chip-idle
+    windows) and the stack sampler's collapsed stacks (each
+    flight-recorder bundle also embeds its statement's own ledger),
 
 and writes them as one JSON document.
 
@@ -63,6 +66,20 @@ def collect(db) -> dict:
             for s in spans
         ],
         "config": {n: v for n, v, _p in db.config.snapshot()},
+        # where do the milliseconds go: per-digest conservation ledger
+        # rows (sorted by total wall) + the recent chip-idle windows,
+        # and whatever the stack sampler caught while armed
+        "host_tax": {
+            "digests": (db.host_tax.rows()
+                        if getattr(db, "host_tax", None) is not None
+                        else []),
+            "windows": (db.host_tax.snapshot().get("windows", [])
+                        if getattr(db, "host_tax", None) is not None
+                        else []),
+        },
+        "stack_samples": (db.stack_sampler.snapshot()
+                          if getattr(db, "stack_sampler", None) is not None
+                          else {}),
         "long_ops": [
             {
                 "op_id": o.op_id,
@@ -105,6 +122,8 @@ def main():
         "flight_bundles": len(bundle["flight_recorder"]),
         "trace_spans": len(bundle["trace_spans"]),
         "counters": len(bundle["sysstat"]["counters"]),
+        "host_tax_digests": len(bundle["host_tax"]["digests"]),
+        "stack_samples": bundle["stack_samples"].get("samples", 0),
     }, indent=2))
 
 

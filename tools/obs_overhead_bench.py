@@ -22,11 +22,14 @@ the serving workload its 2%% budget is written against (`--sessions
 32`) — plus (3) the background storage scrubber OFF then ON against a
 data-dir-backed, checkpointed db, with a helper thread driving
 back-to-back scrub passes through the whole ON leg
-(`serve_scrub.scrub_overhead_pct`). The gated overhead is the median
-paired delta in process CPU per statement (see _serve_ab for why,
-paired throughput reported as context); --strict-pct P exits 1 if any
-overhead exceeds P, the timeline ring outgrew its capacity, or the
-scrub A/B ran zero passes.
+(`serve_scrub.scrub_overhead_pct`) — plus (4) the host-tax gap ledger
+OFF then ON (`serve_hosttax.hosttax_overhead_pct`), with an ungated
+context leg serving under a continuously-armed stack sampler. The
+gated overhead is the median paired delta in process CPU per
+statement (see _serve_ab for why, paired throughput reported as
+context); --strict-pct P exits 1 if any overhead exceeds P, the
+timeline ring outgrew its capacity, the scrub A/B ran zero passes, or
+the host-tax A/B folded zero ledgers.
 
 Prints a small JSON report. The warmup pass compiles every plan first,
 so all timed passes measure pure host dispatch + cached execution —
@@ -71,6 +74,10 @@ def set_sql_stat(db, on: bool) -> None:
 
 def set_timeline(db, on: bool) -> None:
     db.config.set("enable_serving_timeline", "true" if on else "false")
+
+
+def set_host_tax(db, on: bool) -> None:
+    db.config.set("enable_host_tax", "true" if on else "false")
 
 
 def timed_pass(session, iters: int) -> dict:
@@ -267,6 +274,52 @@ def serve_timeline_ab(sessions: int, seconds: float, reps: int) -> dict:
     }
 
 
+def serve_hosttax_ab(sessions: int, seconds: float, reps: int) -> dict:
+    """Host-tax gap ledger OFF vs ON under the same closed-loop serving
+    load — the measurement the ledger's 2%% serving budget is written
+    against (per-statement GapLedger + per-phase wait events + registry
+    fold all ride the ON leg). A third, ungated context leg re-runs the
+    serving loop with the stack sampler armed continuously at its
+    configured interval: the sampler is off by default in production,
+    so its cost is reported, not budgeted."""
+    import latency_bench as LB
+
+    db, _ = LB.build_db(2000)
+    best = _serve_ab(db, set_host_tax, sessions, seconds, reps)
+    snap = db.host_tax.snapshot()
+    out = {
+        "sessions": sessions,
+        "leg_seconds": seconds,
+        "reps": reps,
+        "off_stmts_per_sec": best["off"],
+        "on_stmts_per_sec": best["on"],
+        "hosttax_overhead_pct": best["overhead_pct"],
+        "rep_cpu_overheads_pct": best["rep_cpu_overheads_pct"],
+        "tput_overhead_pct": best["tput_overhead_pct"],
+        # evidence the ON legs actually folded ledgers
+        "digests": len(snap["digests"]),
+        "hosttax_statements": db.metrics.counter("host tax statements"),
+        "window_chip_idle_pct": round(db.host_tax.window_chip_idle_pct(), 2),
+    }
+    # sampler-armed context leg (NOT gated): continuous stack sampling
+    # during one serving leg, vs the ledger-on legs above
+    db.config.set("enable_stack_sampler", "true")
+    try:
+        leg = LB.run_serve_leg(db, sessions, seconds, wait_us=1000,
+                               max_size=16, batching=True)
+    finally:
+        db.config.set("enable_stack_sampler", "false")
+    ss = db.stack_sampler.snapshot()
+    out["sampler_leg"] = {
+        "stmts_per_sec": leg["stmts_per_sec"],
+        "cpu_us_per_stmt": leg["cpu_us_per_stmt"],
+        "samples": ss["samples"],
+        "dropped": ss["dropped"],
+        "distinct_stacks": ss["distinct"],
+    }
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("iters", nargs="?", type=int, default=200)
@@ -345,8 +398,17 @@ def main() -> int:
         sc = serve_scrub_ab(args.sessions, args.serve_seconds,
                             args.serve_reps)
         report["serve_scrub"] = sc
+        ht = serve_hosttax_ab(args.sessions, args.serve_seconds,
+                              args.serve_reps)
+        report["serve_hosttax"] = ht
         if args.strict_pct is not None:
             fails = []
+            if ht["hosttax_overhead_pct"] > args.strict_pct:
+                fails.append(
+                    f"serve host-tax overhead "
+                    f"{ht['hosttax_overhead_pct']}%")
+            if ht["hosttax_statements"] == 0:
+                fails.append("host-tax A/B folded zero ledgers")
             if serve["summary_overhead_pct"] > args.strict_pct:
                 fails.append(
                     f"serve summary overhead "

@@ -6,7 +6,7 @@
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
 #                      [--advisor] [--warmboot] [--elastic] [--oom] [--mesh]
-#                      [--stream] [--scrub] [extra pytest args...]
+#                      [--stream] [--scrub] [--hosttax] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -101,6 +101,13 @@
 # restart returns rows bit-identical to the in-memory model; the JSON
 # artifact (with bench_meta provenance) lands in $BENCH_OUT when set.
 #
+# --hosttax additionally runs the host-tax ledger smoke
+# (tools/hosttax_smoke.py): warm fast-path point reads and a warm Q6
+# aggregate must keep conservation exact (sum(phases) + unattributed ==
+# e2e), the median warm residual under 5%, every phase's median share
+# under its frozen budget, and the VT/sysstat/audit surfaces live; the
+# last stdout line is the JSON verdict.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -124,6 +131,7 @@ oom=0
 mesh=0
 stream=0
 scrub=0
+hosttax=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -138,6 +146,7 @@ while true; do
         --mesh) mesh=1; shift ;;
         --stream) stream=1; shift ;;
         --scrub) scrub=1; shift ;;
+        --hosttax) hosttax=1; shift ;;
         *) break ;;
     esac
 done
@@ -223,6 +232,11 @@ fi
 
 if [ "$scrub" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_bench.py --disk
+    rc=$?
+fi
+
+if [ "$hosttax" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/hosttax_smoke.py
     rc=$?
 fi
 exit $rc
