@@ -3143,6 +3143,12 @@ class Executor:
         prepared = PreparedPlan(
             self, plan, params, jitted, input_spec, overflow_nodes)
         prepared.access_profile = access
+        # optimizer estimates pinned at compile time: the calibration
+        # half of every (estimate, actual) pair the operator profiler
+        # records (engine/plan_profile.py)
+        from ..sql.planner import capture_node_estimates
+
+        prepared.node_estimates = capture_node_estimates(self, plan)
         return prepared
 
     def execute(self, plan: LogicalOp, max_retries: int = 3):
@@ -3286,6 +3292,10 @@ class PreparedPlan:
         # once this plan has an on-disk artifact.
         self._traceable = True
         self.artifact_ref = None
+        # compile-time optimizer row estimates per node id (filled by
+        # prepare(); restored from ArtifactMeta on warm hydrate) — the
+        # estimate half of the operator profiler's calibration pairs
+        self.node_estimates: dict[int, int] = {}
 
     def bind(self, values, dtypes):
         """Values -> the dispatch form (one packed int64 vector when the

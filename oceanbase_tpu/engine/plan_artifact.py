@@ -111,6 +111,10 @@ class ArtifactMeta:
     mesh_sig: tuple = ()
     px_exchanges: list | None = None
     mesh_plan: object = None
+    # compile-time optimizer row estimates per node id: a warm-booted
+    # plan must profile against the estimates it was COMPILED with, or
+    # its (estimate, actual) calibration pairs drift with later stats
+    node_estimates: dict | None = None
 
 
 class _WarmExecutable:
@@ -126,7 +130,12 @@ class _WarmExecutable:
         self._avals = avals
         self._proto = proto
 
-    def __call__(self, inputs, qparams):
+    def validate(self, inputs, qparams):
+        """Raise ArtifactStale on any input-signature drift. Exposed so
+        paths that DON'T dispatch through __call__ — the operator
+        profiler's segmented run traces fresh stages over whatever
+        shapes arrive — can still detect a stale artifact and refresh
+        it instead of silently serving past it forever."""
         leaves = jax.tree_util.tree_leaves((inputs, qparams))
         if len(leaves) != len(self._avals):
             raise ArtifactStale("input leaf count drift")
@@ -134,6 +143,10 @@ class _WarmExecutable:
             if tuple(jnp.shape(a)) != tuple(shp) \
                     or str(jnp.result_type(a)) != dt:
                 raise ArtifactStale("input aval drift")
+        return leaves
+
+    def __call__(self, inputs, qparams):
+        leaves = self.validate(inputs, qparams)
         out_leaves = self._compiled(*leaves)
         return rebuild_output(self._proto, out_leaves)
 
@@ -494,6 +507,8 @@ class PlanArtifactStore:
                 px_exchanges=list(
                     getattr(prepared, "px_exchanges", None) or []),
                 mesh_plan=getattr(prepared, "mesh_plan", None),
+                node_estimates=dict(
+                    getattr(prepared, "node_estimates", None) or {}),
             )
             meta_blob = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
@@ -608,6 +623,8 @@ class PlanArtifactStore:
             meta.overflow_nodes = list(prepared.overflow_nodes)
             meta.in_avals = avals
             meta.out_proto = proto
+            meta.node_estimates = dict(
+                getattr(prepared, "node_estimates", None) or {})
             meta_blob = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
             self._note("plan artifact export error")
@@ -781,6 +798,8 @@ class PlanArtifactStore:
         prepared._traceable = False
         prepared.artifact_ref = (self, aid)
         prepared._art_proto = meta.out_proto
+        prepared.node_estimates = dict(
+            getattr(meta, "node_estimates", None) or {})
         if meta.px_nsh:
             prepared.px_nsh = meta.px_nsh
             # the exchange layout and mesh plan were captured at save

@@ -164,6 +164,14 @@ class Session:
         # logical plan of the LAST run_ast call (flight-recorder bundles
         # capture its repr as the plan text)
         self.last_plan = None
+        # hook: engine/plan_profile.PlanProfiler — sampled per-operator
+        # profiled execution (the server wires it and sets the pending
+        # statement digest before dispatch)
+        self.plan_profiler = None
+        # per-operator profile of the LAST profiled run_ast call (EXPLAIN
+        # ANALYZE reads it to annotate the plan tree); None when the
+        # statement was not profiled
+        self.last_op_profile = None
 
     def materialize(self, text: str, name: str) -> Table:
         """Run a SELECT and materialize its result as a storage-domain
@@ -550,10 +558,40 @@ class Session:
         fetch_s = 0.0
         exec_t0 = time.perf_counter()
         lazy = hasattr(prepared, "run_device") and not jn
+        self.last_op_profile = None
+        op_samples = prof_digest = prof_reason = None
         if lazy:
             from .executor import DeviceResult
 
-            out, ovf_vec = prepared.run_device(qparams=qparams)
+            pp = self.plan_profiler
+            if pp is not None and pp.enabled:
+                from . import plan_profile as _PP
+
+                if _PP.profile_eligible(prepared):
+                    # the server layer hands the statement digest down
+                    # thread-locally; direct engine use falls back to the
+                    # monitor's normalized text as the sampling key
+                    mon0 = getattr(entry, "monitor", None)
+                    prof_digest = pp.take_pending() or (
+                        mon0.sql if mon0 is not None else None)
+                    if prof_digest is not None:
+                        prof_reason = pp.decide(prof_digest)
+            out = None
+            if prof_reason is not None:
+                from . import plan_profile as _PP
+
+                try:
+                    # profiled segmented run: fenced per-operator stages,
+                    # bit-identical (out, ovf_vec) — the statement is
+                    # served FROM this run, nothing executes twice
+                    out, ovf_vec, op_samples = _PP.run_profiled(
+                        prepared, qparams)
+                except Exception:
+                    # a broken profile never fails the statement — fall
+                    # back to the fused dispatch below
+                    out = None
+            if out is None:
+                out, ovf_vec = prepared.run_device(qparams=qparams)
             dispatch_s = time.perf_counter() - exec_t0
             cursor = DeviceResult(prepared, qparams, out, ovf_vec)
             rs = LazyResultSet(entry.output_names, cursor,
@@ -728,6 +766,32 @@ class Session:
                 h2d_d, overlap_d = stream_d[3], stream_d[5]
                 mon.h2d_overlap_pct = (
                     100.0 * overlap_d / h2d_d if h2d_d else 0.0)
+        if op_samples is not None and self.plan_profiler is not None:
+            # fold the (estimate, actual) calibration pairs into the
+            # bounded store + per-op-kind sysstat counters; EXPLAIN
+            # ANALYZE reads last_op_profile right after this run
+            est = getattr(prepared, "node_estimates", None)
+            self.plan_profiler.store.fold(
+                prof_digest, op_samples, est,
+                plan_id=mon.plan_id if mon is not None else 0,
+            )
+            seg = getattr(prepared, "_segmented", None)
+            self.last_op_profile = {
+                "digest": prof_digest,
+                "reason": prof_reason,
+                "estimates": dict(est or {}),
+                "samples": op_samples,
+                # plan nodes the executor never emits standalone (e.g.
+                # a Join absorbed by a clustered-FK aggregate): no
+                # sample, charged to the absorbing parent
+                "absorbed": dict(getattr(seg, "absorbed", None) or {}),
+            }
+            pm = self.metrics
+            if pm is not None and pm.enabled:
+                pm.add("plan profiles")
+                pm.add(f"plan profiles: {prof_reason}")
+                for s in op_samples:
+                    pm.add(f"plan profile ops: {s.op_kind}")
         m = self.metrics
         if m is not None and m.enabled:
             m.observe("sql plan", plan_s)

@@ -521,6 +521,28 @@ class Database:
             "stack_sampler_interval",
             lambda _n, _o, v: setattr(self.stack_sampler, "interval_s",
                                       max(1e-4, v)))
+        # operator-level plan telemetry (engine/plan_profile.py): sampled
+        # per-operator profiled execution folding (estimate, actual)
+        # calibration pairs into the bounded store — per-operator rows in
+        # __all_virtual_sql_plan_monitor, EXPLAIN ANALYZE annotations,
+        # awr_report hot operators, and the misestimate sentinel rule
+        from ..engine.plan_profile import OperatorProfileStore, PlanProfiler
+
+        self.plan_profiler = PlanProfiler(
+            store=OperatorProfileStore(
+                max_digests=self.config["ob_plan_profile_max_digests"]),
+            sample_every=self.config["ob_plan_profile_sample"])
+        self.plan_profiler.enabled = self.config["enable_plan_profile"]
+        self.config.on_change(
+            "enable_plan_profile",
+            lambda _n, _o, v: setattr(self.plan_profiler, "enabled", v))
+        self.config.on_change(
+            "ob_plan_profile_sample",
+            lambda _n, _o, v: setattr(self.plan_profiler, "sample_every",
+                                      int(v)))
+        self.config.on_change(
+            "ob_plan_profile_max_digests",
+            lambda _n, _o, v: self.plan_profiler.store.set_max_digests(v))
         # workload repository (server/workload.py): digest-keyed statement
         # summaries + table/column access heat folded at statement
         # completion, bounded AWR-style snapshots on demand or periodic
@@ -746,6 +768,9 @@ class Database:
         )
         # workload access heat folds per execution inside the engine
         self.engine.access = self.access
+        # sampled per-operator profiling decisions + calibration folds
+        # happen inside the engine's dispatch (engine/plan_profile.py)
+        self.engine.plan_profiler = self.plan_profiler
         # serving timeline feeds: engine dispatches (device busy +
         # compile interference), executor uploads (transfer
         # interference), batcher dispatches (occupancy) — server-side
@@ -2570,6 +2595,13 @@ class DbSession:
                 if self._gap is not None:
                     # tracer span + ASH activity registration glue
                     self._gap.cut("setup")
+                pp = db.plan_profiler
+                if pp is not None and pp.enabled:
+                    # hand the statement digest to the engine's operator
+                    # profiler (memoized text->digest: one dict lookup on
+                    # warm statements) so sampling, EXPLAIN ANALYZE
+                    # forcing and slow-query marks all key identically
+                    pp.set_pending(self._digest_of(text))
                 try:
                     rs = self._run_with_retries(text)
                 except Exception as e:
@@ -2578,6 +2610,8 @@ class DbSession:
                         db.metrics.add("statement timeouts")
                     raise
                 finally:
+                    if pp is not None:
+                        pp.clear_pending()
                     elapsed_s = _time.perf_counter() - t0
                     stype = self._last_stmt_type or "Unknown"
                     m = db.metrics
@@ -2854,14 +2888,32 @@ class DbSession:
             }
             for depth, s in db.tracer.trace_tree(sp.trace_id)
         ]
+        digest = (self._fast_reg[0] if self._fast_reg is not None
+                  else P.digest_text(text))
+        pp = db.plan_profiler
+        op_profile: list = []
+        if pp is not None and pp.enabled:
+            # arm the operator profiler: the NEXT occurrence of this slow
+            # digest runs profiled, so a recurring slow statement's later
+            # bundles carry per-operator evidence — and whatever profile
+            # the store already holds rides THIS bundle now. UNLESS this
+            # very run already carried a profile: a profiled run is
+            # slower (fences), so re-arming on its own slowness would
+            # lock a watermark-straddling digest into profiling forever
+            opp = db.engine.last_op_profile
+            if opp is None or opp.get("digest") != digest:
+                pp.mark_slow(digest)
+            op_profile = pp.store.digest_profile(digest)
         bundle = {
             "trace_id": sp.trace_id,
             "session_id": self.session_id,
             "sql": text,
             # same digest the statement summary folded under — a bundle
             # joins its aggregate without re-normalizing
-            "digest": (self._fast_reg[0] if self._fast_reg is not None
-                       else P.digest_text(text)),
+            "digest": digest,
+            # per-operator calibration records for this digest (est vs
+            # actual rows, device_us) from engine/plan_profile.py
+            "op_profile": op_profile,
             "stmt_type": self._last_stmt_type,
             "elapsed_s": elapsed_s,
             "rows": rs.nrows if rs is not None else 0,
@@ -3475,13 +3527,31 @@ class DbSession:
                         self.db._invalidate(n)
         if analyze:
             engine.last_phases = {}
+            engine.last_op_profile = None
+            pp = self.db.plan_profiler
+            if pp is not None and pp.enabled:
+                # EXPLAIN ANALYZE always profiles: force exactly one
+                # profiled (segmented, fenced) run of the ANALYZED
+                # statement's digest — re-point the pending digest too
+                # (the one set at statement start named the outer
+                # EXPLAIN text, not the inner select)
+                d_inner = self._digest_of(text)
+                pp.force_next(d_inner)
+                pp.set_pending(d_inner)
+            ta = _time.perf_counter()
             rs = self._select(ast, P.normalize_for_cache(text)[0])
+            wall_s = _time.perf_counter() - ta
             ph = engine.last_phases
 
             def us(s: float) -> int:
                 return int(s * 1e6)
 
+            opp = engine.last_op_profile
             lines = list(lines)
+            if opp is not None:
+                from ..sql.explain import annotate_plan_lines
+
+                lines = annotate_plan_lines(lines, opp)
             lines.append("")
             hit = "hit" if ph.get("cache_hit") else "miss"
             lines.append(
@@ -3492,6 +3562,17 @@ class DbSession:
                 lines.append(f"  phase plan:    {us(ph['plan_s'])} us")
                 lines.append(f"  phase compile: {us(ph['compile_s'])} us")
                 lines.append(f"  phase execute: {us(ph['exec_s'])} us")
+            if opp is not None and wall_s > 0:
+                # the host-tax view on the same report: how much of the
+                # analyzed statement's e2e wall the chip actually worked
+                # (device time = the profile's fenced per-operator sum)
+                dev_s = sum(
+                    s.device_us for s in opp["samples"]) / 1e6
+                idle = max(0.0, wall_s - dev_s) / wall_s * 100.0
+                lines.append(
+                    f"  chip_idle_pct: {idle:.1f} "
+                    f"(device {us(dev_s)} us of {us(wall_s)} us e2e)"
+                )
         return ResultSet(("plan",), {"plan": lines})
 
     # ------------------------------------------------------------------ XA
@@ -4288,6 +4369,16 @@ class DbSession:
                 raise d._error() from e
             raise _R.PxAdmissionTimeout(str(e)) from e
 
+    def _digest_of(self, text: str) -> str:
+        """Memoized statement digest (same key the workload summary,
+        host-tax ledger and flight recorder fold under)."""
+        digest = self._digest_memo.get(text)
+        if digest is None:
+            if len(self._digest_memo) >= 256:
+                self._digest_memo.clear()
+            digest = self._digest_memo[text] = P.digest_text(text)
+        return digest
+
     def _reserve_estimate(self, text: str) -> int:
         """Peak-device-bytes estimate for the admission reservation:
         the workload repository's measured per-digest peak when this
@@ -4298,12 +4389,7 @@ class DbSession:
         low = text.lstrip().lower()
         if not low.startswith(("select", "with", "(")):
             return 0
-        digest = self._digest_memo.get(text)
-        if digest is None:
-            if len(self._digest_memo) >= 256:
-                self._digest_memo.clear()
-            digest = self._digest_memo[text] = P.digest_text(text)
-        measured = db.stmt_summary.peak_estimate(digest)
+        measured = db.stmt_summary.peak_estimate(self._digest_of(text))
         if measured > 0:
             return measured
         return int(db.config["ob_governor_cold_reserve"])

@@ -30,7 +30,12 @@ sentinel test replays a recorded pair and asserts the exact alert set):
   storage_corruption — checksum failures detected inside the window
       (scrubber or read path); critical when corruption is sitting
       UNREPAIRED at the window end, warn when every detection was
-      repaired (quarantine + rewrite/rebuild/recompute).
+      repaired (quarantine + rewrite/rebuild/recompute);
+  cardinality_misestimate — an operator's window-average actual
+      cardinality diverged >= miss_ratio x from the optimizer's
+      compile-time estimate (plan-profile calibration records);
+      edge-triggered per (digest, node), critical when the
+      misestimated operator also tops window device time.
 
 Evaluating the same window twice never duplicates an alert: the dedup
 key is (rule, subject key, window-ending snap_id).
@@ -83,6 +88,9 @@ class SentinelConfig:
     govr_min_degraded: int = 1
     # storage_corruption: checksum failures in window to fire at all
     corruption_min_failures: int = 1
+    # cardinality_misestimate: window miss factor + executions floor
+    miss_ratio: float = 8.0
+    miss_min_execs: int = 5
 
 
 @dataclass
@@ -445,6 +453,82 @@ def _rule_storage_corruption(first, last, cfg, out) -> None:
     })
 
 
+def _rule_cardinality_misestimate(first, last, cfg, out) -> None:
+    """An operator's WINDOW-average actual cardinality diverged >=
+    miss_ratio x from the optimizer's compile-time estimate, with
+    enough window executions (profiled samples) to trust the average.
+    Reads the plan-profile calibration records workload snapshots embed
+    (engine/plan_profile.OperatorProfileStore.snapshot). Edge-triggered
+    per (digest, node): a record that was ALREADY misestimated at the
+    window start stays silent — one alert per divergence, not one per
+    window — until a recompile/eviction resets its estimate. Critical
+    when the misestimated operator also tops window device time: the
+    worst estimate is sitting on the hottest operator."""
+    from ..engine.plan_profile import miss_factor
+
+    p0 = (first.get("plan_profile") or {}).get("digests") or {}
+    p1 = (last.get("plan_profile") or {}).get("digests") or {}
+    if not p1:
+        return
+
+    def window(digest, nid, rec):
+        r0 = (p0.get(digest) or {}).get(nid) or {}
+        execs = (int(rec.get("executions", 0))
+                 - int(r0.get("executions", 0)))
+        rows = int(rec.get("rows", 0)) - int(r0.get("rows", 0))
+        dev = (float(rec.get("device_us", 0.0))
+               - float(r0.get("device_us", 0.0)))
+        return execs, rows, dev
+
+    hot = None  # (digest, nid) with the most window device time
+    hot_dev = 0.0
+    cand = []
+    for digest, nodes in p1.items():
+        for nid, rec in nodes.items():
+            execs, rows, dev = window(digest, nid, rec)
+            if dev > hot_dev:
+                hot_dev, hot = dev, (digest, nid)
+            if execs < cfg.miss_min_execs:
+                continue
+            est = rec.get("est_rows", 0)
+            avg = rows / execs
+            mf = miss_factor(est, avg)
+            if mf < cfg.miss_ratio:
+                continue
+            r0 = (p0.get(digest) or {}).get(nid)
+            if (r0 is not None
+                    and int(r0.get("executions", 0)) >= cfg.miss_min_execs
+                    and miss_factor(r0.get("est_rows", 0),
+                                    r0.get("avg_rows", 0.0))
+                    >= cfg.miss_ratio):
+                continue  # was already misestimated at the window start
+            cand.append((digest, nid, rec, execs, est, avg, mf, dev))
+    for digest, nid, rec, execs, est, avg, mf, dev in cand:
+        tops = (digest, nid) == hot
+        out.append({
+            "rule": "cardinality_misestimate",
+            "severity": "critical" if tops else "warn",
+            "key": f"{digest}#{nid}",
+            "summary": (
+                f"node {nid} ({rec.get('op_kind', '?')}) of "
+                f"{digest[:60]}: est {int(est)} vs actual {avg:.0f} rows "
+                f"({mf:.1f}x miss over {execs} profiled execs)"
+                + (", tops window device time" if tops else "")),
+            "evidence": {
+                "digest": digest,
+                "node_id": int(nid) if str(nid).lstrip("-").isdigit()
+                else nid,
+                "op_kind": rec.get("op_kind", ""),
+                "est_rows": int(est),
+                "window_avg_rows": avg,
+                "miss_factor": mf,
+                "window_executions": execs,
+                "window_device_us": dev,
+                "tops_window_device_time": tops,
+            },
+        })
+
+
 _RULES = (
     _rule_digest_regression,
     _rule_error_retry,
@@ -455,6 +539,7 @@ _RULES = (
     _rule_replica_unreachable,
     _rule_device_memory_pressure,
     _rule_storage_corruption,
+    _rule_cardinality_misestimate,
 )
 
 

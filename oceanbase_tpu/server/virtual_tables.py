@@ -146,38 +146,93 @@ def _host_tax(db) -> Table:
 
 
 def _plan_monitor(db) -> Table:
-    es = db.plan_monitor.entries()
-    return _t("__all_virtual_sql_plan_monitor", [
-        ("plan_id", DataType.int64(), [e.plan_id for e in es]),
-        ("query_sql", DataType.varchar(), [e.sql for e in es]),
-        ("compile_us", DataType.int64(), [int(e.compile_s * 1e6) for e in es]),
-        ("executions", DataType.int64(), [e.runs for e in es]),
-        ("total_exec_us", DataType.int64(),
-         [int(e.total_exec_s * 1e6) for e in es]),
-        ("avg_exec_us", DataType.int64(), [int(e.avg_exec_s * 1e6) for e in es]),
-        ("last_rows", DataType.int64(), [e.last_rows for e in es]),
-        ("overflow_retries", DataType.int64(), [e.overflow_retries for e in es]),
-        ("total_transfer_bytes", DataType.int64(),
-         [e.total_transfer_bytes for e in es]),
-        ("last_device_bytes", DataType.int64(),
-         [e.last_device_bytes for e in es]),
-        ("peak_bytes", DataType.int64(), [e.peak_bytes for e in es]),
+    """Plan monitor, reworked per-operator: every PlanMonitorEntry keeps
+    its plan-level row (node_id = -1, operator columns zeroed), and every
+    profiled plan additionally emits ONE ROW PER OPERATOR from the
+    calibration store (engine/plan_profile.py) — node_id, op_kind,
+    est_rows vs actual_rows with the misestimation factor, fenced device
+    time and output bytes, keyed by the statement digest in query_sql."""
+    rows: list[dict] = []
+    for e in db.plan_monitor.entries():
+        rows.append({
+            "plan_id": e.plan_id, "query_sql": e.sql,
+            "node_id": -1, "op_kind": "",
+            "compile_us": int(e.compile_s * 1e6), "executions": e.runs,
+            "total_exec_us": int(e.total_exec_s * 1e6),
+            "avg_exec_us": int(e.avg_exec_s * 1e6),
+            "last_rows": e.last_rows,
+            "overflow_retries": e.overflow_retries,
+            "total_transfer_bytes": e.total_transfer_bytes,
+            "last_device_bytes": e.last_device_bytes,
+            "peak_bytes": e.peak_bytes,
+            "px_collective_ops": e.px_collective_ops,
+            "px_collective_bytes": e.px_collective_bytes,
+            "px_exchanges": e.px_exchanges,
+            "stream_chunks": e.stream_chunks,
+            "h2d_overlap_pct": round(e.h2d_overlap_pct, 3),
+            "spill_partitions": e.spill_partitions,
+            "est_rows": 0, "actual_rows": 0, "miss_factor": 0.0,
+            "device_us": 0, "out_bytes": 0, "op_executions": 0,
+        })
+    pp = getattr(db, "plan_profiler", None)
+    if pp is not None:
+        for r in pp.store.rows():
+            rows.append({
+                "plan_id": r["plan_id"], "query_sql": r["digest"],
+                "node_id": r["node_id"], "op_kind": r["op_kind"],
+                "compile_us": 0, "executions": r["executions"],
+                "total_exec_us": 0, "avg_exec_us": 0,
+                "last_rows": r["last_rows"], "overflow_retries": 0,
+                "total_transfer_bytes": 0, "last_device_bytes": 0,
+                "peak_bytes": 0, "px_collective_ops": 0,
+                "px_collective_bytes": 0, "px_exchanges": "",
+                "stream_chunks": 0, "h2d_overlap_pct": 0.0,
+                "spill_partitions": 0,
+                "est_rows": r["est_rows"],
+                "actual_rows": int(round(r["avg_rows"])),
+                "miss_factor": round(r["miss_factor"], 3),
+                "device_us": int(r["device_us"]),
+                "out_bytes": int(r["out_bytes"]),
+                "op_executions": r["executions"],
+            })
+    spec = [
+        ("plan_id", DataType.int64()),
+        ("query_sql", DataType.varchar()),
+        # per-operator identity: -1/"" on plan-level rows
+        ("node_id", DataType.int64()),
+        ("op_kind", DataType.varchar()),
+        ("compile_us", DataType.int64()),
+        ("executions", DataType.int64()),
+        ("total_exec_us", DataType.int64()),
+        ("avg_exec_us", DataType.int64()),
+        ("last_rows", DataType.int64()),
+        ("overflow_retries", DataType.int64()),
+        ("total_transfer_bytes", DataType.int64()),
+        ("last_device_bytes", DataType.int64()),
+        ("peak_bytes", DataType.int64()),
         # mesh-SPMD plans: how many XLA collectives each execution
         # dispatches, their byte capacity, and the exchange layout
         # ("all_to_all:2,psum:1"); zeros/empty for single-chip plans
-        ("px_collective_ops", DataType.int64(),
-         [e.px_collective_ops for e in es]),
-        ("px_collective_bytes", DataType.int64(),
-         [e.px_collective_bytes for e in es]),
-        ("px_exchanges", DataType.varchar(), [e.px_exchanges for e in es]),
+        ("px_collective_ops", DataType.int64()),
+        ("px_collective_bytes", DataType.int64()),
+        ("px_exchanges", DataType.varchar()),
         # streaming pipeline (engine/pipeline.py): chunks streamed through
         # the plan, last run's H2D/compute overlap percentage, grace-hash
         # partitions spilled; zeros for resident plans
-        ("stream_chunks", DataType.int64(), [e.stream_chunks for e in es]),
-        ("h2d_overlap_pct", DataType.float64(),
-         [round(e.h2d_overlap_pct, 3) for e in es]),
-        ("spill_partitions", DataType.int64(),
-         [e.spill_partitions for e in es]),
+        ("stream_chunks", DataType.int64()),
+        ("h2d_overlap_pct", DataType.float64()),
+        ("spill_partitions", DataType.int64()),
+        # operator calibration columns (engine/plan_profile.py):
+        # estimate vs measured cardinality + fenced device time
+        ("est_rows", DataType.int64()),
+        ("actual_rows", DataType.int64()),
+        ("miss_factor", DataType.float64()),
+        ("device_us", DataType.int64()),
+        ("out_bytes", DataType.int64()),
+        ("op_executions", DataType.int64()),
+    ]
+    return _t("__all_virtual_sql_plan_monitor", [
+        (name, dt, [r[name] for r in rows]) for name, dt in spec
     ])
 
 

@@ -795,6 +795,13 @@ def build_snapshot(db, snap_id: int, ts: float) -> dict:
         # (window)" section diffs two of these
         "host_tax": (db.host_tax.snapshot()
                      if getattr(db, "host_tax", None) is not None else {}),
+        # operator calibration store (engine/plan_profile.py): cumulative
+        # per-(digest, node) est-vs-actual records — awr_report's "Hot
+        # operators (window)" section and the cardinality_misestimate
+        # sentinel rule diff two of these
+        "plan_profile": (db.plan_profiler.store.snapshot()
+                         if getattr(db, "plan_profiler", None) is not None
+                         else {}),
     }
 
 

@@ -216,6 +216,20 @@ def default_params() -> list[Param]:
               "how long the sampler stays armed after a statement "
               "crosses trace_log_slow_query_watermark; 0 disables "
               "auto-arming", min=0.0),
+        # operator-level plan telemetry (engine/plan_profile.py)
+        Param("enable_plan_profile", "bool", True,
+              "sampled per-operator profiled execution: segmented fenced "
+              "stages yield device time / cardinality / bytes per plan "
+              "node as (estimate, actual) calibration pairs "
+              "(__all_virtual_sql_plan_monitor per-operator rows)"),
+        Param("ob_plan_profile_sample", "int", 64,
+              "profile every statement digest's first re-execution (one-"
+              "shot digests never pay a segmented trace), then 1-in-N of "
+              "its later executions; 0 = first re-execution only",
+              min=0, max=1 << 20),
+        Param("ob_plan_profile_max_digests", "int", 128,
+              "bounded count of per-digest operator calibration records",
+              min=1, max=1 << 16),
         Param("enable_health_sentinel", "bool", True,
               "evaluate health rules (latency regressions, starvation, "
               "compile storms...) on every workload snapshot"),

@@ -124,3 +124,37 @@ def explain_plan(executor, plan, params) -> list[str]:
 
     rec(plan, 0)
     return lines
+
+
+def annotate_plan_lines(lines, op_profile, miss_mark: float = 8.0
+                        ) -> list[str]:
+    """EXPLAIN ANALYZE: fold a profiled run's per-operator measurements
+    (engine/plan_profile.py, via Session.last_op_profile) into the plan
+    rendering. explain_plan emits exactly one line per operator in the
+    SAME pre-order _number_nodes assigns, so line i annotates node i:
+    est vs actual rows, the misestimation factor (`>>` marker at >=
+    miss_mark x) and the operator's fenced device time."""
+    from ..engine.plan_profile import miss_factor
+
+    samples = {s.node_id: s for s in op_profile.get("samples", ())}
+    est = op_profile.get("estimates", {})
+    absorbed = op_profile.get("absorbed", {}) or {}
+    out = []
+    for i, ln in enumerate(lines):
+        s = samples.get(i)
+        if s is None:
+            if i in absorbed:
+                # never emitted standalone: its work is measured inside
+                # the absorbing parent's stage
+                out.append(f"{ln} (absorbed into node {absorbed[i]})")
+            else:
+                out.append(ln)
+            continue
+        e = int(est.get(i, 0))
+        mf = miss_factor(e, s.rows)
+        mark = ">> " if mf >= miss_mark else ""
+        out.append(
+            f"{mark}{ln} (est_rows={e} actual_rows={s.rows} "
+            f"miss={mf:.1f}x device={int(s.device_us)}us)"
+        )
+    return out

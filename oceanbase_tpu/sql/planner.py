@@ -52,6 +52,22 @@ class PlannedQuery:
     output_names: tuple[str, ...]
 
 
+def capture_node_estimates(executor, plan: LogicalOp) -> dict:
+    """Optimizer cardinality estimate per pre-order node id, keyed
+    exactly like the compiled program's node numbering (the executor
+    re-numbers the ROUTED plan at compile time, so callers pass that
+    plan, not the raw planner output). Captured once at compile time and
+    pinned to the PreparedPlan / plan artifact, so every profiled actual
+    (engine/plan_profile.py) pairs with the estimate the optimizer
+    planned with — not a later re-estimate over evolved stats."""
+    from ..engine.executor import _number_nodes
+
+    return {
+        nid: int(executor._est_rows(op))
+        for nid, op in _number_nodes(plan).items()
+    }
+
+
 @dataclass
 class Relation:
     """One FROM item: a base scan or a planned derived table."""

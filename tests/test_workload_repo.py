@@ -283,7 +283,8 @@ def test_snapshot_statement_and_ring_bound(db):
     assert last["snap_id"] == snap_id
     assert set(last) == {"snap_id", "ts", "summary", "access", "census",
                          "sysstat", "timeline", "timeline_meta", "qos",
-                         "ls_replica", "governor", "integrity", "host_tax"}
+                         "ls_replica", "governor", "integrity", "host_tax",
+                         "plan_profile"}
     assert last["sysstat"]["sql statements"] > 0
     # the serving-timeline embed is live, not a stub: the statements
     # above landed in at least one bucket and the QoS ledger

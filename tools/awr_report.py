@@ -9,9 +9,11 @@ report runs anywhere the JSON can be copied to.
 
 Output: a human-readable report on stdout — top-K digests by window
 total/p99 time, hottest tables/columns, compile-cache churn, residency
-changes, and the window host-tax view (per-digest phase breakdown from
-the conservation ledger + chip-idle over the interval) — followed by
-ONE machine-readable JSON line (the last stdout
+changes, the window host-tax view (per-digest phase breakdown from the
+conservation ledger + chip-idle over the interval), and the
+hot-operators view (per-operator window device time plus estimate-vs-
+actual cardinality from the plan-profile calibration records) —
+followed by ONE machine-readable JSON line (the last stdout
 line) whose `advisor` block is the data contract the layout advisor
 (ROADMAP item 3) consumes: recommended sorted projections, residency
 priorities, batching candidates.
@@ -366,6 +368,60 @@ def diff_host_tax(first: dict, last: dict, restarted: bool) -> dict:
     }
 
 
+def _miss_factor(est: float, actual: float) -> float:
+    e = max(float(est), 1.0)
+    a = max(float(actual), 1.0)
+    return max(e / a, a / e)
+
+
+def diff_plan_profile(first: dict, last: dict, restarted: bool) -> dict:
+    """Window view of the operator calibration records
+    (engine/plan_profile.OperatorProfileStore.snapshot, embedded per
+    workload snapshot). Same cumulative-diff convention as host_tax:
+    per-(digest, node) window = last - first; a restart baselines at
+    zero. Rows rank by window device time — the 'hot operators'."""
+    p1 = last.get("plan_profile") or {}
+    p0 = {} if restarted else (first.get("plan_profile") or {})
+    d0 = p0.get("digests", {})
+    rows = []
+    for dig, nodes in p1.get("digests", {}).items():
+        z_nodes = d0.get(dig, {})
+        for nid, a in nodes.items():
+            z = z_nodes.get(nid, {})
+            n = a.get("executions", 0) - z.get("executions", 0)
+            if n <= 0:
+                continue
+            dev = max(0.0, a.get("device_us", 0.0)
+                      - z.get("device_us", 0.0))
+            rws = max(0, a.get("rows", 0) - z.get("rows", 0))
+            avg = rws / n
+            est = a.get("est_rows", 0)
+            rows.append({
+                "digest": dig,
+                "node_id": int(nid) if str(nid).lstrip("-").isdigit()
+                else nid,
+                "op_kind": a.get("op_kind", ""),
+                "executions": n,
+                "device_us": dev,
+                "build_us": max(0.0, a.get("build_us", 0.0)
+                                - z.get("build_us", 0.0)),
+                "probe_us": max(0.0, a.get("probe_us", 0.0)
+                                - z.get("probe_us", 0.0)),
+                "rows": rws,
+                "avg_rows": avg,
+                "out_bytes": max(0, a.get("out_bytes", 0)
+                                 - z.get("out_bytes", 0)),
+                "est_rows": est,
+                "miss_factor": _miss_factor(est, avg),
+            })
+    rows.sort(key=lambda r: -r["device_us"])
+    return {
+        "operators": rows,
+        "window_profiles": max(0, p1.get("profiles", 0)
+                               - p0.get("profiles", 0)),
+    }
+
+
 def render(first: dict, last: dict, top: int) -> dict:
     restarted = detect_restart(first, last)
     base = first
@@ -384,6 +440,7 @@ def render(first: dict, last: dict, top: int) -> dict:
             if sys1[k] != sys0.get(k, 0)}
     sat = saturation(first, last, restarted)
     htax = diff_host_tax(first, last, restarted)
+    pprof = diff_plan_profile(first, last, restarted)
 
     interval = last["ts"] - first["ts"]
     w = print
@@ -474,6 +531,24 @@ def render(first: dict, last: dict, top: int) -> dict:
         w("  (no host-tax ledgers folded in window — enable_host_tax "
           "off or dump predates it)")
     w("")
+    w("Hot operators (window):")
+    if pprof["operators"]:
+        w(f"  {pprof['window_profiles']} profiled executions in window; "
+          f"by operator device time:")
+        for r in pprof["operators"][:top]:
+            mark = ">> " if r["miss_factor"] >= 8.0 else "   "
+            bp = (f" build/probe={int(r['build_us'])}/"
+                  f"{int(r['probe_us'])}us"
+                  if r["build_us"] > 0 else "")
+            w(f"  {mark}{int(r['device_us']):>8}us x{r['executions']:<4} "
+              f"node {r['node_id']:>2} {r['op_kind']:<16} "
+              f"est={r['est_rows']} actual={r['avg_rows']:.0f} "
+              f"miss={r['miss_factor']:.1f}x{bp}  "
+              f"{str(r['digest'])[:48]}")
+    else:
+        w("  (no operator profiles folded in window — "
+          "enable_plan_profile off or dump predates it)")
+    w("")
     folds = sysd.get("stmt summary folds", 0)
     if folds:
         w(f"Repository overhead: {sysd.get('stmt summary fold ns', 0) / folds:.0f}"
@@ -487,6 +562,7 @@ def render(first: dict, last: dict, top: int) -> dict:
         "restarted": restarted,
         "saturation": sat,
         "host_tax": htax,
+        "plan_profile": pprof,
         "top_digests": by_total,
         "top_p99_digests": by_p99,
         "hot_tables": tables,

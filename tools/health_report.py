@@ -20,6 +20,7 @@ jobs that expect a healthy window.
     python tools/health_report.py dump.json
     python tools/health_report.py dump.json --rule tenant_starvation
     python tools/health_report.py dump.json --rule device_memory_pressure
+    python tools/health_report.py dump.json --rule cardinality_misestimate
 """
 
 from __future__ import annotations

@@ -16,6 +16,9 @@ given a Database it collects
   - the host-tax registry (per-digest phase breakdown + chip-idle
     windows) and the stack sampler's collapsed stacks (each
     flight-recorder bundle also embeds its statement's own ledger),
+  - the operator calibration records (per-(digest, node) device time
+    and actual-vs-estimated cardinality; slow-query flight-recorder
+    bundles carry their own digest's operator profile inline),
 
 and writes them as one JSON document.
 
@@ -80,6 +83,13 @@ def collect(db) -> dict:
         "stack_samples": (db.stack_sampler.snapshot()
                           if getattr(db, "stack_sampler", None) is not None
                           else {}),
+        # which operator is slow: the operator calibration records
+        # (per-(digest, node) device time / cardinality actuals vs the
+        # optimizer's estimates); each slow-query flight-recorder
+        # bundle above also embeds its own digest's records
+        "plan_profile": (db.plan_profiler.store.snapshot()
+                         if getattr(db, "plan_profiler", None) is not None
+                         else {}),
         "long_ops": [
             {
                 "op_id": o.op_id,
@@ -124,6 +134,7 @@ def main():
         "counters": len(bundle["sysstat"]["counters"]),
         "host_tax_digests": len(bundle["host_tax"]["digests"]),
         "stack_samples": bundle["stack_samples"].get("samples", 0),
+        "profiled_digests": len(bundle["plan_profile"].get("digests", {})),
     }, indent=2))
 
 

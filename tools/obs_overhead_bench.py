@@ -24,12 +24,17 @@ data-dir-backed, checkpointed db, with a helper thread driving
 back-to-back scrub passes through the whole ON leg
 (`serve_scrub.scrub_overhead_pct`) — plus (4) the host-tax gap ledger
 OFF then ON (`serve_hosttax.hosttax_overhead_pct`), with an ungated
-context leg serving under a continuously-armed stack sampler. The
+context leg serving under a continuously-armed stack sampler — plus
+(5) the operator plan profiler OFF then ON after a warm pass that
+pre-traces the segmented stages
+(`serve_planprof.planprof_overhead_pct`: the steady-state cost of the
+per-statement sampling check + 1-in-N profiled executions). The
 gated overhead is the median paired delta in process CPU per
 statement (see _serve_ab for why, paired throughput reported as
 context); --strict-pct P exits 1 if any overhead exceeds P, the
-timeline ring outgrew its capacity, the scrub A/B ran zero passes, or
-the host-tax A/B folded zero ledgers.
+timeline ring outgrew its capacity, the scrub A/B ran zero passes,
+the host-tax A/B folded zero ledgers, or the plan-profile A/B folded
+zero profiles.
 
 Prints a small JSON report. The warmup pass compiles every plan first,
 so all timed passes measure pure host dispatch + cached execution —
@@ -78,6 +83,10 @@ def set_timeline(db, on: bool) -> None:
 
 def set_host_tax(db, on: bool) -> None:
     db.config.set("enable_host_tax", "true" if on else "false")
+
+
+def set_plan_profile(db, on: bool) -> None:
+    db.config.set("enable_plan_profile", "true" if on else "false")
 
 
 def timed_pass(session, iters: int) -> dict:
@@ -320,6 +329,50 @@ def serve_hosttax_ab(sessions: int, seconds: float, reps: int) -> dict:
     return out
 
 
+def serve_planprof_ab(sessions: int, seconds: float, reps: int) -> dict:
+    """Operator plan-profiling OFF vs ON under the same closed-loop
+    serving load — the measurement the profiler's 2%% serving budget is
+    written against. A warm pass with profiling enabled runs FIRST so
+    the segmented stages are already traced and every digest has its
+    first-recurrence sample behind it: the timed ON legs then see only
+    the steady state a production server sees — the per-statement
+    decide() check plus the 1-in-ob_plan_profile_sample profiled
+    executions (each of which still serves its statement's result)."""
+    import latency_bench as LB
+
+    db, s = LB.build_db(2000)
+    set_plan_profile(db, True)
+    # warm: trace the segmented stages + consume first-recurrence sampling.
+    # The serving mix itself is a warm point read (fast path — never
+    # enters the engine's profiled dispatch), so an engine-path
+    # aggregate seeds real segmented profiles alongside the serve warm.
+    for _ in range(3):
+        s.sql("select grp, count(*) as n, sum(v) as sv "
+              "from kv group by grp").rows()
+    LB.run_serve_leg(db, max(2, sessions // 4), min(1.0, seconds),
+                     wait_us=1000, max_size=16, batching=True)
+    profiles0 = db.plan_profiler.store.profiles
+    best = _serve_ab(db, set_plan_profile, sessions, seconds, reps)
+    store = db.plan_profiler.store
+    return {
+        "sessions": sessions,
+        "leg_seconds": seconds,
+        "reps": reps,
+        "off_stmts_per_sec": best["off"],
+        "on_stmts_per_sec": best["on"],
+        "planprof_overhead_pct": best["overhead_pct"],
+        "rep_cpu_overheads_pct": best["rep_cpu_overheads_pct"],
+        "tput_overhead_pct": best["tput_overhead_pct"],
+        # evidence real profiles folded (warm + any leg samples) and
+        # the store stayed bounded
+        "warm_profiles": profiles0,
+        "profiles": store.profiles,
+        "profiled_digests": len(store.snapshot()["digests"]),
+        "store_evictions": store.evictions,
+        "sample_every": db.plan_profiler.sample_every,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("iters", nargs="?", type=int, default=200)
@@ -401,8 +454,17 @@ def main() -> int:
         ht = serve_hosttax_ab(args.sessions, args.serve_seconds,
                               args.serve_reps)
         report["serve_hosttax"] = ht
+        pp = serve_planprof_ab(args.sessions, args.serve_seconds,
+                               args.serve_reps)
+        report["serve_planprof"] = pp
         if args.strict_pct is not None:
             fails = []
+            if pp["planprof_overhead_pct"] > args.strict_pct:
+                fails.append(
+                    f"serve plan-profile overhead "
+                    f"{pp['planprof_overhead_pct']}%")
+            if pp["profiles"] == 0:
+                fails.append("plan-profile A/B folded zero profiles")
             if ht["hosttax_overhead_pct"] > args.strict_pct:
                 fails.append(
                     f"serve host-tax overhead "

@@ -6,7 +6,8 @@
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
 #                      [--advisor] [--warmboot] [--elastic] [--oom] [--mesh]
-#                      [--stream] [--scrub] [--hosttax] [extra pytest args...]
+#                      [--stream] [--scrub] [--hosttax] [--planprof]
+#                      [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -108,6 +109,16 @@
 # under its frozen budget, and the VT/sysstat/audit surfaces live; the
 # last stdout line is the JSON verdict.
 #
+# --planprof additionally runs the plan-profile smoke
+# (tools/planprof_smoke.py): a warm TPC-H Q1/Q6/Q3 mix profiled
+# through the segmented per-operator executor must return rows
+# bit-identical to the fused program, every plan node must surface
+# as a per-operator row in __all_virtual_sql_plan_monitor with
+# fenced device time, EXPLAIN ANALYZE must annotate the plan tree
+# (est/actual/miss/device + chip_idle_pct), and the calibration
+# records must carry compile-time estimates; the JSON summary (with
+# bench_meta provenance) lands in $BENCH_OUT when set.
+#
 # --advisor additionally runs the layout-advisor smoke
 # (tools/layout_advisor_smoke.py): a skewed workload must make the
 # advisor recommend the known-good sorted projection, dry run must
@@ -132,6 +143,7 @@ mesh=0
 stream=0
 scrub=0
 hosttax=0
+planprof=0
 while true; do
     case "$1" in
         --chaos) chaos=1; shift ;;
@@ -147,6 +159,7 @@ while true; do
         --stream) stream=1; shift ;;
         --scrub) scrub=1; shift ;;
         --hosttax) hosttax=1; shift ;;
+        --planprof) planprof=1; shift ;;
         *) break ;;
     esac
 done
@@ -237,6 +250,11 @@ fi
 
 if [ "$hosttax" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/hosttax_smoke.py
+    rc=$?
+fi
+
+if [ "$planprof" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/planprof_smoke.py
     rc=$?
 fi
 exit $rc
