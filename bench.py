@@ -404,6 +404,21 @@ def _best(f, reps):
     return min(ts), out
 
 
+def _reps_all(f, reps):
+    """Every rep's seconds (budget-bounded) + the LAST result — the
+    warm-serving variant of _best: q*_vs_e2e ratios report the per-rep
+    MEDIAN with the spread alongside, so one lucky (or profiled) rep
+    can't flatter or smear the serving number the way min-of-reps did."""
+    ts, out = [], None
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        out = f()
+        ts.append(time.perf_counter() - t0)
+        if over_budget(margin=15.0):
+            break
+    return ts, out
+
+
 def cpu_baseline(qname, sf, fn, reps):
     """(best_seconds, value, source) with the persistent cache."""
     key = f"{qname}@sf{sf:g}"
@@ -770,7 +785,21 @@ def main():
             )
             rs = sess.sql(text)  # compile + first run
             ok = check_result(qname, rs, cpu_val)
-            e2e, _ = _best(lambda t=text: sess.sql(t), max(2, reps // 2))
+            sess.sql(text)  # 2nd warm rep: past the profiled-run sample
+            ets, rs_on = _reps_all(lambda t=text: sess.sql(t), max(3, reps))
+            e2e = float(np.median(ets))
+            phases_on = sess.last_phases
+            # fused-spine A/B: same cached plan, narrowing forced OFF →
+            # full-frame D2H + host-side slicing. Prices exactly what the
+            # whole-statement fused program + on-device narrowing buy.
+            sess.narrow_enabled_fn = lambda: False
+            try:
+                sess.sql(text)  # warm the unfused leg
+                uts, rs_off = _reps_all(
+                    lambda t=text: sess.sql(t), max(2, reps // 2))
+            finally:
+                sess.narrow_enabled_fn = None  # default: narrowing on
+            unfused = float(np.median(uts))
             # device-path timing through the SAME cached executable the
             # session compiled (a separately prepared plan would re-trace
             # and pay a second remote compile on the axon tunnel)
@@ -799,7 +828,15 @@ def main():
                 "tpu_s": round(tpu_t[qname], 6),
                 "cpu_s": round(cpu_t[qname], 6),
                 "cpu_source": src,
+                # e2e_s is the per-rep MEDIAN of the warm serving leg
+                # (min-of-reps let one lucky rep flatter the ratio);
+                # the spread bounds run-to-run noise in the artifact
                 "e2e_s": round(e2e, 6),
+                "e2e_reps": len(ets),
+                "e2e_spread_s": round(float(max(ets) - min(ets)), 6),
+                "unfused_e2e_s": round(unfused, 6),
+                "fused_speedup": round(unfused / e2e, 3) if e2e > 0 else 0.0,
+                "fused_identical": bool(rs_on.rows() == rs_off.rows()),
                 "speedup": round(cpu_t[qname] / tpu_t[qname], 3),
                 "vs_e2e": round(cpu_t[qname] / e2e, 3),
                 "rows_per_s": round(n / tpu_t[qname], 1),
@@ -811,7 +848,7 @@ def main():
                 # phases with an explicit unattributed residual.
                 "host_tax_s": round(max(0.0, e2e - tpu_t[qname]), 6),
                 "host_tax": _GL.GapLedger.from_phases(
-                    e2e, sess.last_phases,
+                    e2e, phases_on,
                     device_s=tpu_t[qname]).to_dict(),
             }
             for k, v in qd.items():

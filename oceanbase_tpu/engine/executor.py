@@ -394,6 +394,10 @@ class Executor:
         # through compile(), which is what the warm-boot smoke pins:
         # compiles + batched_compiles stays 0 across a warm replay
         self.compiles = 0
+        # whole-statement fusion: lifetime count of narrowed (result-frame)
+        # executables built — one per (plan, pow2 narrow bucket), same
+        # bounding argument as batched_compiles
+        self.narrow_compiles = 0
         # hook: engine/memory_governor.MemoryGovernor — when wired, its
         # (OOM-shrunk) effective budget clamps the static device budget
         # so prepare() routes oversized inputs through the chunked path
@@ -3269,6 +3273,25 @@ def pack_qparams(values, dtypes, spec) -> "np.ndarray | tuple":
     return out
 
 
+def _narrow_seed(plan, default_rows: int) -> int:
+    """Row-count seed for the fused result-narrowing frame: how many live
+    rows the client can actually receive from this plan root. LIMIT/TopN
+    roots bound it exactly (n + offset — the engine's limit op keeps the
+    offset rows live and the cursor slices); a group-less aggregate yields
+    one row; everything else falls back to the caller's default (grown on
+    narrow-overflow like any other static capacity)."""
+    node = plan
+    while isinstance(node, Project):
+        node = node.child
+    if isinstance(node, (Limit, TopN)):
+        return max(1, int(node.n) + int(getattr(node, "offset", 0) or 0))
+    if isinstance(node, Aggregate) and not node.group_keys and (
+        getattr(node, "grouping_sets", None) is None
+    ):
+        return 1
+    return max(1, int(default_rows))
+
+
 class PreparedPlan:
     """A compiled plan: jitted XLA program + static capacities. Re-runnable;
     transparently recompiles at larger capacities on overflow."""
@@ -3285,6 +3308,12 @@ class PreparedPlan:
         # cross-session micro-batching: pow2 bucket -> vmapped executable
         # (cleared by recompile(): a capacity bump makes them stale)
         self._batched: dict[int, object] = {}
+        # whole-statement fusion: pow2 narrow cap -> fused executable that
+        # inlines the plan program AND the result-frame gather into ONE
+        # dispatch (cleared by recompile() like the batched buckets)
+        self._narrow: dict[int, object] = {}
+        self._narrow_cap = 0   # current pow2 frame width (0 = unseeded)
+        self._narrow_off = False  # result too wide for fusion: plain path
         # persistent-artifact state (engine/plan_artifact.py): True means
         # jitted is a live traceable jit (vmap-able for batched buckets);
         # False means it is a deserialized AOT executable that must
@@ -3310,6 +3339,7 @@ class PreparedPlan:
             self.executor.compile(self.plan, self.params)
         )
         self._batched.clear()
+        self._narrow.clear()
         self._traceable = True
         # mesh executors rebuild their exchange recorder per compile; the
         # cached plan must follow the fresh one or its mesh plan (worker
@@ -3426,6 +3456,95 @@ class PreparedPlan:
 
         checkpoint()
         return self.jit_call(self._inputs(), qparams)
+
+    # ---- whole-statement fusion (result narrowing) --------------------
+    def narrow_frame(self, default_rows: int, max_rows: int) -> int:
+        """Pow2 width of the fused result frame, or 0 when this plan has
+        opted out (result provably wider than the ceiling, or a prior
+        narrow run overflowed past it). Seeded from the plan root
+        (LIMIT/aggregate bounds), clamped to the root-compaction capacity
+        — narrowing past what compact_batch already emits moves no fewer
+        bytes."""
+        if self._narrow_off:
+            return 0
+        ncap = self._narrow_cap
+        if ncap == 0:
+            ncap = next_pow2(_narrow_seed(self.plan, default_rows))
+            root = self.params.join_cap.get(ROOT_COMPACT)
+            if root:
+                ncap = min(ncap, next_pow2(int(root)))
+            self._narrow_cap = ncap
+        if ncap > max_rows:
+            self._narrow_off = True
+            return 0
+        return ncap
+
+    def _build_narrow(self, ncap: int):
+        """One jitted program = the plan program (inlined: calling the
+        live jit inside jit fuses the traces, same mechanism as the
+        batched buckets' vmap) + the final result-frame gather. The
+        stable-ascending nonzero keeps live rows in their original
+        relative order, so the frame is bit-identical to the plain
+        path's host-side sel masking."""
+        inner = self.jitted
+
+        def run_narrow(inputs, qparams):
+            out, ovf_vec = inner(inputs, qparams)
+            nlive = jnp.sum(out.sel, dtype=jnp.int64)
+            idx = jnp.nonzero(out.sel, size=ncap, fill_value=0)[0]
+            cols = {n: jnp.take(c, idx, axis=0)
+                    for n, c in out.cols.items()}
+            valid = {n: jnp.take(v, idx, axis=0)
+                     for n, v in out.valid.items()}
+            nkeep = jnp.minimum(nlive, jnp.int64(ncap))
+            lanes = jnp.arange(ncap, dtype=jnp.int64) < nkeep
+            nb = ColumnBatch(cols=cols, valid=valid, sel=lanes,
+                             nrows=nkeep, schema=out.schema,
+                             dicts=out.dicts)
+            return nb, ovf_vec, jnp.maximum(nlive - ncap, 0)
+
+        return jax.jit(run_narrow)
+
+    def run_device_narrow(self, qparams: tuple, ncap: int):
+        """Fused dispatch WITHOUT host sync: returns (narrowed ColumnBatch,
+        plan overflow vector, narrow-overflow scalar) as device refs —
+        ONE enqueued program covering predicate through final frame, so
+        the statement's only host roundtrip is NarrowDeviceResult's
+        completion sync."""
+        from ..share.interrupt import checkpoint
+
+        from .plan_artifact import ArtifactStale
+
+        checkpoint()
+        for _attempt in range(3):
+            fn = self._narrow.get(ncap)
+            if fn is None:
+                if not self._traceable:
+                    # AOT-deserialized executable: cannot re-trace inside
+                    # a fresh jit — one honest recompile restores
+                    # traceability (the backend hits the XLA disk cache)
+                    self.recompile()
+                # build + first-trace under the lock: tracing re-enters
+                # plan emission's process-global parameter frame, exactly
+                # like the batched buckets
+                with _BATCH_COMPILE_LOCK:
+                    fn = self._narrow.get(ncap)
+                    if fn is None:
+                        fn = self._build_narrow(ncap)
+                        self.executor.narrow_compiles += 1
+                        try:
+                            res = fn(self._inputs(), qparams)
+                        except ArtifactStale:
+                            self.recompile()
+                            continue
+                        self._narrow[ncap] = fn
+                        return res
+            try:
+                return fn(self._inputs(), qparams)
+            except ArtifactStale:
+                self._narrow.pop(ncap, None)
+                self.recompile()
+        raise RuntimeError("narrowed executable stale after recompiles")
 
     # ---- cross-session micro-batching ---------------------------------
     @property
@@ -3733,6 +3852,92 @@ class DeviceResult:
         host = host_rows(self._out.schema, self._out.dicts, harrs, hvals,
                          np.ones(kb, dtype=np.bool_))
         return {n: v[:k] for n, v in host.items()}
+
+
+class NarrowDeviceResult(DeviceResult):
+    """DeviceResult over a FUSED narrowed dispatch: `out` is the final
+    ncap-row result frame (plan program + compaction gather in one XLA
+    program), so the completion sync fetches the entire client-visible
+    payload in one host roundtrip — no separate d2h leg and no
+    O(capacity) host result fold. A frame overflow grows the pow2 width
+    and redrives; past the configured ceiling the plan surrenders fusion
+    and this cursor falls back to the plain lazy contract."""
+
+    narrowed = True
+
+    def __init__(self, prepared, qparams, out, ovf_vec, novf, ncap: int,
+                 narrow_max: int, max_retries: int = 3, profile=None,
+                 phases=None):
+        super().__init__(prepared, qparams, out, ovf_vec,
+                         max_retries=max_retries, profile=profile,
+                         phases=phases)
+        self._novf = novf
+        self._ncap = int(ncap)
+        self._narrow_max = int(narrow_max)
+        self._fallback = False
+
+    def _sync(self) -> None:
+        if self._nrows is not None:
+            return
+        if self._fallback:
+            return super()._sync()
+        import time as _time
+
+        from ..share.interrupt import checkpoint
+
+        p = self.prepared
+        for attempt in range(self._max_retries + 1):
+            t0 = _time.perf_counter()
+            # the frame IS the result: per-leaf blocking np.asarray of
+            # overflow counters + every (ncap-row) leaf — the base small
+            # path's one-roundtrip shape, made unconditional by the fused
+            # program having already bounded the frame
+            hovf = np.asarray(self._ovf)
+            hnovf = int(np.asarray(self._novf))
+            harrs = {n: np.asarray(a) for n, a in self._out.cols.items()}
+            hvals = {n: np.asarray(a) for n, a in self._out.valid.items()}
+            hsel = np.asarray(self._out.sel)
+            self._observe(_time.perf_counter() - t0,
+                          int(getattr(hovf, "nbytes", 0)) + 8)
+            overflows = p._overflows(np.asarray(hovf))
+            if not overflows and hnovf == 0:
+                self._nrows = int(hsel.sum())
+                # commit ONLY on a clean run (overflowed frames are
+                # garbage), same contract as the base small path
+                self._hcols.update(harrs)
+                self._hvalid.update(hvals)
+                self._hsel = hsel
+                self._observe(0.0, sum(
+                    int(getattr(a, "nbytes", 0))
+                    for d in (harrs, hvals) for a in d.values()
+                ) + int(hsel.nbytes))
+                return
+            if attempt == self._max_retries:
+                raise RuntimeError(
+                    f"capacity overflow after {self._max_retries} "
+                    f"retries: {overflows or {'narrow': hnovf}}")
+            if overflows:
+                p.retries += 1
+                p.params.bump(overflows)
+                p.recompile()
+            if hnovf > 0:
+                grown = next_pow2(self._ncap + hnovf)
+                p._narrow_cap = max(p._narrow_cap, grown)
+                if grown > self._narrow_max:
+                    # frame too wide to fuse: remember on the plan (next
+                    # warm hit skips fusion outright) and finish THIS
+                    # statement on the plain path
+                    p._narrow_off = True
+                    self._fallback = True
+                    checkpoint()
+                    self._out, self._ovf = p.jit_call(
+                        p._inputs(), self._qparams)
+                    return super()._sync()
+                self._ncap = grown
+            checkpoint()
+            self._out, self._ovf, self._novf = p.run_device_narrow(
+                self._qparams, self._ncap)
+        raise AssertionError
 
 
 def _range_bounds(c: E.Expr, qual: str) -> list:

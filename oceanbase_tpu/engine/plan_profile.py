@@ -597,6 +597,12 @@ class PlanProfiler:
         self.slow_marks += 1
         self.force_next(digest)
 
+    def wants_force(self, digest: str) -> bool:
+        """Peek (no mutation): a pending forced profile needs a REAL
+        execution, so the result cache must not serve this digest."""
+        with self._lock:
+            return digest in self._force
+
     def decide(self, digest: str) -> str | None:
         """Count one execution of `digest`; return the profiling reason
         ("forced" | "first" | "sample") or None. Deterministic — cadence

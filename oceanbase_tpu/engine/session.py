@@ -103,6 +103,11 @@ class LazyResultSet:
         return list(zip(*cols)) if cols else []
 
 
+# fast_execute's "caller did not probe the result cache" marker (None is
+# a real probe outcome: probed, uncacheable)
+_RC_UNSET = object()
+
+
 @dataclass
 class _FastHit:
     """A resolved fast-tier lookup: the text entry, the re-bound slot
@@ -113,6 +118,9 @@ class _FastHit:
     fe: FastEntry
     values: list
     entry: CacheEntry
+    # logical cache key of the entry (embeds schema/dict versions via
+    # key_extra) — the result cache reuses it as its identity base
+    key: tuple | None = None
 
 
 class Session:
@@ -168,6 +176,21 @@ class Session:
         # profiled execution (the server wires it and sets the pending
         # statement digest before dispatch)
         self.plan_profiler = None
+        # whole-statement fusion knobs (server wires them to
+        # ob_enable_result_narrow / ob_result_narrow_rows /
+        # ob_result_narrow_max_rows): fuse the final result-frame gather
+        # into the plan's device program so a warm statement is ONE
+        # dispatch + ONE host roundtrip
+        self.narrow_enabled_fn = None
+        self.narrow_default_rows = 256
+        self.narrow_max_rows = 4096
+        # hook: engine/result_cache.ResultCache — device-resident narrowed
+        # results keyed (logical key, bound literals, snapshot watermark);
+        # a hit skips dispatch entirely
+        self.result_cache = None
+        # hook: tables -> snapshot watermark tuple (the server supplies
+        # per-table committed data versions; staleness = key mismatch)
+        self.result_watermark_fn = None
         # per-operator profile of the LAST profiled run_ast call (EXPLAIN
         # ANALYZE reads it to annotate the plan tree); None when the
         # statement was not profiled
@@ -273,18 +296,117 @@ class Session:
             pc.fast_invalidate(text_key)
             pc.note_fast_miss()
             return None
-        return _FastHit(text_key, fe, vals, entry)
+        return _FastHit(text_key, fe, vals, entry, key)
 
-    def fast_execute(self, hit: "_FastHit", fastparse_s: float = 0.0
-                     ) -> ResultSet:
+    def result_cache_key(self, hit: "_FastHit"):
+        """Result-cache identity for a fast hit, or None when the
+        statement is uncacheable (not a SELECT, cache off, unhashable
+        literal values). The key embeds the logical entry key (schema +
+        dictionary versions ride key_extra) plus the bound literals and
+        the referenced tables' snapshot watermark — any committed DML,
+        schema bump or dict growth changes the key instead of serving a
+        stale frame."""
+        rc = self.result_cache
+        if rc is None or not rc.enabled() or hit.key is None:
+            return None
+        if getattr(hit.fe, "stmt_type", None) != "Select":
+            return None
+        wm = (self.result_watermark_fn(hit.fe.tables)
+              if self.result_watermark_fn is not None else ())
+        return (hit.key, tuple(hit.values), wm)
+
+    def result_cache_probe(self, hit: "_FastHit", rc_key,
+                           fastparse_s: float = 0.0):
+        """Serve a fast hit from the device-resident result cache, or
+        None on miss. A hit skips bind + dispatch + sync entirely and
+        still fills last_phases/last_profile so completion accounting
+        (audit, summary, host-tax ledger) sees a normal statement."""
+        rc = self.result_cache
+        if rc is None or rc_key is None:
+            return None
+        ce = rc.get(rc_key)
+        if ce is None:
+            return None
+        rs = ResultSet(ce.names, ce.copy_columns(), plan_cache_hit=True,
+                       fast_path_hit=True)
+        phases = {
+            "plan_s": 0.0, "compile_s": 0.0, "fastparse_s": fastparse_s,
+            "bind_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0,
+            "exec_s": 0.0, "rows": rs.nrows, "cache_hit": True,
+            "fast_hit": True, "result_cache": True,
+        }
+        self.last_phases = phases
+        profile = None
+        if self.profile_enabled_fn is None or self.profile_enabled_fn():
+            from ..server.diag import QueryProfile
+
+            profile = QueryProfile(
+                compile_hit=True, fastparse_s=fastparse_s,
+                fast_path_hit=True)
+        self.last_profile = profile
+        self.last_plan = getattr(hit.entry.prepared, "plan", None)
+        self.last_op_profile = None
+        m = self.metrics
+        if m is not None and m.enabled:
+            m.add("result rows returned", rs.nrows)
+        # a cached serve is still logically a read of its tables: fold
+        # the plan's access profile so advisor heat (projection
+        # keep/drop, index recommendations) doesn't see a dashboard
+        # table go cold the moment its statements start hitting
+        acc = self.access
+        if acc is not None and acc.enabled:
+            prepared = hit.entry.prepared
+            memo = getattr(prepared, "_access_memo", None)
+            if memo is None or memo[0] != acc.epoch:
+                memo = (acc.epoch, acc.resolve(
+                    getattr(prepared, "access_profile", ())))
+                prepared._access_memo = memo
+            acc.fold_resolved(memo[1])
+        return rs
+
+    def _result_cache_put(self, rc_key, hit: "_FastHit", rs) -> None:
+        """Admit a freshly executed fused result: only clean narrowed
+        frames small enough for the entry cap — the cursor reference
+        pins the device-resident frame (that is the 'device cache' half;
+        the decoded host columns make hits free of fold work too)."""
+        rc = self.result_cache
+        cur = getattr(rs, "_cursor", None)
+        if rc is None or cur is None:
+            return
+        if not getattr(cur, "narrowed", False) \
+                or getattr(cur, "_fallback", False):
+            return
+        nbytes = sum(
+            int(getattr(a, "nbytes", 0))
+            for d in (cur._hcols, cur._hvalid) for a in d.values()
+        ) + int(getattr(cur._hsel, "nbytes", 0))
+        if nbytes > rc.entry_limit:
+            return
+        try:
+            cols = rs.columns
+        except Exception:
+            return
+        rc.put(rc_key, rs.names, {n: cols[n] for n in rs.names}, nbytes,
+               getattr(hit.fe, "tables", ()), cursor=cur)
+
+    def fast_execute(self, hit: "_FastHit", fastparse_s: float = 0.0,
+                     rc_key=_RC_UNSET) -> ResultSet:
         """Execute a fast-tier hit: bind + dispatch the cached executable.
         Any failure drops the text entry (the next occurrence re-registers
-        through the full path) and re-raises for the retry controller."""
+        through the full path) and re-raises for the retry controller.
+        `rc_key` carries a result-cache identity the caller already
+        probed (the server fast path probes before the batcher bracket);
+        left unset, this probes/admits the cache itself."""
         profiling = (self.profile_enabled_fn() if self.profile_enabled_fn
                      else True)
+        if rc_key is _RC_UNSET:
+            rc_key = self.result_cache_key(hit)
+            rs = self.result_cache_probe(hit, rc_key, fastparse_s)
+            if rs is not None:
+                return rs
         h2d0 = self.executor.h2d_bytes if profiling else 0
         try:
-            return self._execute_entry(
+            rs = self._execute_entry(
                 hit.entry, hit.values, ex=self.executor, was_hit=True,
                 fast=True, plan_s=0.0, compile_s=0.0,
                 fastparse_s=fastparse_s, profiling=profiling, h2d0=h2d0,
@@ -293,6 +415,12 @@ class Session:
         except Exception:
             self.plan_cache.fast_invalidate(hit.text_key)
             raise
+        if rc_key is not None:
+            try:
+                self._result_cache_put(rc_key, hit, rs)
+            except Exception:
+                pass  # cache admission must never fail the statement
+        return rs
 
     def cached_entry(self, text: str):
         """(CacheEntry, bound qparams) for a statement already run through
@@ -560,8 +688,9 @@ class Session:
         lazy = hasattr(prepared, "run_device") and not jn
         self.last_op_profile = None
         op_samples = prof_digest = prof_reason = None
+        narrow = None  # (novf, ncap) when the dispatch was fused-narrowed
         if lazy:
-            from .executor import DeviceResult
+            from .executor import DeviceResult, NarrowDeviceResult
 
             pp = self.plan_profiler
             if pp is not None and pp.enabled:
@@ -591,9 +720,32 @@ class Session:
                     # back to the fused dispatch below
                     out = None
             if out is None:
+                # whole-statement fusion: compile the final result-frame
+                # gather INTO the plan's device program — one dispatch,
+                # and the completion sync moves only the frame's bytes
+                nfn = self.narrow_enabled_fn
+                # AOT-hydrated plans stay un-narrowed until a natural
+                # recompile makes them traceable again: building the
+                # narrow program would force the honest recompile that
+                # the zero-compile warm-boot promise forbids
+                if ((nfn is None or nfn()) and ex is self.executor
+                        and getattr(prepared, "_traceable", True)
+                        and hasattr(prepared, "narrow_frame")):
+                    ncap = prepared.narrow_frame(
+                        self.narrow_default_rows, self.narrow_max_rows)
+                    if ncap:
+                        out, ovf_vec, novf = prepared.run_device_narrow(
+                            qparams, ncap)
+                        narrow = (novf, ncap)
+            if out is None:
                 out, ovf_vec = prepared.run_device(qparams=qparams)
             dispatch_s = time.perf_counter() - exec_t0
-            cursor = DeviceResult(prepared, qparams, out, ovf_vec)
+            if narrow is not None:
+                cursor = NarrowDeviceResult(
+                    prepared, qparams, out, ovf_vec, narrow[0], narrow[1],
+                    self.narrow_max_rows)
+            else:
+                cursor = DeviceResult(prepared, qparams, out, ovf_vec)
             rs = LazyResultSet(entry.output_names, cursor,
                                plan_cache_hit=was_hit, fast_path_hit=fast)
         elif hasattr(prepared, "run_host"):
@@ -656,7 +808,15 @@ class Session:
                 # shapes are static per compiled executable, so warm
                 # statements reuse the walk (invalidated by a recompile)
                 rmemo = getattr(prepared, "_result_bytes_memo", None)
-                if rmemo is not None and rmemo[0] == retries0:
+                if narrow is not None:
+                    # narrowed frame bytes — NOT memoized: the memo feeds
+                    # the base cursor's small-result heuristic against
+                    # the UN-narrowed output shape
+                    result_bytes = sum(
+                        int(getattr(a, "nbytes", 0))
+                        for d in (out.cols, out.valid) for a in d.values()
+                    ) + int(getattr(out.sel, "nbytes", 0))
+                elif rmemo is not None and rmemo[0] == retries0:
                     result_bytes = rmemo[1]
                 else:
                     result_bytes = sum(
@@ -799,6 +959,8 @@ class Session:
                 m.observe("sql compile", compile_s)
             m.observe("sql execute", exec_s)
             m.add("result rows returned", nrows)
+            if narrow is not None:
+                m.add("stmt fused dispatches")
             retries = getattr(prepared, "retries", 0) - retries0
             if retries > 0:
                 m.add("overflow recompiles", retries)

@@ -63,7 +63,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -79,13 +79,87 @@ class BatcherShutdown(RuntimeError):
 _SOLO = RuntimeError("solo")
 
 
+# Fused-pair executables for bucket-shape coalescing: two DIFFERENT
+# plans' vmapped cohorts inlined into ONE jitted program (one dispatch,
+# one D2H). Keyed by the identity of each plan's live jitted callable
+# plus the pow2 buckets — a recompile swaps the callable, so its old
+# combos simply stop matching and age out of the bounded LRU. The cache
+# value pins both callables: an id() key must never alias a recycled id
+# after the originals are garbage-collected.
+_COMBO_CACHE: OrderedDict = OrderedDict()
+_COMBO_CAP = 16
+
+
+def _combo_run(pa, pb, qa: np.ndarray, qb: np.ndarray):
+    """ONE device dispatch for two different plans' batched cohorts.
+    Returns a pair of run_batched_host-shaped host tuples
+    ((hcols, hvalid, hsel, schema, dicts) x2), or None when the pair
+    cannot fuse (untraceable executable, trace/dispatch failure, or
+    capacity overflow on either plan — the fallback path owns the
+    bump/recompile loop)."""
+    import jax
+
+    from ..engine.executor import _BATCH_COMPILE_LOCK
+
+    if not (getattr(pa, "_traceable", False)
+            and getattr(pb, "_traceable", False)):
+        return None
+    ba = next_pow2(int(qa.shape[0]))
+    bb = next_pow2(int(qb.shape[0]))
+    if ba > qa.shape[0]:
+        qa = np.concatenate(
+            [qa, np.repeat(qa[:1], ba - qa.shape[0], axis=0)])
+    if bb > qb.shape[0]:
+        qb = np.concatenate(
+            [qb, np.repeat(qb[:1], bb - qb.shape[0], axis=0)])
+    fa, fb = pa.jitted, pb.jitted
+    key = (id(fa), id(fb), ba, bb)
+    try:
+        hit = _COMBO_CACHE.get(key)
+        if hit is not None:
+            _COMBO_CACHE.move_to_end(key)
+            outs = hit[0](pa._inputs(), qa, pb._inputs(), qb)
+        else:
+            # build + first-trace under the batch compile lock: tracing
+            # re-enters plan emission's process-global parameter frame,
+            # exactly like the single-plan buckets
+            with _BATCH_COMPILE_LOCK:
+                hit = _COMBO_CACHE.get(key)
+                if hit is None:
+                    def run(ia, qva, ib, qvb, _fa=fa, _fb=fb):
+                        return (
+                            jax.vmap(_fa, in_axes=(None, 0))(ia, qva),
+                            jax.vmap(_fb, in_axes=(None, 0))(ib, qvb),
+                        )
+
+                    fn = jax.jit(run)
+                    outs = fn(pa._inputs(), qa, pb._inputs(), qb)
+                    _COMBO_CACHE[key] = (fn, fa, fb)
+                    while len(_COMBO_CACHE) > _COMBO_CAP:
+                        _COMBO_CACHE.popitem(last=False)
+                else:
+                    outs = hit[0](pa._inputs(), qa, pb._inputs(), qb)
+        (outa, ovfa), (outb, ovfb) = outs
+        hovfa, hca, hva, hsa, hovfb, hcb, hvb, hsb = jax.device_get(
+            (ovfa, outa.cols, outa.valid, outa.sel,
+             ovfb, outb.cols, outb.valid, outb.sel))
+    except Exception:  # noqa: BLE001 — the pair degrades, never fails
+        return None
+    if pa._overflows(np.asarray(hovfa).max(axis=0)):
+        return None
+    if pb._overflows(np.asarray(hovfb).max(axis=0)):
+        return None
+    return ((hca, hva, hsa, outa.schema, outa.dicts),
+            (hcb, hvb, hsb, outb.schema, outb.dicts))
+
+
 class _Batch:
     """One forming / in-flight group of same-entry fast-path hits."""
 
     __slots__ = ("key", "entry", "tenant", "rows", "dead", "max_size",
                  "batch_id", "closed", "queued", "admitted", "dispatching",
-                 "full", "done", "results", "error", "dispatch_s",
-                 "d2h_bytes", "nlanes")
+                 "adopted", "full", "done", "results", "error",
+                 "dispatch_s", "d2h_bytes", "nlanes")
 
     def __init__(self, key, entry, tenant: str, batch_id: int,
                  max_size: int):
@@ -100,6 +174,7 @@ class _Batch:
         self.queued = False  # sitting in its tenant's gate queue
         self.admitted = False  # gate handed this group a busy token
         self.dispatching = False  # lanes frozen; device execution begun
+        self.adopted = False  # riding another leader's fused pair dispatch
         self.full = threading.Event()  # admission/fill/shutdown wake
         self.done = threading.Event()  # results scattered (or error set)
         self.results: list | None = None  # ResultSet per ORIGINAL lane
@@ -297,6 +372,11 @@ class StatementBatcher:
         self.timeline = None
         # A/B switch (latency_bench --sessions: batching on vs off)
         self.enabled = True
+        # bucket-shape coalescing (ob_enable_batch_coalesce): a leader
+        # about to dispatch adopts ONE queued group of a DIFFERENT plan
+        # whose alive cohort pads to the same pow2 bucket — two cohorts,
+        # one fused device program, one D2H
+        self.coalesce_enabled = True
         # config-derived degradation bounds (ob_batch_follower_timeout /
         # ob_batch_queue_depth); Database re-seeds these on hot reload
         self.follower_timeout_s = 10.0
@@ -471,22 +551,35 @@ class StatementBatcher:
             # host-tax hint on the LEADER's ledger: its group-commit
             # window wait (the dispatch is added separately, once)
             led.add("batch window", waited)
+        rider = None
         with self._lock:
             b.closed = True
             if self._forming.get(b.key) is b:
                 del self._forming[b.key]
             gate.remove(b)
-            if not b.admitted:
+            adopted = b.adopted
+            if not b.admitted and not adopted:
                 # filled before admission, gate wedged, or shutdown:
                 # dispatch on a fresh token (a filled batch must not
                 # keep waiting on an unrelated dispatch)
                 gate.busy += 1
             if b.error is not None:  # shutdown raced in
+                if adopted:
+                    gate.busy += 1  # an adopted group holds no token
                 b.done.set()
                 return False
-            alive = [i for i in range(len(b.rows)) if i not in b.dead]
-            b.dispatching = True
+            if not adopted:
+                alive = [i for i in range(len(b.rows))
+                         if i not in b.dead]
+                b.dispatching = True
+                if self.coalesce_enabled and len(alive) >= 2:
+                    rider = self._adopt_rider(b, next_pow2(len(alive)))
             depth = gate.queued_groups
+        if adopted:
+            # another leader's fused pair dispatch carries this cohort:
+            # wait for its scatter instead of dispatching (and holding a
+            # token) ourselves
+            return self._ride(b, m)
         tl = self.timeline
         if tl is not None and tl.enabled:
             tl.record_gate(waited, queued=depth)
@@ -499,12 +592,74 @@ class StatementBatcher:
             if m is not None and m.enabled:
                 m.add("stmt batch solo")
             return False
-        self._dispatch(b, alive, depth)
+        if rider is not None:
+            rb, ralive = rider
+            if not self._dispatch_pair(b, alive, rb, ralive, depth):
+                # the pair couldn't fuse: two separate dispatches on the
+                # one token (the rider's lanes are parked on rb.done and
+                # complete either way)
+                self._dispatch(rb, ralive, depth)
+                self._dispatch(b, alive, depth)
+        else:
+            self._dispatch(b, alive, depth)
         if b.error is not None:
             return False  # token kept for the leader's own solo re-run
         with self._lock:
             gate.release()
         return True
+
+    def _ride(self, b: _Batch, m) -> bool:
+        """Adopted leader half: the adopting leader dispatches and
+        scatters for us. On its error — or a timeout with the adopter
+        wedged — take a fresh token (adopted groups hold none) and
+        degrade this lane to solo; followers degrade themselves off
+        b.error exactly as after a failed dispatch."""
+        ok = b.done.wait(2.0 * self.follower_timeout_s)
+        if ok and b.error is None:
+            if m is not None and m.enabled:
+                m.add("stmt batch coalesced rider")
+            return True
+        with self._lock:
+            self.gate.busy += 1
+        if m is not None and m.enabled:
+            m.add("stmt batch coalesced degrade")
+        return False
+
+    def _adopt_rider(self, b: _Batch, bucket: int):
+        """Called with the gate lock HELD by a leader about to dispatch
+        `b`: pick ONE queued group — any tenant, own queue first — whose
+        alive cohort pads to the same pow2 bucket, freeze it, and pull
+        it out of the queue as a rider on this dispatch. Returns
+        (rider_batch, rider_alive_lanes) or None. The rider's leader
+        wakes on full (sees adopted=True, skips its token take) and its
+        followers ride the dispatch out because dispatching is set."""
+        gate = self.gate
+        queues = [gate._queues.get(self.tenant)]
+        queues += [q for t, q in gate._queues.items()
+                   if t != self.tenant]
+        for q in queues:
+            if not q:
+                continue
+            for rb in q:
+                if rb is b or rb.error is not None or rb.dispatching:
+                    continue
+                if not getattr(rb.entry.prepared, "_traceable", False):
+                    continue
+                ralive = [i for i in range(len(rb.rows))
+                          if i not in rb.dead]
+                if len(ralive) < 2 or next_pow2(len(ralive)) != bucket:
+                    continue
+                rb.closed = True
+                rb.dispatching = True
+                rb.adopted = True
+                gate.remove(rb)
+                # same-tenant riders share this batcher's forming map;
+                # a cross-tenant rider's leader cleans its own up
+                if self._forming.get(rb.key) is rb:
+                    del self._forming[rb.key]
+                rb.full.set()
+                return rb, ralive
+        return None
 
     def _follow(self, b: _Batch, lane: int, wait_us: int, m) -> bool:
         """Follower half: wait for the leader's scatter. On timeout
@@ -550,14 +705,90 @@ class StatementBatcher:
             return False
         return True
 
+    def _scatter(self, b: _Batch, alive: list[int], hcols, hvalid, hsel,
+                 schema, dicts) -> None:
+        """Slice the padded device block down to the alive cohort and
+        scatter per-lane ResultSets back to their ORIGINAL lane slots
+        (one vectorized gather for the whole batch, not nb per-lane
+        gathers). Shared by the single-plan and fused-pair dispatches."""
+        from ..core.column import host_rows_batched
+        from ..engine.session import ResultSet
+
+        b.d2h_bytes = sum(
+            int(getattr(a, "nbytes", 0))
+            for d in (hcols, hvalid) for a in d.values()
+        ) + int(getattr(hsel, "nbytes", 0))
+        names = b.entry.output_names
+        nb = len(alive)
+        b.nlanes = nb
+        lanes = host_rows_batched(
+            schema, dicts,
+            {n: a[:nb] for n, a in hcols.items()},
+            {n: a[:nb] for n, a in hvalid.items()},
+            hsel[:nb],
+        )
+        results: list = [None] * len(b.rows)
+        for j, i in enumerate(alive):
+            lane = lanes[j]
+            results[i] = ResultSet(
+                names, {n: lane[n] for n in names},
+                plan_cache_hit=True, fast_path_hit=True)
+        b.results = results
+
+    def _dispatch_pair(self, b: _Batch, alive: list[int], rb: _Batch,
+                       ralive: list[int], depth: int) -> bool:
+        """Bucket-shape coalescing: ONE fused device program carrying
+        TWO different plans' cohorts (same pow2 bucket) — both vmapped
+        executables inlined into a single jit, one dispatch, one
+        device_get for every lane of both. True = both groups scattered
+        and done. False = the pair couldn't fuse; NOTHING is half-done
+        on that path (no done events, no results) — the caller falls
+        back to two separate dispatches."""
+        m = self.metrics
+        t0 = time.perf_counter()
+        try:
+            qa = np.stack([b.rows[i] for i in alive])
+            qb = np.stack([rb.rows[i] for i in ralive])
+            res = _combo_run(b.entry.prepared, rb.entry.prepared, qa, qb)
+            if res is None:
+                return False
+            dispatch_s = time.perf_counter() - t0
+            led = _gl.current()
+            if led is not None:
+                # ONE device execution on the ADOPTING leader's ledger;
+                # the rider's lanes hint only their window wait — same
+                # exactly-once discipline as the single-plan dispatch
+                led.add("device dispatch", dispatch_s)
+                led.device(dispatch_s)
+            b.dispatch_s = rb.dispatch_s = dispatch_s
+            (ha, hva, hsa, sca, dca), (hb, hvb, hsb, scb, dcb) = res
+            self._scatter(b, alive, ha, hva, hsa, sca, dca)
+            self._scatter(rb, ralive, hb, hvb, hsb, scb, dcb)
+        except Exception:  # noqa: BLE001 — fall back to two dispatches
+            return False
+        na, nr = len(alive), len(ralive)
+        if m is not None and m.enabled:
+            m.bulk(adds=(
+                ("stmt batched dispatches", 1),
+                ("stmt batched statements", na + nr),
+                (f"stmt batch size {next_pow2(na)}", 1),
+                ("stmt batch coalesced dispatches", 1),
+                ("stmt batch coalesced lanes", na + nr),
+            ))
+            m.gauge_max("stmt sched queue depth hwm", depth)
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            # one fused dispatch carrying both cohorts' lanes
+            tl.record_batch(dispatch_s, na + nr, queued=depth)
+        b.done.set()
+        rb.done.set()
+        return True
+
     def _dispatch(self, b: _Batch, alive: list[int], depth: int) -> None:
         """Leader half: stack the ALIVE lanes, ONE batched device
         execution, scatter per-lane ResultSets back to their original
         lane slots. Any failure parks the error and sends every lane
         back to the solo path."""
-        from ..core.column import host_rows_batched
-        from ..engine.session import ResultSet
-
         m = self.metrics
         t0 = time.perf_counter()
         try:
@@ -574,28 +805,8 @@ class StatementBatcher:
                 # count regression test anchors here
                 led.add("device dispatch", b.dispatch_s)
                 led.device(b.dispatch_s)
-            b.d2h_bytes = sum(
-                int(getattr(a, "nbytes", 0))
-                for d in (hcols, hvalid) for a in d.values()
-            ) + int(getattr(hsel, "nbytes", 0))
-            names = b.entry.output_names
-            nb = len(alive)
-            b.nlanes = nb
-            # one vectorized scatter for the whole batch (pad lanes
-            # sliced off first) instead of nb per-lane gathers
-            lanes = host_rows_batched(
-                schema, dicts,
-                {n: a[:nb] for n, a in hcols.items()},
-                {n: a[:nb] for n, a in hvalid.items()},
-                hsel[:nb],
-            )
-            results: list = [None] * len(b.rows)
-            for j, i in enumerate(alive):
-                lane = lanes[j]
-                results[i] = ResultSet(
-                    names, {n: lane[n] for n in names},
-                    plan_cache_hit=True, fast_path_hit=True)
-            b.results = results
+            self._scatter(b, alive, hcols, hvalid, hsel, schema, dicts)
+            nb = b.nlanes
             if m is not None and m.enabled:
                 # batch-size histogram as per-pow2-bucket counters (the
                 # latency Histogram's bounds are seconds, not lanes)

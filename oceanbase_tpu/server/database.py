@@ -780,6 +780,24 @@ class Database:
         # spill-segment corruption counting (storage/tmp_file.py) reaches
         # sysstat through the executor the grace-hash pipeline holds
         self.engine.executor.metrics = self.metrics
+        # whole-statement fusion: the engine fuses the final result-frame
+        # gather into the plan's device program (one dispatch, one D2H of
+        # final bytes). Knobs: ob_enable_result_narrow,
+        # ob_result_narrow_rows, ob_result_narrow_max_rows
+        self.engine.narrow_enabled_fn = (
+            lambda: self.config["ob_enable_result_narrow"])
+        self.engine.narrow_default_rows = int(
+            self.config["ob_result_narrow_rows"])
+        self.engine.narrow_max_rows = int(
+            self.config["ob_result_narrow_max_rows"])
+        self.config.on_change(
+            "ob_result_narrow_rows",
+            lambda _n, _o, v: setattr(
+                self.engine, "narrow_default_rows", int(v)))
+        self.config.on_change(
+            "ob_result_narrow_max_rows",
+            lambda _n, _o, v: setattr(
+                self.engine, "narrow_max_rows", int(v)))
         # cross-session continuous-batching scheduler: concurrent
         # fast-path hits fold into batched device dispatches behind ONE
         # cluster-shared DispatchGate (like cluster._timeline) — the
@@ -840,6 +858,51 @@ class Database:
         self.config.on_change(
             "ob_governor_max_queue",
             lambda _n, _o, v: setattr(gov, "max_queue", int(v)))
+        # device-resident result cache: repeated dashboard statements
+        # serve their narrowed frame with ZERO dispatches. Keyed on the
+        # logical entry key + bound literals + committed-data watermark;
+        # eagerly dropped by DML (_invalidate), schema bumps, plan-cache
+        # flush (the hook below) and the OOM ladder. Its frames are
+        # charged against the tenant unit through _resident_bytes.
+        from ..engine.result_cache import ResultCache
+
+        self.result_cache = ResultCache(
+            capacity_bytes=int(self.config["ob_result_cache_size"]),
+            entry_limit=int(self.config["ob_result_cache_entry_limit"]),
+            enabled_fn=lambda: self.config["ob_enable_result_cache"],
+            pressure_fn=gov.under_pressure,
+            metrics=self.metrics,
+        )
+        self.engine.result_cache = self.result_cache
+        self.engine.result_watermark_fn = self._result_watermark
+        self.plan_cache.result_cache = self.result_cache
+        self.config.on_change(
+            "ob_result_cache_size",
+            lambda _n, _o, v: setattr(
+                self.result_cache, "capacity_bytes", int(v)))
+        self.config.on_change(
+            "ob_result_cache_entry_limit",
+            lambda _n, _o, v: setattr(
+                self.result_cache, "entry_limit", int(v)))
+        # micro-batch coalescing: two heterogeneous-plan cohorts sharing
+        # a pow2 bucket shape fuse into one device dispatch at the gate
+        self.batcher.coalesce_enabled = bool(
+            self.config["ob_enable_batch_coalesce"])
+        self.config.on_change(
+            "ob_enable_batch_coalesce",
+            lambda _n, _o, v: setattr(
+                self.batcher, "coalesce_enabled", bool(v)))
+        # completion drain: statement accounting (audit/summary/metrics/
+        # timeline folds, governor release) moves behind the wire write
+        # when ob_enable_completion_drain is on
+        from .completion import CompletionDrain
+
+        self.completion = CompletionDrain(
+            depth=int(self.config["ob_completion_drain_depth"]),
+            metrics=self.metrics)
+        self.config.on_change(
+            "ob_completion_drain_depth",
+            lambda _n, _o, v: setattr(self.completion, "depth", int(v)))
         # one shared virtual-clock closure: sql() builds a statement
         # Deadline from it on every call — no per-statement lambda
         self._bus_clock = lambda: self.cluster.bus.now
@@ -1255,6 +1318,11 @@ class Database:
         b = getattr(self, "batcher", None)
         if b is not None:
             b.shutdown()
+        # deferred completion folds must land before the process goes:
+        # close() drains the backlog inline (exactly-once accounting)
+        cd = getattr(self, "completion", None)
+        if cd is not None:
+            cd.close()
         pa = getattr(self, "plan_artifact", None)
         if pa is not None:
             # fold this boot's statement-summary exec counts into the
@@ -1283,6 +1351,11 @@ class Database:
         self.engine.executor.invalidate_table(name)
         if self._px_executor_obj is not None:
             self._px_executor_obj.invalidate_table(name)
+        # cached result frames over this table died with the snapshot
+        # (the watermark key already misses; the eager drop frees bytes)
+        rc = getattr(self, "result_cache", None)
+        if rc is not None:
+            rc.invalidate_tables((name,))
 
     def _px_executor(self):
         """Lazily-built distributed executor over the full device mesh
@@ -1340,6 +1413,20 @@ class Database:
             ti = tables.get(t)
             if ti is not None:
                 out.append((t, ti.schema_version, ti.dict_sig))
+        return tuple(out)
+
+    def _result_watermark(self, table_names) -> tuple:
+        """Result-cache key material: the referenced tables' committed
+        data versions (the snapshot watermark). Any committed DML bumps a
+        version, so a cached frame can never serve across it — the
+        key_extra half (schema/dict versions) rides the logical entry key
+        already."""
+        out = []
+        tables = self.tables
+        for t in table_names:
+            ti = tables.get(t)
+            if ti is not None:
+                out.append((t, ti.data_version))
         return tuple(out)
 
     # ---------------------------------------------- plan artifact store
@@ -1439,6 +1526,14 @@ class Database:
             p = PROVIDERS.get(name)
             if p is None:
                 continue
+            if not any_vt:
+                # read-your-own-accounting barrier: deferred completion
+                # folds (audit/summary/metrics) must land before a
+                # diagnostic snapshot materializes, or `SELECT ... FROM
+                # sql_audit` would miss the statements just served
+                cd = getattr(self, "completion", None)
+                if cd is not None and cd.submitted > cd.drained:
+                    cd.flush()
             self.catalog[name] = p(self)
             self._invalidate(name)
             any_vt = True
@@ -2222,6 +2317,12 @@ class Database:
                 continue
             for a in t.data.values():
                 total += getattr(a, "nbytes", 0)
+        # device-pinned result-cache frames are tenant residency too —
+        # the governor must see them or cache growth would hide from
+        # admission control
+        rc = getattr(self, "result_cache", None)
+        if rc is not None:
+            total += rc.device_bytes()
         return total
 
     def _enforce_memory(self, keep: str) -> None:
@@ -2266,6 +2367,11 @@ class Database:
         cached device batches of low-priority tables (advisor residency
         priorities order the walk, like _enforce_memory) and half the
         decoded block cache. Everything re-materializes on next use."""
+        # cached result frames first: the most re-creatable bytes on the
+        # chip (one warm dispatch rebuilds any of them)
+        rc = getattr(self, "result_cache", None)
+        if rc is not None and rc.flush():
+            self.metrics.add("result cache evictions: device oom")
         ex = self.engine.executor
         order = sorted(
             {k[0] for k in ex._batch_cache} | {k[0] for k in ex._assembled},
@@ -2445,6 +2551,11 @@ class DbSession:
             "ob_read_consistency": self._CONSISTENCY_WORDS.get(
                 str(db.config["ob_read_consistency"]), 0),
             "ob_max_read_stale_us": int(db.config["ob_max_read_stale_us"]),
+            # device-resident result cache: per-session opt-out (a bench
+            # A/B or a test that must observe real dispatches turns it
+            # off without flipping the tenant-wide config)
+            "ob_enable_result_cache": int(
+                bool(db.config["ob_enable_result_cache"])),
         }
         # (snapshot, stale_us) of the last follower-served SELECT — the
         # staleness-contract tests and chaos bench read it to re-run the
@@ -2654,85 +2765,120 @@ class DbSession:
                             if self._retry_ctrl else 0,
                             rs, bi is not None, prof,
                         )
+                    snap = None
                     if led is not None:
                         # the return path + digest + summary fold are host
                         # wall too: cut everything since the engine window
-                        # closed, then freeze e2e/residual/chip-idle and
-                        # fold the ledger under the statement's digest
+                        # closed, then freeze e2e/residual/chip-idle.
+                        # Deferred folds must NOT hold the live ledger —
+                        # begin() re-arms it in place for this session's
+                        # next statement — so they read a frozen snapshot
                         led.cut("completion fold")
                         led.close()
-                        db.host_tax.fold(digest, led)
-                    # hot-path diet: when metrics/audit are disabled, skip
-                    # even the counter lookups and kwargs construction —
-                    # the serving path pays zero for observability it
-                    # isn't using
-                    if m.enabled:
-                        adds = self._stmt_adds
-                        adds.append(("sql statements", 1))
-                        if stype in ("Select", "SetSelect"):
-                            adds.append(("sql select count", 1))
-                        elif stype in ("Insert", "Update", "Delete"):
-                            adds.append(("sql dml count", 1))
-                        if err:
-                            adds.append(("sql fail count", 1))
-                        observes = [("sql response time", elapsed_s)]
-                        waits = ()
-                        if led is not None:
-                            # per-phase wait events: sysstat/system_event
-                            # rows AND prometheus summaries for free
-                            adds.append(("host tax statements", 1))
-                            observes.append(
-                                ("host chip idle pct", led.chip_idle_pct))
-                            waits = [("host tax: " + k, v)
-                                     for k, v in led.phases.items()]
-                            if led.unattributed_s > 0.0:
-                                waits.append(("host tax: unattributed",
-                                              led.unattributed_s))
-                        m.bulk(adds=adds, observes=tuple(observes),
-                               waits=tuple(waits))
-                    tl = db.timeline
-                    if tl.enabled:
-                        # timeline completion feed (exactly once per
-                        # statement, beside the summary fold): host wall
-                        # seconds + tenant admitted count + in-flight
-                        # depth sample for the queue histograms
-                        tl.record_stmt(db.tenant_name, elapsed_s,
-                                       bool(err), len(db._active_stmts))
-                    if db.audit.enabled:
-                        p = prof
-                        db.audit.record(
-                            session_id=self.session_id,
-                            trace_id=sp.trace_id,
-                            sql=text,
-                            stmt_type=self._last_stmt_type,
-                            elapsed_s=elapsed_s,
-                            rows=rs.nrows if rs is not None else 0,
-                            affected=rs.affected if rs is not None else 0,
-                            plan_cache_hit=(rs.plan_cache_hit
-                                            if rs is not None else False),
-                            error=err,
-                            compile_s=p.compile_s if p else 0.0,
-                            device_bytes=p.device_bytes if p else 0,
-                            transfer_bytes=p.transfer_bytes if p else 0,
-                            peak_bytes=p.peak_bytes if p else 0,
-                            retry_cnt=(self._retry_ctrl.retry_cnt
-                                       if self._retry_ctrl else 0),
-                            retry_info=(self._retry_ctrl.retry_info
-                                        if self._retry_ctrl else ""),
-                            fastparse_us=int(p.fastparse_s * 1e6) if p else 0,
-                            bind_us=int(p.bind_s * 1e6) if p else 0,
-                            dispatch_us=int(p.dispatch_s * 1e6) if p else 0,
-                            fetch_us=int(p.fetch_s * 1e6) if p else 0,
-                            is_fast_path=bool(p.fast_path_hit) if p else False,
-                            is_batched=bi is not None,
-                            batch_id=bi[0] if bi is not None else 0,
-                            batch_wait_us=bi[2] if bi is not None else 0,
-                            chip_idle_us=int(
-                                max(0.0, led.e2e_s - led.device_s) * 1e6)
-                            if led is not None else 0,
-                            unattributed_us=int(led.unattributed_s * 1e6)
-                            if led is not None else 0,
-                        )
+                        snap = _GL.LedgerSnapshot(led)
+                    retry_cnt = (self._retry_ctrl.retry_cnt
+                                 if self._retry_ctrl else 0)
+                    retry_info = (self._retry_ctrl.retry_info
+                                  if self._retry_ctrl else "")
+                    sid = self.session_id
+                    trace_id = sp.trace_id
+                    stype2 = self._last_stmt_type
+                    depth = len(db._active_stmts)
+                    stmt_adds = self._stmt_adds
+
+                    def _complete():
+                        # statement accounting, exactly once — inline on
+                        # the serving thread, or behind the wire write on
+                        # the completion drain (ob_enable_completion_drain)
+                        if snap is not None:
+                            db.host_tax.fold(digest, snap)
+                        # hot-path diet: when metrics/audit are disabled,
+                        # skip even the counter lookups and kwargs
+                        # construction — the serving path pays zero for
+                        # observability it isn't using
+                        if m.enabled:
+                            adds = stmt_adds
+                            adds.append(("sql statements", 1))
+                            if stype in ("Select", "SetSelect"):
+                                adds.append(("sql select count", 1))
+                            elif stype in ("Insert", "Update", "Delete"):
+                                adds.append(("sql dml count", 1))
+                            if err:
+                                adds.append(("sql fail count", 1))
+                            observes = [("sql response time", elapsed_s)]
+                            waits = ()
+                            if snap is not None:
+                                # per-phase wait events: sysstat/
+                                # system_event rows AND prometheus
+                                # summaries for free
+                                adds.append(("host tax statements", 1))
+                                observes.append(("host chip idle pct",
+                                                 snap.chip_idle_pct))
+                                waits = [("host tax: " + k, v)
+                                         for k, v in snap.phases.items()]
+                                if snap.unattributed_s > 0.0:
+                                    waits.append(
+                                        ("host tax: unattributed",
+                                         snap.unattributed_s))
+                            m.bulk(adds=adds, observes=tuple(observes),
+                                   waits=tuple(waits))
+                        tl = db.timeline
+                        if tl.enabled:
+                            # timeline completion feed (exactly once per
+                            # statement, beside the summary fold): host
+                            # wall seconds + tenant admitted count +
+                            # in-flight depth sample for the queue
+                            # histograms
+                            tl.record_stmt(db.tenant_name, elapsed_s,
+                                           bool(err), depth)
+                        if db.audit.enabled:
+                            p = prof
+                            db.audit.record(
+                                session_id=sid,
+                                trace_id=trace_id,
+                                sql=text,
+                                stmt_type=stype2,
+                                elapsed_s=elapsed_s,
+                                rows=rs.nrows if rs is not None else 0,
+                                affected=(rs.affected
+                                          if rs is not None else 0),
+                                plan_cache_hit=(rs.plan_cache_hit
+                                                if rs is not None
+                                                else False),
+                                error=err,
+                                compile_s=p.compile_s if p else 0.0,
+                                device_bytes=p.device_bytes if p else 0,
+                                transfer_bytes=(p.transfer_bytes
+                                                if p else 0),
+                                peak_bytes=p.peak_bytes if p else 0,
+                                retry_cnt=retry_cnt,
+                                retry_info=retry_info,
+                                fastparse_us=(int(p.fastparse_s * 1e6)
+                                              if p else 0),
+                                bind_us=int(p.bind_s * 1e6) if p else 0,
+                                dispatch_us=(int(p.dispatch_s * 1e6)
+                                             if p else 0),
+                                fetch_us=int(p.fetch_s * 1e6) if p else 0,
+                                is_fast_path=(bool(p.fast_path_hit)
+                                              if p else False),
+                                is_batched=bi is not None,
+                                batch_id=bi[0] if bi is not None else 0,
+                                batch_wait_us=(bi[2]
+                                               if bi is not None else 0),
+                                chip_idle_us=int(
+                                    max(0.0, snap.e2e_s - snap.device_s)
+                                    * 1e6) if snap is not None else 0,
+                                unattributed_us=int(
+                                    snap.unattributed_s * 1e6)
+                                if snap is not None else 0,
+                            )
+
+                    cd = db.completion
+                    if (cd is not None
+                            and db.config["ob_enable_completion_drain"]):
+                        cd.submit(_complete)
+                    else:
+                        _complete()
                     if stype not in ("Show", "SetVar", ""):
                         if self._vars.get("ob_enable_show_trace"):
                             self._last_trace_id = sp.trace_id
@@ -2773,13 +2919,16 @@ class DbSession:
         reserve_bytes = self._reserve_estimate(text)
         while True:
             res = None
+            ok = False
             try:
                 if reserve_bytes > 0:
                     # admission-time device-memory reservation, held for
                     # the whole attempt (re-taken per attempt so post-OOM
                     # attempts charge the SHRUNK pool)
                     res = self._reserve_device_memory(reserve_bytes)
-                return self._dispatch(text)
+                out = self._dispatch(text)
+                ok = True
+                return out
             except Exception as e:
                 if ctrl is None:
                     ctrl = _R.RetryController(deadline=_R.current_deadline())
@@ -2858,9 +3007,18 @@ class DbSession:
                     raise ctrl.timeout_error(e) from e
             finally:
                 # the ledger must balance: release THIS attempt's grant on
-                # every exit — success, retry, or surfaced error
+                # every exit — success, retry, or surfaced error. A
+                # successful attempt's release may ride the completion
+                # drain (the client isn't waiting on ledger arithmetic);
+                # failed attempts release inline so the next attempt/rung
+                # charges an honest pool.
                 if res is not None:
-                    res.release()
+                    cd = db.completion
+                    if (ok and cd is not None
+                            and db.config["ob_enable_completion_drain"]):
+                        cd.submit(res.release)
+                    else:
+                        res.release()
 
     def _maybe_flight_record(self, text, sp, elapsed_s, rs, err,
                              prof) -> None:
@@ -3208,6 +3366,27 @@ class DbSession:
             # tier's whole host cost, as one contiguous cut from the
             # dispatch-entry cursor
             led.cut("fast lookup")
+        # device-resident result cache: probed AFTER the privilege check
+        # (a REVOKE between repeats must bite a cached hit) and the
+        # catalog refresh (the watermark key must see fresh committed
+        # data versions). A hit serves the statement with ZERO device
+        # dispatches; a miss threads the key down so the solo execute
+        # admits the fresh narrowed frame.
+        rc_key = (db.engine.result_cache_key(hit)
+                  if self._vars.get("ob_enable_result_cache", 1) else None)
+        if rc_key is not None and db.plan_profiler is not None \
+                and db.plan_profiler.enabled \
+                and db.plan_profiler.wants_force(fkey):
+            # a pending forced operator profile (EXPLAIN ANALYZE, slow
+            # mark) needs a real execution — neither serve nor admit
+            rc_key = None
+        if rc_key is not None:
+            rs = db.engine.result_cache_probe(hit, rc_key, fastparse_s)
+            if rs is not None:
+                if led is not None:
+                    led.cut("result cache")
+                self._stmt_cache_hit = True
+                return rs
         # cross-session micro-batching: concurrent hits on the SAME entry
         # fold into one batched device dispatch. Admission honors the
         # tenant unit — a batch wider than max_workers could never form
@@ -3254,7 +3433,7 @@ class DbSession:
                 # continuous-batching queue draining.
                 try:
                     rs = db.engine.fast_execute(
-                        hit, fastparse_s=fastparse_s)
+                        hit, fastparse_s=fastparse_s, rc_key=rc_key)
                 finally:
                     db.batcher.solo_done()
                     if led is not None:
@@ -3267,7 +3446,8 @@ class DbSession:
         if led is not None:
             led.window_start()
         try:
-            rs = db.engine.fast_execute(hit, fastparse_s=fastparse_s)
+            rs = db.engine.fast_execute(hit, fastparse_s=fastparse_s,
+                                        rc_key=rc_key)
         finally:
             if led is not None:
                 led.window_end_carved(db.engine.last_phases, "engine host")

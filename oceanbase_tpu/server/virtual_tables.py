@@ -145,6 +145,21 @@ def _host_tax(db) -> Table:
     ])
 
 
+def _result_cache(db) -> Table:
+    """Device-resident result cache, entry by entry (LRU -> MRU):
+    which tables each cached narrowed frame reads, how many live rows
+    it answers with, its byte charge against the tenant unit, and how
+    many repeats it has served. Aggregate hit/miss/put/eviction
+    counters live in __all_virtual_sysstat (`result cache *`)."""
+    rows = db.result_cache.rows()
+    return _t("__all_virtual_result_cache", [
+        ("tables", DataType.varchar(), [r[0] for r in rows]),
+        ("result_rows", DataType.int64(), [r[1] for r in rows]),
+        ("nbytes", DataType.int64(), [r[2] for r in rows]),
+        ("hits", DataType.int64(), [r[3] for r in rows]),
+    ])
+
+
 def _plan_monitor(db) -> Table:
     """Plan monitor, reworked per-operator: every PlanMonitorEntry keeps
     its plan-level row (node_id = -1, operator columns zeroed), and every
@@ -882,6 +897,7 @@ PROVIDERS = {
     "__all_virtual_plan_cache_stat": _plan_cache_stat,
     "__all_virtual_sql_audit": _sql_audit,
     "__all_virtual_host_tax": _host_tax,
+    "__all_virtual_result_cache": _result_cache,
     "__all_virtual_sql_plan_monitor": _plan_monitor,
     "__all_virtual_ash": _ash,
     "__all_virtual_trace_span": _trace,

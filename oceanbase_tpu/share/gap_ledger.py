@@ -356,6 +356,30 @@ def current() -> Optional[GapLedger]:
     return getattr(_tls, "led", None)
 
 
+class LedgerSnapshot:
+    """Frozen copy of a closed ledger's fold-relevant surface.
+
+    The serving session REUSES one GapLedger per statement (begin()
+    re-arms it in place), so completion work deferred behind the wire
+    write (server/completion.py) must never hold the live object — it
+    would read the NEXT statement's numbers. HostTaxRegistry.fold reads
+    exactly these four attributes, so a snapshot substitutes."""
+
+    __slots__ = ("e2e_s", "device_s", "unattributed_s", "phases")
+
+    def __init__(self, led: GapLedger):
+        self.e2e_s = led.e2e_s
+        self.device_s = led.device_s
+        self.unattributed_s = led.unattributed_s
+        self.phases = dict(led.phases)
+
+    @property
+    def chip_idle_pct(self) -> float:
+        if self.e2e_s <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - self.device_s / self.e2e_s)) * 100.0
+
+
 class HostTaxRegistry:
     """Bounded digest-keyed host-tax aggregate + per-window idle ring."""
 

@@ -470,6 +470,9 @@ class PlanCache:
         # the server when ob_plan_artifact_mode != off: misses hydrate
         # exported executables from it, flush() covers it
         self.artifact_store = None
+        # hook: engine/result_cache.ResultCache — flushes with the plan
+        # tiers (the server wires it; see flush())
+        self.result_cache = None
 
     def __len__(self):
         with self._lock:
@@ -626,3 +629,9 @@ class PlanCache:
             # hydrate a plan compiled against a dead schema
             if not memory_only and self.artifact_store is not None:
                 self.artifact_store.flush()
+        # the result cache sits ABOVE the plan tiers (cached frames came
+        # from entries that just died) and must flush with them — its
+        # hook rides the plan cache so every flush caller is covered
+        rc = getattr(self, "result_cache", None)
+        if rc is not None:
+            rc.flush()

@@ -445,6 +445,69 @@ def test_streamed_plan_conserves_with_pipeline_hints():
         d.close()
 
 
+def test_q1_wide_groupby_serves_from_narrowed_frame():
+    """Q1-tail regression pin: the wide group-by whose answer is FOUR
+    groups must serve its warm reps through the FUSED narrowed frame —
+    one dispatch, one completion roundtrip moving the frame's bytes
+    instead of the plan's full pow2 output capacity — bit-identical to
+    the unfused path, and the phase timings it leaves behind still
+    build a conservation-complete ledger."""
+    import time as _time
+
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.models.tpch import datagen
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+
+    tables = datagen.generate(sf=0.01)
+    sess = Session(tables, unique_keys=UNIQUE_KEYS)
+    nc0 = sess.executor.narrow_compiles
+    sess.sql(QUERIES[1]).rows()  # compile + first run builds the frame
+    t0 = _time.perf_counter()
+    rs = sess.sql(QUERIES[1])  # warm rep: fused narrowed dispatch
+    cur = rs._cursor
+    assert getattr(cur, "narrowed", False)
+    warm_rows = rs.rows()
+    e2e = _time.perf_counter() - t0
+    phases = dict(sess.last_phases)
+    # built ONCE, reused warm — a retrace per rep would be its own tail
+    assert sess.executor.narrow_compiles == nc0 + 1
+    assert not cur._fallback
+    # Q1's root is an order-by, so the frame seeds at the 256-row
+    # default — a 4-group answer never grows it, and the committed
+    # host frame IS that pow2 width (the completion sync moved ncap
+    # rows per column, not the group table's capacity)
+    assert cur._ncap <= sess.narrow_default_rows
+    assert int(cur._hsel.shape[-1]) == cur._ncap
+    frame_bytes = sum(
+        int(getattr(a, "nbytes", 0))
+        for d in (cur._hcols, cur._hvalid) for a in d.values()
+    ) + int(cur._hsel.nbytes)
+    # unfused A/B off the SAME cached plan: full-capacity result frame
+    sess.narrow_enabled_fn = lambda: False
+    try:
+        rs_off = sess.sql(QUERIES[1])
+        off_rows = rs_off.rows()
+        cur_off = rs_off._cursor
+    finally:
+        sess.narrow_enabled_fn = None
+    assert not getattr(cur_off, "narrowed", False)
+    assert warm_rows == off_rows  # bit-identical through the fusion
+    # the D2H diet, pinned scale-independently: every committed leaf is
+    # exactly frame-width, so the completion roundtrip moves O(ncap)
+    # bytes no matter how wide the plan's INTERNAL capacities grow (the
+    # Q1 tail was an O(capacity) fetch hiding behind the group table)
+    assert all(int(a.shape[-1]) == cur._ncap
+               for a in cur._hcols.values())
+    assert frame_bytes <= cur._ncap * (
+        len(cur._hcols) + len(cur._hvalid) + 1) * 8
+    # the narrowed rep's phase dict builds a conservation-complete
+    # ledger with the dispatch named (the regression mode was the tail
+    # hiding in an unattributed fetch blob)
+    led = GapLedger.from_phases(e2e, phases)
+    conserved(led)
+    assert led.phases.get("device dispatch", 0.0) > 0.0
+
+
 def test_vt_sysstat_and_snapshot_surfaces_live(db):
     s = db.session()
     for i in range(4):

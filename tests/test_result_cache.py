@@ -1,0 +1,227 @@
+"""Device-resident result cache (engine/result_cache.py + server wiring).
+
+The cache serves a repeated warm statement's narrowed frame with ZERO
+device dispatches, so every test here is really a correctness pin on the
+invalidation surface: committed DML and schema bumps must rotate/drop the
+key, a REVOKE between repeats must bite before the probe, non-strong
+sessions must bypass the leader-keyed frames entirely, and the governor
+must be able to refuse admission (pressure) and reclaim every frame
+(device-OOM ladder rung 1). Plan-profile sampling is disabled so
+admission is deterministic: the FIRST warm rep narrows + admits, the
+second serves from the cache.
+"""
+
+import pytest
+
+from oceanbase_tpu.server.database import Database, SqlError
+
+N = 32
+
+
+def _mkdb(n_nodes=1, n_ls=1):
+    d = Database(n_nodes=n_nodes, n_ls=n_ls)
+    # deterministic admission: the profiled-run sample would claim the
+    # first warm rep (plain cursor, no admit) and push the put one rep
+    d.config.set("enable_plan_profile", False)
+    s = d.session()
+    s.sql("create table rc (id int primary key, k int, v int)")
+    s.sql("insert into rc values " + ", ".join(
+        f"({i + 1}, {i}, {i * 7 + 3})" for i in range(N)))
+    return d
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = _mkdb()
+    yield d
+    d.close()
+
+
+def _warm(s, q, n=2):
+    """Run q n times: registration run + (n-1) warm fast-path reps (the
+    first warm rep narrows and admits the frame)."""
+    out = None
+    for _ in range(n):
+        out = s.sql(q).rows()
+    return out
+
+
+def test_warm_repeat_hits_and_matches_uncached(db):
+    rc = db.result_cache
+    s = db.session()
+    q = "select v from rc where k = 7"
+    st0 = rc.stats()
+    r1 = s.sql(q).rows()  # registration run
+    r2 = s.sql(q).rows()  # first warm rep: narrowed dispatch + admit
+    st1 = rc.stats()
+    assert st1["puts"] == st0["puts"] + 1
+    r3 = s.sql(q).rows()  # served from the cache
+    st2 = rc.stats()
+    assert st2["hits"] == st1["hits"] + 1
+    assert r1 == r2 == r3 == [(7 * 7 + 3,)]
+    # bit-identical to an opted-out session (SET ob_enable_result_cache
+    # = 0 is the per-session A/B): same rows, and the opted-out session
+    # never probes — neither hits nor misses move
+    s2 = db.session()
+    s2.sql("set ob_enable_result_cache = 0")
+    st3 = rc.stats()
+    assert s2.sql(q).rows() == r3
+    assert s2.sql(q).rows() == r3
+    st4 = rc.stats()
+    assert st4["hits"] == st3["hits"] and st4["misses"] == st3["misses"]
+
+
+def test_virtual_table_surfaces_entries(db):
+    # runs BEFORE the device-OOM test: note_oom opens a governor
+    # pressure window during which re-admission is (correctly) refused
+    s = db.session()
+    _warm(s, "select v from rc where k = 23", 3)  # admit + one hit
+    rows = s.sql(
+        "select tables, result_rows, nbytes, hits "
+        "from __all_virtual_result_cache").rows()
+    assert any(t == "rc" and n == 1 and b > 0 and h >= 1
+               for (t, n, b, h) in rows)
+
+
+def test_dml_invalidates_then_recomputes_and_readmits(db):
+    rc = db.result_cache
+    s = db.session()
+    q = "select v from rc where k = 9"
+    _warm(s, q, 2)
+    assert s.sql(q).rows() == [(9 * 7 + 3,)]  # cached serve
+    inv0 = rc.stats()["invalidations"]
+    s.sql("update rc set v = 1000 where k = 9")
+    h0 = rc.stats()["hits"]
+    assert s.sql(q).rows() == [(1000,)]  # recomputed, never stale-served
+    assert rc.stats()["hits"] == h0
+    # eager drop at the next catalog refresh — the watermark key change
+    # alone would strand the dead frame at capacity
+    assert rc.stats()["invalidations"] > inv0
+    assert s.sql(q).rows() == [(1000,)]  # re-admitted frame serves again
+    assert rc.stats()["hits"] == h0 + 1
+
+
+def test_schema_bump_rotates_key_and_readmits(db):
+    rc = db.result_cache
+    s = db.session()
+    q = "select v from rc where k = 11"
+    _warm(s, q, 2)
+    h0 = rc.stats()["hits"]
+    assert s.sql(q).rows() == [(11 * 7 + 3,)]
+    assert rc.stats()["hits"] == h0 + 1
+    # schema bump via DDL that leaves the probe statement's routing
+    # alone (an index on the PREDICATE column would pull `where k = ?`
+    # off the fast path entirely — a different kind of invalidation)
+    s.sql("create index rc_v on rc (v)")
+    h1 = rc.stats()["hits"]
+    # the old frame's key embeds the previous schema version: the next
+    # repeat recomputes (no hit) and the one after serves the re-admit
+    rows = [s.sql(q).rows() for _ in range(4)]
+    assert all(r == [(11 * 7 + 3,)] for r in rows)
+    assert rc.stats()["hits"] > h1  # re-admitted under the bumped key
+    assert rc.stats()["hits"] - h1 < 4  # at least one post-DDL recompute
+
+
+def test_revoke_bites_cached_hit(db):
+    rc = db.result_cache
+    root = db.session()
+    root.sql("create user carol identified by 'pw'")
+    root.sql("grant select on rc to carol")
+    s = db.session(user="carol")
+    q = "select v from rc where k = 13"
+    _warm(s, q, 2)
+    h0 = rc.stats()["hits"]
+    assert s.sql(q).rows() == [(13 * 7 + 3,)]
+    assert rc.stats()["hits"] == h0 + 1  # cached serve with the grant
+    root.sql("revoke select on rc from carol")
+    with pytest.raises(SqlError):
+        s.sql(q)  # the privilege check runs BEFORE the probe
+    assert rc.stats()["hits"] == h0 + 1  # the frame never leaked
+
+
+def test_governor_pressure_refuses_admission(db):
+    rc = db.result_cache
+    s = db.session()
+    # the normalized entry is already warm from earlier tests (same
+    # text shape, different literal), so pressure must be ON before
+    # this literal's first rep — every rep then misses and is refused
+    q = "select v from rc where k = 17"
+    old = rc.pressure_fn
+    rc.pressure_fn = lambda: True
+    try:
+        p0 = rc.stats()["puts"]
+        c0 = db.metrics.counter("result cache admit refused: pressure")
+        _warm(s, q, 3)
+        assert rc.stats()["puts"] == p0
+        assert db.metrics.counter(
+            "result cache admit refused: pressure") > c0
+    finally:
+        rc.pressure_fn = old
+    s.sql(q).rows()  # pressure gone: admits
+    h0 = rc.stats()["hits"]
+    assert s.sql(q).rows() == [(17 * 7 + 3,)]
+    assert rc.stats()["hits"] == h0 + 1
+
+
+def test_capacity_eviction_keeps_bytes_bounded(db):
+    rc = db.result_cache
+    s = db.session()
+    old_cap = rc.capacity_bytes
+    db.config.set("ob_result_cache_size", "4096")
+    try:
+        ev0 = rc.stats()["evictions"]
+        for k in range(8):
+            _warm(s, f"select v from rc where k = {k}", 2)
+        st = rc.stats()
+        assert st["evictions"] > ev0  # LRU frames dropped at capacity
+        assert st["bytes_used"] <= 4096
+        assert st["entries"] >= 1  # the MRU frame survives
+    finally:
+        db.config.set("ob_result_cache_size", str(old_cap))
+
+
+def test_device_oom_ladder_flushes_result_cache(db):
+    from oceanbase_tpu.share import retry as R
+    from oceanbase_tpu.share.errsim import ERRSIM
+
+    rc = db.result_cache
+    s = db.session()
+    _warm(s, "select v from rc where k = 19", 3)
+    assert rc.stats()["entries"] >= 1
+    ev0 = db.metrics.counter("result cache evictions: device oom")
+    ERRSIM.arm("EN_DEVICE_OOM", error=R.DeviceOOM("EN_DEVICE_OOM"),
+               prob=1.0, count=1)
+    try:
+        # a DIFFERENT statement dispatches, OOMs, and rung 1 of the
+        # degradation ladder reclaims every cached frame first (the
+        # most re-creatable bytes on the chip) before the retry
+        assert s.sql("select v from rc where k = 21").rows() == [(150,)]
+    finally:
+        ERRSIM.clear("EN_DEVICE_OOM")
+    assert rc.stats()["entries"] == 0
+    assert db.metrics.counter("result cache evictions: device oom") > ev0
+
+
+def test_weak_consistency_bypasses_result_cache():
+    d = _mkdb(n_nodes=3, n_ls=2)
+    try:
+        d.cluster.settle(1.0)  # followers apply the seed
+        rc = d.result_cache
+        s = d.session()
+        q = "select v from rc where k = 5"
+        _warm(s, q, 2)
+        h0 = rc.stats()["hits"]
+        assert s.sql(q).rows() == [(5 * 7 + 3,)]  # strong: cached serve
+        assert rc.stats()["hits"] == h0 + 1
+        s.sql("set ob_read_consistency = 'weak'")
+        try:
+            # weak reads serve a follower snapshot — a frame keyed on
+            # the leader's committed watermark must never answer them
+            assert s.sql(q).rows() == [(5 * 7 + 3,)]
+            assert s.sql(q).rows() == [(5 * 7 + 3,)]
+            assert s.last_follower_read is not None
+            assert rc.stats()["hits"] == h0 + 1
+        finally:
+            s.sql("set ob_read_consistency = 'strong'")
+    finally:
+        d.close()

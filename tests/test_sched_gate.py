@@ -45,6 +45,10 @@ def _mkdb():
 @pytest.fixture(scope="module")
 def db():
     d = _mkdb()
+    # these tests exercise the GATE protocol: bucket-shape coalescing
+    # would fuse the queued groups they count as separate dispatches —
+    # off for the module; its own test flips it back on
+    d.batcher.coalesce_enabled = False
     yield d
     d.close()
 
@@ -53,6 +57,9 @@ def _session(db):
     s = db.session()
     s.sql("set ob_batch_max_size = 8")
     s.sql("set ob_batch_max_wait_us = 1000")
+    # the result cache would answer warm repeats before they ever
+    # reach the batcher — the gate must see every arrival
+    s.sql("set ob_enable_result_cache = 0")
     return s
 
 
@@ -186,6 +193,53 @@ def test_heterogeneous_plans_queue_and_interleave(db):
     assert delta("stmt batched dispatches") == 2
     assert delta("stmt batched statements") == 4
     assert delta("stmt batch size 2") == 2
+    assert gate.busy == 0 and gate.queued_groups == 0
+
+
+def test_bucket_shape_coalescing_fuses_heterogeneous_groups(db):
+    """Bucket-shape coalescing: the SAME two-plans-two-groups shape as
+    the interleave test, but with ob_enable_batch_coalesce on the
+    admitted leader adopts the other queued group (same pow2 bucket)
+    and ONE fused device program answers all four lanes — one dispatch,
+    one D2H, every row still correct, no leaked tokens."""
+    batcher, gate = db.batcher, db.batcher.gate
+    c0 = db.metrics.counters_snapshot()
+    out: dict = {}
+    threads = []
+    _seize(gate)
+    batcher.coalesce_enabled = True
+    try:
+        threads.append(_spawn(_session(db), "select v from kv where k = 20",
+                              out, "a-lead"))
+        assert _until(lambda: gate.queued_groups == 1)
+        threads.append(_spawn(_session(db), "select v from kv where k = 21",
+                              out, "a-join"))
+        threads.append(_spawn(_session(db), "select id from kv where k = 22",
+                              out, "b-lead"))
+        assert _until(lambda: gate.queued_groups == 2)
+        threads.append(_spawn(_session(db), "select id from kv where k = 23",
+                              out, "b-join"))
+        assert _until(lambda: sum(
+            len(b.rows) for b in batcher._forming.values()) == 4)
+    finally:
+        batcher.solo_done()  # phantom release = the adopter's admission
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    batcher.coalesce_enabled = False  # back to the module's gate setting
+    assert out["a-lead"] == [(20 * 7 + 3,)]
+    assert out["a-join"] == [(21 * 7 + 3,)]
+    assert out["b-lead"] == [(23,)] and out["b-join"] == [(24,)]
+    c1 = db.metrics.counters_snapshot()
+
+    def delta(name):
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    assert delta("stmt batched dispatches") == 1  # ONE fused dispatch
+    assert delta("stmt batch coalesced dispatches") == 1
+    assert delta("stmt batch coalesced lanes") == 4
+    assert delta("stmt batch coalesced rider") == 1
+    assert delta("stmt batched statements") == 4
     assert gate.busy == 0 and gate.queued_groups == 0
 
 

@@ -47,6 +47,9 @@ def _run_rounds(db, nthreads: int, rounds: int, wait_us: int = 50_000,
     for s in sessions:
         s.sql(f"set ob_batch_max_wait_us = {wait_us}")
         s.sql(f"set ob_batch_max_size = {max_size or nthreads}")
+        # this suite pins the BATCHER: a result-cache hit would serve
+        # repeated literals with zero dispatches and no batch to observe
+        s.sql("set ob_enable_result_cache = 0")
     barrier = threading.Barrier(nthreads)
     results: dict = {}
     errors: list = []
@@ -127,6 +130,7 @@ def test_solo_leader_degrades(db):
     s = db.session()
     s.sql("set ob_batch_max_wait_us = 100")
     s.sql("set ob_batch_max_size = 8")
+    s.sql("set ob_enable_result_cache = 0")  # force a real dispatch
     c0 = db.metrics.counters_snapshot()
     assert s.sql("select v from kv where k = 11").rows() == [(80,)]
     c1 = db.metrics.counters_snapshot()

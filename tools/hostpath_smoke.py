@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Dispatch-lean serving-spine smoke: the host-path promises, gated.
+
+Three legs, each pinning one promise of the fused serving spine:
+
+  1. WARM Q6 VS DEVICE — TPC-H Q6 through the engine session at a
+     small SF: the warm per-rep MEDIAN end-to-end must stay within
+     E2E_VS_DEVICE_GATE of the amortized device-only time through the
+     SAME cached executable (bench.py's ``q6_vs_e2e`` acceptance,
+     shrunk to smoke size), and the fused/narrowed rows must be
+     bit-identical to a forced-unfused rep (``narrow_enabled_fn``).
+  2. WARM HOST BUDGET — repeated point reads through a real DbSession:
+     the per-statement gap ledger's median host overhead
+     (e2e * chip_idle) must stay under a frozen absolute budget. A
+     cache-served statement never touches the device, so its host
+     overhead IS its e2e — the budget prices the whole warm statement.
+  3. REPEATED DASHBOARD — a fixed statement mix (point reads + cached
+     aggregates) replayed round-robin: once warm, the device-resident
+     result cache must serve >= HIT_RATE_GATE of the window, and every
+     row must be bit-identical to a session that opted out with
+     ``SET ob_enable_result_cache = 0``.
+
+The last stdout line is the machine-readable JSON verdict (with
+bench_meta provenance; also appended to $BENCH_OUT when set); exit
+code 1 on any gate failure.
+
+    JAX_PLATFORMS=cpu python tools/hostpath_smoke.py [--reps N] [--sf F]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+# Frozen gates. The ratio gate is the ISSUE acceptance (~3x, from 31x
+# pre-spine); measured headroom at SF 0.05 on the CI backend is ~1.7x.
+# The host budget is deliberately an order of magnitude over the
+# measured ~80us median — it catches the warm path regrowing a parse
+# or a dispatch (each costs 100s of us), not scheduler jitter.
+E2E_VS_DEVICE_GATE = 3.0
+HOST_BUDGET_US = 1000.0
+HIT_RATE_GATE = 0.9
+
+_BENCH_OUT = os.environ.get("BENCH_OUT")
+
+
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+    if _BENCH_OUT:
+        with open(_BENCH_OUT, "a") as f:
+            f.write(json.dumps(obj) + "\n")
+
+
+def q6_leg(sf: float, reps: int, fails: list) -> dict:
+    """Warm Q6 e2e (median of reps) vs amortized device time through
+    the session's own cached executable, plus the fused-identity A/B."""
+    from oceanbase_tpu.engine import Session
+    from oceanbase_tpu.models.tpch import datagen
+    from oceanbase_tpu.models.tpch.sql_suite import QUERIES, UNIQUE_KEYS
+
+    sess = Session(datagen.generate(sf=sf), unique_keys=UNIQUE_KEYS)
+    q6 = QUERIES[6]
+    sess.sql(q6).rows()  # compile + first run
+    sess.sql(q6).rows()  # warm
+    ets = []
+    rs_on = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rs_on = sess.sql(q6)
+        warm_rows = rs_on.rows()
+        ets.append(time.perf_counter() - t0)
+    e2e = statistics.median(ets)
+
+    # the A/B must price ONLY narrowing: same plan, full-frame D2H
+    sess.narrow_enabled_fn = lambda: False
+    try:
+        sess.sql(q6).rows()  # warm the unfused leg
+        off_rows = sess.sql(q6).rows()
+    finally:
+        sess.narrow_enabled_fn = None
+    if warm_rows != off_rows:
+        fails.append("q6: fused/narrowed rows != unfused rows")
+
+    # amortized device-only time, same cached executable as the serving
+    # leg (a separately prepared plan would re-trace)
+    entry, qp = sess.cached_entry(q6)
+    if entry is None:
+        fails.append("q6: plan cache miss on timed re-fetch")
+        return {}
+    prepared = entry.prepared
+    prepared.run(qparams=qp)  # warm
+    K = 32
+    ts = []
+    for _ in range(max(3, reps // 4)):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(K):
+            out = prepared.run_nocheck(qparams=qp)
+        int(out.nrows)  # one sync for the whole burst
+        ts.append((time.perf_counter() - t0) / K)
+    dev = min(ts)
+    ratio = e2e / dev if dev > 0 else float("inf")
+    if ratio > E2E_VS_DEVICE_GATE:
+        fails.append(f"q6: warm e2e/device ratio {ratio:.2f} > "
+                     f"{E2E_VS_DEVICE_GATE}")
+    return {
+        "sf": sf,
+        "reps": reps,
+        "e2e_us": round(e2e * 1e6, 1),
+        "e2e_spread_us": round((max(ets) - min(ets)) * 1e6, 1),
+        "device_us": round(dev * 1e6, 1),
+        "e2e_vs_device": round(ratio, 3),
+        "gate": E2E_VS_DEVICE_GATE,
+        "fused_identical": warm_rows == off_rows,
+    }
+
+
+def host_budget_leg(db, s, reps: int, fails: list) -> dict:
+    """Median warm point-read host overhead off the per-statement gap
+    ledger, against the frozen absolute budget."""
+    for i in range(12):  # register the shape + admit the first literals
+        s.sql(f"select v from kv where k = {i}").rows()
+    leds = []
+    for i in range(reps):
+        s.sql(f"select v from kv where k = {20 + i % 8}").rows()
+        led = s._gap
+        if led is None or not led.closed:
+            fails.append("point: gap ledger did not close")
+            return {}
+        leds.append(led.to_dict())
+    host_us = statistics.median(
+        d["e2e_s"] * d["chip_idle_pct"] / 100.0 for d in leds) * 1e6
+    e2e_us = statistics.median(d["e2e_s"] for d in leds) * 1e6
+    if host_us > HOST_BUDGET_US:
+        fails.append(f"point: median warm host overhead {host_us:.1f}us "
+                     f"> budget {HOST_BUDGET_US}us")
+    return {
+        "reps": reps,
+        "median_e2e_us": round(e2e_us, 1),
+        "median_host_overhead_us": round(host_us, 1),
+        "budget_us": HOST_BUDGET_US,
+    }
+
+
+def dashboard_leg(db, s, rounds: int, fails: list) -> dict:
+    """The repeated-dashboard workload: a fixed mix replayed
+    round-robin must serve from the result cache, bit-identical to an
+    opted-out session."""
+    stmts = [f"select v from kv where k = {k}" for k in (3, 7, 11)] + [
+        "select sum(v), count(*) from kv where k < 150",
+        "select grp, sum(v), count(*) from kv group by grp",
+    ]
+    for q in stmts:
+        s.sql(q).rows()  # registration run
+        s.sql(q).rows()  # first warm rep: narrowed dispatch + admit
+    rc = db.result_cache
+    st0 = rc.stats()
+    base = {q: s.sql(q).rows() for q in stmts}
+    for _ in range(rounds - 1):
+        for q in stmts:
+            if s.sql(q).rows() != base[q]:
+                fails.append(f"dashboard: unstable rows for {q!r}")
+    st1 = rc.stats()
+    window = rounds * len(stmts)
+    hits = st1["hits"] - st0["hits"]
+    rate = hits / window if window else 0.0
+    if rate < HIT_RATE_GATE:
+        fails.append(f"dashboard: result-cache hit rate {rate:.3f} < "
+                     f"{HIT_RATE_GATE}")
+    # bit-identity against a session that never probes the cache
+    s2 = db.session()
+    s2.sql("set ob_enable_result_cache = 0")
+    mismatched = [q for q in stmts if s2.sql(q).rows() != base[q]]
+    for q in mismatched:
+        fails.append(f"dashboard: cached rows != uncached rows for {q!r}")
+    return {
+        "stmts": len(stmts),
+        "rounds": rounds,
+        "hits": hits,
+        "hit_rate": round(rate, 4),
+        "gate": HIT_RATE_GATE,
+        "cache_entries": st1["entries"],
+        "cache_bytes": st1["bytes_used"],
+        "identical_to_uncached": not mismatched,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=24)
+    ap.add_argument("--sf", type=float, default=0.05,
+                    help="TPC-H scale factor for the Q6 leg (too small "
+                         "and device time vanishes under dispatch)")
+    args = ap.parse_args()
+
+    import latency_bench as LB
+    from bench_meta import collect as bench_meta
+
+    fails: list = []
+    report = {"legs": {}}
+    report["legs"]["q6"] = q6_leg(args.sf, args.reps, fails)
+
+    db, s = LB.build_db(2000)
+    # deterministic admission for the cache legs: the profiled-run
+    # sample would otherwise claim the first warm rep
+    db.config.set("enable_plan_profile", False)
+    report["legs"]["host_budget"] = host_budget_leg(
+        db, s, max(16, args.reps), fails)
+    report["legs"]["dashboard"] = dashboard_leg(db, s, 8, fails)
+
+    report["meta"] = bench_meta(db)
+    report["fails"] = fails
+    report["ok"] = not fails
+    for f in fails:
+        print("FAIL:", f, file=sys.stderr)
+    emit(report)
+    return 0 if not fails else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

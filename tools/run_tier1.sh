@@ -6,8 +6,8 @@
 #
 #   tools/run_tier1.sh [--chaos] [--latency] [--serve] [--awr] [--health]
 #                      [--advisor] [--warmboot] [--elastic] [--oom] [--mesh]
-#                      [--stream] [--scrub] [--hosttax] [--planprof]
-#                      [extra pytest args...]
+#                      [--stream] [--scrub] [--hosttax] [--hostpath]
+#                      [--planprof] [extra pytest args...]
 #
 # --chaos additionally runs the slow-marked chaos workload drives
 # (tests/test_chaos.py) with their fixed seeds after the tier-1 pass;
@@ -109,6 +109,17 @@
 # under its frozen budget, and the VT/sysstat/audit surfaces live; the
 # last stdout line is the JSON verdict.
 #
+# --hostpath additionally runs the dispatch-lean serving-spine smoke
+# (tools/hostpath_smoke.py): warm TPC-H Q6 through the engine session
+# must stay within 3x of the amortized device-only time through the
+# same cached executable with fused/narrowed rows bit-identical to the
+# unfused path, a warm point read's median host overhead (gap-ledger
+# e2e x chip-idle) must stay under the frozen 1ms budget, and a
+# repeated-dashboard statement mix must serve >= 90% from the
+# device-resident result cache bit-identical to an opted-out session;
+# the JSON verdict (with bench_meta provenance) lands in $BENCH_OUT
+# when set.
+#
 # --planprof additionally runs the plan-profile smoke
 # (tools/planprof_smoke.py): a warm TPC-H Q1/Q6/Q3 mix profiled
 # through the segmented per-operator executor must return rows
@@ -143,6 +154,7 @@ mesh=0
 stream=0
 scrub=0
 hosttax=0
+hostpath=0
 planprof=0
 while true; do
     case "$1" in
@@ -159,6 +171,7 @@ while true; do
         --stream) stream=1; shift ;;
         --scrub) scrub=1; shift ;;
         --hosttax) hosttax=1; shift ;;
+        --hostpath) hostpath=1; shift ;;
         --planprof) planprof=1; shift ;;
         *) break ;;
     esac
@@ -250,6 +263,11 @@ fi
 
 if [ "$hosttax" = "1" ] && [ "$rc" = "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/hosttax_smoke.py
+    rc=$?
+fi
+
+if [ "$hostpath" = "1" ] && [ "$rc" = "0" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/hostpath_smoke.py
     rc=$?
 fi
 
