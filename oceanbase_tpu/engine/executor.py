@@ -27,6 +27,7 @@ Physical choices made here (the optimizer's physical half):
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from dataclasses import dataclass, field, replace
 
@@ -87,6 +88,12 @@ ROOT_COMPACT = -1
 # synthetic overflow-node id space for the pack-validity guards (disjoint
 # from plan node ids and the PX exchange-lane ids, parallel/px.py)
 PACK_GUARD_BASE = 5_000_000
+
+# synthetic overflow-node id space for ANN over-probe escalation: a
+# candidate-starvation counter (live re-rank candidates < k) rides the
+# overflow channel at ANN_PROBE_BASE + nid and bumps the node's
+# effective nprobe instead of a capacity
+ANN_PROBE_BASE = 9_000_000
 
 
 def gather_payload(cols: dict, valid: dict, idx, sel=None):
@@ -154,9 +161,29 @@ class PhysicalParams:
     topn_cand: dict[int, int] = field(default_factory=dict)
     # ANN: TopN-over-vec_l2 nodes served by an IVF index (nid -> spec)
     vector_topns: dict = field(default_factory=dict)
+    # ANN over-probe state: nid -> effective nprobe (survives the
+    # per-compile vector_topns re-detection so an escalation sticks),
+    # nid -> total list count (the escalation ceiling — probing every
+    # list IS the exact answer, so the retry always resolves there)
+    ann_nprobe: dict[int, int] = field(default_factory=dict)
+    ann_lists: dict[int, int] = field(default_factory=dict)
+    ann_escalations: int = 0  # lifetime over-probe bumps (sysstat delta)
 
     def bump(self, overflows: dict[int, int]):
         for nid in overflows:
+            if nid >= ANN_PROBE_BASE:
+                # candidate starvation (the filter decimated the probed
+                # lists): escalate nprobe x8 toward the full list count —
+                # recall-preserving over-probe, not post-filtering a
+                # fixed-k result. x8 reaches any ceiling within the
+                # standard retry budget (8 -> 64 -> 512 -> 4096).
+                vid = nid - ANN_PROBE_BASE
+                cur = self.ann_nprobe.get(vid)
+                if cur is not None:
+                    self.ann_nprobe[vid] = min(
+                        cur * 8, self.ann_lists.get(vid, cur * 8))
+                    self.ann_escalations += 1
+                continue
             if nid >= PACK_GUARD_BASE:
                 self.groupby_nopack.add(nid - PACK_GUARD_BASE)
                 continue
@@ -204,19 +231,31 @@ class _SliceSpec:
 class VectorTopNSpec:
     """ORDER BY vec_l2(col, q) LIMIT k over an IVF-indexed scan: probe =
     centroid matmul + top-nprobe + contiguous-list candidate gather +
-    exact re-rank matmul + top-k (storage/vector_index.py)."""
+    exact re-rank matmul + top-k (storage/vector_index.py). Filter
+    predicates between the TopN and the Scan ride INTO the fused kernel
+    (evaluated as selection masks before the candidate re-rank) with
+    recall preserved by over-probe: a starvation counter on the overflow
+    channel escalates nprobe when the filter decimates the probed
+    lists."""
 
     table: str
     column: str        # unqualified vector column
     qual_col: str      # alias-qualified name in the scan batch
     input_alias: str
-    nprobe: int        # static: probed lists
+    nprobe: int        # static: probed lists (over-probe escalated)
     max_list: int      # static: per-list read window
     nrows: int         # static: live rows of the table at compile
     k: int
     key: object        # the vec_l2 Func (resolved through the Project)
     scan: object       # the Scan node to emit
     proj: object       # Project between TopN and Scan (or None)
+    filters: tuple = ()    # Filter predicates fused into the kernel
+    lists: int = 0         # total IVF list count (escalation ceiling)
+    base_nprobe: int = 0   # registered nprobe before over-probe seeding
+    est_sel: float = 1.0   # estimated filter selectivity at compile
+    ivf_cost: float = 0.0  # optimizer route cost, IVF side (EXPLAIN)
+    brute_cost: float = 0.0  # route cost of the brute-force matmul
+    cost_basis: str = "flops"  # "measured" when calibration records won
 
 
 @dataclass(frozen=True)
@@ -398,6 +437,16 @@ class Executor:
         # executables built — one per (plan, pow2 narrow bucket), same
         # bounding argument as batched_compiles
         self.narrow_compiles = 0
+        # ANN observability: (table, col) -> last-build metadata (the
+        # __all_virtual_vector_index rows' build side) and cumulative
+        # per-index [queries, probes, escalations] counters folded by
+        # the serving session per executed ANN statement
+        self.ann_builds: dict = {}
+        self.ann_stats: dict = {}
+        # hook: engine/plan_profile.OperatorProfileStore — when wired
+        # (server layer), measured TopN-route rates calibrate the
+        # IVF-vs-brute cost comparison in _vector_topn_spec
+        self.profile_store = None
         # hook: engine/memory_governor.MemoryGovernor — when wired, its
         # (OOM-shrunk) effective budget clamps the static device budget
         # so prepare() routes oversized inputs through the chunked path
@@ -634,28 +683,45 @@ class Executor:
         return self.table_batch(table, cols)
 
     def ivf_host(self, table: str, col: str):
-        """Built IvfIndex for (table, col), version-cached: DML bumps the
-        table version and the next use REBUILDS (index maintenance =
-        invalidate + lazy rebuild, same contract as sorted projections)."""
+        """Built IvfIndex for (table, col), staleness-checked two ways:
+        the table VERSION (DML through invalidate_table bumps it) AND the
+        column array's IDENTITY (weakref, same discipline as
+        _monotone_col) — a memtable mutation that swapped t.data[col]
+        without an invalidation hook must never serve a stale index
+        silently. Invalidation = lazy rebuild on next use, same contract
+        as sorted projections."""
         from ..storage.vector_index import build_ivf
 
         t = self.catalog[table]
         spec = getattr(t, "vector_indexes", {}).get(col)
         if spec is None:
             return None
+        arr = t.data[col]
         v = self._table_version.get(table, 0)
         key = (table, ("#ivfh", col))
         hit = self._batch_cache.get(key)
-        if hit is not None and hit[0] == v:
+        if hit is not None and hit[0] == v and hit[2]() is arr:
             return hit[1]
-        idx = build_ivf(np.asarray(t.data[col]), lists=spec.lists)
-        self._batch_cache[key] = (v, idx)
+        t0 = time.perf_counter()
+        idx = build_ivf(np.asarray(arr), lists=spec.lists)
+        # weakref: a strong array ref would double-count host bytes in
+        # the device census walk; the catalog holds the array anyway
+        self._batch_cache[key] = (v, idx, weakref.ref(arr))
+        self.ann_builds[(table, col)] = {
+            "build_version": v,
+            "build_unix": time.time(),
+            "build_s": time.perf_counter() - t0,
+            "rows": int(len(arr)),
+        }
         return idx
 
     def ivf_device(self, table: str, col: str, expect_max_list: int):
         """(centroids, perm, offsets, lengths) device arrays; raises the
         premise-invalidated recompile signal when a rebuild changed the
-        static window shape the compiled program assumed."""
+        static window shape the compiled program assumed. Keyed on the
+        host index OBJECT identity, not just the table version — an
+        identity-detected rebuild (ivf_host's stale-array path) must
+        re-upload even though the version never moved."""
         idx = self.ivf_host(table, col)
         if idx is None or idx.max_list != expect_max_list:
             raise ClusteredPremiseInvalidated(
@@ -664,7 +730,7 @@ class Executor:
         v = self._table_version.get(table, 0)
         key = (table, ("#ivfd", col))
         hit = self._batch_cache.get(key)
-        if hit is not None and hit[0] == v:
+        if hit is not None and hit[0] == v and hit[2] is idx:
             return hit[1]
         dev = (
             jnp.asarray(idx.centroids),
@@ -672,8 +738,26 @@ class Executor:
             jnp.asarray(idx.offsets),
             jnp.asarray(idx.lengths),
         )
-        self._batch_cache[key] = (v, dev)
+        self._batch_cache[key] = (v, dev, idx)
         return dev
+
+    def ann_residency(self) -> dict:
+        """(table, column) -> device bytes of uploaded IVF artifacts.
+        The governor charges these against tenant residency (an index the
+        advisor keeps hot is memory the admission path must see), and
+        __all_virtual_vector_index reads the same walk."""
+        out: dict = {}
+        for k, hit in list(self._batch_cache.items()):
+            if (isinstance(k, tuple) and len(k) == 2
+                    and isinstance(k[1], tuple) and k[1]
+                    and k[1][0] == "#ivfd"):
+                dev = hit[1]
+                out[(k[0], k[1][1])] = sum(
+                    int(getattr(a, "nbytes", 0)) for a in dev)
+        return out
+
+    def ann_device_bytes(self) -> int:
+        return sum(self.ann_residency().values())
 
     # host-side monotonicity cache (id+weakref discipline: see
     # _affine_cache below for why a bare id is not enough)
@@ -1349,12 +1433,18 @@ class Executor:
 
     # ---- ANN vector top-n ---------------------------------------------
     def _vector_topn_spec(self, op: TopN):
-        """Match ORDER BY vec_l2(col, q) [ASC] LIMIT k directly over an
-        un-filtered Scan of a table with an IVF index on `col` — the ANN
-        fast path (the reference's vector-index DAS iterator,
+        """Match ORDER BY vec_l2(col, q) [ASC] LIMIT k over a Scan of a
+        table with an IVF index on `col` — through an optional Project
+        (hoisted $ordN) and any Filter chain / pushed scan filter — the
+        ANN index route (the reference's vector-index DAS iterator,
         src/sql/das/iter). Index presence is the opt-in for approximate
-        results, like obvec; everything else brute-forces exactly
-        through the generic TopN (still a matmul + top-k)."""
+        results, like obvec; whether the route actually wins is COSTED
+        against the brute-force matmul (centroid pass + probed re-rank
+        vs full-table distance), calibrated by measured TopN-route rates
+        from the operator profile store when records exist. Filters ride
+        into the fused kernel as selection masks; the filtered case
+        seeds a recall-preserving over-probe from estimated selectivity
+        and escalates at runtime via the overflow channel."""
         if op.offset != 0 or len(op.keys) != 1:
             return None
         e, desc = op.keys[0]
@@ -1371,7 +1461,12 @@ class Executor:
             node = node.child
         if not isinstance(e, E.Func) or e.name != "vec_l2":
             return None
-        if not isinstance(node, Scan) or node.pushed_filter is not None:
+        filters = []
+        filt_top = node
+        while isinstance(node, Filter):
+            filters.append(node.pred)
+            node = node.child
+        if not isinstance(node, Scan):
             return None
         colref = e.args[0]
         if not isinstance(colref, E.ColRef) or "." not in colref.name:
@@ -1389,7 +1484,51 @@ class Executor:
         idx = self.ivf_host(node.table, col)
         if idx is None or idx.max_list == 0:
             return None
-        nprobe = max(1, min(spec.nprobe, len(idx.lengths)))
+        lists = len(idx.lengths)
+        base_nprobe = max(1, min(spec.nprobe, lists))
+        nprobe = base_nprobe
+        filtered = bool(filters) or node.pushed_filter is not None
+        est_sel = 1.0
+        if filtered:
+            # estimated survivor fraction under the predicate chain —
+            # the over-probe seed: probing nprobe/est_sel lists keeps
+            # the EXPECTED live candidate count at the unfiltered level
+            # instead of post-filtering a decimated fixed-k result
+            try:
+                est_sel = float(self._est_rows(filt_top)) / max(
+                    float(t.nrows), 1.0)
+            except Exception:  # noqa: BLE001 - stats must not kill the route
+                est_sel = 1.0
+            est_sel = min(1.0, max(est_sel, 1e-6))
+            boost = min(8, max(1, int(np.ceil(1.0 / max(est_sel, 0.125)))))
+            nprobe = min(lists, nprobe * boost)
+        # optimizer route: IVF work = centroid pass + probed-window
+        # re-rank; brute work = full-table distance. Both are d-dim
+        # matmul rows, so the un-calibrated comparison is row counts;
+        # measured per-row rates from profiled TopN stages (PR 17
+        # calibration records) replace the equal-rate assumption when
+        # both routes have been observed
+        d = int(np.asarray(idx.centroids).shape[1]) if lists else 1
+        cand_rows = lists + nprobe * idx.max_list
+        brute_rows = max(int(t.nrows), 1)
+        ivf_cost = float(cand_rows * d)
+        brute_cost = float(brute_rows * d)
+        cost_basis = "flops"
+        rates = None
+        store = getattr(self, "profile_store", None)
+        if store is not None:
+            try:
+                rates = store.ann_route_rates()
+            except Exception:  # noqa: BLE001
+                rates = None
+        if rates is not None:
+            ivf_cost = float(cand_rows) * rates[0]
+            brute_cost = float(brute_rows) * rates[1]
+            cost_basis = "measured"
+        if ivf_cost >= brute_cost:
+            # the index loses (tiny table, nprobe escalated to nearly
+            # every list): brute-force exactly through the generic TopN
+            return None
         return VectorTopNSpec(
             table=node.table,
             column=col,
@@ -1402,6 +1541,13 @@ class Executor:
             key=e,
             scan=node,
             proj=proj,
+            filters=tuple(filters),
+            lists=lists,
+            base_nprobe=base_nprobe,
+            est_sel=est_sel,
+            ivf_cost=ivf_cost,
+            brute_cost=brute_cost,
+            cost_basis=cost_basis,
         )
 
     def _emit_vector_topn(self, op: TopN, nid, spec: VectorTopNSpec,
@@ -1413,6 +1559,12 @@ class Executor:
         # exactly the full matmul the index exists to avoid — the
         # projection re-applies over the k winners below
         child, ovf = emit(spec.scan, inputs)
+        # fused filtered ANN: the Filter chain's predicates become
+        # selection masks INSIDE this program (elementwise over the
+        # batch — cheap next to the avoided full-table matmul); the
+        # candidate re-rank below drops dead rows via child.sel
+        for pred in spec.filters:
+            child = child.with_sel(compile_predicate(pred, child))
         cent, perm, offs, lens = inputs[spec.input_alias]
         q = evaluate_vector_literal(spec.key.args[1])
         # round 1: nearest lists by centroid distance (rank-invariant
@@ -1434,6 +1586,17 @@ class Executor:
         live = wv & child.sel[rows]
         dist = jnp.where(live, dist, jnp.inf)
         k = min(spec.k, rows.shape[0])
+        if spec.nprobe < spec.lists:
+            # over-probe escalation: when the fused filter decimates the
+            # probed candidate windows below k live rows, report the
+            # shortfall on the overflow channel; bump() widens nprobe and
+            # the retry recompiles — recall-preserving, unlike
+            # post-filtering a fixed-k result. Once nprobe == lists the
+            # probe is exhaustive (exact), so no counter is emitted and
+            # the retry ladder always terminates.
+            ovf = dict(ovf)
+            ovf[ANN_PROBE_BASE + nid] = jnp.maximum(
+                jnp.int64(k) - jnp.sum(live, dtype=jnp.int64), jnp.int64(0))
         neg, top_i = jax.lax.top_k(-dist, k)
         win_rows = rows[top_i]
         cols, valid, _ = gather_payload(child.cols, child.valid, win_rows)
@@ -1720,6 +1883,15 @@ class Executor:
                 if isinstance(op2, TopN):
                     vspec = self._vector_topn_spec(op2)
                     if vspec is not None:
+                        # over-probe escalations survive re-detection: a
+                        # prior bump() widened this node's nprobe and the
+                        # recompile must honour it or the retry loops
+                        esc = params.ann_nprobe.get(nid2)
+                        if esc is not None and esc > vspec.nprobe:
+                            vspec = replace(
+                                vspec, nprobe=min(esc, vspec.lists))
+                        params.ann_nprobe[nid2] = vspec.nprobe
+                        params.ann_lists[nid2] = vspec.lists
                         params.vector_topns[nid2] = vspec
                         if all(a != vspec.input_alias
                                for a, _t, _c in input_spec):
@@ -1748,6 +1920,11 @@ class Executor:
                 PACK_GUARD_BASE + nid
                 for nid in params.pack_guard
                 if nid not in params.groupby_nopack
+            }
+            | {
+                ANN_PROBE_BASE + nid
+                for nid, vs in params.vector_topns.items()
+                if vs.nprobe < vs.lists
             }
         )
 
@@ -3161,10 +3338,13 @@ class Executor:
 
 def _collect_qparam_spec(plan) -> list | None:
     """Parameter slots of a parameterized plan, in slot order: list of
-    DataType per slot, or None when any parameter cannot ride the packed
-    int64 vector (vector literals). The packed form exists because every
-    separate qparam scalar is one more host->device transfer per dispatch
-    — through the axon tunnel each costs a roundtrip."""
+    (DataType, offset, width) per slot, or None when any parameter cannot
+    ride the packed int64 vector. Scalars take one int64 lane; VECTOR
+    slots take `precision` lanes (each float32 component widened to
+    float64 bits) so a query embedding is ONE bound parameter block and
+    ANN statements batch like point reads. The packed form exists because
+    every separate qparam scalar is one more host->device transfer per
+    dispatch — through the axon tunnel each costs a roundtrip."""
     import dataclasses as _dc
 
     slots: dict[int, object] = {}
@@ -3174,8 +3354,9 @@ def _collect_qparam_spec(plan) -> list | None:
         nonlocal bad
         if isinstance(e, E.Literal):
             if e.slot is not None:
-                if e.dtype.kind is TypeKind.VECTOR:
-                    bad = True
+                if (e.dtype.kind is TypeKind.VECTOR
+                        and int(e.dtype.precision or 0) <= 0):
+                    bad = True  # unknown dimension: cannot size the block
                 slots[e.slot] = e.dtype
             return
         if not hasattr(e, "__dataclass_fields__"):
@@ -3220,19 +3401,40 @@ def _collect_qparam_spec(plan) -> list | None:
         return []
     if sorted(slots) != list(range(len(slots))):
         return None  # non-dense slots: stay on the legacy tuple
-    return [slots[i] for i in range(len(slots))]
+    spec = []
+    off = 0
+    for i in range(len(slots)):
+        dt = slots[i]
+        w = (int(dt.precision) if dt.kind is TypeKind.VECTOR else 1)
+        spec.append((dt, off, w))
+        off += w
+    return spec
+
+
+def packed_width(spec) -> int:
+    """Total int64 lanes of a packed qparam vector for `spec`."""
+    if not spec:
+        return 0
+    _dt, off, w = spec[-1]
+    return off + w
 
 
 def _unpack_qparams(qparams, spec):
-    """Inside the traced program: rebuild the per-slot scalar tuple from
-    the packed int64 vector (floats ride as bitcast bits)."""
+    """Inside the traced program: rebuild the per-slot value tuple from
+    the packed int64 vector (floats ride as bitcast bits; VECTOR slots
+    come back as (d,) arrays)."""
     if not isinstance(qparams, jnp.ndarray):
         return qparams  # legacy tuple path (PX, chunked, direct callers)
     if spec is None:
         raise AssertionError("packed qparams without a pack spec")
     out = []
-    for i, dt in enumerate(spec):
-        raw = qparams[i]
+    for dt, off, w in spec:
+        if dt.kind is TypeKind.VECTOR:
+            raw = jax.lax.dynamic_slice_in_dim(qparams, off, w)
+            v = jax.lax.bitcast_convert_type(raw, jnp.float64)
+            out.append(v.astype(dt.storage_np))
+            continue
+        raw = qparams[off]
         if dt.is_float:
             v = jax.lax.bitcast_convert_type(raw, jnp.float64)
             out.append(v.astype(dt.storage_np))
@@ -3250,8 +3452,15 @@ def pack_qparams(values, dtypes, spec) -> "np.ndarray | tuple":
         return tuple(
             _jnp.asarray(bind_value(v, t)) for v, t in zip(values, dtypes)
         )
-    out = np.empty(len(values), dtype=np.int64)
-    for i, (v, t) in enumerate(zip(values, dtypes)):
+    out = np.empty(packed_width(spec), dtype=np.int64)
+    for (t, off, w), v in zip(spec, values):
+        if w != 1:
+            # VECTOR slot: parse + dim-check once on the host, widen each
+            # float32 component to float64 bits so the device-side bitcast
+            # is uniform across slot kinds
+            a = np.asarray(bind_value(v, t), dtype=np.float64)
+            out[off:off + w] = a.view(np.int64)
+            continue
         if type(v) is int:
             # integer literal into an integer slot: the generic path costs
             # three numpy scalar hops per parameter, and this is THE shape
@@ -3259,17 +3468,17 @@ def pack_qparams(values, dtypes, spec) -> "np.ndarray | tuple":
             # int32 slots get the same explicit bound bind_value enforces.
             k = t.kind
             if k is TypeKind.INT64:
-                out[i] = v
+                out[off] = v
                 continue
             if k is TypeKind.INT32 and -2147483648 <= v <= 2147483647:
-                out[i] = v
+                out[off] = v
                 continue
         s = bind_value(v, t)
         a = np.asarray(s)
         if a.dtype.kind == "f":
-            out[i] = np.float64(a).view(np.int64)
+            out[off] = np.float64(a).view(np.int64)
         else:
-            out[i] = np.int64(a)
+            out[off] = np.int64(a)
     return out
 
 

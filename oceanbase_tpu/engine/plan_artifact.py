@@ -96,7 +96,7 @@ class ArtifactMeta:
     input_spec: list
     overflow_nodes: list
     in_avals: tuple  # ((shape, dtype), ...) per flat input leaf
-    nslots: int  # packed qparam slots
+    nslots: int  # packed qparam width (int64 lanes; vectors span several)
     out_proto: tuple  # (col_names, valid_names, schema, dicts)
     output_names: tuple
     dtypes: list
@@ -485,9 +485,11 @@ class PlanArtifactStore:
             self._note("plan artifact export skip")
             return None
         aid = self.key_id(art_key)
+        from .executor import packed_width
+
         try:
             inputs = prepared._inputs()
-            qex = np.zeros(len(spec), np.int64)
+            qex = np.zeros(packed_width(spec), np.int64)
             blob, proto, avals = export_flat(prepared.jitted, (inputs, qex))
             params = copy.copy(prepared.params)
             params.clustered_aggs = {}
@@ -497,7 +499,7 @@ class PlanArtifactStore:
                 env=env_signature(), plan=prepared.plan, params=params,
                 input_spec=list(prepared.input_spec),
                 overflow_nodes=list(prepared.overflow_nodes),
-                in_avals=avals, nslots=len(spec), out_proto=proto,
+                in_avals=avals, nslots=packed_width(spec), out_proto=proto,
                 output_names=tuple(output_names), dtypes=list(dtypes),
                 fast=fast, text_key=text_key,
                 px_nsh=int(getattr(prepared, "px_nsh", 0)),
@@ -556,9 +558,11 @@ class PlanArtifactStore:
         if ref is None or not spec:
             return
         aid = ref[1]
+        from .executor import packed_width
+
         try:
             inputs = prepared._inputs()
-            qb = np.zeros((bucket, len(spec)), np.int64)
+            qb = np.zeros((bucket, packed_width(spec)), np.int64)
             blob, _proto, _avals = export_flat(fn, (inputs, qb))
         except Exception:
             self._note("plan artifact export error")
@@ -611,9 +615,11 @@ class PlanArtifactStore:
                     pass
             ent["buckets"] = []
         spec = getattr(prepared, "_qparam_spec", None) or ()
+        from .executor import packed_width
+
         try:
             inputs = prepared._inputs()
-            qex = np.zeros(len(spec), np.int64)
+            qex = np.zeros(packed_width(spec), np.int64)
             blob, proto, avals = export_flat(prepared.jitted, (inputs, qex))
             params = copy.copy(prepared.params)
             params.clustered_aggs = {}

@@ -65,6 +65,18 @@ def hist_quantile(hist, q: float) -> float:
     return float(1 << (_NB - 1))
 
 
+def _vec_project(op) -> bool:
+    """True when a Project computes a vec_l2 distance column — the
+    full-batch matmul that dominates the brute-force ANN route, and the
+    measurement the optimizer's brute-side us/row rate comes from."""
+    from ..expr import ir as E
+
+    for _name, e in getattr(op, "exprs", ()) or ():
+        if isinstance(e, E.Func) and e.name == "vec_l2":
+            return True
+    return False
+
+
 def op_kind(op) -> str:
     """Display kind of one plan node (JoinOp carries its join kind —
     an anti join and an inner join calibrate very differently)."""
@@ -97,6 +109,10 @@ class OpSample:
     out_bytes: int
     build_us: float = 0.0
     probe_us: float = 0.0
+    # rows of work the operator actually touched (candidate rows for an
+    # IVF probe, full batch for a brute top-n) — the denominator the ANN
+    # route costing calibrates us/row against; 0 = not tracked
+    work_rows: int = 0
 
 
 class SegmentedPlan:
@@ -139,7 +155,7 @@ class SegmentedPlan:
         # emit() for. A clustered-FK aggregate bypasses its Join child
         # (executor._emit_clustered_agg emits ji.left / ji.right
         # itself), so the absorbed Join never executes as its own node.
-        from ..sql.logical import Aggregate as _Agg
+        from ..sql.logical import Aggregate as _Agg, TopN as _TopN
 
         self.absorbed: dict[int, int] = {}
 
@@ -150,6 +166,16 @@ class SegmentedPlan:
                 ji = params.clustered_aggs[nid].ji
                 self.absorbed[id_of[id(ji)]] = nid
                 return (ji.left, ji.right)
+            if isinstance(op, _TopN) and nid in params.vector_topns:
+                # ANN top-n emits from the SCAN, fusing any intervening
+                # Project/Filter into its own kernel — those nodes never
+                # execute standalone, exactly like the absorbed join
+                vs = params.vector_topns[nid]
+                node = op.child
+                while id(node) != id(vs.scan):
+                    self.absorbed[id_of[id(node)]] = nid
+                    node = node.child
+                return (vs.scan,)
             return _children(op)
 
         # post-order over unique node ids: children before parents (a
@@ -311,14 +337,31 @@ class SegmentedPlan:
                         # dtype): report probe-only, don't retry per run
                         self.builders.pop(nid, None)
                 build_us = min(build_us, device_us)
+                kind = op_kind(self.nodes[nid])
+                work_rows = 0
+                if kind == "TopN":
+                    vs = self._params.vector_topns.get(nid)
+                    if vs is not None:
+                        # IVF route: centroid pass + padded candidate
+                        # windows — the static work the kernel really does
+                        kind = "VectorTopN"
+                        work_rows = vs.lists + vs.nprobe * vs.max_list
+                elif (kind == "Project" and childs
+                        and _vec_project(self.nodes[nid])):
+                    # brute route: the hoisted distance matmul ranks the
+                    # whole padded batch (ordinary projections stay
+                    # untracked — their us/row would skew the route rates)
+                    kind = "VecDistance"
+                    work_rows = int(childs[0].sel.shape[0])
                 samples.append(OpSample(
                     node_id=nid,
-                    op_kind=op_kind(self.nodes[nid]),
+                    op_kind=kind,
                     device_us=device_us,
                     rows=int(nrows),
                     out_bytes=int(_device_nbytes(out)),
                     build_us=build_us,
                     probe_us=max(device_us - build_us, 0.0),
+                    work_rows=work_rows,
                 ))
             t0 = time.perf_counter()
             out, oc = self._compact(outs[self.root])
@@ -399,6 +442,7 @@ class OperatorRecord:
     probe_us: float = 0.0
     rows: int = 0
     out_bytes: int = 0
+    work_rows: int = 0
     last_rows: int = 0
     last_device_us: float = 0.0
     max_miss: float = 1.0
@@ -425,6 +469,7 @@ class OperatorRecord:
         self.probe_us += s.probe_us
         self.rows += s.rows
         self.out_bytes += s.out_bytes
+        self.work_rows += s.work_rows
         self.last_rows = s.rows
         self.last_device_us = s.device_us
         self.max_miss = max(self.max_miss,
@@ -446,6 +491,7 @@ class OperatorRecord:
             "probe_us": self.probe_us,
             "rows": self.rows,
             "out_bytes": self.out_bytes,
+            "work_rows": self.work_rows,
             "last_rows": self.last_rows,
             "last_device_us": self.last_device_us,
             "avg_rows": self.avg_rows,
@@ -535,6 +581,28 @@ class OperatorProfileStore:
             if d is None:
                 return []
             return [d["nodes"][n].as_dict() for n in sorted(d["nodes"])]
+
+    def ann_route_rates(self) -> tuple[float, float] | None:
+        """Measured (ivf_us_per_row, brute_us_per_row) for the ANN route
+        decision, aggregated across every digest's VectorTopN /
+        VecDistance records. None until BOTH routes have been profiled
+        with tracked work — the optimizer then falls back to its flops
+        model rather than cost against a one-sided measurement."""
+        ivf_us = ivf_rows = brute_us = brute_rows = 0.0
+        with self._lock:
+            for d in self._digests.values():
+                for r in d["nodes"].values():
+                    if r.work_rows <= 0:
+                        continue
+                    if r.op_kind == "VectorTopN":
+                        ivf_us += r.device_us
+                        ivf_rows += r.work_rows
+                    elif r.op_kind == "VecDistance":
+                        brute_us += r.device_us
+                        brute_rows += r.work_rows
+        if ivf_rows <= 0 or brute_rows <= 0:
+            return None
+        return (ivf_us / ivf_rows, brute_us / brute_rows)
 
     def snapshot(self) -> dict:
         """Cumulative plain-data image for workload snapshots. Node ids

@@ -10,6 +10,7 @@ cache IS the compile cache.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass
 
@@ -313,7 +314,15 @@ class Session:
             return None
         wm = (self.result_watermark_fn(hit.fe.tables)
               if self.result_watermark_fn is not None else ())
-        return (hit.key, tuple(hit.values), wm)
+        # long string literals (query embeddings — a 128-d vector is a
+        # ~1.4KB bracket text) key by digest: an exact-text collision is
+        # a SHA-256 collision, and the key stays a few dozen bytes
+        vals = tuple(
+            hashlib.sha256(v.encode()).digest()
+            if type(v) is str and len(v) > 256 else v
+            for v in hit.values
+        )
+        return (hit.key, vals, wm)
 
     def result_cache_probe(self, hit: "_FastHit", rc_key,
                            fastparse_s: float = 0.0):
@@ -349,6 +358,13 @@ class Session:
         m = self.metrics
         if m is not None and m.enabled:
             m.add("result rows returned", rs.nrows)
+            vts = getattr(
+                getattr(hit.entry.prepared, "params", None),
+                "vector_topns", None)
+            if vts:
+                # an ANN statement served straight from the device-
+                # resident cache: the whole probe+re-rank was skipped
+                m.add("ann cache hits")
         # a cached serve is still logically a read of its tables: fold
         # the plan's access profile so advisor heat (projection
         # keep/drop, index recommendations) doesn't see a dashboard
@@ -669,6 +685,8 @@ class Session:
         jn = getattr(entry, "json_specs", ())
         prepared = entry.prepared
         retries0 = getattr(prepared, "retries", 0)
+        ann0 = getattr(
+            getattr(prepared, "params", None), "ann_escalations", 0)
         # streaming pipeline counters are cumulative on the prepared plan
         # (plan-cache shared): fold per-run deltas, like overflow retries
         sstats = getattr(prepared, "stream_stats", None)
@@ -964,6 +982,22 @@ class Session:
             retries = getattr(prepared, "retries", 0) - retries0
             if retries > 0:
                 m.add("overflow recompiles", retries)
+            params = getattr(prepared, "params", None)
+            vts = getattr(params, "vector_topns", None)
+            if vts:
+                m.add("ann probes",
+                      sum(v.nprobe for v in vts.values()))
+                esc = getattr(params, "ann_escalations", 0) - ann0
+                if esc > 0:
+                    m.add("ann over-probe escalations", esc)
+                stats = getattr(ex, "ann_stats", None)
+                if stats is not None:
+                    for v in vts.values():
+                        st = stats.setdefault(
+                            (v.table, v.column), [0, 0, 0])
+                        st[0] += 1
+                        st[1] += v.nprobe
+                        st[2] += max(esc, 0)
             if mesh_plan is not None:
                 for coll, cnt in mesh_plan.ops_by_collective().items():
                     m.add(f"px collective {coll}", cnt)

@@ -771,6 +771,10 @@ class Database:
         # sampled per-operator profiling decisions + calibration folds
         # happen inside the engine's dispatch (engine/plan_profile.py)
         self.engine.plan_profiler = self.plan_profiler
+        # the measured ANN route rates (IVF vs brute us/row) come out of
+        # the same calibration store — the optimizer's _vector_topn_spec
+        # reads them through this hook when costing the index route
+        self.engine.executor.profile_store = self.plan_profiler.store
         # serving timeline feeds: engine dispatches (device busy +
         # compile interference), executor uploads (transfer
         # interference), batcher dispatches (occupancy) — server-side
@@ -2119,6 +2123,18 @@ class Database:
                 if len(data[col]):
                     data[col] = remap[data[col]]
                 dicts[col] = sd
+            for f in ti.schema.fields:
+                # tablet cells store vectors as tuples, so the scan
+                # yields a 1-D object column; every downstream consumer
+                # (IVF build, route costing, H2D upload, mesh sharding)
+                # wants the dense (n, d) float32 form — normalize once
+                if f.dtype.kind is TypeKind.VECTOR:
+                    a = data[f.name]
+                    dim = int(f.dtype.precision)
+                    data[f.name] = (
+                        np.asarray(a.tolist(), dtype=np.float32)
+                        .reshape(len(a), dim)
+                        if len(a) else np.zeros((0, dim), np.float32))
             t = Table(name, ti.schema, data, dicts)
             if in_tx:
                 # tx-private view (BEGIN snapshot + own staged rows): lives
@@ -2162,6 +2178,17 @@ class Database:
                     for col, (lists, nprobe) in vspecs.items():
                         register_vector_index(
                             self.catalog, name, col, lists, nprobe)
+                    # DML invalidated the built IVF artifacts (the
+                    # _invalidate below drops the executor's #ivfh/#ivfd
+                    # caches): re-queue background rebuilds so the next
+                    # ANN query probes warm instead of k-means inline
+                    self.metrics.add(
+                        "vector index invalidations", len(vspecs))
+                    try:
+                        self.layout_advisor.note_vector_invalidated(
+                            name, list(vspecs))
+                    except Exception:  # noqa: BLE001 - advisory path
+                        pass
                 self._invalidate(name)
                 ti.cached_data_version = ti.data_version
                 if requeue is not None:
@@ -2323,6 +2350,13 @@ class Database:
         rc = getattr(self, "result_cache", None)
         if rc is not None:
             total += rc.device_bytes()
+        # device-resident IVF artifacts: an index the advisor keeps hot
+        # is tenant memory too (eviction via the same priority order —
+        # dropping a table's snapshot invalidates its index caches)
+        try:
+            total += self.engine.executor.ann_device_bytes()
+        except Exception:  # noqa: BLE001 - accounting must not fail DML
+            pass
         return total
 
     def _enforce_memory(self, keep: str) -> None:

@@ -59,7 +59,7 @@ _ENC_NAMES = {enc.ENC_RAW: "raw", enc.ENC_CONST: "const",
 class Recommendation:
     """One ranked layout action with its evidence and estimated benefit."""
 
-    action: str  # create_projection | drop_projection | set_encoding | set_residency
+    action: str  # create_projection | drop_projection | set_encoding | set_residency | create_vector_index
     table: str
     column: str = ""
     detail: str = ""  # action payload: covered cols / encoding / priority
@@ -189,6 +189,46 @@ def propose(
             status=status,
         ))
 
+    # ---- create vector indexes (ANN route enablement) ---------------
+    # query heat on a VECTOR column's SORT role means someone is running
+    # ORDER BY vec_l2(col, ?) LIMIT k as a brute-force full matmul; an
+    # IVF index turns that into the optimizer's probe route
+    from ..core.dtypes import TypeKind
+
+    for row in access_rows:
+        table = row["table"]
+        t = catalog.get(table)
+        if t is None or "#sp:" in table or table.startswith("__all_virtual"):
+            continue
+        if int(row.get("scans", 0)) < min_scans:
+            continue
+        for c in row.get("columns", ()):
+            sc = int(c.get("sort_count", 0))
+            if sc <= 0:
+                continue
+            cname = c["column"]
+            fld = next(
+                (f for f in t.schema.fields if f.name == cname), None)
+            if fld is None or fld.dtype.kind is not TypeKind.VECTOR:
+                continue
+            if cname in getattr(t, "vector_indexes", {}):
+                continue
+            arr = t.data.get(cname)
+            nrows = int(len(arr)) if arr is not None else 0
+            if nrows <= 0:
+                continue
+            dim = int(np.asarray(arr).shape[1])
+            # index footprint: perm (4B/row) + ~sqrt(n) centroids
+            L = max(4, int(np.sqrt(nrows)))
+            cost = nrows * 4 + L * dim * 4
+            recs.append(Recommendation(
+                action="create_vector_index", table=table, column=cname,
+                detail="lists=auto", benefit=float(sc) * nrows,
+                cost_bytes=cost,
+                evidence=(f"vec_sorted_scans={sc} rows={nrows} dim={dim} "
+                          f"brute_rows_per_query={nrows}"),
+            ))
+
     # ---- drop idle advisor-created projections ----------------------
     for (table, key_col), pname in created.items():
         n_idle = int(idle.get((table, key_col), 0))
@@ -283,6 +323,9 @@ class LayoutAdvisor:
         self.dropped: dict[tuple, int] = {}
         # (table, col) -> encoding name chosen by the cost model
         self.encoding_hints: dict[tuple, str] = {}
+        # (table, column) vector indexes THIS advisor created: their
+        # DML-invalidated rebuilds re-queue in any mode, like created[]
+        self.created_vec: dict[tuple, bool] = {}
         self.last: list[Recommendation] = []
         self.runs = 0
 
@@ -377,6 +420,10 @@ class LayoutAdvisor:
                 self._drop(r.table, r.column, r.detail)
                 r.status = "applied"
                 applied += 1
+            elif r.action == "create_vector_index":
+                queued = self._queue_vector_build(r.table, r.column)
+                r.status = "queued" if queued else "queued:duplicate"
+                applied += queued
             elif r.action == "set_residency":
                 self.db.residency_priority[r.table] = float(r.detail)
                 r.status = "applied"
@@ -441,6 +488,60 @@ class LayoutAdvisor:
                   key=("layout rebuild", pname))
         dag.add_task(build, name=f"build {pname}")
         return db.dag_scheduler.add_dag(dag)
+
+    def _queue_vector_build(self, table: str, column: str) -> bool:
+        """Enqueue a BACKGROUND IVF build DAG: register the spec (auto
+        lists, default nprobe — the same durable registration the DDL
+        path writes) and warm the executor's index artifact off the
+        statement path, so the first ANN query probes instead of paying
+        the k-means build inline. Dedup by (table, column) while
+        queued."""
+        from ..share.dag_scheduler import Dag, DagPriority
+
+        db = self.db
+        with self._lock:
+            self.created_vec[(table, column)] = True
+
+        def build():
+            from ..storage.vector_index import register_vector_index
+
+            # ti is None for preloaded read-only tables that live only in
+            # the catalog — the build still proceeds off the catalog copy
+            ti = db.tables.get(table)
+            if ti is not None and ti.cached_data_version != ti.data_version:
+                db.refresh_catalog([table])
+            t = db.catalog.get(table)
+            if t is None or column not in t.data:
+                return
+            if column not in getattr(t, "vector_indexes", {}):
+                specs = db._vector_specs.setdefault(table, {})
+                specs.setdefault(column, (0, 8))
+                lists, nprobe = specs[column]
+                register_vector_index(db.catalog, table, column,
+                                      lists, nprobe)
+                db._save_node_meta()
+            try:
+                db.engine.executor.ivf_host(table, column)
+            except Exception:  # noqa: BLE001 - warm-build is advisory
+                pass
+            # cached brute-force plans predate the index route
+            db.plan_cache.flush()
+            db.metrics.add("layout advisor vector indexes built")
+
+        dag = Dag("vector index build", DagPriority.BACKGROUND,
+                  key=("vector index build", table, column))
+        dag.add_task(build, name=f"build ivf {table}.{column}")
+        return db.dag_scheduler.add_dag(dag)
+
+    def note_vector_invalidated(self, table: str, cols) -> None:
+        """refresh_catalog hook, after a DML-invalidated table's vector
+        specs re-register: queue background rebuilds (auto mode, or any
+        advisor-created index) so the next ANN query probes a warm index
+        instead of paying the k-means rebuild inline."""
+        for col in cols:
+            if self.mode != "auto" and (table, col) not in self.created_vec:
+                continue
+            self._queue_vector_build(table, col)
 
     def _drop(self, table: str, key_col: str, pname: str) -> None:
         db = self.db

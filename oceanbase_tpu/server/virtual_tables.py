@@ -882,6 +882,52 @@ def _storage_integrity(db) -> Table:
     ])
 
 
+def _vector_index(db) -> Table:
+    """Registered vector indexes with build + serving counters: spec
+    (lists/nprobe), built artifact metadata (version/scn/rows/build
+    seconds), uploaded device bytes, and cumulative probe / over-probe /
+    query counters folded at statement completion."""
+    ex = db.engine.executor
+    residency = {}
+    try:
+        residency = ex.ann_residency()
+    except Exception:  # noqa: BLE001 - diagnostics never fail a read
+        pass
+    builds = getattr(ex, "ann_builds", {}) or {}
+    stats = getattr(ex, "ann_stats", {}) or {}
+    rows = []
+    for tname, specs in sorted(db._vector_specs.items()):
+        t = db.catalog.get(tname)
+        live = getattr(t, "vector_indexes", {}) if t is not None else {}
+        for col, (lists, nprobe) in sorted(specs.items()):
+            spec = live.get(col)
+            b = builds.get((tname, col), {})
+            st = stats.get((tname, col), (0, 0, 0))
+            rows.append((
+                tname, col,
+                int(getattr(spec, "lists", lists) or lists),
+                int(getattr(spec, "nprobe", nprobe) or nprobe),
+                int(residency.get((tname, col), 0)),
+                int(b.get("build_version", -1)),
+                float(b.get("build_s", 0.0)),
+                int(b.get("rows", 0)),
+                int(st[0]), int(st[1]), int(st[2]),
+            ))
+    return _t("__all_virtual_vector_index", [
+        ("table_name", DataType.varchar(), [r[0] for r in rows]),
+        ("column_name", DataType.varchar(), [r[1] for r in rows]),
+        ("lists", DataType.int64(), [r[2] for r in rows]),
+        ("nprobe", DataType.int64(), [r[3] for r in rows]),
+        ("device_bytes", DataType.int64(), [r[4] for r in rows]),
+        ("build_scn", DataType.int64(), [r[5] for r in rows]),
+        ("build_seconds", DataType.float64(), [r[6] for r in rows]),
+        ("build_rows", DataType.int64(), [r[7] for r in rows]),
+        ("queries", DataType.int64(), [r[8] for r in rows]),
+        ("probes", DataType.int64(), [r[9] for r in rows]),
+        ("over_probe_escalations", DataType.int64(), [r[10] for r in rows]),
+    ])
+
+
 def _xa(db) -> Table:
     rows = sorted(db._xa_prepared.items())
     return _t("__all_virtual_xa_transaction", [
@@ -921,6 +967,7 @@ PROVIDERS = {
     "__all_virtual_trigger": _triggers,
     "__all_virtual_sequence": _sequences,
     "__all_virtual_mview": _mviews,
+    "__all_virtual_vector_index": _vector_index,
     "__all_virtual_xa_transaction": _xa,
     "__all_virtual_statement_summary": _statement_summary,
     "__all_virtual_table_access_stat": _table_access_stat,

@@ -89,11 +89,23 @@ def explain_plan(executor, plan, params) -> list[str]:
             )
         elif isinstance(op, TopN):
             vspec = params.vector_topns.get(nid)
-            mode = (
-                f"ANN IVF probe (nprobe={vspec.nprobe}, "
-                f"max_list={vspec.max_list})"
-                if vspec is not None else "top-n sort"
-            )
+            if vspec is not None:
+                mode = (
+                    f"ANN IVF probe (nprobe={vspec.nprobe}, "
+                    f"max_list={vspec.max_list}")
+                if vspec.filters or getattr(vspec, "est_sel", 1.0) < 1.0:
+                    nf = len(vspec.filters) + (
+                        1 if getattr(vspec.scan, "pushed_filter", None)
+                        is not None else 0)
+                    mode += (f", filtered sel~{vspec.est_sel:.3g}"
+                             f" fused={nf}")
+                if vspec.nprobe > vspec.base_nprobe > 0:
+                    mode += f", over-probe from {vspec.base_nprobe}"
+                mode += (f") route: ivf={vspec.ivf_cost:.3g} < "
+                         f"brute={vspec.brute_cost:.3g}"
+                         f" [{vspec.cost_basis}]")
+            else:
+                mode = "top-n sort"
             lines.append(f"{pad}TOPN [{mode}] n={op.n} {est(op)}")
         elif isinstance(op, Filter):
             lines.append(f"{pad}FILTER {op.pred}")

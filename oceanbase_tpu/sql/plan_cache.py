@@ -318,6 +318,12 @@ def _tok_candidate(tok: str, kind: str):
             return ("int", int(tok))
         if _DATE_TOK_RE.match(tok):
             return ("date", int(np.datetime64(tok, "D").astype(np.int64)))
+        if kind == "str" and tok.startswith("[") and tok.endswith("]"):
+            # vector literal: the slot value IS the raw bracket text
+            # (sql/logical.py binds it at execution), so the slot match
+            # below is plain string equality — a fresh embedding per
+            # statement re-binds instead of baking a fast-tier miss
+            return ("vec", tok)
     except ValueError:
         pass
     return None
@@ -339,6 +345,14 @@ def _convert_token(tok: str, tag: str):
             if not _DATE_TOK_RE.match(tok):
                 return None
             return int(np.datetime64(tok, "D").astype(np.int64))
+        if tag == "vec":
+            if not (tok.startswith("[") and tok.endswith("]")):
+                return None
+            # validate components parse; dimension is checked by
+            # bind_value at execution (a mismatch raises there exactly
+            # like the slow path would)
+            [float(x) for x in tok[1:-1].split(",")]
+            return tok
     except ValueError:
         return None
     return None

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.dtypes import Schema
+from ..core.dtypes import Schema, TypeKind
 from .memtable import Memtable
 from .sstable import OP_COL, OP_PUT, VERSION_COL, SSTable
 
@@ -26,8 +26,15 @@ def _memtable_arrays(
 ) -> dict[str, np.ndarray]:
     rows = mt.snapshot_rows(snapshot, tx_id)
     names = schema.names()
+
+    def _empty(n):
+        f = schema[n]
+        if f.kind is TypeKind.VECTOR:
+            return np.zeros((0, int(f.precision)), dtype=f.storage_np)
+        return np.zeros(0, dtype=f.storage_np)
+
     if not rows:
-        out = {n: np.zeros(0, dtype=schema[n].storage_np) for n in names}
+        out = {n: _empty(n) for n in names}
         out[VERSION_COL] = np.zeros(0, np.int64)
         out[OP_COL] = np.zeros(0, np.int8)
         return out
@@ -40,8 +47,14 @@ def _memtable_arrays(
         if key_pos >= 0:
             out[n] = np.array([k[key_pos] for k in rows.keys()], dtype=dt)
         else:
+            # a tombstone's filler must keep the cell's SHAPE: vector
+            # cells are (d,) tuples, and a scalar 0 among them makes the
+            # row list inhomogeneous
+            fill = ((0.0,) * int(schema[n].precision)
+                    if schema[n].kind is TypeKind.VECTOR else 0)
             out[n] = np.array(
-                [v[ci] if op == OP_PUT else 0 for op, v in vals], dtype=dt
+                [v[ci] if op == OP_PUT else fill for op, v in vals],
+                dtype=dt,
             )
     # staged rows of the reading tx are visible "infinitely new"
     out[VERSION_COL] = np.full(len(vals), np.iinfo(np.int64).max, np.int64)
